@@ -1,0 +1,135 @@
+"""Benchmark harness — prints ONE JSON line on stdout:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Workload: BASELINE.json config #2 (wide regression MLP, 4x512 hidden), the
+config that stresses the gradient allreduce — trained with this framework's
+jitted SPMD train step on the available accelerator.
+
+``vs_baseline``: ratio against the reference's own stack measured inline —
+a single-process torch CPU implementation of the reference's training loop
+(the only configuration the reference was ever run in: its README says the
+cluster path was untested, README.md:10, and it publishes no numbers,
+BASELINE.md).  Identical model, batch size, optimizer, and loss.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+BATCH = 8192
+WIDTH = 512
+DEPTH = 4
+IN_FEATURES = 32
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+BASELINE_STEPS = 5
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_framework() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.mlp import wide_mlp
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    devices = jax.devices()
+    log(f"framework devices: {devices}")
+    mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    model = wide_mlp(in_features=IN_FEATURES, width=WIDTH, depth=DEPTH,
+                     compute_dtype=jnp.float32)
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    state = TrainState.create(model, opt, prng.init_key(0))
+    state = dp.replicate_state(state, mesh)
+    step = dp.make_train_step(model, opt, mesh, "mse", "global_mean")
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": rng.standard_normal((BATCH, IN_FEATURES)).astype(np.float32),
+        "y": rng.standard_normal((BATCH, 1)).astype(np.float32),
+        "mask": np.ones((BATCH,), np.float32),
+    }
+    batch = shd.shard_batch(mesh, batch)
+
+    t0 = time.perf_counter()
+    for _ in range(WARMUP_STEPS):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    log(f"compile+warmup: {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = BATCH * MEASURE_STEPS / dt
+    log(f"framework: {MEASURE_STEPS} steps in {dt:.3f}s -> {sps:,.0f} samples/sec")
+    return sps
+
+
+def bench_reference_baseline() -> float:
+    """The reference's training loop (torch MLP + SGD + MSE, full-batch
+    steps; dataParallelTraining_NN_MPI.py:149-211) on CPU, single process,
+    same workload — re-expressed, not copied."""
+    import torch
+
+    torch.manual_seed(0)
+    layers = []
+    prev = IN_FEATURES
+    for _ in range(DEPTH):
+        layers += [torch.nn.Linear(prev, WIDTH), torch.nn.ReLU()]
+        prev = WIDTH
+    layers.append(torch.nn.Linear(prev, 1))
+    model = torch.nn.Sequential(*layers)
+    optimizer = torch.optim.SGD(model.parameters(), lr=1e-4, momentum=0.9)
+    loss_fn = torch.nn.MSELoss()
+    x = torch.randn(BATCH, IN_FEATURES)
+    y = torch.randn(BATCH, 1)
+
+    def one_step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        optimizer.step()
+
+    one_step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(BASELINE_STEPS):
+        one_step()
+    dt = time.perf_counter() - t0
+    sps = BATCH * BASELINE_STEPS / dt
+    log(f"reference baseline (torch cpu): {BASELINE_STEPS} steps in {dt:.3f}s "
+        f"-> {sps:,.0f} samples/sec")
+    return sps
+
+
+def main() -> None:
+    framework_sps = bench_framework()
+    baseline_sps = bench_reference_baseline()
+    print(json.dumps({
+        "metric": "wide_mlp_train_samples_per_sec",
+        "value": round(framework_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(framework_sps / baseline_sps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""CLI entrypoint.
+
+Usage (the TPU-native analogue of the reference's
+``mpiexec -n numprocs python dataParallelTraining_NN_MPI.py --lr --momentum
+--batch_size --nepochs``, README.md:12):
+
+    python -m neural_networks_parallel_training_with_mpi_tpu \
+        --lr 0.001 --momentum 0.9 --batch_size 4 --nepochs 3
+
+No external launcher is needed on a single host: parallelism comes from the
+device mesh, not from process replication.  On multi-host pods, run the same
+command on every host (the TPU runtime provides world configuration).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .config import build_argparser, config_from_args
+from .train.trainer import Trainer
+from .utils.logging import log
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    cfg = config_from_args(args)
+    trainer = Trainer(cfg)
+    result = trainer.fit()
+    log(f"done: final loss {result['final_loss']:.6f}, "
+        f"{result['samples_per_sec']:.1f} samples/sec")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,270 @@
+"""Typed configuration for training jobs.
+
+The reference exposes four untyped argparse flags
+(dataParallelTraining_NN_MPI.py:244-253): ``--lr`` (default 0.001),
+``--momentum`` (default 0.9), ``--batch_size`` (default 4, parsed but never
+used — bug B1 in SURVEY.md §2.5) and ``--nepochs`` (default 3).  Here every
+knob is a typed dataclass field (fixing bug B3: the reference's flags lack
+``type=`` so CLI-passed values arrive as ``str``), ``batch_size`` is honored
+for real, and the config is serializable for logging/checkpoint metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+
+@dataclass
+class MeshConfig:
+    """Logical device mesh axes.
+
+    Replaces the reference's world discovery (``MPI.COMM_WORLD`` /
+    ``Get_rank`` / ``Get_size``, dataParallelTraining_NN_MPI.py:61-63): on
+    TPU the "world" is a named mesh over the chips, and parallelism styles
+    are axis assignments rather than process topologies.
+
+    ``data=-1`` means "all devices not consumed by other axes" (the common
+    pure-DP case, mirroring the reference where every process is a data
+    worker).
+    """
+
+    data: int = -1      # data parallelism (the reference's only axis)
+    fsdp: int = 1       # parameter/optimizer sharding (ZeRO-style)
+    tensor: int = 1     # tensor (model) parallelism
+    pipe: int = 1       # pipeline parallelism
+    seq: int = 1        # sequence/context parallelism (ring attention)
+    expert: int = 1     # expert parallelism (MoE)
+
+    def axis_sizes(self, n_devices: int) -> Dict[str, int]:
+        sizes = {
+            "data": self.data,
+            "fsdp": self.fsdp,
+            "tensor": self.tensor,
+            "pipe": self.pipe,
+            "seq": self.seq,
+            "expert": self.expert,
+        }
+        fixed = 1
+        wild = None
+        for name, s in sizes.items():
+            if s == -1:
+                if wild is not None:
+                    raise ValueError("at most one mesh axis may be -1")
+                wild = name
+            else:
+                if s < 1:
+                    raise ValueError(f"mesh axis {name} must be >=1 or -1, got {s}")
+                fixed *= s
+        if wild is not None:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild] = n_devices // fixed
+        else:
+            if fixed != n_devices:
+                raise ValueError(
+                    f"mesh axes product {fixed} != device count {n_devices}"
+                )
+        return sizes
+
+
+@dataclass
+class DataConfig:
+    """Dataset generation/loading knobs.
+
+    Defaults reproduce the reference workload: sklearn ``make_regression``
+    with 16 samples x 2 features, noise=1, random_state=42
+    (dataParallelTraining_NN_MPI.py:72), globally standardized (fixing bug
+    B4: the reference standardizes per-shard at :21-22 so workers see
+    differently-normalized data).
+    """
+
+    dataset: str = "regression"  # regression | mnist | cifar10 | lm | wide_regression
+    n_samples: Optional[int] = None  # None = per-dataset default (16 for regression)
+    n_features: int = 2
+    noise: float = 1.0
+    seed: int = 42
+    standardize: bool = True
+    # sequence datasets (lm)
+    seq_len: int = 128
+    vocab_size: int = 256
+    # classification datasets
+    n_classes: int = 10
+    # how to make the global batch divisible by the data-axis size:
+    #   pad  - zero-pad + mask (exact global gradient; SURVEY.md §7 "hard parts")
+    #   drop - drop the remainder samples
+    remainder: str = "pad"
+
+
+@dataclass
+class ModelConfig:
+    """Model selection.  ``mlp`` with default sizes is the reference MLP
+    Linear(2,3)->ReLU->Linear(3,1) (dataParallelTraining_NN_MPI.py:41-45)."""
+
+    arch: str = "mlp"  # mlp | convnet | transformer
+    in_features: int = 2
+    hidden: Tuple[int, ...] = (3,)
+    out_features: int = 1
+    activation: str = "relu"
+    # convnet
+    channels: Tuple[int, ...] = (32, 64)
+    image_hw: Tuple[int, int] = (32, 32)
+    in_channels: int = 3
+    # transformer
+    n_layers: int = 2
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    vocab_size: int = 256
+    max_seq_len: int = 512
+    attention: str = "dense"  # dense | ring | ulysses (seq-parallel impls)
+    dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
+    compute_dtype: str = "float32"
+    remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
+
+
+@dataclass
+class TrainConfig:
+    """Full job config.  The four reference knobs keep their reference
+    defaults (dataParallelTraining_NN_MPI.py:245-252)."""
+
+    lr: float = 1e-3
+    momentum: float = 0.9
+    batch_size: int = 4        # honored (reference parses but ignores it — bug B1)
+    nepochs: int = 3
+    full_batch: bool = True    # reference behavior: one full-shard batch per epoch (:146)
+    optimizer: str = "sgd"     # sgd | adam | adamw
+    weight_decay: float = 0.0
+    loss: str = "mse"          # mse | cross_entropy
+    # how gradients are reduced across the data axis:
+    #   global_mean    - exact gradient of the global-batch mean loss (default;
+    #                    correct even with uneven/padded shards)
+    #   per_shard_mean - mean of per-shard mean-gradients, the reference's
+    #                    semantics (:188-197); equals global_mean when shards
+    #                    are even
+    grad_reduction: str = "global_mean"
+    seed: int = 0
+    log_every: int = 1
+    shuffle: bool = True
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    # checkpointing (extension beyond reference parity, SURVEY.md §5.4)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 0  # steps; 0 = only at end
+    resume: bool = False
+    # observability (SURVEY.md §5.1/5.5)
+    profile_dir: Optional[str] = None
+    metrics_jsonl: Optional[str] = None
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), default=str)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TrainConfig":
+        d = dict(d)
+        for key, cls in (("mesh", MeshConfig), ("data", DataConfig), ("model", ModelConfig)):
+            if key in d and isinstance(d[key], dict):
+                sub = dict(d[key])
+                for f in dataclasses.fields(cls):
+                    if f.name in sub and isinstance(sub[f.name], list):
+                        sub[f.name] = tuple(sub[f.name])
+                d[key] = cls(**sub)
+        return TrainConfig(**d)
+
+
+def _add_bool_flag(p: argparse.ArgumentParser, name: str, default: bool, help: str) -> None:
+    p.add_argument(f"--{name}", dest=name.replace("-", "_"), action="store_true",
+                   default=default, help=help)
+    p.add_argument(f"--no-{name}", dest=name.replace("-", "_"), action="store_false")
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    """CLI mirroring the reference's entrypoint (:242-253), typed (fixes B3),
+    with framework extensions behind additional flags."""
+    p = argparse.ArgumentParser(
+        description="TPU-native synchronous data-parallel training"
+    )
+    # the reference's four knobs, same defaults, now typed
+    p.add_argument("--lr", type=float, default=1e-3, help="learning rate")
+    p.add_argument("--momentum", type=float, default=0.9, help="SGD momentum")
+    p.add_argument("--batch_size", type=int, default=None,
+                   help="global batch size; passing it switches off full-batch "
+                        "mode so it is actually honored (the reference parses "
+                        "but ignores it — bug B1)")
+    p.add_argument("--nepochs", type=int, default=3, help="number of epochs")
+    # framework knobs; default (neither flag) = full-batch iff --batch_size
+    # was not given, preserving reference behavior (:146) without silently
+    # ignoring an explicit --batch_size
+    _add_bool_flag(p, "full-batch", None,
+                   "one full-dataset batch per epoch (reference behavior)")
+    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw"], default="sgd")
+    p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--loss", choices=["mse", "cross_entropy"], default="mse")
+    p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
+                   default="global_mean")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--dataset",
+                   choices=["regression", "wide_regression", "mnist", "cifar10", "lm"],
+                   default="regression")
+    p.add_argument("--n_samples", type=int, default=None,
+                   help="dataset size (default: per-dataset)")
+    p.add_argument("--n_features", type=int, default=2)
+    p.add_argument("--arch", choices=["mlp", "convnet", "transformer"], default="mlp")
+    p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
+    p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    p.add_argument("--checkpoint_dir", type=str, default=None)
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
+    p.add_argument("--profile_dir", type=str, default=None)
+    p.add_argument("--metrics_jsonl", type=str, default=None)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    full_batch = (args.full_batch if args.full_batch is not None
+                  else args.batch_size is None)
+    cfg = TrainConfig(
+        lr=args.lr,
+        momentum=args.momentum,
+        batch_size=args.batch_size if args.batch_size is not None else 4,
+        nepochs=args.nepochs,
+        full_batch=full_batch,
+        optimizer=args.optimizer,
+        weight_decay=args.weight_decay,
+        loss=args.loss,
+        grad_reduction=args.grad_reduction,
+        seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+        profile_dir=args.profile_dir,
+        metrics_jsonl=args.metrics_jsonl,
+    )
+    cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
+                          seq=args.sp, fsdp=args.fsdp)
+    cfg.data = DataConfig(dataset=args.dataset, n_samples=args.n_samples,
+                          n_features=args.n_features)
+    cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features)
+    if args.dataset in ("mnist", "cifar10"):
+        cfg.loss = "cross_entropy"
+    if args.dataset == "mnist":
+        cfg.model = ModelConfig(arch="mlp", in_features=784,
+                                hidden=(256, 128), out_features=10)
+    if args.dataset == "cifar10":
+        cfg.model = ModelConfig(arch="convnet", out_features=10)
+    if args.dataset == "lm":
+        cfg.loss = "cross_entropy"
+        cfg.model.arch = "transformer"
+    if args.sp > 1:
+        # sequence parallelism needs a seq-sharded attention impl
+        cfg.model.attention = "ring"
+    return cfg

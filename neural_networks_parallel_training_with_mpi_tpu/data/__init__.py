@@ -1,0 +1,4 @@
+"""Host-side data pipeline: dataset builders and the sharded loader."""
+
+from .datasets import build_dataset, regression_dataset
+from .loader import ShardedLoader

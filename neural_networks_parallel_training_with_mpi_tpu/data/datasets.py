@@ -1,0 +1,154 @@
+"""Dataset builders.
+
+``regression_dataset`` reproduces the reference workload byte-for-byte:
+sklearn ``make_regression(n_samples=16, n_features=2, noise=1,
+random_state=42)`` (dataParallelTraining_NN_MPI.py:72).  Standardization is
+*global* (train-set statistics applied before sharding), deliberately fixing
+reference bug B4 (per-shard ``StandardScaler`` at :21-22 gives each worker a
+differently-normalized view).
+
+MNIST/CIFAR-10/LM builders first look for real data under ``data_dir`` and
+otherwise generate deterministic synthetic stand-ins with the right
+shapes/dtypes — the benchmark harness measures throughput, which is
+data-content-independent, and CI must run hermetic (zero egress).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..config import DataConfig
+
+Arrays = Dict[str, np.ndarray]
+
+
+def standardize(x: np.ndarray) -> np.ndarray:
+    """Global z-score over axis 0 (the fix for bug B4)."""
+    mean = x.mean(axis=0, keepdims=True)
+    std = x.std(axis=0, keepdims=True)
+    return (x - mean) / np.where(std == 0.0, 1.0, std)
+
+
+def regression_dataset(n_samples: int = 16, n_features: int = 2,
+                       noise: float = 1.0, seed: int = 42,
+                       do_standardize: bool = True) -> Arrays:
+    """The reference's dataset (reference :72), X globally standardized."""
+    from sklearn.datasets import make_regression
+
+    x, y = make_regression(n_samples=n_samples, n_features=n_features,
+                           noise=noise, random_state=seed)
+    x = x.astype(np.float32)
+    y = y.astype(np.float32).reshape(-1, 1)
+    if do_standardize:
+        x = standardize(x)
+    return {"x": x, "y": y}
+
+
+def _load_idx_images(path: Path) -> Optional[np.ndarray]:
+    """Minimal IDX reader for locally-present MNIST files (no download)."""
+    import gzip
+    import struct
+
+    opener = gzip.open if path.suffix == ".gz" else open
+    try:
+        with opener(path, "rb") as f:
+            magic, = struct.unpack(">I", f.read(4))
+            ndim = magic & 0xFF
+            dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+            return data.reshape(dims)
+    except (OSError, ValueError):
+        return None
+
+
+def mnist_dataset(data_dir: Optional[str] = None, seed: int = 0,
+                  n_samples: int = 60_000) -> Arrays:
+    """Real MNIST if idx files exist under data_dir, else synthetic with
+    identical shapes: x (N, 784) float32 in [0,1]-ish, y (N,) int32 in [0,10)."""
+    if data_dir:
+        d = Path(data_dir)
+        imgs = _load_idx_images(d / "train-images-idx3-ubyte.gz") \
+            if (d / "train-images-idx3-ubyte.gz").exists() else \
+            _load_idx_images(d / "train-images-idx3-ubyte")
+        labs = _load_idx_images(d / "train-labels-idx1-ubyte.gz") \
+            if (d / "train-labels-idx1-ubyte.gz").exists() else \
+            _load_idx_images(d / "train-labels-idx1-ubyte")
+        if imgs is not None and labs is not None:
+            x = imgs.reshape(imgs.shape[0], -1).astype(np.float32) / 255.0
+            return {"x": x, "y": labs.astype(np.int32)}
+    rng = np.random.default_rng(seed)
+    x = rng.random((n_samples, 784), dtype=np.float32)
+    y = rng.integers(0, 10, size=n_samples).astype(np.int32)
+    return {"x": x, "y": y}
+
+
+def cifar10_dataset(data_dir: Optional[str] = None, seed: int = 0,
+                    n_samples: int = 50_000) -> Arrays:
+    """CIFAR-10 NHWC: x (N, 32, 32, 3) float32, y (N,) int32."""
+    if data_dir:
+        d = Path(data_dir) / "cifar-10-batches-py"
+        if d.exists():
+            import pickle
+
+            xs, ys = [], []
+            for i in range(1, 6):
+                with open(d / f"data_batch_{i}", "rb") as f:
+                    batch = pickle.load(f, encoding="bytes")
+                xs.append(batch[b"data"])
+                ys.append(batch[b"labels"])
+            x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            return {"x": x.astype(np.float32) / 255.0,
+                    "y": np.concatenate(ys).astype(np.int32)}
+    rng = np.random.default_rng(seed)
+    x = rng.random((n_samples, 32, 32, 3), dtype=np.float32)
+    y = rng.integers(0, 10, size=n_samples).astype(np.int32)
+    return {"x": x, "y": y}
+
+
+def lm_dataset(seq_len: int = 128, vocab_size: int = 256, seed: int = 0,
+               n_samples: int = 2048, data_dir: Optional[str] = None) -> Arrays:
+    """Next-token LM data: x (N, T) int32 tokens, y (N, T) int32 shifted
+    targets.  Uses a local WikiText-2-style text file if present (byte-level
+    tokenization), else a deterministic Markov-ish synthetic stream."""
+    text_path = None
+    if data_dir:
+        for name in ("wiki.train.tokens", "wikitext-2/wiki.train.tokens",
+                     "train.txt"):
+            p = Path(data_dir) / name
+            if p.exists():
+                text_path = p
+                break
+    if text_path is not None:
+        raw = np.frombuffer(text_path.read_bytes(), dtype=np.uint8)
+        tokens = (raw % vocab_size).astype(np.int32)
+    else:
+        rng = np.random.default_rng(seed)
+        tokens = rng.integers(0, vocab_size,
+                              size=n_samples * (seq_len + 1)).astype(np.int32)
+    n = min(n_samples, len(tokens) // (seq_len + 1))
+    tokens = tokens[: n * (seq_len + 1)].reshape(n, seq_len + 1)
+    return {"x": tokens[:, :-1].copy(), "y": tokens[:, 1:].copy()}
+
+
+def build_dataset(cfg: DataConfig, data_dir: Optional[str] = None) -> Arrays:
+    data_dir = data_dir or os.environ.get("NNPT_DATA_DIR")
+    if cfg.dataset == "regression":
+        return regression_dataset(cfg.n_samples or 16, cfg.n_features,
+                                  cfg.noise, cfg.seed, cfg.standardize)
+    if cfg.dataset == "wide_regression":
+        return regression_dataset(cfg.n_samples or 1_000_000, cfg.n_features,
+                                  cfg.noise, cfg.seed, cfg.standardize)
+    if cfg.dataset == "mnist":
+        return mnist_dataset(data_dir, cfg.seed,
+                             n_samples=cfg.n_samples or 60_000)
+    if cfg.dataset == "cifar10":
+        return cifar10_dataset(data_dir, cfg.seed,
+                               n_samples=cfg.n_samples or 50_000)
+    if cfg.dataset == "lm":
+        return lm_dataset(cfg.seq_len, cfg.vocab_size, cfg.seed,
+                          n_samples=cfg.n_samples or 2048, data_dir=data_dir)
+    raise ValueError(f"unknown dataset {cfg.dataset!r}")

@@ -1,0 +1,19 @@
+"""Model zoo: pure-pytree functional modules.
+
+``mlp.reference_mlp()`` is the parity model — the reference's
+``MLP = Sequential(Linear(2,3), ReLU(), Linear(3,1))``
+(dataParallelTraining_NN_MPI.py:41-45).  The rest covers the BASELINE.json
+configs: wide MLP, MNIST MLP, CIFAR ConvNet, tiny Transformer LM.
+"""
+
+from .core import Module, Linear, Sequential, Activation, Conv2D, LayerNorm, Embedding
+from .mlp import MLP, reference_mlp
+from .convnet import ConvNet
+from .transformer import Transformer, TransformerConfig
+from .registry import build_model
+
+__all__ = [
+    "Module", "Linear", "Sequential", "Activation", "Conv2D", "LayerNorm",
+    "Embedding", "MLP", "reference_mlp", "ConvNet", "Transformer",
+    "TransformerConfig", "build_model",
+]

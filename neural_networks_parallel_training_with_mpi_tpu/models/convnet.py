@@ -1,0 +1,55 @@
+"""Small ConvNet for CIFAR-10 (BASELINE.json config #4).
+
+NHWC / HWIO layouts so XLA tiles the convs straight onto the MXU.  The
+reference has no conv model (its only model is the 13-param MLP,
+dataParallelTraining_NN_MPI.py:41-45); this is part of the model-zoo widening
+mandated by BASELINE.json's configs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .core import Activation, AvgPool2D, Conv2D, Flatten, Linear, Module, Sequential
+
+
+@dataclass(frozen=True)
+class ConvNet(Module):
+    """conv-act-pool blocks -> flatten -> dense head."""
+
+    in_channels: int = 3
+    channels: Tuple[int, ...] = (32, 64)
+    image_hw: Tuple[int, int] = (32, 32)
+    n_classes: int = 10
+    hidden: int = 128
+    activation: str = "relu"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Optional[Any] = None
+
+    @property
+    def net(self) -> Sequential:
+        layers = []
+        prev = self.in_channels
+        h, w = self.image_hw
+        for c in self.channels:
+            layers += [Conv2D(prev, c, kernel=3, param_dtype=self.param_dtype),
+                       Activation(self.activation),
+                       AvgPool2D(2)]
+            prev = c
+            h, w = h // 2, w // 2
+        layers += [Flatten(),
+                   Linear(prev * h * w, self.hidden, param_dtype=self.param_dtype,
+                          compute_dtype=self.compute_dtype),
+                   Activation(self.activation),
+                   Linear(self.hidden, self.n_classes, param_dtype=self.param_dtype,
+                          compute_dtype=self.compute_dtype)]
+        return Sequential(tuple(layers))
+
+    def init(self, key):
+        return self.net.init(key)
+
+    def apply(self, params, x, **kwargs):
+        return self.net.apply(params, x, **kwargs)

@@ -1,0 +1,35 @@
+"""Model construction from config (the 'model zoo' front door)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..config import ModelConfig
+from .convnet import ConvNet
+from .mlp import MLP
+from .core import Module
+from .transformer import Transformer, TransformerConfig
+
+_DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16, "float16": jnp.float16}
+
+
+def build_model(cfg: ModelConfig) -> Module:
+    pdt = _DTYPES[cfg.dtype]
+    cdt = _DTYPES[cfg.compute_dtype]
+    if cfg.arch == "mlp":
+        return MLP(in_features=cfg.in_features, hidden=tuple(cfg.hidden),
+                   out_features=cfg.out_features, activation=cfg.activation,
+                   param_dtype=pdt, compute_dtype=cdt)
+    if cfg.arch == "convnet":
+        return ConvNet(in_channels=cfg.in_channels, channels=tuple(cfg.channels),
+                       image_hw=tuple(cfg.image_hw), n_classes=cfg.out_features,
+                       activation=cfg.activation, param_dtype=pdt,
+                       compute_dtype=cdt)
+    if cfg.arch == "transformer":
+        tc = TransformerConfig(
+            vocab_size=cfg.vocab_size, max_seq_len=cfg.max_seq_len,
+            n_layers=cfg.n_layers, d_model=cfg.d_model, n_heads=cfg.n_heads,
+            d_ff=cfg.d_ff, attention=cfg.attention, param_dtype=pdt,
+            compute_dtype=cdt, remat=cfg.remat)
+        return Transformer(tc)
+    raise ValueError(f"unknown arch {cfg.arch!r}")

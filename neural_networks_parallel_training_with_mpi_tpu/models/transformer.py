@@ -1,0 +1,134 @@
+"""Tiny decoder-only Transformer LM (BASELINE.json config #5).
+
+The reference has no attention or sequence axis (SURVEY.md §5.7); this model
+is the flagship for the TPU-native capabilities the framework adds on top of
+reference parity: bfloat16 matmuls on the MXU, optional rematerialization,
+and pluggable attention (dense / ring / ulysses — parallel.sequence) so the
+sequence dimension can be sharded over the mesh's 'seq' axis.
+
+Pre-LN architecture: x + Attn(LN(x)), x + MLP(LN(x)); learned positional
+embeddings; weight-tied output head kept separate (simpler sharding).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sequence import sequence_sharded_attention
+from .core import Embedding, LayerNorm, Linear, Module, ACTIVATIONS
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    max_seq_len: int = 512
+    n_layers: int = 2
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    activation: str = "gelu"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32   # set bfloat16 for TPU throughput
+    attention: str = "dense"           # dense | ring | ulysses
+    seq_axis: str = "seq"
+    remat: bool = False                # jax.checkpoint each block (HBM <-> FLOPs)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+@dataclass(frozen=True)
+class Transformer(Module):
+    cfg: TransformerConfig = dataclasses.field(default_factory=TransformerConfig)
+
+    # ---- submodule builders (stateless; params live in the pytree) ----
+    def _block_modules(self):
+        c = self.cfg
+        return {
+            "ln1": LayerNorm(c.d_model, param_dtype=c.param_dtype),
+            "qkv": Linear(c.d_model, 3 * c.d_model, param_dtype=c.param_dtype,
+                          compute_dtype=c.compute_dtype),
+            "attn_out": Linear(c.d_model, c.d_model, param_dtype=c.param_dtype,
+                               compute_dtype=c.compute_dtype),
+            "ln2": LayerNorm(c.d_model, param_dtype=c.param_dtype),
+            "ff_in": Linear(c.d_model, c.d_ff, param_dtype=c.param_dtype,
+                            compute_dtype=c.compute_dtype),
+            "ff_out": Linear(c.d_ff, c.d_model, param_dtype=c.param_dtype,
+                             compute_dtype=c.compute_dtype),
+        }
+
+    def init(self, key: jax.Array):
+        c = self.cfg
+        keys = jax.random.split(key, c.n_layers + 3)
+        embed = Embedding(c.vocab_size, c.d_model, c.param_dtype)
+        pos = Embedding(c.max_seq_len, c.d_model, c.param_dtype)
+        head = Linear(c.d_model, c.vocab_size, use_bias=False,
+                      param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
+        mods = self._block_modules()
+        blocks = []
+        for i in range(c.n_layers):
+            bkeys = jax.random.split(keys[i], len(mods))
+            blocks.append({name: m.init(k) for (name, m), k in zip(mods.items(), bkeys)})
+        return {
+            "embed": embed.init(keys[-3]),
+            "pos": pos.init(keys[-2]),
+            "blocks": blocks,
+            "ln_f": LayerNorm(c.d_model, param_dtype=c.param_dtype).init(keys[-1]),
+            "head": head.init(keys[-1]),
+        }
+
+    def _block(self, params, x: jax.Array) -> jax.Array:
+        c = self.cfg
+        mods = self._block_modules()
+        h = mods["ln1"].apply(params["ln1"], x)
+        qkv = mods["qkv"].apply(params["qkv"], h)
+        b, t, _ = qkv.shape
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, c.n_heads, c.head_dim)
+        out = sequence_sharded_attention(
+            c.attention, q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            axis=c.seq_axis, causal=True)
+        out = out.reshape(b, t, c.d_model)
+        x = x + mods["attn_out"].apply(params["attn_out"], out)
+        h = mods["ln2"].apply(params["ln2"], x)
+        h = mods["ff_in"].apply(params["ff_in"], h)
+        h = ACTIVATIONS[c.activation](h)
+        x = x + mods["ff_out"].apply(params["ff_out"], h)
+        return x
+
+    def apply(self, params, ids: jax.Array, **kwargs) -> jax.Array:
+        """ids: (B, T_local) int32 -> logits (B, T_local, vocab).
+
+        Under sequence parallelism T_local = T / seq_axis_size and
+        ``pos_offset`` (the shard's global starting position) is derived from
+        the bound axis index; dense attention uses offset 0.
+        """
+        c = self.cfg
+        b, t = ids.shape
+        if c.attention == "dense":
+            offset = jnp.zeros((), jnp.int32)
+        else:
+            offset = jax.lax.axis_index(c.seq_axis) * t
+        positions = offset + jnp.arange(t)
+        x = Embedding(c.vocab_size, c.d_model, c.param_dtype).apply(
+            params["embed"], ids)
+        x = x + Embedding(c.max_seq_len, c.d_model, c.param_dtype).apply(
+            params["pos"], positions)
+        x = x.astype(c.compute_dtype)
+        block_fn = self._block
+        if c.remat:
+            block_fn = jax.checkpoint(block_fn, static_argnums=())
+        for layer_params in params["blocks"]:
+            x = block_fn(layer_params, x)
+        x = LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(params["ln_f"], x)
+        logits = Linear(c.d_model, c.vocab_size, use_bias=False,
+                        param_dtype=c.param_dtype,
+                        compute_dtype=c.compute_dtype).apply(params["head"], x)
+        return logits.astype(jnp.float32)

@@ -1,0 +1,3 @@
+"""Numerical ops: losses, optimizers, and Pallas TPU kernels."""
+
+from . import losses, optim

@@ -1,0 +1,82 @@
+"""Loss functions with masked reduction.
+
+The reference uses ``nn.MSELoss()`` (dataParallelTraining_NN_MPI.py:94) —
+a plain mean over the local shard.  Here every loss returns ``(sum, count)``
+under an optional validity mask so the caller chooses the reduction:
+
+* local mean             ``sum / count``                      (reference :173)
+* exact global mean      ``psum(sum) / psum(count)``          (fixes the
+  reference's small-shard bias, SURVEY.md §7 "hard parts": averaging unequal
+  per-shard means at :190-197 is not the global-batch gradient)
+
+Masking exists because uneven datasets are zero-padded to equal per-device
+shapes (parallel.sharding.pad_to_multiple) — padded rows must contribute
+nothing to either sum or count.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _masked(per_example: jax.Array, mask: Optional[jax.Array]) -> Tuple[jax.Array, jax.Array]:
+    per_example = per_example.astype(jnp.float32)
+    if mask is None:
+        return per_example.sum(), jnp.asarray(per_example.size, jnp.float32)
+    mask = mask.astype(jnp.float32)
+    return (per_example * mask).sum(), mask.sum()
+
+
+def mse(pred: jax.Array, target: jax.Array,
+        mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Squared-error (sum, count) over examples.  ``pred``/``target`` are
+    ``(B, ...)``; per-example error is the mean over trailing dims, matching
+    ``nn.MSELoss`` semantics on ``(B, 1)`` outputs (reference :160, :173)."""
+    err = (pred.astype(jnp.float32) - target.astype(jnp.float32)) ** 2
+    per_example = err.reshape(err.shape[0], -1).mean(axis=-1)
+    return _masked(per_example, mask)
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Cross-entropy (sum, count) with integer labels.  ``logits`` is
+    ``(B, C)`` or ``(B, T, C)`` with ``labels`` ``(B,)`` / ``(B, T)``; for the
+    sequence case the mask is broadcast over T (all tokens of a padded row are
+    masked)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold  # (B,) or (B, T)
+    if nll.ndim > 1:
+        if mask is not None:
+            mask = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (nll.ndim - 1)),
+                                    nll.shape)
+        nll = nll.reshape(nll.shape[0], -1)
+        mask = None if mask is None else mask.reshape(mask.shape[0], -1)
+        per = nll if mask is None else nll * mask
+        s = per.sum()
+        c = jnp.asarray(nll.size, jnp.float32) if mask is None else mask.sum()
+        return s, c
+    return _masked(nll, mask)
+
+
+def accuracy(logits: jax.Array, labels: jax.Array,
+             mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+    """Correct-prediction (sum, count) — an eval metric, realizing the intent
+    of the reference's dead validation code (:213-236)."""
+    hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    hit = hit.reshape(hit.shape[0], -1).mean(axis=-1)
+    return _masked(hit, mask)
+
+
+LOSSES = {"mse": mse, "cross_entropy": softmax_cross_entropy}
+
+
+def get(name: str):
+    try:
+        return LOSSES[name]
+    except KeyError:
+        raise ValueError(f"unknown loss {name!r}; have {sorted(LOSSES)}") from None

@@ -1,0 +1,117 @@
+"""Pure-pytree optimizers.
+
+The reference uses ``torch.optim.SGD(model.parameters(), lr, momentum)``
+(dataParallelTraining_NN_MPI.py:91), one instance per rank, replicas kept in
+lockstep only because the applied gradient is identical (SURVEY.md C6).  Here
+the optimizer is a pure function over pytrees — ``init(params) -> state`` and
+``update(grads, state, params) -> (new_params, new_state)`` — so there is one
+*logical* optimizer whose state is replicated (or fsdp-sharded) by sharding
+annotations, and the lockstep property is by construction.
+
+``sgd`` reproduces torch SGD semantics exactly (dampening=0, no Nesterov):
+
+    buf   <- momentum * buf + grad        (buf = grad on first step)
+    param <- param - lr * buf
+
+which is what keeps the parity test (tests/test_parity.py) bit-exact against
+the reference algorithm.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    init: Callable[[Pytree], Pytree]
+    update: Callable[[Pytree, Pytree, Pytree], Tuple[Pytree, Pytree]]
+    name: str = "optimizer"
+
+
+class SGDState(NamedTuple):
+    momentum_buf: Pytree  # matches torch's momentum_buffer
+
+
+def sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimizer:
+    """torch-semantics SGD (see module docstring)."""
+
+    def init(params: Pytree) -> SGDState:
+        return SGDState(jax.tree_util.tree_map(jnp.zeros_like, params))
+
+    def update(grads: Pytree, state: SGDState, params: Pytree):
+        if weight_decay:
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g + weight_decay * p, grads, params)
+        if momentum:
+            buf = jax.tree_util.tree_map(
+                lambda b, g: momentum * b + g, state.momentum_buf, grads)
+            step = buf
+        else:
+            buf = state.momentum_buf
+            step = grads
+        new_params = jax.tree_util.tree_map(
+            lambda p, s: p - lr * s.astype(p.dtype), params, step)
+        return new_params, SGDState(buf)
+
+    return Optimizer(init, update, f"sgd(lr={lr},m={momentum})")
+
+
+class AdamState(NamedTuple):
+    count: jax.Array
+    mu: Pytree
+    nu: Pytree
+
+
+def adam(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+         weight_decay: float = 0.0, decoupled: bool = False) -> Optimizer:
+    """Adam / AdamW (``decoupled=True``)."""
+
+    def init(params: Pytree) -> AdamState:
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return AdamState(jnp.zeros((), jnp.int32), zeros(), zeros())
+
+    def update(grads: Pytree, state: AdamState, params: Pytree):
+        if weight_decay and not decoupled:
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g + weight_decay * p, grads, params)
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g,
+                                    state.mu, grads)
+        nu = jax.tree_util.tree_map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                                    state.nu, grads)
+        mu_hat = jax.tree_util.tree_map(lambda m: m / (1 - b1 ** t), mu)
+        nu_hat = jax.tree_util.tree_map(lambda v: v / (1 - b2 ** t), nu)
+        def step(p, m, v):
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay and decoupled:
+                upd = upd + weight_decay * p
+            return p - lr * upd.astype(p.dtype)
+        new_params = jax.tree_util.tree_map(step, params, mu_hat, nu_hat)
+        return new_params, AdamState(count, mu, nu)
+
+    return Optimizer(init, update, f"{'adamw' if decoupled else 'adam'}(lr={lr})")
+
+
+def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+          weight_decay: float = 0.01) -> Optimizer:
+    return adam(lr, b1, b2, eps, weight_decay, decoupled=True)
+
+
+def make(name: str, lr: float, momentum: float = 0.0,
+         weight_decay: float = 0.0) -> Optimizer:
+    """Build from config strings (config.TrainConfig.optimizer)."""
+    if name == "sgd":
+        return sgd(lr, momentum, weight_decay)
+    if name == "adam":
+        return adam(lr, weight_decay=weight_decay)
+    if name == "adamw":
+        return adamw(lr, weight_decay=weight_decay or 0.01)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,18 @@
+"""Parallelism layer: mesh/world formation, sharding math, collectives,
+and the DP/TP/PP/SP strategy builders.
+
+This is the TPU-native replacement for the reference's entire mpi4py
+communication layer (SURVEY.md §2.3): ``MPI.COMM_WORLD`` world discovery,
+``bcast``/``Scatter``/``Scatterv`` data distribution, and the
+gather-average-at-root gradient sync.
+"""
+
+from .mesh import make_mesh, world_setup, local_mesh, MeshAxes
+from .sharding import (
+    shard_sizes,
+    pad_to_multiple,
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+)
+from . import collectives

@@ -1,0 +1,95 @@
+"""Named-axis collective wrappers.
+
+The reference's communication primitives (SURVEY.md §2.3) map onto XLA
+collectives that run over ICI/DCN inside compiled SPMD programs:
+
+* ``comm.gather`` + root average + N x ``comm.send``  (reference :185-203,
+  the O(N) star-topology manual allreduce, bug B6)  ->  ``pmean``
+* ``comm.bcast`` of arrays                            ->  replicated shardings
+  (no op at runtime) or ``broadcast_from`` below when a true intra-step
+  broadcast is wanted
+* point-to-point ring traffic (none in the reference, needed for pipeline /
+  ring attention)                                     ->  ``ppermute_ring``
+
+All functions take pytrees and must be called inside ``shard_map`` (or any
+context where the named axis is bound).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Pytree = Any
+AxisName = Union[str, Sequence[str]]
+
+
+def pmean(tree: Pytree, axis: AxisName) -> Pytree:
+    """Mean over the named axis — the one-line replacement for the
+    reference's entire gradient-sync round (:179-208)."""
+    return jax.tree_util.tree_map(lambda x: lax.pmean(x, axis), tree)
+
+
+def psum(tree: Pytree, axis: AxisName) -> Pytree:
+    return jax.tree_util.tree_map(lambda x: lax.psum(x, axis), tree)
+
+
+def all_gather(tree: Pytree, axis: AxisName, *, axis_index: int = 0,
+               tiled: bool = True) -> Pytree:
+    return jax.tree_util.tree_map(
+        lambda x: lax.all_gather(x, axis, axis=axis_index, tiled=tiled), tree
+    )
+
+
+def reduce_scatter(tree: Pytree, axis: AxisName, *, scatter_axis: int = 0) -> Pytree:
+    """Sum-reduce then scatter along ``scatter_axis`` — the building block of
+    ZeRO/FSDP gradient sharding."""
+    return jax.tree_util.tree_map(
+        lambda x: lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True),
+        tree,
+    )
+
+
+def broadcast_from(tree: Pytree, axis: str, src: int = 0) -> Pytree:
+    """Broadcast ``src``'s value over ``axis`` — semantic equivalent of
+    ``comm.bcast(..., root=0)`` (:87/:97) for use inside a mapped program.
+    Implemented as select+psum so it lowers to one allreduce."""
+
+    def bcast(x):
+        idx = lax.axis_index(axis)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis)
+
+    return jax.tree_util.tree_map(bcast, tree)
+
+
+def ppermute_ring(tree: Pytree, axis: str, *, shift: int = 1) -> Pytree:
+    """Rotate values around the named axis (ring step for pipeline stages and
+    ring attention).  ``shift=+1`` sends each member's value to the next."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree_util.tree_map(lambda x: lax.ppermute(x, axis, perm), tree)
+
+
+def all_to_all(tree: Pytree, axis: str, *, split_axis: int, concat_axis: int) -> Pytree:
+    """All-to-all over the named axis — the head/sequence exchange used by
+    DeepSpeed-Ulysses-style sequence parallelism (parallel.sequence)."""
+    return jax.tree_util.tree_map(
+        lambda x: lax.all_to_all(x, axis, split_axis=split_axis,
+                                 concat_axis=concat_axis, tiled=True),
+        tree,
+    )
+
+
+def axis_index(axis: str) -> jax.Array:
+    """This member's coordinate on ``axis`` — the reference's
+    ``comm.Get_rank()`` (:62) in mesh terms."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    """Static size of ``axis`` — the reference's ``comm.Get_size()`` (:63)."""
+    return lax.axis_size(axis)

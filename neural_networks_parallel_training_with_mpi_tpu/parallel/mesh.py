@@ -1,0 +1,124 @@
+"""Device-mesh construction and multi-host world formation.
+
+Replaces the reference's world discovery
+(``comm = MPI.COMM_WORLD; rank = comm.Get_rank(); nprocs = comm.Get_size()``,
+dataParallelTraining_NN_MPI.py:61-63) and its external ``mpiexec`` launcher
+(README.md:12).  On TPU:
+
+* multi-host world formation = ``jax.distributed.initialize()`` over DCN,
+* the "communicator" = a named ``jax.sharding.Mesh`` over all chips,
+* "rank"/"size" = ``jax.process_index()`` / ``jax.process_count()`` at the
+  host level and mesh axis coordinates at the device level.
+
+The mesh axis order is chosen so the innermost (fastest-varying, best
+ICI-locality) axes carry the most latency-sensitive collectives: tensor and
+sequence parallelism innermost, data parallelism outermost (its allreduce is
+bandwidth-bound and tolerant of the extra hop count).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config import MeshConfig
+
+# Canonical axis order, outermost first.  DCN-spanning axes must come first so
+# that a multi-host mesh places the slow (DCN) hops on the outermost axis.
+AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "pipe", "expert", "seq", "tensor")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxes:
+    """Names of the mesh axes a strategy uses; import-friendly constants."""
+
+    DATA: str = "data"
+    FSDP: str = "fsdp"
+    PIPE: str = "pipe"
+    EXPERT: str = "expert"
+    SEQ: str = "seq"
+    TENSOR: str = "tensor"
+
+
+def world_setup(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    timeout_s: int = 300,
+) -> Tuple[int, int]:
+    """Form the multi-host world; returns (process_index, process_count).
+
+    This is the TPU-native ``mpiexec`` + ``COMM_WORLD`` (reference :61-63):
+    on Cloud TPU pods the coordinator/process info comes from the environment
+    and ``jax.distributed.initialize()`` needs no arguments.  Fail-fast
+    behavior (SURVEY.md §5.3): initialization that cannot form the world
+    within ``timeout_s`` raises instead of hanging the way a lost MPI rank
+    hangs the reference's blocking collectives (:185).
+    """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if callable(already) and already():
+        return jax.process_index(), jax.process_count()
+    multi_host = (
+        coordinator_address is not None
+        or os.environ.get("COORDINATOR_ADDRESS")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if multi_host:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            initialization_timeout=timeout_s,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_sizes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Build a named mesh over ``devices`` (default: all devices).
+
+    Axes with size 1 are kept in the mesh (size-1 axes are free) so that
+    sharding specs can always refer to every canonical axis name; this keeps
+    pure-DP, DP+TP, etc. all expressible against one mesh type.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        cfg = cfg or MeshConfig()
+        axis_sizes = cfg.axis_sizes(n)
+    shape = tuple(axis_sizes.get(name, 1) for name in AXIS_ORDER)
+    total = int(np.prod(shape))
+    if total != n:
+        raise ValueError(f"mesh shape {dict(zip(AXIS_ORDER, shape))} needs {total} "
+                         f"devices, have {n}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def local_mesh(n: int, platform: str = "cpu") -> Mesh:
+    """A pure-DP mesh over the first ``n`` local devices — the moral
+    equivalent of ``mpiexec -n N`` on a laptop (reference README.md:10-12).
+
+    For CI, combine with ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    (SURVEY.md §4) so N fake CPU devices stand in for N chips.
+    """
+    devices = jax.devices(platform) if platform else jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"need {n} {platform} devices, have {len(devices)}")
+    return make_mesh(MeshConfig(data=n), devices=devices[:n])
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return int(mesh.shape[axis])
+
+
+def describe(mesh: Mesh) -> str:
+    return " ".join(f"{k}={v}" for k, v in mesh.shape.items() if v > 1) or "single-device"

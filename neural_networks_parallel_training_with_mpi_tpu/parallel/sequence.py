@@ -1,0 +1,159 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no sequence axis at all (inputs are (B, 2) feature vectors,
+dataParallelTraining_NN_MPI.py:72; SURVEY.md §5.7), but long-context scaling
+is first-class here: a sequence sharded over the mesh's 'seq' axis is attended
+to without ever materializing the full (T, T) score matrix on one chip.
+
+Two strategies, both pure functions meant to run inside ``shard_map`` with the
+'seq' axis bound:
+
+* ``ring_attention`` — K/V blocks rotate around the ring via ``ppermute``
+  while each device keeps its Q shard, combining partial results with a
+  numerically-stable online softmax (the blockwise/flash recurrence).  ICI
+  traffic per step: one K/V block per hop, overlappable with the local
+  block matmul.
+* ``ulysses_attention`` — ``all_to_all`` re-shards from sequence-sharded to
+  head-sharded, runs ordinary full-sequence attention per head group, then
+  all-to-alls back.  Cheaper compute, two all-to-alls of activation size.
+
+Shapes: q/k/v are the *local* shards (B, T_local, H, Dh); positions are
+global (block i owns [i*T_local, (i+1)*T_local)), which is how causal masking
+stays exact across the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_scores(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
+    """(B, H, Tq, Tk) attention scores for one block pair, fp32 accumulate."""
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _causal_mask(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """(Tq, Tk) True where k may be attended (k_pos <= q_pos)."""
+    return k_pos[None, :] <= q_pos[:, None]
+
+
+def attention_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = True,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain full-sequence attention (B, T, H, Dh) — the single-device
+    semantics that ring/ulysses must reproduce; also the dense path of
+    models.transformer."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    scores = _block_scores(q, k, scale)
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = _causal_mask(jnp.arange(t_q), jnp.arange(t_k))
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis: str = "seq", causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over the named ``axis`` (must be bound by shard_map).
+
+    Online-softmax state per Q row: running max ``m``, normalizer ``l``,
+    accumulator ``o``.  Each of the S ring steps processes the K/V block that
+    currently resides on this device, then rotates K/V one hop so every device
+    sees every block after S steps.  Communication is S-1 ppermutes of one
+    local K/V block — no all-gather of the full sequence, which is what makes
+    context length scale linearly in devices.
+    """
+    b, t_local, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    s = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    m0 = jnp.full((b, h, t_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+
+    def step(carry, step_idx):
+        m, l, o, k_blk, v_blk = carry
+        # the block currently on this device originated at ring position:
+        blk_idx = (my_idx + step_idx) % s
+        k_pos = blk_idx * t_local + jnp.arange(t_local)
+        scores = _block_scores(q, k_blk, scale)  # (B,H,Tq,Tk) fp32
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        blk_max = scores.max(axis=-1)                      # (B,H,Tq)
+        new_m = jnp.maximum(m, blk_max)
+        # guard: rows with nothing attendable yet keep m=-inf; exp underflows to 0
+        correction = jnp.exp(m - new_m)                    # (B,H,Tq)
+        p = jnp.exp(scores - new_m[..., None])             # (B,H,Tq,Tk)
+        new_l = l * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
+                        preferred_element_type=jnp.float32)
+        new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+        # rotate K/V to the next device (shift -1 so blk_idx advances by +1)
+        perm = [(i, (i - 1) % s) for i in range(s)]
+        k_next = lax.ppermute(k_blk, axis, perm)
+        v_next = lax.ppermute(v_blk, axis, perm)
+        return (new_m, new_l, new_o, k_next, v_next), None
+
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, k, v), jnp.arange(s))
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (none in causal LM) -> 0 output
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = "seq", causal: bool = True,
+                      scale: Optional[float] = None) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all heads<->seq.
+
+    Requires ``n_heads % axis_size == 0``.  Inside shard_map, local shards are
+    (B, T/S, H, Dh); after the first all-to-all each device holds the *full*
+    sequence for H/S heads; after attention, the second all-to-all restores
+    sequence sharding.
+    """
+    s = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % s != 0:
+        raise ValueError(f"n_heads={h} not divisible by seq axis size {s}")
+    # (B, T/S, H, D) -> gather seq, split heads -> (B, T, H/S, D)
+    def to_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    out = attention_reference(to_heads(q), to_heads(k), to_heads(v),
+                              causal=causal, scale=scale)
+    return to_seq(out)
+
+
+ATTENTION_IMPLS = {
+    "dense": attention_reference,
+    "ring": ring_attention,
+    "ulysses": ulysses_attention,
+}
+
+
+def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
+                               causal: bool = True,
+                               scale: Optional[float] = None) -> jax.Array:
+    if impl == "dense":
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    if impl == "ring":
+        return ring_attention(q, k, v, axis=axis, causal=causal, scale=scale)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, axis=axis, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

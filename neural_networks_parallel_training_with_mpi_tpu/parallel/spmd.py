@@ -1,0 +1,104 @@
+"""Composed SPMD train step: data x sequence (x tensor/pipeline) parallelism.
+
+``data_parallel.make_train_step`` is the pure-DP path (the reference's only
+strategy).  This module generalizes it: the batch dim is sharded over the
+data axes AND the sequence dim over the 'seq' axis (ring/ulysses attention,
+parallel.sequence), with the gradient reduction spanning every axis that
+shards loss terms.  The math is unchanged — gradients of the global-batch
+mean loss — only the set of axes in the ``psum`` grows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import losses as losses_lib
+from ..ops.optim import Optimizer
+from ..train.state import TrainState
+from .data_parallel import DATA_AXES
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+
+
+def batch_specs(batch: Batch, seq_axis: Optional[str]) -> Dict[str, P]:
+    """Per-leaf PartitionSpecs: dim 0 over the data axes; dim 1 over 'seq'
+    for rank>=2 leaves when sequence parallelism is on; mask stays dim-0."""
+    specs = {}
+    for k, v in batch.items():
+        ndim = getattr(v, "ndim", len(getattr(v, "shape", ())))
+        if k == "mask" or ndim < 2 or not seq_axis:
+            specs[k] = P(DATA_AXES)
+        else:
+            specs[k] = P(DATA_AXES, seq_axis)
+    return specs
+
+
+def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
+                         loss_name: str = "cross_entropy",
+                         seq_axis: Optional[str] = None,
+                         donate: bool = True,
+                         example_batch: Optional[Batch] = None):
+    """(state, batch) -> (state, loss) jitted over data x seq axes.
+
+    ``seq_axis`` should be set iff the model's attention is ring/ulysses and
+    the mesh's 'seq' axis is >1; the loss/grad reduction then spans it so the
+    update uses the exact global-mean gradient over all tokens.
+    """
+    base = losses_lib.get(loss_name)
+    use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
+    reduce_axes = DATA_AXES + ((seq_axis,) if use_seq else ())
+
+    def loss_sum(params, batch):
+        pred = model.apply(params, batch["x"])
+        return base(pred, batch["y"], batch.get("mask"))
+
+    def shard_step(state: TrainState, batch: Batch):
+        def scalar(p):
+            s, c = loss_sum(p, batch)
+            return s, c
+
+        (s, c), grads = jax.value_and_grad(scalar, has_aux=True)(state.params)
+        total = lax.psum(c, reduce_axes)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, reduce_axes) / total, grads)
+        loss = lax.psum(s, reduce_axes) / total
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), loss
+
+    if example_batch is None:
+        raise ValueError("example_batch required to derive per-leaf specs")
+    specs = batch_specs(example_batch, seq_axis if use_seq else None)
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(P(), specs),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def place_batch(mesh: Mesh, batch: Batch, seq_axis: Optional[str]) -> Batch:
+    specs = batch_specs(batch, seq_axis)
+    return {k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
+            for k, v in batch.items()}
+
+
+def run_one_step(model, optimizer: Optimizer, mesh: Mesh, state: TrainState,
+                 batch: Batch, loss_name: str = "cross_entropy",
+                 seq_axis: str = "seq") -> Tuple[TrainState, jax.Array]:
+    """Convenience for dry-runs: place state+batch on the mesh, build the
+    step, execute once."""
+    use_seq = mesh.shape.get(seq_axis, 1) > 1
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    placed = place_batch(mesh, batch, seq_axis if use_seq else None)
+    step = make_spmd_train_step(model, optimizer, mesh, loss_name,
+                                seq_axis if use_seq else None,
+                                donate=False, example_batch=placed)
+    return step(state, placed)

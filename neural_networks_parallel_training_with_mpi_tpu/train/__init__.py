@@ -1,0 +1,4 @@
+"""Training orchestration: state, step builders, trainer loop."""
+
+from .state import TrainState
+from .trainer import Trainer

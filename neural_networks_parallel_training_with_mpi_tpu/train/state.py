@@ -1,0 +1,30 @@
+"""Train state: the one logical copy of params + optimizer state.
+
+The reference's equivalent state is implicit and per-process — N model
+replicas kept identical by construction (state-dict bcast at
+dataParallelTraining_NN_MPI.py:87-88, identical applied gradients at
+:206-211).  Here it is a single pytree whose placement (replicated for DP,
+sharded for FSDP/TP) is a sharding annotation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+class TrainState(NamedTuple):
+    step: jax.Array          # int32 scalar
+    params: Pytree
+    opt_state: Pytree
+
+    @classmethod
+    def create(cls, model, optimizer, key: jax.Array) -> "TrainState":
+        params = model.init(key)
+        return cls(step=jnp.zeros((), jnp.int32),
+                   params=params,
+                   opt_state=optimizer.init(params))

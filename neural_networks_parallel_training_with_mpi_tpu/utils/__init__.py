@@ -1,0 +1,4 @@
+"""Utilities: logging/metrics, PRNG, checkpointing, profiling."""
+
+from . import logging as log
+from . import prng

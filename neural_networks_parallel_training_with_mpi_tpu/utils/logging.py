@@ -1,0 +1,68 @@
+"""Structured metrics + process-0 logging.
+
+The reference's observability is interleaved per-rank ``print`` under mpiexec
+(dataParallelTraining_NN_MPI.py:152, :224; SURVEY.md §5.5).  Here: only
+process 0 logs (each message carries global, already-allreduced values — so
+one line *is* the whole job), optionally mirrored as JSONL for machines.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional, TextIO
+
+import jax
+
+
+def is_leader() -> bool:
+    return jax.process_index() == 0
+
+
+def log(msg: str, *, every_process: bool = False) -> None:
+    if every_process or is_leader():
+        print(msg, flush=True)
+
+
+class MetricsLogger:
+    """Per-step structured metrics with samples/sec, from process 0 only."""
+
+    def __init__(self, jsonl_path: Optional[str] = None):
+        self.jsonl: Optional[TextIO] = None
+        if jsonl_path and is_leader():
+            self.jsonl = open(jsonl_path, "a")
+        self._t0 = time.perf_counter()
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if not is_leader():
+            return
+        record = {k: (float(v) if hasattr(v, "item") else v)
+                  for k, v in record.items()}
+        record["t"] = round(time.perf_counter() - self._t0, 6)
+        if self.jsonl:
+            self.jsonl.write(json.dumps(record) + "\n")
+            self.jsonl.flush()
+
+    def close(self) -> None:
+        if self.jsonl:
+            self.jsonl.close()
+
+
+class Throughput:
+    """Rolling samples/sec measurement (the BASELINE.md north-star metric)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.samples = 0
+        self.start = time.perf_counter()
+
+    def add(self, n: int) -> None:
+        self.samples += int(n)
+
+    @property
+    def samples_per_sec(self) -> float:
+        dt = time.perf_counter() - self.start
+        return self.samples / dt if dt > 0 else 0.0

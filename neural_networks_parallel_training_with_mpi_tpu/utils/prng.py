@@ -1,0 +1,37 @@
+"""Deterministic PRNG key management.
+
+Fixes reference bug B5 (SURVEY.md §2.5): ``torch.manual_seed(rank)`` runs
+only on rank 0 (dataParallelTraining_NN_MPI.py:66-69) while the comment
+claims per-process seeding.  Here every stream is derived explicitly from the
+job seed with ``jax.random.fold_in``, so init, shuffling, and any per-host
+streams are reproducible and documented.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# stream tags (fold_in constants) — one per independent randomness consumer
+INIT = 0
+DATA = 1
+DROPOUT = 2
+HOST = 3
+
+
+def job_key(seed: int) -> jax.Array:
+    return jax.random.PRNGKey(seed)
+
+
+def stream(seed: int, tag: int) -> jax.Array:
+    return jax.random.fold_in(job_key(seed), tag)
+
+
+def init_key(seed: int) -> jax.Array:
+    """Model-init stream — same on every host (replicated init replaces the
+    reference's state-dict bcast, :87-88)."""
+    return stream(seed, INIT)
+
+
+def host_key(seed: int) -> jax.Array:
+    """A per-host stream for host-local randomness (e.g. data augmentation)."""
+    return jax.random.fold_in(stream(seed, HOST), jax.process_index())

@@ -1,0 +1,64 @@
+"""Test harness: 8 virtual CPU devices, no TPU.
+
+The SPMD logic is tested against fake CPU devices
+(``--xla_force_host_platform_device_count=8``) exactly as SURVEY.md §4
+prescribes — this plays the role ``mpiexec -n N`` plays for the reference on
+a laptop (reference README.md:10-12).
+
+Note: this image's sitecustomize registers an 'axon' TPU-tunnel backend and
+force-updates ``jax_platforms`` to "axon,cpu" at interpreter start; we must
+(a) point XLA_FLAGS at 8 host devices and (b) re-update the config to pure
+cpu *before* any JAX backend initialization, or every test process would
+claim the (exclusive, single-chip) TPU tunnel.
+"""
+
+import os
+
+_N_DEVICES = 8
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={_N_DEVICES}"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+# keep any axon PJRT plugin from being touched in test workers
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= _N_DEVICES, (
+        f"expected {_N_DEVICES} virtual CPU devices, got {len(devs)}"
+    )
+    return devs[:_N_DEVICES]
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+
+    return make_mesh(MeshConfig(data=8), devices=devices)
+
+
+@pytest.fixture(scope="session")
+def mesh1(devices):
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+
+    return make_mesh(MeshConfig(data=1), devices=devices[:1])

@@ -1,0 +1,69 @@
+"""Collectives wrappers over the 8-device CPU mesh — the primitives that
+replace the reference's MPI call inventory (SURVEY.md §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neural_networks_parallel_training_with_mpi_tpu.parallel import collectives as coll
+
+
+def _run(mesh, fn, x, in_spec=P("data"), out_spec=P()):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                                 out_specs=out_spec, check_vma=False))(x)
+
+
+def test_pmean_replaces_gather_average_send(mesh8):
+    # the reference's whole grad-sync round (:185-208) in one collective
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run(mesh8, lambda v: coll.pmean(v, "data"), x, out_spec=P())
+    np.testing.assert_allclose(np.asarray(out), [[3.5]])
+
+
+def test_psum_over_mesh(mesh8):
+    x = np.ones((8, 2), np.float32)
+    out = _run(mesh8, lambda v: coll.psum(v, "data"), x)
+    np.testing.assert_allclose(np.asarray(out), np.full((1, 2), 8.0))
+
+
+def test_broadcast_from_matches_mpi_bcast(mesh8):
+    # semantic equivalent of comm.bcast(..., root=0) (:87/:97)
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+
+    out = _run(mesh8, lambda v: coll.broadcast_from(v, "data", src=3), x,
+               out_spec=P("data"))
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 1), 3.0))
+
+
+def test_ppermute_ring_rotates(mesh8):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run(mesh8, lambda v: coll.ppermute_ring(v, "data", shift=1), x,
+               out_spec=P("data"))
+    # member i's value goes to member i+1
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               [7, 0, 1, 2, 3, 4, 5, 6])
+
+
+def test_all_gather(mesh8):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run(mesh8, lambda v: coll.all_gather(v, "data"), x,
+               out_spec=P("data"))
+    got = np.asarray(out)
+    assert got.shape == (64, 1)
+    np.testing.assert_allclose(got[:8].ravel(), np.arange(8))
+
+
+def test_reduce_scatter(mesh8):
+    x = np.tile(np.arange(8, dtype=np.float32), (8, 1)).reshape(8, 8)
+
+    out = _run(mesh8, lambda v: coll.reduce_scatter(v, "data", scatter_axis=1),
+               x, in_spec=P("data"), out_spec=P("data"))
+    # all-sum over members = 8*[0..7]; member i keeps column block i -> 8*i
+    np.testing.assert_allclose(np.asarray(out).ravel(), 8.0 * np.arange(8))
+
+
+def test_axis_index_is_get_rank(mesh8):
+    out = _run(mesh8, lambda v: coll.axis_index("data").reshape(1, 1).astype(jnp.float32),
+               np.zeros((8, 1), np.float32), out_spec=P("data"))
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.arange(8))

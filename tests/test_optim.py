@@ -1,0 +1,77 @@
+"""Optimizer semantics vs torch — the replicas-in-lockstep property the
+reference relies on (SURVEY.md C6: identical grads => identical SGD states,
+dataParallelTraining_NN_MPI.py:91, :206-211) requires our SGD to match
+``torch.optim.SGD`` update math exactly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+
+
+def _torch_sgd_trajectory(params0, grads_seq, lr, momentum):
+    import torch
+
+    p = torch.nn.Parameter(torch.tensor(params0))
+    opt = torch.optim.SGD([p], lr=lr, momentum=momentum)
+    out = []
+    for g in grads_seq:
+        opt.zero_grad()
+        p.grad = torch.tensor(g)
+        opt.step()
+        out.append(p.detach().numpy().copy())
+    return out
+
+
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_sgd_matches_torch(momentum):
+    rng = np.random.default_rng(0)
+    p0 = rng.standard_normal(5).astype(np.float32)
+    grads = [rng.standard_normal(5).astype(np.float32) for _ in range(4)]
+
+    opt = optim.sgd(lr=0.1, momentum=momentum)
+    state = opt.init(jnp.asarray(p0))
+    p = jnp.asarray(p0)
+    ours = []
+    for g in grads:
+        p, state = opt.update(jnp.asarray(g), state, p)
+        ours.append(np.asarray(p))
+
+    torch_traj = _torch_sgd_trajectory(p0, grads, lr=0.1, momentum=momentum)
+    for a, b in zip(ours, torch_traj):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_sgd_weight_decay():
+    opt = optim.sgd(lr=1.0, momentum=0.0, weight_decay=0.1)
+    p = jnp.asarray([1.0])
+    state = opt.init(p)
+    p2, _ = opt.update(jnp.asarray([0.0]), state, p)
+    np.testing.assert_allclose(np.asarray(p2), [0.9])
+
+
+def test_adam_first_step_is_lr_sized():
+    opt = optim.adam(lr=0.01)
+    p = jnp.asarray([1.0, 1.0])
+    state = opt.init(p)
+    p2, _ = opt.update(jnp.asarray([0.5, -0.5]), state, p)
+    # bias-corrected first step = lr * sign(g) (up to eps)
+    np.testing.assert_allclose(np.asarray(p2), [0.99, 1.01], atol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    opt = optim.adamw(lr=0.0, weight_decay=0.1)
+    # lr=0 -> decoupled decay also scaled by lr -> no-op
+    p = jnp.asarray([1.0])
+    state = opt.init(p)
+    p2, _ = opt.update(jnp.asarray([1.0]), state, p)
+    np.testing.assert_allclose(np.asarray(p2), [1.0])
+
+
+def test_make_from_config():
+    assert "sgd" in optim.make("sgd", 0.1, 0.9).name
+    assert "adam" in optim.make("adam", 0.1).name
+    with pytest.raises(ValueError):
+        optim.make("sophia", 0.1)

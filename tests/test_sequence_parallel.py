@@ -1,0 +1,116 @@
+"""Sequence-parallel attention must reproduce dense attention exactly —
+ring (ppermute ring + online softmax) and ulysses (all-to-all) vs the
+full-sequence reference implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+from neural_networks_parallel_training_with_mpi_tpu.parallel import sequence as sq
+from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    devs = jax.devices("cpu")[:4]
+    return make_mesh(MeshConfig(data=1, seq=4), devices=devs)
+
+
+def _qkv(b=2, t=32, h=4, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((b, t, h, d)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(seq_mesh, causal):
+    q, k, v = _qkv()
+    expected = sq.attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=causal)
+
+    ring = jax.jit(jax.shard_map(
+        lambda a, b_, c: sq.ring_attention(a, b_, c, axis="seq", causal=causal),
+        mesh=seq_mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    got = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(seq_mesh, causal):
+    q, k, v = _qkv()
+    expected = sq.attention_reference(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=causal)
+
+    uly = jax.jit(jax.shard_map(
+        lambda a, b_, c: sq.ulysses_attention(a, b_, c, axis="seq", causal=causal),
+        mesh=seq_mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    got = uly(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_grads_flow(seq_mesh):
+    """Ring attention must be differentiable through the ppermute chain."""
+    q, k, v = _qkv(t=16)
+
+    def loss_dense(q, k, v):
+        return (sq.attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    def loss_ring(q, k, v):
+        out = jax.shard_map(
+            lambda a, b_, c: sq.ring_attention(a, b_, c, axis="seq"),
+            mesh=seq_mesh,
+            in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )(q, k, v)
+        return (out ** 2).sum()
+
+    g_dense = jax.grad(loss_dense)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    g_ring = jax.jit(jax.grad(loss_ring))(jnp.asarray(q), jnp.asarray(k),
+                                          jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_dense),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_transformer_seq_parallel_matches_dense(seq_mesh):
+    """Full model: ring-attention Transformer under seq sharding == dense
+    Transformer on one device."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    t = 32
+    dense_cfg = TransformerConfig(vocab_size=64, max_seq_len=t, n_layers=2,
+                                  d_model=32, n_heads=4, d_ff=64,
+                                  attention="dense")
+    ring_cfg = TransformerConfig(vocab_size=64, max_seq_len=t, n_layers=2,
+                                 d_model=32, n_heads=4, d_ff=64,
+                                 attention="ring")
+    dense_model, ring_model = Transformer(dense_cfg), Transformer(ring_cfg)
+    params = dense_model.init(prng.init_key(0))
+    ids = np.random.default_rng(0).integers(0, 64, (2, t)).astype(np.int32)
+
+    expected = dense_model.apply(params, jnp.asarray(ids))
+    got = jax.jit(jax.shard_map(
+        lambda p, i: ring_model.apply(p, i),
+        mesh=seq_mesh,
+        in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)

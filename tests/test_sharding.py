@@ -1,0 +1,60 @@
+"""Shard-size math tests — the reference's Scatter/Scatterv replacement
+(dataParallelTraining_NN_MPI.py:96-143), including the overflow regimes the
+reference's int8 counts could not survive (bug B2, SURVEY.md §2.5)."""
+
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.parallel import sharding as shd
+
+
+def test_even_split_matches_reference_scatter():
+    # reference even path: 16 rows / 8 procs = 2 each (:101-108)
+    sizes = shd.shard_sizes(16, 8)
+    assert sizes.tolist() == [2] * 8
+
+
+@pytest.mark.parametrize("n,k", [(16, 3), (17, 4), (7, 8), (1, 8), (100, 7)])
+def test_uneven_split_matches_reference_scatterv_policy(n, k):
+    # reference uneven path: first `residue` shards get one extra row (:117)
+    sizes = shd.shard_sizes(n, k)
+    base, residue = divmod(n, k)
+    assert sizes.sum() == n
+    assert sizes.tolist() == [base + 1] * residue + [base] * (k - residue)
+    offs = shd.shard_offsets(n, k)
+    assert offs.tolist() == np.concatenate([[0], np.cumsum(sizes)[:-1]]).tolist()
+
+
+def test_int8_overflow_regime_is_safe():
+    # 43+ rows/shard overflowed the reference's int8 counts (bug B2)
+    sizes = shd.shard_sizes(1_000_000, 3)
+    assert sizes.dtype == np.int64
+    assert sizes.sum() == 1_000_000
+    assert sizes.max() >= 333_334
+
+
+def test_pad_to_multiple():
+    x = np.arange(14, dtype=np.float32).reshape(7, 2)
+    padded, mask = shd.pad_to_multiple(x, 4)
+    assert padded.shape == (8, 2)
+    assert mask.tolist() == [1] * 7 + [0]
+    np.testing.assert_array_equal(padded[:7], x)
+    np.testing.assert_array_equal(padded[7], 0)
+
+    same, mask = shd.pad_to_multiple(x, 7)
+    assert same.shape == (7, 2) and mask.sum() == 7
+
+
+def test_process_local_slice_covers_everything():
+    spans = [shd.process_local_slice(17, 4, i) for i in range(4)]
+    assert spans == [(0, 5), (5, 9), (9, 13), (13, 17)]
+
+
+def test_shard_batch_places_on_data_axis(mesh8):
+    x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    placed = shd.shard_batch(mesh8, {"x": x})["x"]
+    assert placed.shape == (16, 2)
+    # each of the 8 devices holds 2 rows
+    assert len(placed.addressable_shards) == 8
+    assert placed.addressable_shards[0].data.shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(placed), x)
