@@ -33,6 +33,10 @@ class Optimizer:
     init: Callable[[Pytree], Pytree]
     update: Callable[[Pytree, Pytree, Pytree], Tuple[Pytree, Pytree]]
     name: str = "optimizer"
+    # maps a param PartitionSpec tree -> an opt-state PartitionSpec tree;
+    # per-param slots (momentum, mu, nu) inherit the param's sharding so
+    # TP/FSDP shard optimizer state exactly like the params they mirror
+    state_specs: Optional[Callable[[Pytree], Pytree]] = None
 
 
 class SGDState(NamedTuple):
@@ -60,7 +64,8 @@ def sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimize
             lambda p, s: p - lr * s.astype(p.dtype), params, step)
         return new_params, SGDState(buf)
 
-    return Optimizer(init, update, f"sgd(lr={lr},m={momentum})")
+    return Optimizer(init, update, f"sgd(lr={lr},m={momentum})",
+                     state_specs=lambda ps: SGDState(ps))
 
 
 class AdamState(NamedTuple):
@@ -97,7 +102,14 @@ def adam(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         new_params = jax.tree_util.tree_map(step, params, mu_hat, nu_hat)
         return new_params, AdamState(count, mu, nu)
 
-    return Optimizer(init, update, f"{'adamw' if decoupled else 'adam'}(lr={lr})")
+    def state_specs(ps):
+        from jax.sharding import PartitionSpec
+
+        return AdamState(PartitionSpec(), ps, ps)
+
+    return Optimizer(init, update,
+                     f"{'adamw' if decoupled else 'adam'}(lr={lr})",
+                     state_specs=state_specs)
 
 
 def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,

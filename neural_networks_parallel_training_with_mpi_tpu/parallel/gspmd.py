@@ -1,0 +1,134 @@
+"""GSPMD train step: DP x TP x FSDP via jit + sharding annotations.
+
+Second composition style next to the explicit ``shard_map`` paths
+(parallel.data_parallel, parallel.spmd): the step is written in *global*
+array semantics — one logical batch, one logical parameter tree — and the
+mesh placement of every tensor is declared through ``in_shardings``/
+``out_shardings``.  XLA's SPMD partitioner then materializes the same
+communication the reference hand-rolls over MPI (SURVEY.md §2.3): the batch
+split is the Scatter (:108), parameter layouts are the bcast (:87), and the
+gradient reduction (:185-208) appears as psum/reduce-scatter chosen by the
+compiler — plus the TP/FSDP collectives the reference never had.
+
+This is the "annotate shardings, let XLA insert collectives" recipe; use it
+for DP+TP+FSDP with dense attention.  Ring-attention sequence parallelism
+needs per-device program text and stays on the shard_map path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import losses as losses_lib
+from ..ops.optim import Optimizer
+from ..train.state import TrainState
+from . import tensor_parallel as tp
+from .data_parallel import DATA_AXES
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+
+
+def _named(mesh: Mesh, spec_tree: Pytree) -> Pytree:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def state_specs(model, params: Pytree, optimizer: Optimizer,
+                mesh: Mesh) -> TrainState:
+    """PartitionSpec tree for a TrainState: params per TP/FSDP rules,
+    optimizer slots mirroring their params, scalar step replicated."""
+    ps = tp.param_specs(model, params, mesh)
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    return TrainState(step=P(), params=ps, opt_state=optimizer.state_specs(ps))
+
+
+def batch_specs(batch: Batch) -> Pytree:
+    return {k: P(DATA_AXES, *([None] * (np_ndim(v) - 1)))
+            for k, v in batch.items()}
+
+
+def np_ndim(x) -> int:
+    return getattr(x, "ndim", len(getattr(x, "shape", ())))
+
+
+def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
+                          loss_name: str = "mse",
+                          example_batch: Optional[Batch] = None,
+                          donate: bool = True):
+    """(state, batch) -> (state, loss), global semantics, sharded by
+    annotation.  The loss is the exact masked global-batch mean."""
+    if example_batch is None:
+        raise ValueError("example_batch required to derive batch specs")
+    base = losses_lib.get(loss_name)
+
+    def step_fn(state: TrainState, batch: Batch):
+        def scalar(p):
+            pred = model.apply(p, batch["x"])
+            s, c = base(pred, batch["y"], batch.get("mask"))
+            return s / c, c
+
+        (loss, _), grads = jax.value_and_grad(scalar, has_aux=True)(state.params)
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), loss
+
+    dummy_params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    sspec = state_specs(model, dummy_params, optimizer, mesh)
+    bspec = batch_specs(example_batch)
+    return jax.jit(
+        step_fn,
+        in_shardings=(_named(mesh, sspec), _named(mesh, bspec)),
+        out_shardings=(_named(mesh, sspec), NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_gspmd_eval_step(model, mesh: Mesh,
+                         loss_name: str = "mse",
+                         with_accuracy: bool = False,
+                         example_batch: Optional[Batch] = None):
+    """(params, batch) -> metrics, global semantics (params stay TP/FSDP
+    sharded — no all-gather of the whole tree as the shard_map eval would
+    force)."""
+    if example_batch is None:
+        raise ValueError("example_batch required to derive batch specs")
+    base = losses_lib.get(loss_name)
+
+    def eval_fn(params, batch):
+        pred = model.apply(params, batch["x"])
+        s, c = base(pred, batch["y"], batch.get("mask"))
+        out = {"loss": s / c, "count": c}
+        if with_accuracy:
+            hs, hc = losses_lib.accuracy(pred, batch["y"], batch.get("mask"))
+            out["accuracy"] = hs / hc
+            out["example_count"] = hc
+        return out
+
+    dummy_params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    pspec = tp.param_specs(model, dummy_params, mesh)
+    bspec = batch_specs(example_batch)
+    return jax.jit(eval_fn,
+                   in_shardings=(_named(mesh, pspec), _named(mesh, bspec)),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+def shard_state(model, state: TrainState, optimizer: Optimizer,
+                mesh: Mesh) -> TrainState:
+    """Place a host TrainState per the TP/FSDP specs."""
+    sspec = state_specs(model, state.params, optimizer, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, sspec)
+
+
+def shard_batch(mesh: Mesh, batch: Batch) -> Batch:
+    """Alias of parallel.sharding.shard_batch (single batch-placement
+    definition shared by the shard_map and GSPMD paths)."""
+    from . import sharding as shd
+
+    return shd.shard_batch(mesh, batch)

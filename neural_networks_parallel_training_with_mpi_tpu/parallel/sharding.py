@@ -68,9 +68,12 @@ def pad_to_multiple(
     return np.pad(x, pad_width), mask
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 2, batch_axes: Tuple[str, ...] = ("data",)) -> NamedSharding:
-    """Sharding that splits dim 0 (the batch) over the data axis and
-    replicates everything else — the role of ``comm.Scatter`` (:108)."""
+def batch_sharding(mesh: Mesh, ndim: int = 2,
+                   batch_axes: Tuple[str, ...] = ("data", "fsdp")) -> NamedSharding:
+    """Sharding that splits dim 0 (the batch) over the data axes and
+    replicates everything else — the role of ``comm.Scatter`` (:108).
+    'fsdp' co-shards the batch: it is a data-parallel axis whose *parameters*
+    are additionally sharded (ZeRO), so the batch dim spans both."""
     spec = P(batch_axes, *([None] * (ndim - 1)))
     return NamedSharding(mesh, spec)
 

@@ -1,0 +1,120 @@
+"""Tensor parallelism + FSDP: parameter partition rules.
+
+The reference has no tensor parallelism (its model is a fully-replicated
+13-param MLP, dataParallelTraining_NN_MPI.py:41-45; SURVEY.md §2.2 lists TP
+as absent-but-mesh-ready).  Here TP/FSDP are *sharding annotations*: a rule
+maps each parameter's tree path to a ``PartitionSpec`` over the mesh's
+'tensor' and 'fsdp' axes, and XLA's SPMD partitioner inserts the collectives
+(all-gather for fsdp-sharded params at use, psum for row-parallel matmul
+outputs) — the Megatron column/row-parallel pattern without hand-written
+communication (see parallel.gspmd for the jit wiring).
+
+Transformer rules (Megatron-style):
+
+* ``qkv``/``ff_in`` weights:  column-parallel, P(fsdp, tensor) — output dim
+  split over 'tensor', so attention heads and FF hidden units are local.
+* ``attn_out``/``ff_out`` weights: row-parallel, P(tensor, fsdp) — input dim
+  split; XLA inserts the psum that merges partial outputs.
+* biases of column-parallel layers: P(tensor); row-parallel biases and all
+  LayerNorm/embedding params: replicated (or fsdp on the big embedding).
+* MLP/ConvNet models: 'tensor' is ignored (pure DP/fsdp) — alternate-layer
+  column/row rules for generic MLPs come with the TP-MLP model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+PathRule = Callable[[Tuple[str, ...], Any], P]
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "idx"):
+            names.append(str(p.idx))
+        else:
+            names.append(str(p))
+    return tuple(names)
+
+
+def _divisible(dim: int, mesh: Mesh, axis: str) -> bool:
+    size = mesh.shape.get(axis, 1)
+    return size > 1 and dim % size == 0
+
+
+def transformer_rules(mesh: Mesh) -> PathRule:
+    """Megatron-style rules keyed on the transformer's param paths
+    (models.transformer.Transformer.init)."""
+
+    def rule(path: Tuple[str, ...], leaf) -> P:
+        shape = np.shape(leaf)
+        col = ("qkv" in path or "ff_in" in path)
+        row = ("attn_out" in path or "ff_out" in path)
+        is_w = path[-1] == "w"
+        if is_w and len(shape) == 2:
+            in_dim, out_dim = shape
+            tensor_in = row and _divisible(in_dim, mesh, "tensor")
+            tensor_out = col and _divisible(out_dim, mesh, "tensor")
+            if tensor_out:
+                # column-parallel: fsdp on input dim if it divides
+                fs = "fsdp" if _divisible(in_dim, mesh, "fsdp") else None
+                return P(fs, "tensor")
+            if tensor_in:
+                fs = "fsdp" if _divisible(out_dim, mesh, "fsdp") else None
+                return P("tensor", fs)
+            # plain weight (head, etc.): fsdp the input dim when possible
+            if path[0] == "head" and _divisible(out_dim, mesh, "tensor"):
+                return P("fsdp" if _divisible(in_dim, mesh, "fsdp") else None,
+                         "tensor")
+            if _divisible(in_dim, mesh, "fsdp"):
+                return P("fsdp")
+            return P()
+        if path[-1] == "b" and col and _divisible(shape[0], mesh, "tensor"):
+            return P("tensor")
+        if path[-1] == "table" and len(shape) == 2:
+            # embeddings: fsdp over the vocab/position dim
+            if _divisible(shape[0], mesh, "fsdp"):
+                return P("fsdp")
+            return P()
+        return P()
+
+    return rule
+
+
+def generic_rules(mesh: Mesh) -> PathRule:
+    """Models without TP structure (MLP/ConvNet): fsdp-shard any weight whose
+    leading dim divides; everything else replicated."""
+
+    def rule(path: Tuple[str, ...], leaf) -> P:
+        shape = np.shape(leaf)
+        if len(shape) >= 2 and _divisible(shape[0], mesh, "fsdp"):
+            return P("fsdp", *([None] * (len(shape) - 1)))
+        return P()
+
+    return rule
+
+
+def rules_for(model, mesh: Mesh) -> PathRule:
+    from ..models.transformer import Transformer
+
+    if isinstance(model, Transformer):
+        return transformer_rules(mesh)
+    return generic_rules(mesh)
+
+
+def param_specs(model, params: Pytree, mesh: Mesh) -> Pytree:
+    """PartitionSpec pytree matching ``params``.  Placement of a whole
+    TrainState per these specs lives in parallel.gspmd.shard_state — the
+    TP/FSDP-aware version of the replicated placement that replaces the
+    reference's state-dict bcast (:87-88)."""
+    rule = rules_for(model, mesh)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: rule(_path_names(path), leaf), params)

@@ -34,13 +34,25 @@ class Trainer:
         self.cfg = cfg
         world_setup()
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
-        for axis in ("tensor", "pipe", "expert"):
+        for axis in ("pipe", "expert"):
             if self.mesh.shape.get(axis, 1) > 1:
                 raise NotImplementedError(
                     f"mesh axis {axis!r} > 1 is not wired into Trainer yet; "
-                    "use parallel.tensor_parallel / parallel.pipeline "
-                    "directly")
+                    "use parallel.pipeline directly")
         self.seq_parallel = self.mesh.shape.get("seq", 1) > 1
+        # GSPMD (jit + sharding annotations) when params are sharded;
+        # explicit shard_map otherwise
+        self.gspmd = (self.mesh.shape.get("tensor", 1) > 1
+                      or self.mesh.shape.get("fsdp", 1) > 1)
+        if self.seq_parallel and self.gspmd:
+            raise NotImplementedError(
+                "seq x tensor/fsdp composition is not wired into Trainer "
+                "yet; use parallel.spmd/gspmd directly")
+        if self.gspmd and cfg.grad_reduction != "global_mean":
+            raise ValueError(
+                "grad_reduction='per_shard_mean' (the reference's :188-197 "
+                "semantics) is only available on the pure-DP shard_map path; "
+                "GSPMD global semantics always compute the exact global mean")
         self.model = build_model(cfg.model)
         if self.seq_parallel and cfg.model.arch != "transformer":
             raise ValueError("seq axis > 1 requires the transformer model")
@@ -63,6 +75,17 @@ class Trainer:
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
                 seq_axis="seq")
+        elif self.gspmd:
+            from ..parallel import gspmd
+
+            example = next(iter(self.loader.epoch(0)))
+            self.train_step = gspmd.make_gspmd_train_step(
+                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                example_batch=example)
+            self.eval_step = gspmd.make_gspmd_eval_step(
+                self.model, self.mesh, loss_name=cfg.loss,
+                with_accuracy=(cfg.loss == "cross_entropy"),
+                example_batch=example)
         else:
             self.train_step = dp.make_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
@@ -75,12 +98,18 @@ class Trainer:
 
     # ---- state lifecycle -------------------------------------------------
     def init_state(self) -> TrainState:
-        """Deterministic replicated init — every host derives identical
-        params from the job seed (replaces the reference's rank-0 state-dict
-        bcast, :87-88)."""
+        """Deterministic init — every host derives identical params from the
+        job seed (replaces the reference's rank-0 state-dict bcast, :87-88);
+        placement is replicated for DP/SP or TP/FSDP-sharded for GSPMD."""
         state = TrainState.create(self.model, self.optimizer,
                                   prng.init_key(self.cfg.seed))
-        self.state = dp.replicate_state(state, self.mesh)
+        if self.gspmd:
+            from ..parallel import gspmd
+
+            self.state = gspmd.shard_state(self.model, state, self.optimizer,
+                                           self.mesh)
+        else:
+            self.state = dp.replicate_state(state, self.mesh)
         return self.state
 
     def maybe_resume(self) -> int:
@@ -94,7 +123,13 @@ class Trainer:
         restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
         if restored is None:
             return 0
-        self.state = dp.replicate_state(restored, self.mesh)
+        if self.gspmd:
+            from ..parallel import gspmd
+
+            self.state = gspmd.shard_state(self.model, restored,
+                                           self.optimizer, self.mesh)
+        else:
+            self.state = dp.replicate_state(restored, self.mesh)
         return int(jax.device_get(self.state.step))
 
     def save(self) -> None:

@@ -41,10 +41,31 @@ def test_sp_through_trainer(devices):
     assert np.isfinite(result["final_loss"])
 
 
-def test_tp_guard_raises(mesh8):
-    cfg = TrainConfig(mesh=MeshConfig(data=4, tensor=2))
+def test_pipe_guard_raises(mesh8):
+    cfg = TrainConfig(mesh=MeshConfig(data=4, pipe=2))
     with pytest.raises(NotImplementedError):
         Trainer(cfg)
+
+
+def test_tp_through_trainer(devices):
+    """--tp 2 engages the GSPMD step with actually-sharded params."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    mesh = make_mesh(MeshConfig(data=2, tensor=2, fsdp=2), devices=devices)
+    cfg = TrainConfig(loss="cross_entropy", nepochs=1, full_batch=False,
+                      batch_size=8, mesh=MeshConfig(data=2, tensor=2, fsdp=2))
+    cfg.data = DataConfig(dataset="lm", n_samples=16, seq_len=16, vocab_size=32)
+    cfg.model = ModelConfig(arch="transformer", vocab_size=32, max_seq_len=16,
+                            n_layers=1, d_model=32, n_heads=4, d_ff=64)
+    t = Trainer(cfg, mesh=mesh)
+    assert t.gspmd
+    t.init_state()
+    qkv = t.state.params["blocks"][0]["qkv"]["w"]
+    assert qkv.addressable_shards[0].data.shape == (16, 48)  # fsdp x tensor
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
 
 
 def test_n_samples_plumbs_to_lm():
