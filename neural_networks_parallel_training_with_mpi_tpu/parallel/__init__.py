@@ -16,3 +16,4 @@ from .sharding import (
     shard_batch,
 )
 from . import collectives
+from . import pipeline

@@ -49,12 +49,8 @@ def state_specs(model, params: Pytree, optimizer: Optimizer,
 
 
 def batch_specs(batch: Batch) -> Pytree:
-    return {k: P(DATA_AXES, *([None] * (np_ndim(v) - 1)))
+    return {k: P(DATA_AXES, *([None] * (v.ndim - 1)))
             for k, v in batch.items()}
-
-
-def np_ndim(x) -> int:
-    return getattr(x, "ndim", len(getattr(x, "shape", ())))
 
 
 def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,

@@ -1,0 +1,258 @@
+"""Pipeline parallelism over the 'pipe' mesh axis (GPipe-style microbatching).
+
+The reference has no pipeline parallelism — its model is a single
+``nn.Sequential`` with no stage split (SURVEY.md §2.2) — so this module is a
+capability the TPU-native framework adds on top of reference parity, shaped
+for TPU rather than for a process-per-stage MPI design:
+
+* **Stage placement is a sharding annotation, not a process topology.**
+  Transformer blocks are stacked into one pytree with a leading
+  ``(n_stages, layers_per_stage)`` axis and sharded over the mesh's 'pipe'
+  axis; every device holds exactly its stage's weights.
+* **The schedule is a single SPMD program.**  One ``lax.scan`` over
+  ``n_microbatches + n_stages - 1`` ticks; each tick every device applies its
+  stage to its current activation and rotates activations one hop around the
+  ring with ``lax.ppermute`` (ICI neighbor traffic, no host round-trips).
+  Stage 0 injects embedded microbatches; the last stage applies the final
+  LayerNorm + head and accumulates the loss.  The pipeline bubble is the
+  standard (n_stages - 1) / (n_microbatches + n_stages - 1) fraction.
+* **Backward is the transpose.**  ``jax.value_and_grad`` inside ``shard_map``
+  differentiates the scan; ``ppermute``'s VJP is the reverse rotation, so the
+  backward pipeline runs automatically in the opposite direction.
+
+Composes with data parallelism (batch dim sharded over the data axes,
+gradient psum spans data + pipe for the replicated embed/head params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.core import LayerNorm, Linear
+from ..models.transformer import Transformer
+from ..ops import losses as losses_lib
+from ..ops.optim import Optimizer
+from ..train.state import TrainState
+from .data_parallel import DATA_AXES
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+PIPE_AXIS = "pipe"
+
+
+# --------------------------------------------------------------------------
+# Parameter layout: per-layer list -> (n_stages, layers_per_stage, ...) stack
+# --------------------------------------------------------------------------
+
+def stack_blocks(blocks, n_stages: int) -> Pytree:
+    """Stack a list of per-layer block pytrees into one pytree whose leaves
+    have a leading ``(n_stages, layers_per_stage)`` axis — the layout that
+    shards cleanly over 'pipe' (dim 0) and scans over layers (dim 1)."""
+    n_layers = len(blocks)
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible into {n_stages} stages")
+    per = n_layers // n_stages
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *blocks)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+
+
+def unstack_blocks(stacked: Pytree) -> list:
+    """Inverse of :func:`stack_blocks` — back to a per-layer list, so
+    pipelined checkpoints interchange with the unpipelined model."""
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n_stages, per = leaves[0].shape[:2]
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages * per,) + x.shape[2:]), stacked)
+    return [jax.tree_util.tree_map(lambda x: x[i], flat)
+            for i in range(n_stages * per)]
+
+
+def init_pipeline_params(model: Transformer, key: jax.Array,
+                         n_stages: int) -> Pytree:
+    """``model.init`` then restack ``blocks`` for pipeline sharding."""
+    params = model.init(key)
+    params = dict(params)
+    params["blocks"] = stack_blocks(params["blocks"], n_stages)
+    return params
+
+
+def init_pipeline_state(model: Transformer, optimizer: Optimizer,
+                        key: jax.Array, n_stages: int) -> TrainState:
+    params = init_pipeline_params(model, key, n_stages)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=optimizer.init(params))
+
+
+def pipeline_param_specs(params: Pytree) -> Pytree:
+    """PartitionSpec tree: stacked blocks sharded over 'pipe' (dim 0),
+    embed/pos/ln_f/head replicated (they live on every stage; their grads are
+    psum'd over 'pipe' so replicas stay identical)."""
+    return {
+        k: jax.tree_util.tree_map(
+            lambda _: P(PIPE_AXIS) if k == "blocks" else P(), v)
+        for k, v in params.items()
+    }
+
+
+def shard_pipeline_state(state: TrainState, mesh: Mesh,
+                         optimizer: Optimizer) -> TrainState:
+    """Place the state on the mesh: blocks pipe-sharded, rest replicated."""
+    pspecs = pipeline_param_specs(state.params)
+    ospecs = (optimizer.state_specs(pspecs) if optimizer.state_specs
+              else jax.tree_util.tree_map(lambda _: P(), state.opt_state))
+    specs = TrainState(step=P(), params=pspecs, opt_state=ospecs)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+# --------------------------------------------------------------------------
+# The pipelined train step
+# --------------------------------------------------------------------------
+
+def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
+                             mesh: Mesh, loss_name: str = "cross_entropy",
+                             n_microbatches: Optional[int] = None,
+                             donate: bool = True,
+                             batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+    """(state, batch) -> (state, loss), jitted over data x pipe.
+
+    ``batch`` is ``{"x": (B, T) int32, "y": (B, T), "mask": (B,)}`` (mask
+    optional — drop it from ``batch_keys`` too) with the per-data-shard rows
+    divisible by ``n_microbatches`` (default: the number of pipeline stages —
+    the minimum that keeps every stage busy once full).
+    """
+    c = model.cfg
+    n_stages = int(mesh.shape[PIPE_AXIS])
+    if n_stages < 2:
+        raise ValueError("pipeline needs mesh axis 'pipe' > 1; use the plain "
+                         "spmd/data_parallel step otherwise")
+    if c.n_layers % n_stages:
+        raise ValueError(f"n_layers={c.n_layers} not divisible by "
+                         f"n_stages={n_stages}")
+    n_mb = int(n_microbatches or n_stages)
+    base = losses_lib.get(loss_name)
+    reduce_axes = DATA_AXES + (PIPE_AXIS,)
+
+    def stage_apply(stage_params, x):
+        # stage_params leaves: (layers_per_stage, ...); scan = the stage body
+        def body(h, layer_params):
+            return model._block(layer_params, h), None
+        out, _ = lax.scan(body, x, stage_params)
+        return out
+
+    def embed(params, ids_mb):
+        t = ids_mb.shape[-1]
+        x = jnp.take(params["embed"]["table"], ids_mb, axis=0)
+        x = x + jnp.take(params["pos"]["table"], jnp.arange(t), axis=0)
+        return x.astype(c.compute_dtype)
+
+    # final LN + head: the same modules Transformer.apply uses, so the
+    # pipelined path can never drift numerically from the dense model
+    ln_f = LayerNorm(c.d_model, param_dtype=c.param_dtype)
+    head = Linear(c.d_model, c.vocab_size, use_bias=False,
+                  param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
+
+    def head_loss(params, h, tgt, msk):
+        h = ln_f.apply(params["ln_f"], h)
+        logits = head.apply(params["head"], h)
+        return base(logits.astype(jnp.float32), tgt, msk)
+
+    def local_fwd(params, batch):
+        ids, tgts = batch["x"], batch["y"]
+        b_local, t = ids.shape
+        if b_local % n_mb:
+            raise ValueError(f"per-shard batch {b_local} not divisible by "
+                             f"{n_mb} microbatches")
+        mb = b_local // n_mb
+        ids_mb = ids.reshape(n_mb, mb, t)
+        tgt_mb = tgts.reshape(n_mb, mb, t)
+        mask = batch.get("mask")
+        mask_mb = (jnp.ones((n_mb, mb), jnp.float32) if mask is None
+                   else mask.reshape(n_mb, mb))
+        stage_idx = lax.axis_index(PIPE_AXIS)
+        # local view of the pipe-sharded stack: (1, per, ...) -> (per, ...)
+        stage_params = jax.tree_util.tree_map(lambda x: x[0], params["blocks"])
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, tick_i):
+            act, lsum, cnt = carry
+            inj_i = jnp.minimum(tick_i, n_mb - 1)
+            inj = embed(params, lax.dynamic_index_in_dim(
+                ids_mb, inj_i, 0, keepdims=False))
+            x = jnp.where(stage_idx == 0, inj, act)
+            y = stage_apply(stage_params, x)
+            out_i = jnp.clip(tick_i - (n_stages - 1), 0, n_mb - 1)
+            ls, cn = head_loss(
+                params, y,
+                lax.dynamic_index_in_dim(tgt_mb, out_i, 0, keepdims=False),
+                lax.dynamic_index_in_dim(mask_mb, out_i, 0, keepdims=False))
+            valid = ((tick_i >= n_stages - 1)
+                     & (stage_idx == n_stages - 1)).astype(jnp.float32)
+            nxt = lax.ppermute(y, PIPE_AXIS, perm)
+            return (nxt, lsum + valid * ls, cnt + valid * cn), None
+
+        act0 = jnp.zeros((mb, t, c.d_model), c.compute_dtype)
+        (_, lsum, cnt), _ = lax.scan(
+            tick, (act0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            jnp.arange(n_mb + n_stages - 1))
+        return lsum, cnt
+
+    def shard_step(state: TrainState, batch: Batch):
+        (s, cnt), grads = jax.value_and_grad(
+            local_fwd, has_aux=True)(state.params, batch)
+        total = lax.psum(cnt, reduce_axes)
+        # blocks are pipe-SHARDED (each device owns its stage's grads; reduce
+        # over data only); embed/pos/ln_f/head are pipe-REPLICATED (their
+        # grads are nonzero on one stage each; psum over pipe re-replicates)
+        grads = {
+            k: jax.tree_util.tree_map(
+                lambda g: lax.psum(
+                    g, DATA_AXES if k == "blocks" else reduce_axes) / total, v)
+            for k, v in grads.items()
+        }
+        loss = lax.psum(s, reduce_axes) / total
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), loss
+
+    # shard_map specs must mirror the state placement exactly
+    dummy = jax.eval_shape(
+        lambda: init_pipeline_params(model, jax.random.PRNGKey(0), n_stages))
+    pspecs = pipeline_param_specs(dummy)
+    ospecs = (optimizer.state_specs(pspecs) if optimizer.state_specs
+              else None)
+    if ospecs is None:
+        raise ValueError("optimizer must provide state_specs for pipeline")
+    state_specs = TrainState(step=P(), params=pspecs, opt_state=ospecs)
+    batch_specs = {k: P(DATA_AXES) for k in batch_keys}
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(state_specs, batch_specs),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def run_one_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
+                 batch: Batch, key: jax.Array,
+                 loss_name: str = "cross_entropy",
+                 n_microbatches: Optional[int] = None
+                 ) -> Tuple[TrainState, jax.Array]:
+    """Convenience for dry-runs and tests: init, place, one pipelined step."""
+    n_stages = int(mesh.shape[PIPE_AXIS])
+    state = init_pipeline_state(model, optimizer, key, n_stages)
+    state = shard_pipeline_state(state, mesh, optimizer)
+    placed = {k: jax.device_put(
+        jnp.asarray(v), NamedSharding(mesh, P(DATA_AXES)))
+        for k, v in batch.items()}
+    step = make_pipeline_train_step(model, optimizer, mesh, loss_name,
+                                    n_microbatches, donate=False,
+                                    batch_keys=tuple(placed))
+    return step(state, placed)
