@@ -1,0 +1,145 @@
+"""Mixture-of-Experts feed-forward layer (Switch-style top-1 routing).
+
+The reference has no MoE (SURVEY.md §2.2: expert parallelism "not required")
+— this is a TPU-native capability layered on top of parity, built the way
+MoE maps onto XLA rather than onto per-process MPI alltoallv:
+
+* **Static shapes everywhere.**  Routing is expressed as dense one-hot
+  dispatch/combine tensors with a fixed per-expert capacity ``C`` — the
+  einsum formulation of GShard/Switch — so XLA sees only matmuls, never
+  data-dependent gather sizes.  Tokens overflowing an expert's capacity are
+  dropped (contribute zero), the standard trade.
+* **Expert parallelism is one pair of `lax.all_to_all`s.**  With experts
+  sharded over the mesh's 'expert' axis, the locally-dispatched slot tensor
+  ``(E, C, d)`` is exchanged so each device receives every peer's slots for
+  its own experts, runs its expert FFNs as one batched einsum on the MXU,
+  and the reverse all_to_all brings results home (parallel.expert wires the
+  train step).
+* **Load balancing** is the Switch aux loss ``E * Σ_e f_e · p_e`` (fraction
+  of tokens routed to e times mean router prob for e), returned alongside
+  the output for the trainer to weight.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .core import ACTIVATIONS, Module, Pytree, _uniform
+
+
+@dataclass(frozen=True)
+class MoEFFN(Module):
+    """Top-1 gated mixture of ``n_experts`` two-layer FFNs.
+
+    ``expert_axis`` selects the execution path:
+    * ``None`` — dense: every device holds all experts (or there is one
+      device); pure einsum, no collectives.
+    * an axis name — expert-parallel: expert params are sharded over that
+      mesh axis (leading expert dim), and apply() must run inside a
+      ``shard_map`` that binds the axis; slots travel by all_to_all.
+
+    ``capacity`` is the per-routing-group per-expert slot count; default
+    ``ceil(capacity_factor * group_tokens / n_experts)``.
+    """
+
+    d_model: int
+    d_ff: int
+    n_experts: int
+    capacity_factor: float = 1.25
+    capacity: Optional[int] = None
+    activation: str = "gelu"
+    expert_axis: Optional[str] = None
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+
+    def init(self, key: jax.Array) -> Pytree:
+        kg, k1, k2, k3, k4 = jax.random.split(key, 5)
+        e, d, f = self.n_experts, self.d_model, self.d_ff
+        bd, bf = 1.0 / math.sqrt(d), 1.0 / math.sqrt(f)
+        return {
+            "gate": {"w": _uniform(kg, (d, e), bd, self.param_dtype)},
+            "experts": {
+                "w_in": _uniform(k1, (e, d, f), bd, self.param_dtype),
+                "b_in": _uniform(k2, (e, f), bd, self.param_dtype),
+                "w_out": _uniform(k3, (e, f, d), bf, self.param_dtype),
+                "b_out": _uniform(k4, (e, d), bf, self.param_dtype),
+            },
+        }
+
+    # ---- routing -------------------------------------------------------
+
+    def _capacity(self, n_tokens: int) -> int:
+        if self.capacity is not None:
+            return self.capacity
+        return max(1, math.ceil(self.capacity_factor * n_tokens
+                                / self.n_experts))
+
+    def _route(self, gate_params: Pytree, x: jax.Array, cap: int):
+        """x: (N, d) -> dispatch (N, E, C) bool-ish, combine (N, E, C),
+        aux scalar."""
+        e = self.n_experts
+        logits = jnp.matmul(x.astype(jnp.float32),
+                            gate_params["w"].astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # (N, E)
+        expert_idx = jnp.argmax(probs, axis=-1)            # (N,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        gate_val = (probs * onehot).sum(-1)                # (N,)
+        # slot assignment: position of each token within its expert's queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # (N, E), 0-based
+        pos_tok = pos.sum(-1)                               # (N,)
+        keep = (pos_tok < cap) & (onehot.sum(-1) > 0)
+        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap,
+                              dtype=jnp.float32)                 # (N, C)
+        dispatch = onehot[:, :, None] * slot[:, None, :]         # (N, E, C)
+        dispatch = dispatch * keep[:, None, None].astype(jnp.float32)
+        combine = dispatch * gate_val[:, None, None]
+        # Switch load-balance loss: E * sum_e f_e * p_e  (1.0 when uniform)
+        f_e = onehot.mean(0)
+        p_e = probs.mean(0)
+        aux = e * jnp.sum(f_e * p_e)
+        return dispatch, combine, aux
+
+    # ---- expert compute ------------------------------------------------
+
+    def _experts_ffn(self, ep: Pytree, slots: jax.Array) -> jax.Array:
+        """slots: (E_local, S, d) -> (E_local, S, d); one batched einsum
+        pair per layer — E_local independent matmuls tiled onto the MXU."""
+        cdt = self.compute_dtype
+        h = jnp.einsum("esd,edf->esf", slots.astype(cdt),
+                       ep["w_in"].astype(cdt)) + ep["b_in"][:, None, :].astype(cdt)
+        h = ACTIVATIONS[self.activation](h)
+        out = jnp.einsum("esf,efd->esd", h,
+                         ep["w_out"].astype(cdt)) + ep["b_out"][:, None, :].astype(cdt)
+        return out
+
+    def apply(self, params: Pytree, x: jax.Array, **kwargs
+              ) -> Tuple[jax.Array, jax.Array]:
+        """x: (..., d_model) -> (y, aux).  Leading dims are flattened into
+        the token axis for routing."""
+        lead = x.shape[:-1]
+        d = x.shape[-1]
+        toks = x.reshape(-1, d)
+        n = toks.shape[0]
+        cap = self._capacity(n)
+        dispatch, combine, aux = self._route(params["gate"], toks, cap)
+        cdt = self.compute_dtype
+        slots = jnp.einsum("nec,nd->ecd", dispatch.astype(cdt),
+                           toks.astype(cdt))               # (E, C, d)
+        if self.expert_axis is None:
+            out = self._experts_ffn(params["experts"], slots)
+        else:
+            # (E, C, d) -> exchange -> (E_local, ep*C, d): each device
+            # gathers every peer's slots for the experts it owns
+            slots = lax.all_to_all(slots, self.expert_axis,
+                                   split_axis=0, concat_axis=1, tiled=True)
+            out = self._experts_ffn(params["experts"], slots)
+            out = lax.all_to_all(out, self.expert_axis,
+                                 split_axis=1, concat_axis=0, tiled=True)
+        y = jnp.einsum("nec,ecd->nd", combine.astype(cdt), out)
+        return y.reshape(*lead, d).astype(cdt), aux

@@ -37,6 +37,13 @@ class TransformerConfig:
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
     remat: bool = False                # jax.checkpoint each block (HBM <-> FLOPs)
+    # MoE FFN (models.moe): 0 experts = dense FFN.  With ``moe_expert_axis``
+    # set, apply() must run inside a shard_map binding that mesh axis and
+    # expert params sharded over it (parallel.expert wires the train step).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_capacity: Optional[int] = None
+    moe_expert_axis: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -51,18 +58,31 @@ class Transformer(Module):
     # ---- submodule builders (stateless; params live in the pytree) ----
     def _block_modules(self):
         c = self.cfg
-        return {
+        mods = {
             "ln1": LayerNorm(c.d_model, param_dtype=c.param_dtype),
             "qkv": Linear(c.d_model, 3 * c.d_model, param_dtype=c.param_dtype,
                           compute_dtype=c.compute_dtype),
             "attn_out": Linear(c.d_model, c.d_model, param_dtype=c.param_dtype,
                                compute_dtype=c.compute_dtype),
             "ln2": LayerNorm(c.d_model, param_dtype=c.param_dtype),
-            "ff_in": Linear(c.d_model, c.d_ff, param_dtype=c.param_dtype,
-                            compute_dtype=c.compute_dtype),
-            "ff_out": Linear(c.d_ff, c.d_model, param_dtype=c.param_dtype,
-                             compute_dtype=c.compute_dtype),
         }
+        if c.moe_experts > 0:
+            from .moe import MoEFFN
+
+            mods["moe"] = MoEFFN(
+                c.d_model, c.d_ff, c.moe_experts,
+                capacity_factor=c.moe_capacity_factor,
+                capacity=c.moe_capacity, activation=c.activation,
+                expert_axis=c.moe_expert_axis,
+                param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
+        else:
+            mods["ff_in"] = Linear(c.d_model, c.d_ff,
+                                   param_dtype=c.param_dtype,
+                                   compute_dtype=c.compute_dtype)
+            mods["ff_out"] = Linear(c.d_ff, c.d_model,
+                                    param_dtype=c.param_dtype,
+                                    compute_dtype=c.compute_dtype)
+        return mods
 
     def init(self, key: jax.Array):
         c = self.cfg
@@ -84,7 +104,9 @@ class Transformer(Module):
             "head": head.init(keys[-1]),
         }
 
-    def _block(self, params, x: jax.Array) -> jax.Array:
+    def _block(self, params, x: jax.Array):
+        """One pre-LN block: (params, x) -> (x, aux); aux is the MoE
+        load-balance loss for this block (0.0 for a dense FFN)."""
         c = self.cfg
         mods = self._block_modules()
         h = mods["ln1"].apply(params["ln1"], x)
@@ -98,13 +120,20 @@ class Transformer(Module):
         out = out.reshape(b, t, c.d_model)
         x = x + mods["attn_out"].apply(params["attn_out"], out)
         h = mods["ln2"].apply(params["ln2"], x)
-        h = mods["ff_in"].apply(params["ff_in"], h)
-        h = ACTIVATIONS[c.activation](h)
-        x = x + mods["ff_out"].apply(params["ff_out"], h)
-        return x
+        if c.moe_experts > 0:
+            ff, aux = mods["moe"].apply(params["moe"], h)
+        else:
+            h = mods["ff_in"].apply(params["ff_in"], h)
+            h = ACTIVATIONS[c.activation](h)
+            ff = mods["ff_out"].apply(params["ff_out"], h)
+            aux = jnp.zeros((), jnp.float32)
+        return x + ff.astype(x.dtype), aux
 
-    def apply(self, params, ids: jax.Array, **kwargs) -> jax.Array:
-        """ids: (B, T_local) int32 -> logits (B, T_local, vocab).
+    def apply(self, params, ids: jax.Array, return_aux: bool = False,
+              **kwargs):
+        """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or
+        (logits, aux) with ``return_aux`` (aux = summed MoE load-balance
+        loss over blocks; 0.0 for dense FFNs).
 
         Under sequence parallelism T_local = T / seq_axis_size and
         ``pos_offset`` (the shard's global starting position) is derived from
@@ -125,10 +154,13 @@ class Transformer(Module):
         block_fn = self._block
         if c.remat:
             block_fn = jax.checkpoint(block_fn, static_argnums=())
+        aux_total = jnp.zeros((), jnp.float32)
         for layer_params in params["blocks"]:
-            x = block_fn(layer_params, x)
+            x, aux = block_fn(layer_params, x)
+            aux_total = aux_total + aux
         x = LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(params["ln_f"], x)
         logits = Linear(c.d_model, c.vocab_size, use_bias=False,
                         param_dtype=c.param_dtype,
                         compute_dtype=c.compute_dtype).apply(params["head"], x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        return (logits, aux_total) if return_aux else logits

@@ -16,4 +16,5 @@ from .sharding import (
     shard_batch,
 )
 from . import collectives
+from . import expert
 from . import pipeline

@@ -1,0 +1,145 @@
+"""Expert-parallel (MoE) train step over the 'expert' mesh axis.
+
+The reference has no MoE or alltoall communication (SURVEY.md §2.2/§2.3) —
+this is an added TPU-native capability.  Layout:
+
+* **Tokens** are batch-sharded over ``data x fsdp x expert`` — the expert
+  axis's devices each carry their own batch slice, so the expert axis does
+  double duty as extra data parallelism (the GShard arrangement).
+* **Expert weights** (leaves under ``.../moe/experts``) are sharded over
+  'expert' on their leading expert dim; gate and all other params are
+  replicated.
+* Each MoE layer performs one all_to_all to move routed token slots to the
+  devices owning their experts and one to bring outputs home
+  (models.moe.MoEFFN with ``expert_axis`` set) — the collective rides ICI.
+* Gradient reduction mirrors the layout: expert-sharded grads psum over the
+  token axes except 'expert'; replicated params psum over all token axes.
+
+The loss is ``global_mean(task loss) + aux_weight * mean(load_balance)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import Transformer
+from ..ops import losses as losses_lib
+from ..ops.optim import Optimizer
+from ..train.state import TrainState
+from .data_parallel import DATA_AXES
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+EXPERT_AXIS = "expert"
+# token (batch-dim) sharding for the MoE path: expert axis carries data too
+TOKEN_AXES: Tuple[str, ...] = DATA_AXES + (EXPERT_AXIS,)
+
+
+def _is_expert_path(path) -> bool:
+    return any(getattr(k, "key", None) == "experts" for k in path)
+
+
+def moe_param_specs(params: Pytree) -> Pytree:
+    """Expert-stacked leaves (under an 'experts' subtree) -> P('expert');
+    everything else replicated."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: P(EXPERT_AXIS) if _is_expert_path(path) else P(),
+        params)
+
+
+def moe_state_specs(optimizer: Optimizer, params: Pytree) -> TrainState:
+    pspecs = moe_param_specs(params)
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    return TrainState(step=P(), params=pspecs,
+                      opt_state=optimizer.state_specs(pspecs))
+
+
+def shard_moe_state(state: TrainState, mesh: Mesh,
+                    optimizer: Optimizer) -> TrainState:
+    specs = moe_state_specs(optimizer, state.params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
+                        loss_name: str = "cross_entropy",
+                        aux_weight: float = 0.01,
+                        donate: bool = True,
+                        batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+    """(state, batch) -> (state, metrics) jitted over data x fsdp x expert.
+
+    ``metrics`` = {"loss": task loss, "aux": mean load-balance loss}.  The
+    model's ``moe_expert_axis`` must equal 'expert' when the mesh's expert
+    axis is >1 (so MoEFFN issues the all_to_alls).
+    """
+    c = model.cfg
+    ep = int(mesh.shape[EXPERT_AXIS])
+    if c.moe_experts <= 0:
+        raise ValueError("model has no MoE layers; use the spmd/gspmd step")
+    if ep > 1 and c.moe_expert_axis != EXPERT_AXIS:
+        raise ValueError(f"mesh expert={ep} but model.moe_expert_axis="
+                         f"{c.moe_expert_axis!r}; set it to {EXPERT_AXIS!r}")
+    if c.moe_experts % max(ep, 1):
+        raise ValueError(f"{c.moe_experts} experts not divisible over "
+                         f"expert axis of size {ep}")
+    base = losses_lib.get(loss_name)
+
+    def local_fwd(params, batch):
+        logits, aux = model.apply(params, batch["x"], return_aux=True)
+        s, cnt = base(logits, batch["y"], batch.get("mask"))
+        return s, (cnt, aux)
+
+    def shard_step(state: TrainState, batch: Batch):
+        def scalar(p):
+            s, (cnt, aux) = local_fwd(p, batch)
+            # aux is a per-shard mean-style scalar: average it over shards,
+            # weight it, and add to the per-shard loss-sum scaled by the
+            # local count so the global-mean task loss + aux_weight * mean
+            # aux comes out of the same psum
+            return s + aux_weight * aux * cnt, (s, cnt, aux)
+
+        (_, (s, cnt, aux)), grads = jax.value_and_grad(
+            scalar, has_aux=True)(state.params)
+        total = lax.psum(cnt, TOKEN_AXES)
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: lax.psum(
+                g, DATA_AXES if _is_expert_path(path) else TOKEN_AXES) / total,
+            grads)
+        metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
+                   "aux": lax.pmean(aux, TOKEN_AXES)}
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    state_specs = moe_state_specs(optimizer, dummy)
+    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(state_specs, batch_specs),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def run_one_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
+                 batch: Batch, key: jax.Array,
+                 loss_name: str = "cross_entropy",
+                 aux_weight: float = 0.01
+                 ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    """Convenience for dry-runs and tests: init, place, one MoE step."""
+    state = TrainState.create(model, optimizer, key)
+    state = shard_moe_state(state, mesh, optimizer)
+    placed = {k: jax.device_put(jnp.asarray(v),
+                                NamedSharding(mesh, P(TOKEN_AXES)))
+              for k, v in batch.items()}
+    step = make_moe_train_step(model, optimizer, mesh, loss_name, aux_weight,
+                               donate=False, batch_keys=tuple(placed))
+    return step(state, placed)

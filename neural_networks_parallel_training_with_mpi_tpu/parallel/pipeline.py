@@ -139,10 +139,16 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
     base = losses_lib.get(loss_name)
     reduce_axes = DATA_AXES + (PIPE_AXIS,)
 
+    if c.moe_experts > 0:
+        raise NotImplementedError("MoE + pipeline composition is not wired "
+                                  "yet (aux loss would be dropped); use "
+                                  "parallel.expert for MoE models")
+
     def stage_apply(stage_params, x):
         # stage_params leaves: (layers_per_stage, ...); scan = the stage body
         def body(h, layer_params):
-            return model._block(layer_params, h), None
+            h, _aux = model._block(layer_params, h)  # dense FFN: aux == 0
+            return h, None
         out, _ = lax.scan(body, x, stage_params)
         return out
 
