@@ -141,10 +141,10 @@ class Transformer(Module):
         """
         c = self.cfg
         b, t = ids.shape
-        if c.attention == "dense":
-            offset = jnp.zeros((), jnp.int32)
-        else:
+        if c.attention in ("ring", "ulysses"):  # seq-sharded: global offset
             offset = jax.lax.axis_index(c.seq_axis) * t
+        else:  # dense/flash see the full sequence locally
+            offset = jnp.zeros((), jnp.int32)
         positions = offset + jnp.arange(t)
         x = Embedding(c.vocab_size, c.d_model, c.param_dtype).apply(
             params["embed"], ids)

@@ -1,0 +1,246 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference's compute path is torch's C++/ATen kernels (SURVEY.md §2.4 —
+no in-repo native code); the TPU-native equivalent of "hand-tuned hot op"
+is a Pallas kernel lowered through Mosaic onto the MXU/VPU.  This module
+provides:
+
+* **flash_attention** — blocked causal attention with online softmax.
+  Never materializes the (T, T) score matrix: each q-block streams over
+  k/v-blocks in VMEM, carrying running (max, denominator, accumulator) —
+  the FlashAttention recurrence.  Causal blocks above the diagonal are
+  skipped entirely (the fori_loop upper bound shrinks per q-block), saving
+  ~2x FLOPs at long T.  O(T) memory per head instead of O(T^2).
+* **fused_layernorm** — single-pass LayerNorm on the VPU; one read of x
+  per row instead of XLA's separate mean/var/normalize passes when fusion
+  declines.
+
+Both run in interpreter mode on CPU (tests, SURVEY.md §4's fake-device
+strategy) and compiled on TPU.  The backward pass of flash_attention
+recomputes attention block-paired (same tiling, no (T, T) buffer) in plain
+JAX — XLA fuses it well; a hand-written Mosaic backward is a later
+optimization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on some non-TPU builds; interpret mode needs pl only
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ==========================================================================
+# Flash attention
+# ==========================================================================
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+                      block_k: int, seq_len: int, causal: bool,
+                      scale: float):
+    """Grid: (batch*heads, T // block_q).  Refs (block-local):
+    q (1, block_q, D), k/v (1, T, D), o (1, block_q, D)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
+    d = q.shape[-1]
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # highest k-block overlapping this q-block's last row
+        hi = lax.min(num_k_blocks,
+                     lax.div((qi + 1) * block_q + block_k - 1, block_k))
+    else:
+        hi = num_k_blocks
+
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (Bq, Bk)
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + p.sum(axis=-1, keepdims=True)
+        acc_new = corr * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+                   block_q: int, block_k: int,
+                   interpret: Optional[bool]) -> jax.Array:
+    """q/k/v: (B, T, H, D) -> (B, T, H, D)."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq_len {t} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = _interpret_default()
+    # (B, T, H, D) -> (B*H, T, D): contiguous per-head rows for the kernel
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
+                               block_k=block_k, seq_len=t, causal=causal,
+                               scale=scale)
+    mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), **mem),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
+                               **mem),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _blocked_attention_reference(q, k, v, causal: bool, block_k: int):
+    """Same math as the kernel in plain JAX (for the VJP): q-rows attend to
+    k/v in blocks via lax.scan — O(T * block_k) live memory, XLA-fusable."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    q_pos = jnp.arange(t)[:, None]
+
+    num_blocks = t // block_k
+    kb = kf.reshape(b, num_blocks, block_k, h, d)
+    vb = vf.reshape(b, num_blocks, block_k, h, d)
+
+    def step(carry, inp):
+        acc, m, l = carry
+        kj, vj, j = inp
+        s = jnp.einsum("bthd,bshd->bhts", qf, kj)
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + p.sum(-1, keepdims=True)
+        acc_new = corr[..., 0][..., None] * acc + jnp.einsum(
+            "bhts,bshd->bthd", p, vj).transpose(0, 2, 1, 3)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, t, 1), jnp.float32)
+    (acc, m, l), _ = lax.scan(
+        step, (acc0, m0, l0),
+        (kb.transpose(1, 0, 2, 3, 4), vb.transpose(1, 0, 2, 3, 4),
+         jnp.arange(num_blocks)))
+    out = acc / l[..., 0][..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Blocked attention, Pallas forward.  q/k/v: (B, T, H, D)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _blocked_attention_reference(q_, k_, v_, causal,
+                                                        min(block_k,
+                                                            q.shape[1])),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# ==========================================================================
+# Fused LayerNorm
+# ==========================================================================
+
+def _ln_kernel(x_ref, scale_ref, bias_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    mean = x.mean(-1, keepdims=True)
+    xc = x - mean
+    var = (xc * xc).mean(-1, keepdims=True)
+    y = xc * lax.rsqrt(var + eps)
+    o_ref[:] = (y * scale_ref[:].astype(jnp.float32)
+                + bias_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def fused_layernorm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                    eps: float = 1e-5, block_rows: int = 256,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """LayerNorm over the last dim; rows processed in VMEM blocks."""
+    if interpret is None:
+        interpret = _interpret_default()
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    rows = 1
+    for s in lead:
+        rows *= s
+    x2 = x.reshape(rows, d)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        block_rows = 1  # degenerate but correct fallback
+    mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, d), lambda i: (i, 0), **mem),
+            pl.BlockSpec((d,), lambda i: (0,), **mem),
+            pl.BlockSpec((d,), lambda i: (0,), **mem),
+        ],
+        out_specs=pl.BlockSpec((block_rows, d), lambda i: (i, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=interpret,
+    )(x2, scale, bias)
+    return out.reshape(*lead, d)

@@ -152,6 +152,10 @@ def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
                                scale: Optional[float] = None) -> jax.Array:
     if impl == "dense":
         return attention_reference(q, k, v, causal=causal, scale=scale)
+    if impl == "flash":
+        from ..ops.pallas_kernels import flash_attention
+
+        return flash_attention(q, k, v, causal)
     if impl == "ring":
         return ring_attention(q, k, v, axis=axis, causal=causal, scale=scale)
     if impl == "ulysses":
