@@ -1,0 +1,97 @@
+"""Multi-host runtime utilities (DCN-spanning world).
+
+The reference's world is ``mpiexec`` + ``MPI.COMM_WORLD`` (SURVEY.md §2.3);
+its host-level primitives map here as:
+
+* world formation        -> :func:`parallel.mesh.world_setup`
+                            (``jax.distributed.initialize`` over DCN)
+* blocking barrier       -> :func:`barrier` (a tiny psum across all devices;
+                            the reference relies on collectives as implicit
+                            barriers, :185)
+* pickle ``bcast``/``gather`` of host objects (:87, :185)
+                         -> :func:`broadcast_host_array` /
+                            :func:`allgather_host_array` over
+                            ``jax.experimental.multihost_utils``
+* "did every rank compute the same thing?" (implicit in the reference's
+  replicated-optimizer correctness argument, :206-211)
+                         -> :func:`assert_same_across_hosts` (debug tool)
+
+Single-process runs degrade to no-ops/identity, so the same training script
+works from a laptop CPU to a multi-host pod (unlike the reference, whose
+cluster path was never run — README.md:10).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def is_multi_host() -> bool:
+    return jax.process_count() > 1
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (fail-fast replacement
+    for the reference's implicit gather barrier, :185)."""
+    if not is_multi_host():
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_host_array(x: Any, is_source: bool = None) -> Any:
+    """Broadcast a host-side pytree of numpy arrays from process 0 to all
+    (the reference's pickled ``comm.bcast(state_dict)``, :87 — needed only
+    for data that genuinely originates on one host, e.g. a downloaded
+    dataset shard index; model init never needs it because every host
+    derives identical params from the job seed)."""
+    if not is_multi_host():
+        return x
+    from jax.experimental import multihost_utils
+
+    if is_source is None:
+        is_source = jax.process_index() == 0
+    return multihost_utils.broadcast_one_to_all(x, is_source=is_source)
+
+
+def allgather_host_array(x: Any) -> Any:
+    """Gather a per-process pytree to every process (the reference's
+    ``comm.gather`` + redistribution, :185-203, minus the root bottleneck)."""
+    if not is_multi_host():
+        return jax.tree_util.tree_map(lambda v: np.asarray(v)[None], x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def assert_same_across_hosts(x: Any, name: str = "value",
+                             atol: float = 0.0) -> None:
+    """Debug check that a host value is bitwise (or atol-close) identical on
+    every process — the property the reference only asserts in comments
+    (replica lockstep, :206-211)."""
+    if not is_multi_host():
+        return
+    gathered = allgather_host_array(x)
+
+    def check(leaf):
+        ref = leaf[0]
+        for i in range(1, leaf.shape[0]):
+            if not np.allclose(leaf[i], ref, atol=atol, rtol=0):
+                raise AssertionError(
+                    f"{name}: process {i} diverges from process 0 "
+                    f"(max abs diff {np.abs(leaf[i] - ref).max()})")
+
+    jax.tree_util.tree_map(check, gathered)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()

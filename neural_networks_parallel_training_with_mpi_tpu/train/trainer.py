@@ -10,7 +10,6 @@ those are absent in the reference).
 
 from __future__ import annotations
 
-import contextlib
 import time
 from typing import Any, Dict, Optional
 
@@ -24,7 +23,7 @@ from ..models.registry import build_model
 from ..ops import optim as optim_lib
 from ..parallel import data_parallel as dp
 from ..parallel.mesh import describe, make_mesh, world_setup
-from ..utils import prng
+from ..utils import profiling, prng
 from ..utils.logging import MetricsLogger, Throughput, is_leader, log
 from .state import TrainState
 
@@ -133,10 +132,13 @@ class Trainer:
         return int(jax.device_get(self.state.step))
 
     def save(self) -> None:
-        if self.cfg.checkpoint_dir and is_leader():
+        # every process calls in: checkpoint.save is leader-only for
+        # addressable state and shard-parallel (orbax) for TP/FSDP state
+        # that spans hosts (device_get would raise there)
+        if self.cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
-            ckpt.save(self.cfg.checkpoint_dir, jax.device_get(self.state))
+            ckpt.save(self.cfg.checkpoint_dir, self.state)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -149,10 +151,9 @@ class Trainer:
         log(f"mesh: {describe(self.mesh)} | model: {cfg.model.arch} "
             f"({self.model.n_params():,} params) | "
             f"{self.loader.n} samples, {self.loader.steps_per_epoch} steps/epoch")
-        profiler = contextlib.nullcontext()
-        if cfg.profile_dir and is_leader():
-            profiler = jax.profiler.trace(cfg.profile_dir)
+        profiler = profiling.trace(cfg.profile_dir)
         thr = Throughput()
+        timer = profiling.StepTimer()
         last_loss = float("nan")
         # host-side step counter: keeps the hot loop free of device->host
         # syncs so XLA's async dispatch pipelines steps (the whole point of
@@ -179,6 +180,7 @@ class Trainer:
                             "samples_per_sec": thr.samples_per_sec,
                         })
                     self.state, loss = self.train_step(self.state, batch)
+                    timer.tick()
                     thr.add(self.loader.batch_rows(epoch_start_step + i))
                     step += 1
                     prev = (step, epoch, loss)
@@ -199,7 +201,8 @@ class Trainer:
         self.metrics.close()
         return {"final_loss": last_loss,
                 "steps": step,
-                "samples_per_sec": thr.samples_per_sec}
+                "samples_per_sec": thr.samples_per_sec,
+                **timer.stats()}
 
     def evaluate(self, data: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, float]:
         loader = self.loader if data is None else ShardedLoader(

@@ -2,47 +2,141 @@
 the reference saves nothing; its only state transfer is the initial
 state-dict bcast at dataParallelTraining_NN_MPI.py:87).
 
-Plain-numpy pytree snapshots: ``<dir>/state.npz`` (leaves) +
-``treedef.pkl`` (structure) + ``meta.json`` (step).  Restore validates
-structure and leaf shapes/dtypes against the caller's live state so a
-checkpoint from a different model/optimizer config fails loudly here rather
-than as an opaque shape error inside a jitted step.
+Layout: ``<dir>/ckpt-<step>/`` per snapshot, newest-wins restore, optional
+retention of the last K snapshots.  Two serialization paths:
+
+* **npz** (default): plain-numpy pytree snapshot — ``state.npz`` (leaves) +
+  ``treedef.pkl`` (structure) + ``meta.json`` (step).  Used whenever the
+  state is fully addressable from this process (single-host, or replicated
+  multi-host where every host holds every leaf).
+* **orbax**: when any leaf spans non-addressable devices (TP/FSDP-sharded
+  state on a multi-host mesh), ``jax.device_get`` would raise — each
+  process must write only its own shards.  Orbax's StandardCheckpointer
+  implements exactly that protocol, so we delegate to it.
+
+Restore validates structure and leaf shapes/dtypes against the caller's
+live state so a checkpoint from a different model/optimizer config fails
+loudly here rather than as an opaque shape error inside a jitted step.
 """
 
 from __future__ import annotations
 
 import json
 import pickle
+import shutil
 from pathlib import Path
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from ..train.state import TrainState
 
+_CKPT_PREFIX = "ckpt-"
 
-def save(directory: str, state: TrainState) -> None:
+
+def _is_fully_addressable(state: Any) -> bool:
+    return all(getattr(l, "is_fully_addressable", True)
+               for l in jax.tree_util.tree_leaves(state))
+
+
+def _snapshot_dirs(d: Path):
+    """[(step, path)] sorted ascending; tolerates foreign dirs."""
+    out = []
+    if not d.exists():
+        return out
+    for p in d.iterdir():
+        if p.is_dir() and p.name.startswith(_CKPT_PREFIX):
+            try:
+                out.append((int(p.name[len(_CKPT_PREFIX):]), p))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def save(directory: str, state: TrainState, keep: int = 3) -> Path:
+    """Write ``<directory>/ckpt-<step>/``; prune to the newest ``keep``.
+
+    Safe for sharded (non-addressable) state: falls back to orbax, where
+    every process participates and writes its own shards — callers must
+    therefore invoke save() on every process; the npz path internally
+    no-ops on non-leader processes.
+    """
+    step = int(jax.device_get(state.step))
     d = Path(directory)
-    d.mkdir(parents=True, exist_ok=True)
-    leaves, treedef = jax.tree_util.tree_flatten(jax.device_get(state))
-    np.savez(d / "state.npz", **{f"leaf_{i}": np.asarray(l)
-                                 for i, l in enumerate(leaves)})
-    (d / "treedef.pkl").write_bytes(pickle.dumps(treedef))
-    (d / "meta.json").write_text(json.dumps(
-        {"step": int(np.asarray(leaves[0]))}))
+    target = d / f"{_CKPT_PREFIX}{step}"
+    if _is_fully_addressable(state):
+        if jax.process_index() == 0:
+            tmp = d / f".tmp-{_CKPT_PREFIX}{step}"
+            if tmp.exists():
+                shutil.rmtree(tmp)
+            tmp.mkdir(parents=True)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                jax.device_get(state))
+            np.savez(tmp / "state.npz", **{f"leaf_{i}": np.asarray(l)
+                                           for i, l in enumerate(leaves)})
+            (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
+            (tmp / "meta.json").write_text(json.dumps(
+                {"step": step, "format": "npz"}))
+            if target.exists():
+                shutil.rmtree(target)
+            tmp.rename(target)
+    else:  # multi-host sharded: orbax shard-parallel write
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(target.absolute() / "orbax",
+                       jax.tree_util.tree_map(lambda x: x, state))
+        if jax.process_index() == 0:
+            (target / "meta.json").write_text(json.dumps(
+                {"step": step, "format": "orbax"}))
+    if keep and jax.process_index() == 0:
+        for _, old in _snapshot_dirs(d)[:-keep]:
+            shutil.rmtree(old, ignore_errors=True)
+    return target
 
 
-def restore(directory: str, template: Optional[TrainState] = None
-            ) -> Optional[TrainState]:
-    """Load a checkpoint; ``template`` (the freshly-initialized state)
-    gates structure/shape/dtype compatibility."""
+def latest_step(directory: str) -> Optional[int]:
+    snaps = _snapshot_dirs(Path(directory))
+    return snaps[-1][0] if snaps else None
+
+
+def restore(directory: str, template: Optional[TrainState] = None,
+            step: Optional[int] = None) -> Optional[TrainState]:
+    """Load the newest (or a specific) snapshot; ``template`` (the freshly-
+    initialized, placed state) gates structure/shape compatibility and, for
+    orbax snapshots, provides the target shardings."""
     d = Path(directory)
-    if not (d / "state.npz").exists():
+    snaps = _snapshot_dirs(d)
+    # legacy flat layout (state.npz directly in `directory`)
+    if not snaps and (d / "state.npz").exists():
+        return _restore_npz(d, template)
+    if not snaps:
         return None
-    data = np.load(d / "state.npz")
+    if step is not None:
+        match = [p for s, p in snaps if s == step]
+        if not match:
+            raise ValueError(f"no checkpoint for step {step} in {directory}; "
+                             f"have {[s for s, _ in snaps]}")
+        path = match[0]
+    else:
+        path = snaps[-1][1]
+    meta = json.loads((path / "meta.json").read_text())
+    if meta.get("format") == "orbax":
+        import orbax.checkpoint as ocp
+
+        if template is None:
+            raise ValueError("orbax restore requires a template state")
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path.absolute() / "orbax", template)
+    return _restore_npz(path, template)
+
+
+def _restore_npz(path: Path, template: Optional[TrainState]
+                 ) -> TrainState:
+    data = np.load(path / "state.npz")
     leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
-    treedef = pickle.loads((d / "treedef.pkl").read_bytes())
+    treedef = pickle.loads((path / "treedef.pkl").read_bytes())
     if template is not None:
         t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
         if t_treedef != treedef:

@@ -1,0 +1,100 @@
+"""Tracing / profiling (extension — SURVEY.md §5.1: the reference has no
+timers or profiler hooks, only ``print``).
+
+Three tools, all zero-cost when disabled:
+
+* :func:`trace` — leader-only ``jax.profiler`` trace context writing a
+  TensorBoard/XProf-compatible trace of device + host activity.
+* :func:`annotate` — named region annotation that shows up inside the
+  trace timeline (wraps ``jax.profiler.TraceAnnotation``).
+* :class:`StepTimer` — host-side per-step wall-clock stats (p50/p95/max,
+  steps/sec) measured the async-dispatch-friendly way: the timer never
+  forces a device sync itself; call ``tick()`` once per dispatched step
+  and ``block()`` at measurement boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from .logging import is_leader
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str], leader_only: bool = True):
+    """Profiler trace context; no-op if ``log_dir`` is falsy (or on
+    non-leader processes with ``leader_only``)."""
+    if not log_dir or (leader_only and not is_leader()):
+        yield
+        return
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named region for the trace timeline: ``with annotate("step"): ...``"""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device live/peak memory where the backend reports it (TPU does;
+    CPU returns {})."""
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.local_devices():
+        stats = getattr(d, "memory_stats", lambda: None)()
+        if stats:
+            out[str(d)] = {k: int(v) for k, v in stats.items()
+                           if isinstance(v, (int, float))}
+    return out
+
+
+class StepTimer:
+    """Wall-clock per-step statistics.
+
+    Under async dispatch a ``tick()`` measures dispatch-to-dispatch time,
+    which converges to true step time once the pipeline is saturated —
+    without inserting any ``block_until_ready`` into the hot loop (the
+    reference blocks every step by construction, :185)."""
+
+    def __init__(self, skip_first: int = 1):
+        self.skip_first = skip_first
+        self._times: List[float] = []
+        self._last: Optional[float] = None
+        self._seen = 0
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._seen += 1
+            if self._seen > self.skip_first:
+                self._times.append(now - self._last)
+        self._last = now
+
+    def block(self, value: Any) -> Any:
+        """Block on a step output at a measurement boundary and restart the
+        interval clock (so the sync isn't charged to the next step)."""
+        value = jax.block_until_ready(value)
+        self._last = time.perf_counter()
+        return value
+
+    @staticmethod
+    def _pct(sorted_times: List[float], q: float) -> float:
+        if not sorted_times:
+            return float("nan")
+        i = min(len(sorted_times) - 1, int(q * (len(sorted_times) - 1)))
+        return sorted_times[i]
+
+    def stats(self) -> Dict[str, float]:
+        ts = sorted(self._times)
+        if not ts:
+            return {}
+        return {
+            "step_time_p50_ms": 1e3 * self._pct(ts, 0.50),
+            "step_time_p95_ms": 1e3 * self._pct(ts, 0.95),
+            "step_time_max_ms": 1e3 * ts[-1],
+            "steps_per_sec": len(ts) / sum(ts),
+        }

@@ -1,0 +1,42 @@
+"""Profiling utilities and single-host degradation of the multi-host
+runtime helpers."""
+
+import numpy as np
+
+from neural_networks_parallel_training_with_mpi_tpu.parallel import distributed
+from neural_networks_parallel_training_with_mpi_tpu.utils import profiling
+
+
+def test_step_timer_stats():
+    import time
+
+    t = profiling.StepTimer(skip_first=1)
+    for _ in range(12):
+        t.tick()
+        time.sleep(0.002)
+    s = t.stats()
+    assert s["step_time_p50_ms"] >= 1.5
+    assert s["step_time_p95_ms"] >= s["step_time_p50_ms"]
+    assert s["steps_per_sec"] > 0
+
+
+def test_trace_noop_without_dir():
+    with profiling.trace(None):
+        pass  # must not raise or start a profiler
+
+
+def test_annotate_context():
+    with profiling.annotate("unit-test-region"):
+        x = np.ones(4).sum()
+    assert x == 4
+
+
+def test_single_host_degradation():
+    assert not distributed.is_multi_host()
+    distributed.barrier()  # no-op
+    x = {"a": np.arange(3)}
+    assert distributed.broadcast_host_array(x)["a"].tolist() == [0, 1, 2]
+    gathered = distributed.allgather_host_array(x)
+    assert gathered["a"].shape == (1, 3)  # leading process axis
+    distributed.assert_same_across_hosts(x)  # no-op single host
+    assert distributed.global_device_count() >= 1
