@@ -1,0 +1,201 @@
+// Native data-loading runtime: threaded shuffle + row-gather + prefetch.
+//
+// TPU-native equivalent of the host-side data path the reference delegates
+// to torch's DataLoader (dataParallelTraining_NN_MPI.py:146) — but built for
+// the TPU regime where the accelerator must never wait on the host: batches
+// are assembled by a worker pool *ahead* of consumption into a bounded
+// ready-queue, so the Python thread only memcpy-wraps a finished buffer
+// while workers gather the next batches in parallel with device compute.
+//
+// Fields are opaque byte rows (any dtype/shape), so one permutation is
+// shared by every field of a dataset — the row pairing (x[i], y[i]) is
+// preserved by construction, unlike per-field shuffles.
+//
+// Determinism: Fisher-Yates driven by splitmix64 seeded with (seed, epoch),
+// identical across hosts for a given config — the property the reference's
+// rank-0-only torch.manual_seed (bug B5, SURVEY.md §2.5) was meant to have.
+//
+// C ABI (ctypes-friendly); all functions are thread-compatible per handle.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Field {
+  const uint8_t* data;
+  uint64_t row_bytes;
+};
+
+struct Batch {
+  std::vector<std::vector<uint8_t>> buffers;  // one per field
+  uint64_t rows = 0;
+};
+
+static inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+struct Loader {
+  uint64_t n_rows = 0;
+  uint64_t seed = 0;
+  bool shuffle = true;
+  std::vector<Field> fields;
+
+  // epoch state
+  std::vector<uint64_t> order;
+  uint64_t batch_size = 0;
+  uint64_t n_batches = 0;
+  std::atomic<uint64_t> next_claim{0};
+
+  // prefetch machinery
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_ready;   // consumer waits for next_deliver
+  std::condition_variable cv_space;   // workers wait for queue space
+  std::map<uint64_t, Batch> ready;
+  uint64_t next_deliver = 0;
+  uint64_t max_ready = 4;
+  bool stopping = false;
+
+  Batch current;  // last delivered batch; alive until the next delivery
+
+  void reset_epoch_order(uint64_t epoch) {
+    order.resize(n_rows);
+    for (uint64_t i = 0; i < n_rows; ++i) order[i] = i;
+    if (shuffle) {
+      uint64_t s = seed * 0x9e3779b97f4a7c15ULL + epoch + 1;
+      for (uint64_t i = n_rows; i > 1; --i) {
+        uint64_t j = splitmix64(s) % i;
+        std::swap(order[i - 1], order[j]);
+      }
+    }
+  }
+
+  void gather(uint64_t batch_idx, Batch& out) const {
+    const uint64_t start = batch_idx * batch_size;
+    const uint64_t rows = std::min(batch_size, n_rows - start);
+    out.rows = rows;
+    out.buffers.resize(fields.size());
+    for (size_t f = 0; f < fields.size(); ++f) {
+      const Field& fld = fields[f];
+      out.buffers[f].resize(rows * fld.row_bytes);
+      uint8_t* dst = out.buffers[f].data();
+      for (uint64_t r = 0; r < rows; ++r) {
+        std::memcpy(dst + r * fld.row_bytes,
+                    fld.data + order[start + r] * fld.row_bytes,
+                    fld.row_bytes);
+      }
+    }
+  }
+
+  void worker_main() {
+    for (;;) {
+      const uint64_t idx = next_claim.fetch_add(1);
+      if (idx >= n_batches) return;
+      Batch b;
+      gather(idx, b);
+      std::unique_lock<std::mutex> lk(mu);
+      // bound memory: don't run more than max_ready ahead of delivery
+      cv_space.wait(lk, [&] {
+        return stopping || idx < next_deliver + max_ready;
+      });
+      if (stopping) return;
+      ready.emplace(idx, std::move(b));
+      cv_ready.notify_all();
+    }
+  }
+
+  void stop_workers() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_space.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    workers.clear();
+    ready.clear();
+    stopping = false;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_create(uint64_t n_rows, uint64_t seed, int shuffle) {
+  auto* l = new Loader();
+  l->n_rows = n_rows;
+  l->seed = seed;
+  l->shuffle = shuffle != 0;
+  return l;
+}
+
+// data must stay valid for the loader's lifetime (numpy array owned by
+// the Python wrapper).  Returns the field index.
+int dl_add_field(void* handle, const void* data, uint64_t row_bytes) {
+  auto* l = static_cast<Loader*>(handle);
+  l->fields.push_back(Field{static_cast<const uint8_t*>(data), row_bytes});
+  return static_cast<int>(l->fields.size()) - 1;
+}
+
+// Returns the number of batches this epoch will deliver.
+uint64_t dl_start_epoch(void* handle, uint64_t epoch, uint64_t batch_size,
+                        int drop_remainder, uint64_t start_batch,
+                        int n_threads, uint64_t prefetch_depth) {
+  auto* l = static_cast<Loader*>(handle);
+  l->stop_workers();
+  l->reset_epoch_order(epoch);
+  l->batch_size = batch_size == 0 ? l->n_rows : batch_size;
+  uint64_t nb = l->n_rows / l->batch_size;
+  if (!drop_remainder && l->n_rows % l->batch_size) nb += 1;
+  if (nb == 0) nb = 1;
+  l->n_batches = nb;
+  l->next_claim.store(start_batch);
+  l->next_deliver = start_batch;
+  l->max_ready = prefetch_depth == 0 ? 4 : prefetch_depth;
+  if (n_threads < 1) n_threads = 1;
+  for (int i = 0; i < n_threads; ++i) {
+    l->workers.emplace_back([l] { l->worker_main(); });
+  }
+  return nb - std::min(start_batch, nb);
+}
+
+// Blocks until the next in-order batch is ready.  Returns rows in the
+// batch (0 = epoch exhausted).  out_ptrs[f] receives the field buffers,
+// valid until the next dl_next_batch/dl_start_epoch/dl_destroy call.
+uint64_t dl_next_batch(void* handle, void** out_ptrs) {
+  auto* l = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(l->mu);
+  if (l->next_deliver >= l->n_batches) return 0;
+  const uint64_t want = l->next_deliver;
+  l->cv_ready.wait(lk, [&] { return l->ready.count(want) != 0; });
+  l->current = std::move(l->ready[want]);
+  l->ready.erase(want);
+  l->next_deliver = want + 1;
+  l->cv_space.notify_all();
+  for (size_t f = 0; f < l->current.buffers.size(); ++f) {
+    out_ptrs[f] = l->current.buffers[f].data();
+  }
+  return l->current.rows;
+}
+
+void dl_destroy(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  l->stop_workers();
+  delete l;
+}
+
+}  // extern "C"

@@ -37,9 +37,12 @@ class ShardedLoader:
                  *, shuffle: bool = True, seed: int = 0,
                  full_batch: bool = False, remainder: str = "pad",
                  multi_host: Optional[bool] = None,
-                 seq_axis: Optional[str] = None):
+                 seq_axis: Optional[str] = None,
+                 backend: str = "numpy"):
         if remainder not in ("pad", "drop"):
             raise ValueError("remainder must be 'pad' or 'drop'")
+        if backend not in ("numpy", "native", "auto"):
+            raise ValueError("backend must be 'numpy', 'native' or 'auto'")
         self.mesh = mesh
         # when sequence parallelism is on, rank>=2 leaves are also sharded
         # along dim 1 over this axis (see parallel.spmd.batch_specs)
@@ -58,6 +61,21 @@ class ShardedLoader:
         self.remainder = remainder
         self.multi_host = (jax.process_count() > 1 if multi_host is None
                            else multi_host)
+        # native (C++) shuffle+gather+prefetch path: batch assembly overlaps
+        # device compute on a worker pool (data.native_loader).  Its shuffle
+        # permutation differs from the numpy path's, so the backend is
+        # pinned per loader instance (resume must not switch backends).
+        self._native = None
+        if backend in ("native", "auto"):
+            from . import native_loader
+
+            if native_loader.available():
+                self._native = native_loader.NativeBatcher(
+                    self.data, self.batch_size, seed=seed, shuffle=shuffle,
+                    drop_remainder=(remainder == "drop"))
+            elif backend == "native":
+                raise RuntimeError("backend='native' requested but the "
+                                   "native loader is unavailable")
 
     @property
     def steps_per_epoch(self) -> int:
@@ -83,6 +101,10 @@ class ShardedLoader:
         skips already-trained batches when resuming mid-epoch (the order is
         deterministic per (seed, epoch), so a resumed run sees the identical
         remaining batches)."""
+        if self._native is not None:
+            for batch in self._native.epoch(epoch, start_batch=start_step):
+                yield self._place(batch)
+            return
         order = self._epoch_order(epoch)
         bs = self.batch_size
         for step in range(start_step, self.steps_per_epoch):

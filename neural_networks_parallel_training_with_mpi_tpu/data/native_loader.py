@@ -1,0 +1,155 @@
+"""ctypes binding for the native (C++) batch loader in ``native/``.
+
+The reference's host data path is torch's Python DataLoader over a
+root-materialized array (dataParallelTraining_NN_MPI.py:146, :72).  The
+native runtime replaces the per-batch numpy fancy-indexing with a C++
+worker pool that shuffles, gathers rows (shared permutation across fields),
+and prefetches finished batches into a bounded queue — so batch assembly
+overlaps device compute instead of serializing with it.
+
+The binding is optional everywhere: :func:`available` gates it, and
+``ShardedLoader`` silently falls back to the numpy path when the shared
+library is missing or the build toolchain is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_NATIVE_DIR = _REPO_ROOT / "native"
+_SO_PATH = _NATIVE_DIR / "libnnploader.so"
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    if not (_NATIVE_DIR / "dataloader.cpp").exists():
+        return False
+    try:
+        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                       capture_output=True, timeout=120)
+        return _SO_PATH.exists()
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _SO_PATH.exists() and not _build():
+            return None
+        lib = ctypes.CDLL(str(_SO_PATH))
+        lib.dl_create.restype = ctypes.c_void_p
+        lib.dl_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                  ctypes.c_int]
+        lib.dl_add_field.restype = ctypes.c_int
+        lib.dl_add_field.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64]
+        lib.dl_start_epoch.restype = ctypes.c_uint64
+        lib.dl_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_uint64, ctypes.c_int,
+                                       ctypes.c_uint64]
+        lib.dl_next_batch.restype = ctypes.c_uint64
+        lib.dl_next_batch.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_void_p)]
+        lib.dl_destroy.restype = None
+        lib.dl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeBatcher:
+    """Shuffle+gather+prefetch over a dict of equal-length numpy arrays.
+
+    Yields batches as dicts of freshly-owned numpy arrays with the SAME
+    permutation applied to every field.  Shuffle order is deterministic in
+    (seed, epoch) — but intentionally a different (native splitmix64)
+    sequence than the numpy path's, so resuming a run must stick with one
+    backend (ShardedLoader pins it per instance).
+    """
+
+    def __init__(self, data: Dict[str, np.ndarray], batch_size: int,
+                 *, seed: int = 0, shuffle: bool = True,
+                 drop_remainder: bool = False, n_threads: int = 2,
+                 prefetch_depth: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native loader unavailable (libnnploader.so "
+                               "missing and build failed)")
+        self._lib = lib
+        self.keys = list(data)
+        # keep C-contiguous copies alive for the loader's whole lifetime
+        self.arrays = {k: np.ascontiguousarray(v) for k, v in data.items()}
+        lens = {k: v.shape[0] for k, v in self.arrays.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"ragged dataset: {lens}")
+        self.n = next(iter(lens.values()))
+        self.batch_size = batch_size if batch_size else self.n
+        self.drop_remainder = drop_remainder
+        self.n_threads = n_threads
+        self.prefetch_depth = prefetch_depth
+        self._handle = lib.dl_create(self.n, seed, int(shuffle))
+        self._row_shapes = {}
+        self._dtypes = {}
+        for k in self.keys:
+            a = self.arrays[k]
+            row_bytes = a.dtype.itemsize * int(np.prod(a.shape[1:], dtype=np.int64))
+            lib.dl_add_field(self._handle, a.ctypes.data_as(ctypes.c_void_p),
+                             row_bytes)
+            self._row_shapes[k] = a.shape[1:]
+            self._dtypes[k] = a.dtype
+
+    @property
+    def steps_per_epoch(self) -> int:
+        if self.drop_remainder:
+            return max(self.n // self.batch_size, 1)
+        return -(-self.n // self.batch_size)
+
+    def epoch(self, epoch: int, start_batch: int = 0
+              ) -> Iterator[Dict[str, np.ndarray]]:
+        lib = self._lib
+        remaining = lib.dl_start_epoch(
+            self._handle, epoch, self.batch_size, int(self.drop_remainder),
+            start_batch, self.n_threads, self.prefetch_depth)
+        ptrs = (ctypes.c_void_p * len(self.keys))()
+        for _ in range(remaining):
+            rows = lib.dl_next_batch(self._handle, ptrs)
+            if rows == 0:
+                return
+            out = {}
+            for i, k in enumerate(self.keys):
+                shape = (rows,) + self._row_shapes[k]
+                nbytes = int(np.prod(shape, dtype=np.int64)) * \
+                    self._dtypes[k].itemsize
+                buf = ctypes.cast(
+                    ptrs[i], ctypes.POINTER(ctypes.c_uint8 * nbytes))
+                # copy out: the native buffer is reused on the next call
+                arr = np.frombuffer(bytearray(buf.contents),
+                                    dtype=self._dtypes[k]).reshape(shape)
+                out[k] = arr
+            yield out
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
