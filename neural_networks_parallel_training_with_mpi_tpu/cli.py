@@ -28,6 +28,10 @@ def main(argv=None) -> int:
     result = trainer.fit()
     log(f"done: final loss {result['final_loss']:.6f}, "
         f"{result['samples_per_sec']:.1f} samples/sec")
+    val = {k: v for k, v in result.items() if k.startswith("val_")}
+    if val:
+        log("validation: " + ", ".join(f"{k[4:]} {v:.6f}"
+                                       for k, v in sorted(val.items())))
     return 0
 
 

@@ -99,6 +99,9 @@ class DataConfig:
     #   pad  - zero-pad + mask (exact global gradient; SURVEY.md §7 "hard parts")
     #   drop - drop the remainder samples
     remainder: str = "pad"
+    # held-out validation fraction (0 = train on everything, the reference
+    # default; its own validation/test blocks are dead code — SURVEY.md C10)
+    val_fraction: float = 0.0
 
 
 @dataclass
@@ -126,6 +129,11 @@ class ModelConfig:
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
+    # MoE FFN (transformer only): 0 = dense.  moe_expert_axis is set to
+    # 'expert' when the mesh's expert axis is >1 (parallel.expert wires the
+    # all_to_all dispatch)
+    moe_experts: int = 0
+    moe_expert_axis: Optional[str] = None
 
 
 @dataclass
@@ -140,6 +148,16 @@ class TrainConfig:
     full_batch: bool = True    # reference behavior: one full-shard batch per epoch (:146)
     optimizer: str = "sgd"     # sgd | adam | adamw
     weight_decay: float = 0.0
+    # lr schedule over optimizer steps (ops.schedules); "constant" = the
+    # reference's fixed lr.  total_steps is derived from nepochs x
+    # steps-per-epoch by the Trainer.
+    lr_schedule: str = "constant"  # constant | cosine | linear
+    warmup_steps: int = 0
+    min_lr: float = 0.0
+    grad_clip: float = 0.0     # global-norm clip; 0 = off
+    # microbatch gradient accumulation inside the jitted step (DP path);
+    # 1 = off.  One accumulated update = one optimizer step.
+    accum_steps: int = 1
     loss: str = "mse"          # mse | cross_entropy
     # how gradients are reduced across the data axis:
     #   global_mean    - exact gradient of the global-batch mean loss (default;
@@ -161,6 +179,9 @@ class TrainConfig:
     # observability (SURVEY.md §5.1/5.5)
     profile_dir: Optional[str] = None
     metrics_jsonl: Optional[str] = None
+    # evaluate on the validation split every N epochs (0 = only after
+    # training); needs data.val_fraction > 0
+    eval_every: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)
@@ -205,6 +226,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    "one full-dataset batch per epoch (reference behavior)")
     p.add_argument("--optimizer", choices=["sgd", "adam", "adamw"], default="sgd")
     p.add_argument("--weight_decay", type=float, default=0.0)
+    p.add_argument("--lr_schedule", choices=["constant", "cosine", "linear"],
+                   default="constant")
+    p.add_argument("--warmup_steps", type=int, default=0)
+    p.add_argument("--min_lr", type=float, default=0.0)
+    p.add_argument("--grad_clip", type=float, default=0.0,
+                   help="global-norm gradient clipping (0 = off)")
+    p.add_argument("--accum_steps", type=int, default=1,
+                   help="microbatch gradient-accumulation factor (DP path)")
     p.add_argument("--loss", choices=["mse", "cross_entropy"], default="mse")
     p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
                    default="global_mean")
@@ -215,12 +244,21 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--n_samples", type=int, default=None,
                    help="dataset size (default: per-dataset)")
     p.add_argument("--n_features", type=int, default=2)
+    p.add_argument("--val_fraction", type=float, default=0.0,
+                   help="held-out validation fraction (makes the reference's "
+                        "dead validation code a real feature)")
+    p.add_argument("--eval_every", type=int, default=0,
+                   help="evaluate on the validation split every N epochs "
+                        "(0 = only after training)")
     p.add_argument("--arch", choices=["mlp", "convnet", "transformer"], default="mlp")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
     p.add_argument("--fsdp", type=int, default=1, help="fsdp axis size")
+    p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
+    p.add_argument("--moe_experts", type=int, default=0,
+                   help="MoE experts per FFN (transformer only; 0 = dense)")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=0)
     _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
@@ -240,6 +278,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         full_batch=full_batch,
         optimizer=args.optimizer,
         weight_decay=args.weight_decay,
+        lr_schedule=args.lr_schedule,
+        warmup_steps=args.warmup_steps,
+        min_lr=args.min_lr,
+        grad_clip=args.grad_clip,
+        accum_steps=args.accum_steps,
         loss=args.loss,
         grad_reduction=args.grad_reduction,
         seed=args.seed,
@@ -248,11 +291,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         resume=args.resume,
         profile_dir=args.profile_dir,
         metrics_jsonl=args.metrics_jsonl,
+        eval_every=args.eval_every,
     )
     cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
-                          seq=args.sp, fsdp=args.fsdp)
+                          seq=args.sp, fsdp=args.fsdp, expert=args.ep)
     cfg.data = DataConfig(dataset=args.dataset, n_samples=args.n_samples,
-                          n_features=args.n_features)
+                          n_features=args.n_features,
+                          val_fraction=args.val_fraction)
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features)
     if args.dataset in ("mnist", "cifar10"):
         cfg.loss = "cross_entropy"
@@ -267,4 +312,11 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     if args.sp > 1:
         # sequence parallelism needs a seq-sharded attention impl
         cfg.model.attention = "ring"
+    if args.moe_experts:
+        cfg.model.moe_experts = args.moe_experts
+    if args.ep > 1:
+        # expert-sharded MoE: route token slots over the 'expert' axis
+        cfg.model.moe_expert_axis = "expert"
+        if not cfg.model.moe_experts:
+            cfg.model.moe_experts = 2 * args.ep
     return cfg

@@ -134,6 +134,31 @@ def lm_dataset(seq_len: int = 128, vocab_size: int = 256, seed: int = 0,
     return {"x": tokens[:, :-1].copy(), "y": tokens[:, 1:].copy()}
 
 
+def train_val_split(data: Arrays, val_fraction: float,
+                    seed: int = 0) -> Tuple[Arrays, Arrays]:
+    """Deterministic shuffled train/validation split.
+
+    Realizes the held-out-eval intent of the reference's dead validation
+    code (dataParallelTraining_NN_MPI.py:213-220, :227-236 — commented out,
+    SURVEY.md C10) as a real feature.  Every host computes the identical
+    split from the seed — no root-rank coordination needed.
+    """
+    if not 0.0 <= val_fraction < 1.0:
+        raise ValueError(f"val_fraction must be in [0, 1), got {val_fraction}")
+    n = next(iter(data.values())).shape[0]
+    n_val = int(round(n * val_fraction))
+    if n_val == 0:
+        return data, {}
+    if n_val >= n:
+        raise ValueError(
+            f"val_fraction={val_fraction} leaves no training samples (n={n})")
+    perm = np.random.default_rng(seed).permutation(n)
+    val_idx, train_idx = perm[:n_val], perm[n_val:]
+    train = {k: v[train_idx] for k, v in data.items()}
+    val = {k: v[val_idx] for k, v in data.items()}
+    return train, val
+
+
 def build_dataset(cfg: DataConfig, data_dir: Optional[str] = None) -> Arrays:
     data_dir = data_dir or os.environ.get("NNPT_DATA_DIR")
     if cfg.dataset == "regression":

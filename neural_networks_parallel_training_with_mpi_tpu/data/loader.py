@@ -38,7 +38,8 @@ class ShardedLoader:
                  full_batch: bool = False, remainder: str = "pad",
                  multi_host: Optional[bool] = None,
                  seq_axis: Optional[str] = None,
-                 backend: str = "numpy"):
+                 backend: str = "numpy",
+                 batch_axes: Optional[tuple] = None):
         if remainder not in ("pad", "drop"):
             raise ValueError("remainder must be 'pad' or 'drop'")
         if backend not in ("numpy", "native", "auto"):
@@ -54,7 +55,10 @@ class ShardedLoader:
         if len(set(lens.values())) != 1:
             raise ValueError(f"ragged dataset: {lens}")
         self.n = next(iter(lens.values()))
-        self.dp = int(np.prod([mesh.shape[a] for a in ("data", "fsdp")]))
+        # axes that jointly shard the batch dim; the expert-parallel path
+        # adds 'expert' (tokens are batch-sharded over it too)
+        self.batch_axes = tuple(batch_axes or ("data", "fsdp"))
+        self.dp = int(np.prod([mesh.shape[a] for a in self.batch_axes]))
         self.batch_size = self.n if full_batch else min(batch_size, self.n)
         self.shuffle = shuffle
         self.seed = seed
@@ -131,10 +135,10 @@ class ShardedLoader:
                 from ..parallel import spmd
 
                 return spmd.place_batch(self.mesh, padded, self.seq_axis)
-            return shd.shard_batch(self.mesh, padded)
+            return shd.shard_batch(self.mesh, padded, self.batch_axes)
         # multi-host: slice out this process's contiguous row block
         total = padded["mask"].shape[0]
         nproc = jax.process_count()
         start, stop = shd.process_local_slice(total, nproc, jax.process_index())
         local = {k: v[start:stop] for k, v in padded.items()}
-        return shd.make_global_batch(self.mesh, local, total)
+        return shd.make_global_batch(self.mesh, local, total, self.batch_axes)

@@ -30,6 +30,8 @@ def build_model(cfg: ModelConfig) -> Module:
             vocab_size=cfg.vocab_size, max_seq_len=cfg.max_seq_len,
             n_layers=cfg.n_layers, d_model=cfg.d_model, n_heads=cfg.n_heads,
             d_ff=cfg.d_ff, attention=cfg.attention, param_dtype=pdt,
-            compute_dtype=cdt, remat=cfg.remat)
+            compute_dtype=cdt, remat=cfg.remat,
+            moe_experts=cfg.moe_experts,
+            moe_expert_axis=cfg.moe_expert_axis)
         return Transformer(tc)
     raise ValueError(f"unknown arch {cfg.arch!r}")

@@ -20,12 +20,38 @@ the reference algorithm.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 Pytree = Any
+# constant lr or a jax-traceable step -> lr schedule (ops.schedules)
+LR = Union[float, Callable[[jax.Array], jax.Array]]
+
+
+def _lr_at(lr: LR, count: jax.Array) -> jax.Array:
+    return lr(count) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def global_norm(grads: Pytree) -> jax.Array:
+    """L2 norm over every leaf of the gradient pytree (float32 accum)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in leaves))
+
+
+def clip_by_global_norm(grads: Pytree, max_norm: float) -> Pytree:
+    """Scale the whole pytree so its global L2 norm is <= ``max_norm``.
+
+    Called on *reduced* (post-psum) gradients inside the train step, so the
+    norm is the true global-batch gradient norm on every replica — there is
+    no per-shard clipping inconsistency.
+    """
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -40,16 +66,19 @@ class Optimizer:
 
 
 class SGDState(NamedTuple):
+    count: jax.Array      # optimizer steps taken (drives lr schedules)
     momentum_buf: Pytree  # matches torch's momentum_buffer
 
 
-def sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimizer:
-    """torch-semantics SGD (see module docstring)."""
+def sgd(lr: LR, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimizer:
+    """torch-semantics SGD (see module docstring); ``lr`` may be a schedule."""
 
     def init(params: Pytree) -> SGDState:
-        return SGDState(jax.tree_util.tree_map(jnp.zeros_like, params))
+        return SGDState(jnp.zeros((), jnp.int32),
+                        jax.tree_util.tree_map(jnp.zeros_like, params))
 
     def update(grads: Pytree, state: SGDState, params: Pytree):
+        lr_t = _lr_at(lr, state.count)
         if weight_decay:
             grads = jax.tree_util.tree_map(
                 lambda g, p: g + weight_decay * p, grads, params)
@@ -60,12 +89,20 @@ def sgd(lr: float, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimize
         else:
             buf = state.momentum_buf
             step = grads
+        # multiply in f32 then cast: lr_t is a strong f32 scalar, and naive
+        # promotion would silently upcast bf16 params
         new_params = jax.tree_util.tree_map(
-            lambda p, s: p - lr * s.astype(p.dtype), params, step)
-        return new_params, SGDState(buf)
+            lambda p, s: (p - (lr_t * s.astype(jnp.float32)).astype(p.dtype)),
+            params, step)
+        return new_params, SGDState(state.count + 1, buf)
+
+    def state_specs(ps):
+        from jax.sharding import PartitionSpec
+
+        return SGDState(PartitionSpec(), ps)
 
     return Optimizer(init, update, f"sgd(lr={lr},m={momentum})",
-                     state_specs=lambda ps: SGDState(ps))
+                     state_specs=state_specs)
 
 
 class AdamState(NamedTuple):
@@ -74,15 +111,16 @@ class AdamState(NamedTuple):
     nu: Pytree
 
 
-def adam(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+def adam(lr: LR, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
          weight_decay: float = 0.0, decoupled: bool = False) -> Optimizer:
-    """Adam / AdamW (``decoupled=True``)."""
+    """Adam / AdamW (``decoupled=True``); ``lr`` may be a schedule."""
 
     def init(params: Pytree) -> AdamState:
         zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
         return AdamState(jnp.zeros((), jnp.int32), zeros(), zeros())
 
     def update(grads: Pytree, state: AdamState, params: Pytree):
+        lr_t = _lr_at(lr, state.count)
         if weight_decay and not decoupled:
             grads = jax.tree_util.tree_map(
                 lambda g, p: g + weight_decay * p, grads, params)
@@ -98,7 +136,7 @@ def adam(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
             upd = m / (jnp.sqrt(v) + eps)
             if weight_decay and decoupled:
                 upd = upd + weight_decay * p
-            return p - lr * upd.astype(p.dtype)
+            return p - (lr_t * upd).astype(p.dtype)
         new_params = jax.tree_util.tree_map(step, params, mu_hat, nu_hat)
         return new_params, AdamState(count, mu, nu)
 
@@ -112,18 +150,38 @@ def adam(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                      state_specs=state_specs)
 
 
-def adamw(lr: float, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+def adamw(lr: LR, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
           weight_decay: float = 0.01) -> Optimizer:
     return adam(lr, b1, b2, eps, weight_decay, decoupled=True)
 
 
-def make(name: str, lr: float, momentum: float = 0.0,
-         weight_decay: float = 0.0) -> Optimizer:
-    """Build from config strings (config.TrainConfig.optimizer)."""
+def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
+    """Clip gradients by global L2 norm before the wrapped update.
+
+    Intended to wrap the *reduced* gradients (the train steps call
+    ``optimizer.update`` after psum), so every replica clips by the same
+    global-batch norm.
+    """
+    if max_norm <= 0:
+        return opt
+
+    def update(grads, state, params):
+        return opt.update(clip_by_global_norm(grads, max_norm), state, params)
+
+    return Optimizer(opt.init, update, f"clip({max_norm}):{opt.name}",
+                     state_specs=opt.state_specs)
+
+
+def make(name: str, lr: LR, momentum: float = 0.0,
+         weight_decay: float = 0.0, grad_clip: float = 0.0) -> Optimizer:
+    """Build from config strings (config.TrainConfig.optimizer).  ``lr`` may
+    be a constant or a schedule from ``ops.schedules.make``."""
     if name == "sgd":
-        return sgd(lr, momentum, weight_decay)
-    if name == "adam":
-        return adam(lr, weight_decay=weight_decay)
-    if name == "adamw":
-        return adamw(lr, weight_decay=weight_decay or 0.01)
-    raise ValueError(f"unknown optimizer {name!r}")
+        opt = sgd(lr, momentum, weight_decay)
+    elif name == "adam":
+        opt = adam(lr, weight_decay=weight_decay)
+    elif name == "adamw":
+        opt = adamw(lr, weight_decay=weight_decay or 0.01)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    return with_clipping(opt, grad_clip)

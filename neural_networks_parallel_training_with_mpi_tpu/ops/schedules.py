@@ -1,0 +1,85 @@
+"""Learning-rate schedules.
+
+The reference has a single constant learning rate (`--lr`, default 0.001,
+dataParallelTraining_NN_MPI.py:245, consumed by ``torch.optim.SGD`` at :91).
+Constant stays the default here; warmup + cosine/linear decay are framework
+extensions for the larger BASELINE.json configs (MNIST/CIFAR/LM), where a
+flat lr is far from standard practice.
+
+A schedule is a jax-traceable ``step -> lr`` function over the *optimizer*
+step count (with gradient accumulation, one accumulated update = one step).
+Everything is ``jnp``-level so schedules work inside jitted train steps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Schedule = Callable[[jax.Array], jax.Array]
+
+
+def constant(lr: float) -> Schedule:
+    def sched(step):
+        del step
+        return jnp.asarray(lr, jnp.float32)
+
+    return sched
+
+
+def _warmup(step: jax.Array, lr: float, warmup_steps: int) -> jax.Array:
+    """Linear 0 -> lr over ``warmup_steps`` (lr at step >= warmup_steps)."""
+    if warmup_steps <= 0:
+        return jnp.asarray(lr, jnp.float32)
+    frac = (step.astype(jnp.float32) + 1.0) / float(warmup_steps)
+    return lr * jnp.minimum(frac, 1.0)
+
+
+def warmup_cosine(lr: float, total_steps: int, warmup_steps: int = 0,
+                  min_lr: float = 0.0) -> Schedule:
+    """Linear warmup then cosine decay to ``min_lr`` at ``total_steps``."""
+    decay_steps = max(total_steps - warmup_steps, 1)
+
+    def sched(step):
+        step = jnp.asarray(step)
+        warm = _warmup(step, lr, warmup_steps)
+        t = jnp.clip((step.astype(jnp.float32) - warmup_steps) / decay_steps,
+                     0.0, 1.0)
+        cos = min_lr + 0.5 * (lr - min_lr) * (1.0 + jnp.cos(jnp.pi * t))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return sched
+
+
+def warmup_linear(lr: float, total_steps: int, warmup_steps: int = 0,
+                  min_lr: float = 0.0) -> Schedule:
+    """Linear warmup then linear decay to ``min_lr`` at ``total_steps``."""
+    decay_steps = max(total_steps - warmup_steps, 1)
+
+    def sched(step):
+        step = jnp.asarray(step)
+        warm = _warmup(step, lr, warmup_steps)
+        t = jnp.clip((step.astype(jnp.float32) - warmup_steps) / decay_steps,
+                     0.0, 1.0)
+        lin = lr + (min_lr - lr) * t
+        return jnp.where(step < warmup_steps, warm, lin)
+
+    return sched
+
+
+SCHEDULES = {"constant": constant, "cosine": warmup_cosine,
+             "linear": warmup_linear}
+
+
+def make(name: str, lr: float, total_steps: int = 0, warmup_steps: int = 0,
+         min_lr: float = 0.0) -> Schedule:
+    """Build from config strings (config.TrainConfig.lr_schedule)."""
+    if name == "constant":
+        return constant(lr)
+    if name not in SCHEDULES:
+        raise ValueError(f"unknown schedule {name!r}; have {sorted(SCHEDULES)}")
+    if total_steps <= 0:
+        raise ValueError(f"schedule {name!r} needs total_steps > 0")
+    return SCHEDULES[name](lr, total_steps, warmup_steps, min_lr)

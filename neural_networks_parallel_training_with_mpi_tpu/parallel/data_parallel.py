@@ -61,21 +61,31 @@ def make_loss_fn(model, loss_name: str) -> Callable[[Pytree, Batch],
 def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
                     loss_name: str = "mse",
                     grad_reduction: str = "global_mean",
-                    donate: bool = True) -> Callable[[TrainState, Batch],
-                                                     Tuple[TrainState, jax.Array]]:
+                    donate: bool = True,
+                    accum_steps: int = 1) -> Callable[[TrainState, Batch],
+                                                      Tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: (state, batch) -> (state, loss).
 
     ``state`` is replicated over the mesh; ``batch`` is dim-0-sharded over
     the data axes.  Uses ``shard_map`` so the collective is explicit — the
     honest TPU translation of the reference's explicitly-communicating
     design, and the shape that scales to TP/PP/SP composition.
+
+    ``accum_steps > 1`` splits each device's shard into that many
+    microbatches and accumulates loss/grad *sums* over a ``lax.scan`` before
+    the single psum + optimizer update — bit-identical math to the unsplit
+    step (sums are associative), trading step latency for peak activation
+    memory.  One train step remains one optimizer step.
     """
     if grad_reduction not in ("global_mean", "per_shard_mean"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     loss_fn = make_loss_fn(model, loss_name)
 
     def shard_step(state: TrainState, batch: Batch):
-        s, c, grads = _sum_and_grads(loss_fn, state.params, batch)
+        s, c, grads = _accumulated_sum_and_grads(
+            loss_fn, state.params, batch, accum_steps)
         if grad_reduction == "global_mean":
             total = lax.psum(c, DATA_AXES)
             grads = jax.tree_util.tree_map(
@@ -109,6 +119,36 @@ def _sum_and_grads(loss_fn, params, batch):
         return s, c
 
     (s, c), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    return s, c, grads
+
+
+def _accumulated_sum_and_grads(loss_fn, params, batch, accum_steps):
+    """Per-shard (loss_sum, count, grad-of-sum), microbatched when
+    ``accum_steps > 1``.  Because every loss returns *sums* (ops.losses),
+    accumulating microbatch sums and grad-sums in f32 is exactly the
+    unsplit computation."""
+    if accum_steps == 1:
+        return _sum_and_grads(loss_fn, params, batch)
+    micro = {}
+    for k, v in batch.items():
+        rows = v.shape[0]
+        if rows % accum_steps != 0:
+            raise ValueError(
+                f"per-device batch rows {rows} (leaf {k!r}) not divisible by "
+                f"accum_steps={accum_steps}")
+        micro[k] = v.reshape((accum_steps, rows // accum_steps) + v.shape[1:])
+
+    def body(carry, mb):
+        cs, cc, cg = carry
+        s, c, g = _sum_and_grads(loss_fn, params, mb)
+        cg = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(jnp.float32), cg, g)
+        return (cs + s, cc + c, cg), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zeros)
+    (s, c, grads), _ = lax.scan(body, init, micro)
     return s, c, grads
 
 

@@ -71,12 +71,18 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                         loss_name: str = "cross_entropy",
                         aux_weight: float = 0.01,
                         donate: bool = True,
-                        batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+                        batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
+                        grad_clip: float = 0.0):
     """(state, batch) -> (state, metrics) jitted over data x fsdp x expert.
 
     ``metrics`` = {"loss": task loss, "aux": mean load-balance loss}.  The
     model's ``moe_expert_axis`` must equal 'expert' when the mesh's expert
     axis is >1 (so MoEFFN issues the all_to_alls).
+
+    ``grad_clip`` clips by the *global* norm: expert-sharded leaves' squared
+    norms are psum'd over 'expert' first — do NOT wrap ``optimizer`` in
+    ``optim.with_clipping`` here (shard-local norms would desynchronize the
+    replicated params across the expert axis).
     """
     c = model.cfg
     ep = int(mesh.shape[EXPERT_AXIS])
@@ -113,6 +119,21 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
             grads)
         metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
                    "aux": lax.pmean(aux, TOKEN_AXES)}
+        if grad_clip > 0:
+            sq_sharded = jnp.zeros((), jnp.float32)
+            sq_rep = jnp.zeros((), jnp.float32)
+            for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+                term = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                if _is_expert_path(path):
+                    sq_sharded = sq_sharded + term
+                else:
+                    sq_rep = sq_rep + term
+            gsq = sq_rep + lax.psum(sq_sharded, EXPERT_AXIS)
+            scale = jnp.minimum(
+                1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                grads)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), metrics
@@ -127,6 +148,40 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_moe_eval_step(model: Transformer, mesh: Mesh,
+                       loss_name: str = "cross_entropy",
+                       with_accuracy: bool = True,
+                       batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+    """Jitted global-mean eval mirroring the train step's layout:
+    (params, batch) -> metrics.  Tokens reduce over all TOKEN_AXES (the
+    expert axis carries batch rows too)."""
+    base = losses_lib.get(loss_name)
+
+    def shard_eval(params, batch):
+        logits, _aux = model.apply(params, batch["x"], return_aux=True)
+        s, c = base(logits, batch["y"], batch.get("mask"))
+        total = lax.psum(c, TOKEN_AXES)
+        out = {"loss": lax.psum(s, TOKEN_AXES) / total, "count": total}
+        if with_accuracy:
+            hs, hc = losses_lib.accuracy(logits, batch["y"],
+                                         batch.get("mask"))
+            ex_total = lax.psum(hc, TOKEN_AXES)
+            out["accuracy"] = lax.psum(hs, TOKEN_AXES) / ex_total
+            out["example_count"] = ex_total
+        return out
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    pspecs = moe_param_specs(dummy)
+    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    mapped = jax.shard_map(
+        shard_eval, mesh=mesh,
+        in_specs=(pspecs, batch_specs),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
 
 
 def run_one_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,

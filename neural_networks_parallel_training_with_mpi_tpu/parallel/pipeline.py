@@ -119,13 +119,20 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
                              mesh: Mesh, loss_name: str = "cross_entropy",
                              n_microbatches: Optional[int] = None,
                              donate: bool = True,
-                             batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+                             batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
+                             grad_clip: float = 0.0):
     """(state, batch) -> (state, loss), jitted over data x pipe.
 
     ``batch`` is ``{"x": (B, T) int32, "y": (B, T), "mask": (B,)}`` (mask
     optional — drop it from ``batch_keys`` too) with the per-data-shard rows
     divisible by ``n_microbatches`` (default: the number of pipeline stages —
     the minimum that keeps every stage busy once full).
+
+    ``grad_clip`` clips by the *global* gradient norm: block grads are
+    pipe-sharded after reduction, so their squared norms are psum'd over
+    'pipe' before the norm — do NOT wrap ``optimizer`` in
+    ``optim.with_clipping`` here (its norm would be shard-local and would
+    desynchronize the pipe-replicated params).
     """
     c = model.cfg
     n_stages = int(mesh.shape[PIPE_AXIS])
@@ -223,6 +230,17 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
             for k, v in grads.items()
         }
         loss = lax.psum(s, reduce_axes) / total
+        if grad_clip > 0:
+            sq = {k: sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                         for l in jax.tree_util.tree_leaves(v))
+                  for k, v in grads.items()}
+            gsq = sum(v for k, v in sq.items() if k != "blocks") \
+                + lax.psum(sq["blocks"], PIPE_AXIS)
+            scale = jnp.minimum(
+                1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                grads)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), loss

@@ -85,9 +85,12 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch: Pytree) -> Pytree:
+def shard_batch(mesh: Mesh, batch: Pytree,
+                batch_axes: Tuple[str, ...] = ("data", "fsdp")) -> Pytree:
     """Place a host-global batch pytree onto the mesh, dim-0-sharded over
-    'data' (single-host path: every leaf holds the full global batch).
+    ``batch_axes`` (single-host path: every leaf holds the full global
+    batch).  The expert-parallel path passes data+fsdp+expert, since the
+    expert axis carries its own batch slice (parallel.expert).
 
     Multi-host path: use ``make_global_batch`` instead, where each process
     holds only its local rows (unlike the reference, which materializes the
@@ -95,12 +98,13 @@ def shard_batch(mesh: Mesh, batch: Pytree) -> Pytree:
 
     def put(x):
         x = np.asarray(x)
-        return jax.device_put(x, batch_sharding(mesh, x.ndim))
+        return jax.device_put(x, batch_sharding(mesh, x.ndim, batch_axes))
 
     return jax.tree_util.tree_map(put, batch)
 
 
-def make_global_batch(mesh: Mesh, local_batch: Pytree, global_rows: int) -> Pytree:
+def make_global_batch(mesh: Mesh, local_batch: Pytree, global_rows: int,
+                      batch_axes: Tuple[str, ...] = ("data", "fsdp")) -> Pytree:
     """Assemble a logically-global, data-sharded array from per-process local
     rows (multi-host).  Each host materializes only its shard — the scalable
     replacement for root-materializes-everything (+Scatterv) at :72/:138."""
@@ -109,7 +113,7 @@ def make_global_batch(mesh: Mesh, local_batch: Pytree, global_rows: int) -> Pytr
         x = np.asarray(x)
         global_shape = (global_rows,) + x.shape[1:]
         return jax.make_array_from_process_local_data(
-            batch_sharding(mesh, x.ndim), x, global_shape
+            batch_sharding(mesh, x.ndim, batch_axes), x, global_shape
         )
 
     return jax.tree_util.tree_map(assemble, local_batch)

@@ -21,6 +21,7 @@ from ..data.datasets import build_dataset
 from ..data.loader import ShardedLoader
 from ..models.registry import build_model
 from ..ops import optim as optim_lib
+from ..ops import schedules
 from ..parallel import data_parallel as dp
 from ..parallel.mesh import describe, make_mesh, world_setup
 from ..utils import profiling, prng
@@ -33,20 +34,30 @@ class Trainer:
         self.cfg = cfg
         world_setup()
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
-        for axis in ("pipe", "expert"):
-            if self.mesh.shape.get(axis, 1) > 1:
-                raise NotImplementedError(
-                    f"mesh axis {axis!r} > 1 is not wired into Trainer yet; "
-                    "use parallel.pipeline directly")
         self.seq_parallel = self.mesh.shape.get("seq", 1) > 1
+        self.pipeline = self.mesh.shape.get("pipe", 1) > 1
+        self.expert = self.mesh.shape.get("expert", 1) > 1
         # GSPMD (jit + sharding annotations) when params are sharded;
         # explicit shard_map otherwise
         self.gspmd = (self.mesh.shape.get("tensor", 1) > 1
                       or self.mesh.shape.get("fsdp", 1) > 1)
-        if self.seq_parallel and self.gspmd:
+        exclusive = [name for name, on in
+                     (("seq", self.seq_parallel), ("tensor/fsdp", self.gspmd),
+                      ("pipe", self.pipeline), ("expert", self.expert)) if on]
+        if len(exclusive) > 1:
             raise NotImplementedError(
-                "seq x tensor/fsdp composition is not wired into Trainer "
-                "yet; use parallel.spmd/gspmd directly")
+                f"Trainer wires one non-data parallelism style at a time, "
+                f"got {exclusive}; compose parallel.* step builders directly "
+                "for mixed meshes")
+        if self.pipeline and cfg.model.arch != "transformer":
+            raise ValueError("pipe axis > 1 requires the transformer model")
+        if self.expert and (cfg.model.arch != "transformer"
+                            or cfg.model.moe_experts <= 0):
+            raise ValueError("expert axis > 1 requires a transformer with "
+                             "moe_experts > 0 (--moe_experts)")
+        if (self.pipeline or self.expert) and cfg.grad_reduction != "global_mean":
+            raise ValueError("pipeline/expert steps always use global_mean "
+                             "gradient semantics")
         if self.gspmd and cfg.grad_reduction != "global_mean":
             raise ValueError(
                 "grad_reduction='per_shard_mean' (the reference's :188-197 "
@@ -55,15 +66,68 @@ class Trainer:
         self.model = build_model(cfg.model)
         if self.seq_parallel and cfg.model.arch != "transformer":
             raise ValueError("seq axis > 1 requires the transformer model")
-        self.optimizer = optim_lib.make(cfg.optimizer, cfg.lr, cfg.momentum,
-                                        cfg.weight_decay)
         self.data = data if data is not None else build_dataset(cfg.data)
+        self.val_data: Optional[Dict[str, np.ndarray]] = None
+        if cfg.data.val_fraction > 0:
+            from ..data.datasets import train_val_split
+
+            self.data, val = train_val_split(self.data,
+                                             cfg.data.val_fraction, cfg.seed)
+            self.val_data = val or None
+        # the expert axis carries batch rows too (parallel.expert layout)
+        self.batch_axes = (("data", "fsdp", "expert") if self.expert
+                           else ("data", "fsdp"))
         self.loader = ShardedLoader(
             self.mesh, self.data, cfg.batch_size, shuffle=cfg.shuffle,
             seed=cfg.seed, full_batch=cfg.full_batch,
             remainder=cfg.data.remainder,
-            seq_axis="seq" if self.seq_parallel else None)
-        if self.seq_parallel:
+            seq_axis="seq" if self.seq_parallel else None,
+            batch_axes=self.batch_axes)
+        # schedule domain: optimizer steps = train steps (accumulation is
+        # inside the step), known once the loader fixes steps-per-epoch
+        lr = schedules.make(
+            cfg.lr_schedule, cfg.lr,
+            total_steps=cfg.nepochs * max(self.loader.steps_per_epoch, 1),
+            warmup_steps=cfg.warmup_steps, min_lr=cfg.min_lr)
+        # pipeline/expert steps clip inside the step (their grad leaves are
+        # axis-sharded; optim.with_clipping's shard-local norm would be
+        # wrong there — see make_pipeline_train_step / make_moe_train_step)
+        step_clips = self.pipeline or self.expert
+        self.optimizer = optim_lib.make(
+            cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
+            grad_clip=0.0 if step_clips else cfg.grad_clip)
+        if cfg.accum_steps > 1 and (self.gspmd or self.seq_parallel
+                                    or self.pipeline or self.expert):
+            raise NotImplementedError(
+                "accum_steps > 1 is wired into the pure-DP shard_map path "
+                "only; the other parallel steps run unaccumulated")
+        if self.pipeline:
+            from ..parallel import pipeline as pp
+
+            self.train_step = pp.make_pipeline_train_step(
+                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                grad_clip=cfg.grad_clip)
+            # eval runs the *dense* model on pipe-gathered params
+            # (_eval_params); same math, no pipelining needed off the hot path
+            self.eval_step = dp.make_eval_step(
+                self.model, self.mesh, loss_name=cfg.loss,
+                with_accuracy=(cfg.loss == "cross_entropy"))
+        elif self.expert:
+            from ..parallel import expert as ep_lib
+
+            moe_step = ep_lib.make_moe_train_step(
+                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                grad_clip=cfg.grad_clip)
+
+            def train_step(state, batch):
+                state, metrics = moe_step(state, batch)
+                return state, metrics["loss"]
+
+            self.train_step = train_step
+            self.eval_step = ep_lib.make_moe_eval_step(
+                self.model, self.mesh, loss_name=cfg.loss,
+                with_accuracy=(cfg.loss == "cross_entropy"))
+        elif self.seq_parallel:
             from ..parallel import spmd
 
             example = next(iter(self.loader.epoch(0)))
@@ -88,7 +152,8 @@ class Trainer:
         else:
             self.train_step = dp.make_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
-                grad_reduction=cfg.grad_reduction)
+                grad_reduction=cfg.grad_reduction,
+                accum_steps=cfg.accum_steps)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
@@ -100,9 +165,23 @@ class Trainer:
         """Deterministic init — every host derives identical params from the
         job seed (replaces the reference's rank-0 state-dict bcast, :87-88);
         placement is replicated for DP/SP or TP/FSDP-sharded for GSPMD."""
+        if self.pipeline:
+            from ..parallel import pipeline as pp
+
+            state = pp.init_pipeline_state(
+                self.model, self.optimizer, prng.init_key(self.cfg.seed),
+                int(self.mesh.shape["pipe"]))
+            self.state = pp.shard_pipeline_state(state, self.mesh,
+                                                 self.optimizer)
+            return self.state
         state = TrainState.create(self.model, self.optimizer,
                                   prng.init_key(self.cfg.seed))
-        if self.gspmd:
+        if self.expert:
+            from ..parallel import expert as ep_lib
+
+            self.state = ep_lib.shard_moe_state(state, self.mesh,
+                                                self.optimizer)
+        elif self.gspmd:
             from ..parallel import gspmd
 
             self.state = gspmd.shard_state(self.model, state, self.optimizer,
@@ -122,7 +201,17 @@ class Trainer:
         restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
         if restored is None:
             return 0
-        if self.gspmd:
+        if self.pipeline:
+            from ..parallel import pipeline as pp
+
+            self.state = pp.shard_pipeline_state(restored, self.mesh,
+                                                 self.optimizer)
+        elif self.expert:
+            from ..parallel import expert as ep_lib
+
+            self.state = ep_lib.shard_moe_state(restored, self.mesh,
+                                                self.optimizer)
+        elif self.gspmd:
             from ..parallel import gspmd
 
             self.state = gspmd.shard_state(self.model, restored,
@@ -163,6 +252,7 @@ class Trainer:
         # on it does not stall the pipeline.
         step = start_step
         prev: Optional[tuple] = None  # (step, epoch, loss_future)
+        last_eval: Optional[tuple] = None  # (step, metrics dict)
         with profiler:
             for epoch in range(start_epoch, cfg.nepochs):
                 log(f"Starting epoch {epoch + 1}")  # reference banner, :152
@@ -193,25 +283,64 @@ class Trainer:
                     last_loss = float(jax.device_get(loss))
                 log(f"epoch {epoch + 1}: loss {last_loss:.6f} "
                     f"({time.perf_counter() - epoch_t0:.3f}s)")
+                # periodic held-out eval (the reference's :213-220 intent)
+                if (self.val_data is not None and cfg.eval_every
+                        and (epoch + 1) % cfg.eval_every == 0):
+                    ev = self.evaluate(self.val_data)
+                    last_eval = (step, ev)
+                    log("validation: " + ", ".join(
+                        f"{k} {v:.6f}" for k, v in sorted(ev.items())))
+                    self.metrics.write({"step": step, "epoch": epoch,
+                                        **{f"val_{k}": v
+                                           for k, v in ev.items()}})
         if prev is not None and cfg.log_every and prev[0] % cfg.log_every == 0:
             self.metrics.write({"step": prev[0], "epoch": prev[1],
                                 "loss": last_loss,
                                 "samples_per_sec": thr.samples_per_sec})
         self.save()
+        result = {"final_loss": last_loss,
+                  "steps": step,
+                  "samples_per_sec": thr.samples_per_sec,
+                  **timer.stats()}
+        # post-training held-out eval (the reference's :227-236 intent);
+        # reuse the periodic eval when it already ran at this exact step
+        if self.val_data is not None:
+            if last_eval is not None and last_eval[0] == step:
+                ev = last_eval[1]
+            else:
+                ev = self.evaluate(self.val_data)
+                self.metrics.write({"step": step, "final": True,
+                                    **{f"val_{k}": v for k, v in ev.items()}})
+            result.update({f"val_{k}": v for k, v in ev.items()})
         self.metrics.close()
-        return {"final_loss": last_loss,
-                "steps": step,
-                "samples_per_sec": thr.samples_per_sec,
-                **timer.stats()}
+        return result
+
+    def _eval_params(self):
+        """Params in the layout the eval step expects.  The pipelined state
+        keeps blocks stage-stacked and pipe-sharded; eval runs the dense
+        model, so gather them to host, unstack, and re-place replicated
+        (single-host path — pipelined multi-host eval would need its own
+        pipelined eval step)."""
+        if not self.pipeline:
+            return self.state.params
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel import pipeline as pp
+
+        params = dict(jax.device_get(self.state.params))
+        params["blocks"] = pp.unstack_blocks(params["blocks"])
+        return jax.device_put(params, NamedSharding(self.mesh, P()))
 
     def evaluate(self, data: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, float]:
         loader = self.loader if data is None else ShardedLoader(
             self.mesh, data, self.cfg.batch_size, shuffle=False,
-            seed=self.cfg.seed, full_batch=self.cfg.full_batch)
+            seed=self.cfg.seed, full_batch=self.cfg.full_batch,
+            batch_axes=self.batch_axes)
+        params = self._eval_params()
         sums: Dict[str, float] = {}
         totals: Dict[str, float] = {}
         for batch in loader.epoch(0):
-            m = jax.device_get(self.eval_step(self.state.params, batch))
+            m = jax.device_get(self.eval_step(params, batch))
             c = float(m.pop("count"))
             ec = float(m.pop("example_count", c))
             for k, v in m.items():

@@ -142,7 +142,9 @@ def _restore_npz(path: Path, template: Optional[TrainState]
         if t_treedef != treedef:
             raise ValueError(
                 f"checkpoint structure mismatch: saved {treedef}, "
-                f"expected {t_treedef} — wrong model/optimizer config?")
+                f"expected {t_treedef} — wrong model/optimizer config, or a "
+                "checkpoint written by an older framework version (e.g. "
+                "SGDState gained a 'count' field)?")
         for i, (saved, want) in enumerate(zip(leaves, t_leaves)):
             w_shape = tuple(np.shape(want))
             if tuple(saved.shape) != w_shape:

@@ -41,9 +41,11 @@ def test_sp_through_trainer(devices):
     assert np.isfinite(result["final_loss"])
 
 
-def test_pipe_guard_raises(mesh8):
+def test_pipe_requires_transformer(mesh8):
+    # pipe>1 is wired into Trainer now (test_trainer_pp_ep), but only for
+    # stage-splittable models — the default MLP must be rejected up front
     cfg = TrainConfig(mesh=MeshConfig(data=4, pipe=2))
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(ValueError, match="transformer"):
         Trainer(cfg)
 
 
