@@ -53,8 +53,14 @@ def bench_framework() -> float:
     devices = jax.devices()
     log(f"framework devices: {devices}")
     mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    # TPU: bfloat16 matmuls feed the MXU at 2x the f32 rate (params and the
+    # loss stay f32 — ops.losses accumulates in f32).  CPU smoke runs keep
+    # f32: host bf16 is emulated and would only slow the hermetic test.
+    on_tpu = devices[0].platform not in ("cpu",)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    log(f"compute dtype: {compute_dtype.__name__}")
     model = wide_mlp(in_features=IN_FEATURES, width=WIDTH, depth=DEPTH,
-                     compute_dtype=jnp.float32)
+                     compute_dtype=compute_dtype)
     opt = optim.sgd(lr=1e-4, momentum=0.9)
     state = TrainState.create(model, opt, prng.init_key(0))
     state = dp.replicate_state(state, mesh)

@@ -182,6 +182,10 @@ class TrainConfig:
     # evaluate on the validation split every N epochs (0 = only after
     # training); needs data.val_fraction > 0
     eval_every: int = 0
+    # verify replicated state stays bit-identical across device shards
+    # every N steps (0 = off) — the SPMD analogue of a race detector
+    # (utils.consistency; SURVEY.md §5.2: the reference has none)
+    check_replicas_every: int = 0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)
@@ -238,6 +242,7 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)
+    _add_bool_flag(p, "shuffle", True, "shuffle batches each epoch")
     p.add_argument("--dataset",
                    choices=["regression", "wide_regression", "mnist", "cifar10", "lm"],
                    default="regression")
@@ -251,6 +256,22 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="evaluate on the validation split every N epochs "
                         "(0 = only after training)")
     p.add_argument("--arch", choices=["mlp", "convnet", "transformer"], default="mlp")
+    # precision / memory (TPU knobs: bfloat16 feeds the MXU at 2x the f32
+    # rate; remat trades recompute FLOPs for HBM)
+    p.add_argument("--dtype", choices=["float32", "bfloat16", "float16"],
+                   default="float32", help="parameter dtype")
+    p.add_argument("--compute_dtype", choices=["float32", "bfloat16", "float16"],
+                   default=None,
+                   help="matmul/activation dtype (default: same as --dtype)")
+    _add_bool_flag(p, "remat", False,
+                   "rematerialize transformer blocks (jax.checkpoint)")
+    # transformer size knobs (BASELINE.json config #5 sweeps)
+    p.add_argument("--n_layers", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=128)
+    p.add_argument("--n_heads", type=int, default=4)
+    p.add_argument("--d_ff", type=int, default=512)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--vocab_size", type=int, default=256)
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
@@ -264,6 +285,9 @@ def build_argparser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--check_replicas_every", type=int, default=0,
+                   help="assert replicated state is bit-identical across "
+                        "device shards every N steps (0 = off)")
     return p
 
 
@@ -286,26 +310,38 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         loss=args.loss,
         grad_reduction=args.grad_reduction,
         seed=args.seed,
+        shuffle=args.shuffle,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
         profile_dir=args.profile_dir,
         metrics_jsonl=args.metrics_jsonl,
         eval_every=args.eval_every,
+        check_replicas_every=args.check_replicas_every,
     )
     cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
                           seq=args.sp, fsdp=args.fsdp, expert=args.ep)
     cfg.data = DataConfig(dataset=args.dataset, n_samples=args.n_samples,
                           n_features=args.n_features,
-                          val_fraction=args.val_fraction)
-    cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features)
+                          val_fraction=args.val_fraction,
+                          seq_len=args.seq_len, vocab_size=args.vocab_size)
+    cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
+                            dtype=args.dtype,
+                            compute_dtype=args.compute_dtype or args.dtype,
+                            remat=args.remat,
+                            n_layers=args.n_layers, d_model=args.d_model,
+                            n_heads=args.n_heads, d_ff=args.d_ff,
+                            vocab_size=args.vocab_size,
+                            max_seq_len=max(args.seq_len, 512))
     if args.dataset in ("mnist", "cifar10"):
         cfg.loss = "cross_entropy"
     if args.dataset == "mnist":
-        cfg.model = ModelConfig(arch="mlp", in_features=784,
-                                hidden=(256, 128), out_features=10)
+        cfg.model = dataclasses.replace(
+            cfg.model, arch="mlp", in_features=784, hidden=(256, 128),
+            out_features=10)
     if args.dataset == "cifar10":
-        cfg.model = ModelConfig(arch="convnet", out_features=10)
+        cfg.model = dataclasses.replace(cfg.model, arch="convnet",
+                                        out_features=10)
     if args.dataset == "lm":
         cfg.loss = "cross_entropy"
         cfg.model.arch = "transformer"

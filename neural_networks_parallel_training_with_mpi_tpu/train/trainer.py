@@ -277,6 +277,12 @@ class Trainer:
                     if (cfg.checkpoint_every and
                             step % cfg.checkpoint_every == 0):
                         self.save()
+                    if (cfg.check_replicas_every and
+                            step % cfg.check_replicas_every == 0):
+                        from ..utils import consistency
+
+                        consistency.assert_replicated(
+                            self.state, what=f"train state @ step {step}")
                 # per-epoch loss line (reference :224, but one global line
                 # instead of N interleaved per-rank prints)
                 if loss is not None:

@@ -1,0 +1,88 @@
+"""Replica-consistency checking (the SPMD analogue of race detection).
+
+The reference has no sanitizers (SURVEY.md §5.2); its correctness rests on
+an *implicit* invariant — every rank's model/optimizer state stays
+bit-identical because every rank applies the identical averaged gradient
+(dataParallelTraining_NN_MPI.py:206-211).  A lost message or a
+nondeterministic kernel would silently desynchronize replicas, and nothing
+in the reference would ever notice.
+
+Here the invariant is explicit and checkable: replicated arrays (sharding
+``P()``) must hold bit-identical values on every device shard.  Divergence
+can only come from a bug (e.g. a ``shard_map`` body whose out_spec claims
+replication the math doesn't guarantee, hidden by ``check_vma=False``) or
+from flaky hardware — both things a periodic check catches early.  The
+Trainer exposes it as ``--check_replicas_every N``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def _leaf_paths(tree: Pytree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield jax.tree_util.keystr(path), leaf
+
+
+def replica_divergence(tree: Pytree) -> Dict[str, float]:
+    """Max |shard - shard0| per *replicated* leaf, over this process's
+    addressable shards.  Non-replicated (genuinely sharded) leaves and
+    non-jax leaves are skipped.  An all-zero result is the healthy state."""
+    out: Dict[str, float] = {}
+    for name, leaf in _leaf_paths(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not getattr(sharding, "is_fully_replicated", False):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) < 2:
+            continue
+        ref = np.asarray(shards[0].data)
+        worst = 0.0
+        for s in shards[1:]:
+            arr = np.asarray(s.data)
+            if arr.dtype != ref.dtype or arr.shape != ref.shape:
+                worst = float("inf")
+                break
+            # jnp.issubdtype, not np: ml_dtypes' bfloat16/float16 extension
+            # dtypes are not np.floating subdtypes, and falling into the
+            # exact-equality branch would report inf for a 1-ulp divergence
+            import jax.numpy as jnp
+
+            if jnp.issubdtype(ref.dtype, jnp.floating):
+                worst = max(worst, float(
+                    np.max(np.abs(arr.astype(np.float64)
+                                  - ref.astype(np.float64)), initial=0.0)))
+            elif not np.array_equal(arr, ref):
+                worst = float("inf")
+        out[name] = worst
+    return out
+
+
+def check_replicas(tree: Pytree, atol: float = 0.0) -> Dict[str, float]:
+    """Return only the diverged leaves (> atol).  Empty dict == healthy."""
+    return {k: v for k, v in replica_divergence(tree).items() if v > atol}
+
+
+def assert_replicated(tree: Pytree, atol: float = 0.0,
+                      what: str = "state") -> None:
+    """Raise if any replicated leaf differs across local device shards.
+
+    Multi-host note: this checks the local process's shards; combine with
+    :func:`parallel.distributed.assert_same_across_hosts` for a cross-host
+    sweep (each host's replicated shards are compared locally first, which
+    is where XLA-level divergence shows up)."""
+    bad = check_replicas(tree, atol)
+    if bad:
+        worst = sorted(bad.items(), key=lambda kv: -kv[1])[:5]
+        raise AssertionError(
+            f"replica divergence in {what}: {len(bad)} replicated leaves "
+            f"differ across device shards (worst: {worst}); a shard_map "
+            "out_spec probably claims replication the computation does not "
+            "guarantee, or hardware is flaky")

@@ -111,3 +111,35 @@ def test_expert_grad_clip_keeps_replicas_identical():
     shards = [np.asarray(s.data) for s in emb.addressable_shards]
     for s in shards[1:]:
         np.testing.assert_array_equal(shards[0], s)
+
+
+def test_cli_precision_flags():
+    args = build_argparser().parse_args(
+        ["--dataset", "mnist", "--dtype", "bfloat16", "--remat"])
+    cfg = config_from_args(args)
+    assert cfg.model.dtype == "bfloat16"
+    assert cfg.model.compute_dtype == "bfloat16"
+    assert cfg.model.remat and cfg.model.arch == "mlp"
+    args2 = build_argparser().parse_args(
+        ["--dataset", "lm", "--dtype", "float32",
+         "--compute_dtype", "bfloat16", "--n_layers", "3",
+         "--d_model", "64", "--seq_len", "32"])
+    cfg2 = config_from_args(args2)
+    assert cfg2.model.compute_dtype == "bfloat16"
+    assert cfg2.model.n_layers == 3 and cfg2.model.d_model == 64
+    assert cfg2.data.seq_len == 32
+
+
+def test_bfloat16_training_runs():
+    import jax.numpy as jnp
+
+    cfg = _lm_cfg(data=8)
+    cfg.model = dataclasses.replace(cfg.model, compute_dtype="bfloat16")
+    t = Trainer(cfg)
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    # params stay in the declared param dtype
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(t.state.params)[0]
+    assert leaf.dtype == jnp.float32
