@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# The reference's job, verbatim semantics (its four flags, now typed):
+#   mpiexec -n N python dataParallelTraining_NN_MPI.py --lr 0.001 \
+#       --momentum 0.9 --batch_size 4 --nepochs 3        (README.md:12)
+# Parallelism comes from the device mesh instead of mpiexec.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --lr 0.001 --momentum 0.9 --batch_size 4 --nepochs 3

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Mixture-of-experts transformer: expert weights sharded over the 'expert'
+# axis, token slots exchanged by all_to_all (GShard arrangement).
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --dp 4 --ep 2 --moe_experts 4

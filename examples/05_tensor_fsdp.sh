@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Megatron-style tensor parallelism x ZeRO-style parameter/optimizer
+# sharding, expressed as GSPMD sharding annotations — XLA inserts the
+# all-gathers/reduce-scatters.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --dataset lm --no-full-batch --batch_size 16 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --dp 2 --tp 2 --fsdp 2

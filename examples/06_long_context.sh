@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Sequence/context parallelism: the sequence dimension is sharded over the
+# 'seq' axis and attention runs as a ring (K/V blocks rotate by ppermute),
+# so context length scales with the number of chips.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --dataset lm --seq_len 256 --no-full-batch --batch_size 8 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --dp 4 --sp 2

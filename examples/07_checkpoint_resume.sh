@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Checkpoint mid-training, then resume at the exact next step (the
+# reference has no save/load at all — SURVEY.md §5.4).
+set -euo pipefail
+CKPT=$(mktemp -d)
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --n_samples 1024 --no-full-batch --batch_size 64 --nepochs 2 \
+    --checkpoint_dir "$CKPT" --checkpoint_every 8
+echo "--- resuming ---"
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --n_samples 1024 --no-full-batch --batch_size 64 --nepochs 4 \
+    --checkpoint_dir "$CKPT" --resume

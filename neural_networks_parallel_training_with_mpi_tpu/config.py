@@ -102,6 +102,9 @@ class DataConfig:
     # held-out validation fraction (0 = train on everything, the reference
     # default; its own validation/test blocks are dead code — SURVEY.md C10)
     val_fraction: float = 0.0
+    # batch assembly backend: numpy (in-process), native (C++ threaded
+    # shuffle/gather/prefetch runtime, data.native_loader), or auto
+    backend: str = "numpy"
 
 
 @dataclass
@@ -125,7 +128,7 @@ class ModelConfig:
     d_ff: int = 512
     vocab_size: int = 256
     max_seq_len: int = 512
-    attention: str = "dense"  # dense | ring | ulysses (seq-parallel impls)
+    attention: str = "dense"  # dense | flash (pallas) | ring | ulysses
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
@@ -186,6 +189,9 @@ class TrainConfig:
     # every N steps (0 = off) — the SPMD analogue of a race detector
     # (utils.consistency; SURVEY.md §5.2: the reference has none)
     check_replicas_every: int = 0
+    # fail fast if no step completes within this many seconds (0 = off);
+    # the reference hangs forever on a lost rank (utils.watchdog, §5.3)
+    hang_timeout: float = 0.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)
@@ -249,6 +255,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--n_samples", type=int, default=None,
                    help="dataset size (default: per-dataset)")
     p.add_argument("--n_features", type=int, default=2)
+    p.add_argument("--data_backend", choices=["numpy", "native", "auto"],
+                   default="numpy",
+                   help="batch assembly: in-process numpy or the C++ "
+                        "threaded prefetch runtime (native/)")
     p.add_argument("--val_fraction", type=float, default=0.0,
                    help="held-out validation fraction (makes the reference's "
                         "dead validation code a real feature)")
@@ -272,6 +282,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--d_ff", type=int, default=512)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--vocab_size", type=int, default=256)
+    p.add_argument("--attention",
+                   choices=["dense", "flash", "ring", "ulysses"], default=None,
+                   help="attention impl (default: dense; ring when --sp > 1; "
+                        "flash = blocked pallas kernel)")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
@@ -288,6 +302,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--check_replicas_every", type=int, default=0,
                    help="assert replicated state is bit-identical across "
                         "device shards every N steps (0 = off)")
+    p.add_argument("--hang_timeout", type=float, default=0.0,
+                   help="abort with thread stacks if no step completes "
+                        "within this many seconds (0 = off)")
     return p
 
 
@@ -318,13 +335,15 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         metrics_jsonl=args.metrics_jsonl,
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
+        hang_timeout=args.hang_timeout,
     )
     cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
                           seq=args.sp, fsdp=args.fsdp, expert=args.ep)
     cfg.data = DataConfig(dataset=args.dataset, n_samples=args.n_samples,
                           n_features=args.n_features,
                           val_fraction=args.val_fraction,
-                          seq_len=args.seq_len, vocab_size=args.vocab_size)
+                          seq_len=args.seq_len, vocab_size=args.vocab_size,
+                          backend=args.data_backend)
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
                             dtype=args.dtype,
                             compute_dtype=args.compute_dtype or args.dtype,
@@ -348,6 +367,16 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     if args.sp > 1:
         # sequence parallelism needs a seq-sharded attention impl
         cfg.model.attention = "ring"
+    if args.attention:
+        if args.sp > 1 and args.attention not in ("ring", "ulysses"):
+            raise SystemExit(
+                f"--attention {args.attention} cannot shard the sequence "
+                "axis; --sp > 1 needs ring or ulysses")
+        if args.sp <= 1 and args.attention in ("ring", "ulysses"):
+            raise SystemExit(
+                f"--attention {args.attention} needs a sequence-sharded "
+                "mesh; pass --sp > 1 (or use dense/flash)")
+        cfg.model.attention = args.attention
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
     if args.ep > 1:

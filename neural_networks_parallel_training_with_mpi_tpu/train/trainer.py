@@ -58,6 +58,19 @@ class Trainer:
         if (self.pipeline or self.expert) and cfg.grad_reduction != "global_mean":
             raise ValueError("pipeline/expert steps always use global_mean "
                              "gradient semantics")
+        if (cfg.model.arch == "transformer"
+                and cfg.model.attention in ("ring", "ulysses")
+                and not self.seq_parallel):
+            raise ValueError(
+                f"attention={cfg.model.attention!r} needs the 'seq' mesh "
+                "axis > 1 (--sp); use dense or flash on an unsharded "
+                "sequence")
+        if cfg.hang_timeout and not cfg.log_every:
+            raise ValueError(
+                "--hang_timeout needs log_every > 0: the periodic loss "
+                "device_get is the loop's only blocking point, and without "
+                "it async dispatch would keep patting the watchdog while "
+                "the device is wedged")
         if self.gspmd and cfg.grad_reduction != "global_mean":
             raise ValueError(
                 "grad_reduction='per_shard_mean' (the reference's :188-197 "
@@ -82,7 +95,8 @@ class Trainer:
             seed=cfg.seed, full_batch=cfg.full_batch,
             remainder=cfg.data.remainder,
             seq_axis="seq" if self.seq_parallel else None,
-            batch_axes=self.batch_axes)
+            batch_axes=self.batch_axes,
+            backend=cfg.data.backend)
         # schedule domain: optimizer steps = train steps (accumulation is
         # inside the step), known once the loader fixes steps-per-epoch
         lr = schedules.make(
@@ -253,7 +267,13 @@ class Trainer:
         step = start_step
         prev: Optional[tuple] = None  # (step, epoch, loss_future)
         last_eval: Optional[tuple] = None  # (step, metrics dict)
-        with profiler:
+        # hang watchdog (SURVEY.md §5.3): with log_every on, the loop blocks
+        # in device_get on the previous step's loss, so a stalled device
+        # stalls the pats and the watchdog fires instead of hanging forever
+        from ..utils.watchdog import HangWatchdog
+
+        watchdog = HangWatchdog(cfg.hang_timeout or None)
+        with profiler, watchdog:
             for epoch in range(start_epoch, cfg.nepochs):
                 log(f"Starting epoch {epoch + 1}")  # reference banner, :152
                 epoch_t0 = time.perf_counter()
@@ -270,19 +290,22 @@ class Trainer:
                             "samples_per_sec": thr.samples_per_sec,
                         })
                     self.state, loss = self.train_step(self.state, batch)
+                    watchdog.pat()
                     timer.tick()
                     thr.add(self.loader.batch_rows(epoch_start_step + i))
                     step += 1
                     prev = (step, epoch, loss)
                     if (cfg.checkpoint_every and
                             step % cfg.checkpoint_every == 0):
-                        self.save()
+                        with watchdog.suspended():
+                            self.save()
                     if (cfg.check_replicas_every and
                             step % cfg.check_replicas_every == 0):
                         from ..utils import consistency
 
-                        consistency.assert_replicated(
-                            self.state, what=f"train state @ step {step}")
+                        with watchdog.suspended():
+                            consistency.assert_replicated(
+                                self.state, what=f"train state @ step {step}")
                 # per-epoch loss line (reference :224, but one global line
                 # instead of N interleaved per-rank prints)
                 if loss is not None:
@@ -292,7 +315,8 @@ class Trainer:
                 # periodic held-out eval (the reference's :213-220 intent)
                 if (self.val_data is not None and cfg.eval_every
                         and (epoch + 1) % cfg.eval_every == 0):
-                    ev = self.evaluate(self.val_data)
+                    with watchdog.suspended():
+                        ev = self.evaluate(self.val_data)
                     last_eval = (step, ev)
                     log("validation: " + ", ".join(
                         f"{k} {v:.6f}" for k, v in sorted(ev.items())))
