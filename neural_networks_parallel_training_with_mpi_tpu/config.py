@@ -179,6 +179,9 @@ class TrainConfig:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # steps; 0 = only at end
     resume: bool = False
+    # overlap periodic checkpoint writes with compute (background writer;
+    # the final save is always synchronous)
+    async_checkpoint: bool = False
     # observability (SURVEY.md §5.1/5.5)
     profile_dir: Optional[str] = None
     metrics_jsonl: Optional[str] = None
@@ -297,6 +300,8 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=0)
     _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
+    _add_bool_flag(p, "async-checkpoint", False,
+                   "write periodic checkpoints on a background thread")
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--metrics_jsonl", type=str, default=None)
     p.add_argument("--check_replicas_every", type=int, default=0,
@@ -331,6 +336,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
+        async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,
         metrics_jsonl=args.metrics_jsonl,
         eval_every=args.eval_every,

@@ -60,6 +60,15 @@ def world_setup(
     within ``timeout_s`` raises instead of hanging the way a lost MPI rank
     hangs the reference's blocking collectives (:185).
     """
+    # opt-in persistent XLA compilation cache: first TPU compiles take tens
+    # of seconds; restarts/resumes of the same job shape become instant
+    cache_dir = os.environ.get("NNPT_COMPILE_CACHE")
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass  # unavailable on this jax build; purely an optimization
     already = getattr(jax.distributed, "is_initialized", None)
     if callable(already) and already():
         return jax.process_index(), jax.process_count()

@@ -20,7 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import losses as losses_lib
 from ..ops.optim import Optimizer
 from ..train.state import TrainState
-from .data_parallel import DATA_AXES
+from .data_parallel import DATA_AXES, _accumulated_sum_and_grads
 
 Pytree = Any
 Batch = Dict[str, jax.Array]
@@ -43,12 +43,19 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                          loss_name: str = "cross_entropy",
                          seq_axis: Optional[str] = None,
                          donate: bool = True,
-                         example_batch: Optional[Batch] = None):
+                         example_batch: Optional[Batch] = None,
+                         accum_steps: int = 1):
     """(state, batch) -> (state, loss) jitted over data x seq axes.
 
     ``seq_axis`` should be set iff the model's attention is ring/ulysses and
     the mesh's 'seq' axis is >1; the loss/grad reduction then spans it so the
     update uses the exact global-mean gradient over all tokens.
+
+    ``accum_steps`` microbatches the per-shard *batch* rows (dim 0; the
+    sequence shard stays whole so ring/ulysses collectives see the full
+    local sequence) and accumulates loss/grad sums before the single psum +
+    update — the same math as the unsplit step in exact arithmetic, with
+    ulp-level f32 differences from the reassociated summation order.
     """
     base = losses_lib.get(loss_name)
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
@@ -59,11 +66,8 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
         return base(pred, batch["y"], batch.get("mask"))
 
     def shard_step(state: TrainState, batch: Batch):
-        def scalar(p):
-            s, c = loss_sum(p, batch)
-            return s, c
-
-        (s, c), grads = jax.value_and_grad(scalar, has_aux=True)(state.params)
+        s, c, grads = _accumulated_sum_and_grads(
+            loss_sum, state.params, batch, accum_steps)
         total = lax.psum(c, reduce_axes)
         grads = jax.tree_util.tree_map(
             lambda g: lax.psum(g, reduce_axes) / total, grads)

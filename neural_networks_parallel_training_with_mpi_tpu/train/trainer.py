@@ -110,11 +110,12 @@ class Trainer:
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
-        if cfg.accum_steps > 1 and (self.gspmd or self.seq_parallel
-                                    or self.pipeline or self.expert):
+        if cfg.accum_steps > 1 and (self.gspmd or self.pipeline
+                                    or self.expert):
             raise NotImplementedError(
-                "accum_steps > 1 is wired into the pure-DP shard_map path "
-                "only; the other parallel steps run unaccumulated")
+                "accum_steps > 1 is wired into the shard_map DP and DP x "
+                "seq paths; the GSPMD/pipeline/expert steps run "
+                "unaccumulated")
         if self.pipeline:
             from ..parallel import pipeline as pp
 
@@ -147,7 +148,8 @@ class Trainer:
             example = next(iter(self.loader.epoch(0)))
             self.train_step = spmd.make_spmd_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
-                seq_axis="seq", example_batch=example)
+                seq_axis="seq", example_batch=example,
+                accum_steps=cfg.accum_steps)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -234,14 +236,19 @@ class Trainer:
             self.state = dp.replicate_state(restored, self.mesh)
         return int(jax.device_get(self.state.step))
 
-    def save(self) -> None:
+    def save(self, final: bool = False) -> None:
         # every process calls in: checkpoint.save is leader-only for
         # addressable state and shard-parallel (orbax) for TP/FSDP state
         # that spans hosts (device_get would raise there)
         if self.cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
-            ckpt.save(self.cfg.checkpoint_dir, self.state)
+            if self.cfg.async_checkpoint and not final:
+                ckpt.save_async(self.cfg.checkpoint_dir, self.state)
+            else:
+                if final:  # drain in-flight writes before the last snapshot
+                    ckpt.wait_pending()
+                ckpt.save(self.cfg.checkpoint_dir, self.state)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -327,7 +334,7 @@ class Trainer:
             self.metrics.write({"step": prev[0], "epoch": prev[1],
                                 "loss": last_loss,
                                 "samples_per_sec": thr.samples_per_sec})
-        self.save()
+        self.save(final=True)
         result = {"final_loss": last_loss,
                   "steps": step,
                   "samples_per_sec": thr.samples_per_sec,

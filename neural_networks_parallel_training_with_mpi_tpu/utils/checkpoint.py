@@ -24,8 +24,9 @@ from __future__ import annotations
 import json
 import pickle
 import shutil
+import threading
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
@@ -33,6 +34,20 @@ import numpy as np
 from ..train.state import TrainState
 
 _CKPT_PREFIX = "ckpt-"
+# async writer bookkeeping: one write at a time (_write_lock), joinable
+# threads (wait_pending), failures drained under _err_lock and re-raised on
+# the caller's thread
+_write_lock = threading.Lock()
+_err_lock = threading.Lock()
+_pending: List[threading.Thread] = []
+_async_errors: List[BaseException] = []
+
+
+def _drain_errors() -> List[BaseException]:
+    with _err_lock:
+        err = _async_errors[:]
+        _async_errors.clear()
+    return err
 
 
 def _is_fully_addressable(state: Any) -> bool:
@@ -67,20 +82,8 @@ def save(directory: str, state: TrainState, keep: int = 3) -> Path:
     target = d / f"{_CKPT_PREFIX}{step}"
     if _is_fully_addressable(state):
         if jax.process_index() == 0:
-            tmp = d / f".tmp-{_CKPT_PREFIX}{step}"
-            if tmp.exists():
-                shutil.rmtree(tmp)
-            tmp.mkdir(parents=True)
-            leaves, treedef = jax.tree_util.tree_flatten(
-                jax.device_get(state))
-            np.savez(tmp / "state.npz", **{f"leaf_{i}": np.asarray(l)
-                                           for i, l in enumerate(leaves)})
-            (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
-            (tmp / "meta.json").write_text(json.dumps(
-                {"step": step, "format": "npz"}))
-            if target.exists():
-                shutil.rmtree(target)
-            tmp.rename(target)
+            _write_npz(d, step, jax.device_get(state), keep)
+            return target
     else:  # multi-host sharded: orbax shard-parallel write
         import orbax.checkpoint as ocp
 
@@ -94,6 +97,76 @@ def save(directory: str, state: TrainState, keep: int = 3) -> Path:
         for _, old in _snapshot_dirs(d)[:-keep]:
             shutil.rmtree(old, ignore_errors=True)
     return target
+
+
+def _write_npz(d: Path, step: int, host_state: Any, keep: int) -> None:
+    """Serialized (lock-held) atomic npz snapshot write + pruning; runs on
+    the caller's thread (sync save) or the writer thread (async save)."""
+    with _write_lock:
+        target = d / f"{_CKPT_PREFIX}{step}"
+        tmp = d / f".tmp-{_CKPT_PREFIX}{step}"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        leaves, treedef = jax.tree_util.tree_flatten(host_state)
+        np.savez(tmp / "state.npz", **{f"leaf_{i}": np.asarray(l)
+                                       for i, l in enumerate(leaves)})
+        (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
+        (tmp / "meta.json").write_text(json.dumps(
+            {"step": step, "format": "npz"}))
+        if target.exists():
+            shutil.rmtree(target)
+        tmp.rename(target)
+        if keep:
+            for _, old in _snapshot_dirs(d)[:-keep]:
+                shutil.rmtree(old, ignore_errors=True)
+
+
+def save_async(directory: str, state: TrainState, keep: int = 3) -> None:
+    """Non-blocking save: snapshot device state to host now, write npz on a
+    background thread so the train loop keeps dispatching steps (checkpoint
+    I/O overlaps compute instead of stalling it — the reference, which has
+    no checkpointing at all, pays nothing; a naive sync save would pay the
+    full write on the hot path).
+
+    Falls back to the synchronous path for sharded multi-host state (orbax
+    coordinates all processes and is not thread-safe to background
+    per-process).  Call :func:`wait_pending` before process exit / final
+    restore; write errors surface there (or on the next save_async call).
+    """
+    err = _drain_errors()
+    if err:
+        raise RuntimeError("previous async checkpoint write failed") from err[0]
+    if not _is_fully_addressable(state):
+        save(directory, state, keep)
+        return
+    if jax.process_index() != 0:
+        return
+    step = int(jax.device_get(state.step))
+    host_state = jax.device_get(state)  # device sync happens here, once
+
+    def work():
+        try:
+            _write_npz(Path(directory), step, host_state, keep)
+        except BaseException as e:  # surfaced on the next save/wait call
+            with _err_lock:
+                _async_errors.append(e)
+
+    t = threading.Thread(target=work, name=f"ckpt-writer-{step}")
+    t.start()
+    _pending.append(t)
+    # opportunistic reaping keeps the list bounded on long runs
+    _pending[:] = [p for p in _pending if p.is_alive()]
+
+
+def wait_pending() -> None:
+    """Join all in-flight async checkpoint writes; re-raise their errors."""
+    for t in list(_pending):
+        t.join()
+    _pending.clear()
+    err = _drain_errors()
+    if err:
+        raise RuntimeError("async checkpoint write failed") from err[0]
 
 
 def latest_step(directory: str) -> Optional[int]:
