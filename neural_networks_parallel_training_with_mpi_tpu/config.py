@@ -169,6 +169,10 @@ class TrainConfig:
     #                    semantics (:188-197); equals global_mean when shards
     #                    are even
     grad_reduction: str = "global_mean"
+    # 'zero1' shards the weight update + optimizer state across the data
+    # axes (reduce-scatter grads, update 1/N slice, all-gather params) —
+    # cross-replica weight-update sharding; pure-DP shard_map path only
+    update_sharding: str = "replicated"  # replicated | zero1
     seed: int = 0
     log_every: int = 1
     shuffle: bool = True
@@ -252,6 +256,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)
     _add_bool_flag(p, "shuffle", True, "shuffle batches each epoch")
+    p.add_argument("--update_sharding", choices=["replicated", "zero1"],
+                   default="replicated",
+                   help="zero1 = shard optimizer state + weight update "
+                        "across the data axes (reduce-scatter/all-gather)")
     p.add_argument("--dataset",
                    choices=["regression", "wide_regression", "mnist", "cifar10", "lm"],
                    default="regression")
@@ -331,6 +339,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         accum_steps=args.accum_steps,
         loss=args.loss,
         grad_reduction=args.grad_reduction,
+        update_sharding=args.update_sharding,
         seed=args.seed,
         shuffle=args.shuffle,
         checkpoint_dir=args.checkpoint_dir,

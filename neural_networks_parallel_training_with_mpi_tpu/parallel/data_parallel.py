@@ -58,12 +58,42 @@ def make_loss_fn(model, loss_name: str) -> Callable[[Pytree, Batch],
     return loss_fn
 
 
+def data_axis_size(mesh: Mesh) -> int:
+    import numpy as np
+
+    return int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+
+
+def zero1_opt_state(optimizer: Optimizer, params: Pytree, mesh: Mesh,
+                    place: bool = True) -> Pytree:
+    """Optimizer state for ``update_sharding='zero1'``: one flat f32 buffer
+    per slot, sharded over the data axes (each replica keeps 1/N of the
+    optimizer state — the cross-replica weight-update sharding of the
+    'Automatic Cross-Replica Sharding of Weight Update' paper, a.k.a.
+    ZeRO-1, expressed with psum_scatter/all_gather over ICI)."""
+    from jax.flatten_util import ravel_pytree
+
+    flat, _ = ravel_pytree(params)
+    n = data_axis_size(mesh)
+    pad = (-flat.shape[0]) % n
+    state = optimizer.init(jnp.zeros((flat.shape[0] + pad,), jnp.float32))
+    if not place:
+        return state
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    specs = optimizer.state_specs(P(DATA_AXES))
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
 def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
                     loss_name: str = "mse",
                     grad_reduction: str = "global_mean",
                     donate: bool = True,
-                    accum_steps: int = 1) -> Callable[[TrainState, Batch],
-                                                      Tuple[TrainState, jax.Array]]:
+                    accum_steps: int = 1,
+                    update_sharding: str = "replicated"
+                    ) -> Callable[[TrainState, Batch],
+                                  Tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: (state, batch) -> (state, loss).
 
     ``state`` is replicated over the mesh; ``batch`` is dim-0-sharded over
@@ -73,12 +103,28 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
 
     ``accum_steps > 1`` splits each device's shard into that many
     microbatches and accumulates loss/grad *sums* over a ``lax.scan`` before
-    the single psum + optimizer update — bit-identical math to the unsplit
-    step (sums are associative), trading step latency for peak activation
-    memory.  One train step remains one optimizer step.
+    the single psum + optimizer update — the unsplit step's math in exact
+    arithmetic (sums reassociate; expect ulp-level f32 differences), trading
+    step latency for peak activation memory.  One train step remains one
+    optimizer step.
+
+    ``update_sharding='zero1'`` shards the *weight update* across the data
+    axes: gradients are reduce-scattered (one fused psum_scatter instead of
+    a full psum), each replica updates only its 1/N slice of the flattened
+    parameters with its 1/N slice of optimizer state, and the updated slices
+    are all-gathered back.  Same math as 'replicated'; optimizer state
+    memory and update FLOPs drop by the data-axis size.  Requires
+    ``grad_reduction='global_mean'`` and opt state built by
+    :func:`zero1_opt_state`.
     """
     if grad_reduction not in ("global_mean", "per_shard_mean"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
+    if update_sharding not in ("replicated", "zero1"):
+        raise ValueError(f"unknown update_sharding {update_sharding!r}")
+    if update_sharding == "zero1" and grad_reduction != "global_mean":
+        raise ValueError("update_sharding='zero1' implies the exact "
+                         "global-mean gradient; per_shard_mean is a "
+                         "replicated-path-only compatibility mode")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     loss_fn = make_loss_fn(model, loss_name)
@@ -86,6 +132,32 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     def shard_step(state: TrainState, batch: Batch):
         s, c, grads = _accumulated_sum_and_grads(
             loss_fn, state.params, batch, accum_steps)
+        if update_sharding == "zero1":
+            from jax.flatten_util import ravel_pytree
+
+            total = lax.psum(c, DATA_AXES)
+            loss = lax.psum(s, DATA_AXES) / total
+            flat_params, unravel = ravel_pytree(state.params)
+            flat_grads, _ = ravel_pytree(grads)
+            # opt-state slot length fixes the padded shard size (the local
+            # view inside shard_map is the per-device slice)
+            shard_len = jax.tree_util.tree_leaves(
+                state.opt_state)[-1].shape[0]
+            n = data_axis_size(mesh)
+            pad = shard_len * n - flat_params.shape[0]
+            g_shard = lax.psum_scatter(
+                jnp.pad(flat_grads.astype(jnp.float32), (0, pad)),
+                DATA_AXES, scatter_dimension=0, tiled=True) / total
+            idx = lax.axis_index(DATA_AXES)
+            p_shard = lax.dynamic_slice(
+                jnp.pad(flat_params, (0, pad)), (idx * shard_len,),
+                (shard_len,))
+            new_p_shard, new_opt = optimizer.update(g_shard, state.opt_state,
+                                                    p_shard)
+            flat_new = lax.all_gather(new_p_shard, DATA_AXES, axis=0,
+                                      tiled=True)[:flat_params.shape[0]]
+            new_params = unravel(flat_new)
+            return TrainState(state.step + 1, new_params, new_opt), loss
         if grad_reduction == "global_mean":
             total = lax.psum(c, DATA_AXES)
             grads = jax.tree_util.tree_map(
@@ -102,10 +174,17 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         return TrainState(state.step + 1, new_params, new_opt), loss
 
     batch_spec = P(DATA_AXES)
+    if update_sharding == "zero1":
+        if optimizer.state_specs is None:
+            raise ValueError(f"{optimizer.name} lacks state_specs")
+        opt_spec = optimizer.state_specs(P(DATA_AXES))
+        state_spec = TrainState(step=P(), params=P(), opt_state=opt_spec)
+    else:
+        state_spec = P()
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -202,3 +281,20 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     equivalent of the reference's initial state-dict broadcast (:87-88)."""
     sharding = NamedSharding(mesh, P())
     return jax.device_put(state, sharding)
+
+
+def place_zero1_state(state: TrainState, mesh: Mesh,
+                      optimizer: Optimizer) -> TrainState:
+    """Place a zero1-layout TrainState: step/params replicated, flat
+    optimizer-state buffers sharded over the data axes (used on resume;
+    fresh init goes through :func:`zero1_opt_state`)."""
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    opt_spec = optimizer.state_specs(P(DATA_AXES))
+    rep = NamedSharding(mesh, P())
+    return TrainState(
+        step=jax.device_put(state.step, rep),
+        params=jax.device_put(state.params, rep),
+        opt_state=jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            state.opt_state, opt_spec))

@@ -17,8 +17,10 @@ Transformer rules (Megatron-style):
   split; XLA inserts the psum that merges partial outputs.
 * biases of column-parallel layers: P(tensor); row-parallel biases and all
   LayerNorm/embedding params: replicated (or fsdp on the big embedding).
-* MLP/ConvNet models: 'tensor' is ignored (pure DP/fsdp) — alternate-layer
-  column/row rules for generic MLPs come with the TP-MLP model.
+* MLP models: Megatron alternating column/row rules (``mlp_rules``) — the
+  wide-MLP benchmark config shards its hidden layers over 'tensor'.
+* ConvNet and other models: 'tensor' is ignored (pure DP/fsdp fallback,
+  ``generic_rules``).
 """
 
 from __future__ import annotations
@@ -89,6 +91,46 @@ def transformer_rules(mesh: Mesh) -> PathRule:
     return rule
 
 
+def mlp_rules(mesh: Mesh) -> PathRule:
+    """Megatron-style alternating column/row parallelism for the MLP family
+    (models.mlp.MLP: a Sequential of [Linear, Activation]*depth + Linear,
+    so Linear layers sit at even sequential indices).
+
+    Even-ordinal Linears (the 1st, 3rd, ... in the chain) are
+    column-parallel (output dim over 'tensor' — the hidden units become
+    device-local), odd-ordinal ones row-parallel (input dim over 'tensor';
+    XLA inserts the partial-sum psum).  Pairing
+    column->row keeps the activation feature dim sharded between them, the
+    classic trick that makes the wide-MLP allreduce (BASELINE.json config
+    #2) ride ICI once per pair instead of per layer.  Dims that don't
+    divide fall back to fsdp/replicated, so any width still places."""
+
+    def rule(path: Tuple[str, ...], leaf) -> P:
+        shape = np.shape(leaf)
+        try:
+            ordinal = int(path[-2]) // 2  # Linear position in the chain
+        except (ValueError, IndexError):
+            ordinal = 0
+        col = ordinal % 2 == 0
+        if path[-1] == "w" and len(shape) == 2:
+            in_dim, out_dim = shape
+            if col and _divisible(out_dim, mesh, "tensor"):
+                return P("fsdp" if _divisible(in_dim, mesh, "fsdp") else None,
+                         "tensor")
+            if not col and _divisible(in_dim, mesh, "tensor"):
+                return P("tensor",
+                         "fsdp" if _divisible(out_dim, mesh, "fsdp") else None)
+            if _divisible(in_dim, mesh, "fsdp"):
+                return P("fsdp")
+            return P()
+        if (path[-1] == "b" and col and len(shape) == 1
+                and _divisible(shape[0], mesh, "tensor")):
+            return P("tensor")
+        return P()
+
+    return rule
+
+
 def generic_rules(mesh: Mesh) -> PathRule:
     """Models without TP structure (MLP/ConvNet): fsdp-shard any weight whose
     leading dim divides; everything else replicated."""
@@ -103,10 +145,13 @@ def generic_rules(mesh: Mesh) -> PathRule:
 
 
 def rules_for(model, mesh: Mesh) -> PathRule:
+    from ..models.mlp import MLP
     from ..models.transformer import Transformer
 
     if isinstance(model, Transformer):
         return transformer_rules(mesh)
+    if isinstance(model, MLP):
+        return mlp_rules(mesh)
     return generic_rules(mesh)
 
 

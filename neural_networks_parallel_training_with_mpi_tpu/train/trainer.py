@@ -65,6 +65,20 @@ class Trainer:
                 f"attention={cfg.model.attention!r} needs the 'seq' mesh "
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
+        self.zero1 = cfg.update_sharding == "zero1"
+        if self.zero1 and (self.gspmd or self.seq_parallel or self.pipeline
+                           or self.expert):
+            raise NotImplementedError(
+                "update_sharding='zero1' is wired into the pure-DP "
+                "shard_map path only (fsdp/tensor axes already shard "
+                "state on the GSPMD path)")
+        if self.zero1 and cfg.grad_clip:
+            raise NotImplementedError(
+                "grad_clip with update_sharding='zero1' would clip by the "
+                "local shard's norm; use the replicated path for clipping")
+        if self.zero1 and cfg.grad_reduction != "global_mean":
+            raise ValueError("update_sharding='zero1' implies global_mean "
+                             "gradient semantics")
         if cfg.hang_timeout and not cfg.log_every:
             raise ValueError(
                 "--hang_timeout needs log_every > 0: the periodic loss "
@@ -169,7 +183,8 @@ class Trainer:
             self.train_step = dp.make_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
                 grad_reduction=cfg.grad_reduction,
-                accum_steps=cfg.accum_steps)
+                accum_steps=cfg.accum_steps,
+                update_sharding=cfg.update_sharding)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
@@ -189,6 +204,17 @@ class Trainer:
                 int(self.mesh.shape["pipe"]))
             self.state = pp.shard_pipeline_state(state, self.mesh,
                                                  self.optimizer)
+            return self.state
+        if self.zero1:
+            import jax.numpy as jnp
+
+            params = self.model.init(prng.init_key(self.cfg.seed))
+            host = TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=dp.zero1_opt_state(self.optimizer, params,
+                                             self.mesh, place=False))
+            self.state = dp.place_zero1_state(host, self.mesh,
+                                              self.optimizer)
             return self.state
         state = TrainState.create(self.model, self.optimizer,
                                   prng.init_key(self.cfg.seed))
@@ -232,6 +258,9 @@ class Trainer:
 
             self.state = gspmd.shard_state(self.model, restored,
                                            self.optimizer, self.mesh)
+        elif self.zero1:
+            self.state = dp.place_zero1_state(restored, self.mesh,
+                                              self.optimizer)
         else:
             self.state = dp.replicate_state(restored, self.mesh)
         return int(jax.device_get(self.state.step))
