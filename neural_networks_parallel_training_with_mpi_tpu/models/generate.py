@@ -1,0 +1,163 @@
+"""Autoregressive decoding for the Transformer LM (inference API).
+
+The reference is a pure training demo — it has no inference path at all
+(its dead test-evaluation block at dataParallelTraining_NN_MPI.py:227-236 is
+the closest thing).  A complete framework needs one, so this module adds
+jitted autoregressive decoding, TPU-shaped:
+
+* **KV cache with static shapes**: the cache is a preallocated
+  ``(B, max_len, heads, head_dim)`` buffer per layer, written with
+  ``lax.dynamic_update_slice`` at the current position — no growing arrays,
+  so the whole decode loop is one compiled program.
+* **Prefill + scan**: uniform prompts are prefixed in ONE batched chunk
+  (prompt positions run in parallel on the MXU, exactly like the training
+  forward), then new tokens come from a ``lax.scan`` of single-position
+  chunks.  Ragged prompts (``prompt_lens``) fall back to the fully
+  sequential scan so short rows' generated tokens — not their pads — enter
+  the cache.
+* **Shared wiring with training**: embeddings/head come from
+  ``Transformer.embed``/``head_logits`` and the block weights from
+  ``Transformer._block_modules``, so inference cannot drift from training
+  (pinned by tests/test_generate.py's replay check).
+
+Works with the dense-attention configuration (flash/ring add nothing at
+chunk size 1).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .core import ACTIVATIONS
+from .transformer import Transformer
+
+
+def init_kv_cache(model: Transformer, batch: int, max_len: int):
+    """Per-layer (k, v) buffers, (B, max_len, n_heads, head_dim)."""
+    c = model.cfg
+    shape = (batch, max_len, c.n_heads, c.head_dim)
+    zeros = lambda: jnp.zeros(shape, c.compute_dtype)
+    return [{"k": zeros(), "v": zeros()} for _ in range(c.n_layers)]
+
+
+def _block_chunk(model: Transformer, params, cache, x, pos):
+    """One block on a chunk ``x`` (B, S, D) starting at position ``pos``:
+    writes the chunk's K/V into the cache and attends causally over
+    positions 0..pos+S-1.  S = prompt length at prefill, 1 per decode step.
+    Mirrors Transformer._block for the incremental case."""
+    c = model.cfg
+    mods = model._block_modules()
+    h = mods["ln1"].apply(params["ln1"], x)
+    qkv = mods["qkv"].apply(params["qkv"], h)
+    b, s, _ = qkv.shape
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    shape = (b, s, c.n_heads, c.head_dim)
+    q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+    new_k = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
+                                     (0, pos, 0, 0))
+    new_v = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
+                                     (0, pos, 0, 0))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        new_k.astype(jnp.float32)) * scale
+    T = cache["k"].shape[1]
+    # causal within the chunk: key position <= pos + query offset
+    mask = (jnp.arange(T)[None, None, None, :]
+            <= pos + jnp.arange(s)[None, None, :, None])
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                     new_v.astype(jnp.float32)).astype(x.dtype)
+    out = out.reshape(b, s, c.d_model)
+    x = x + mods["attn_out"].apply(params["attn_out"], out)
+    h = mods["ln2"].apply(params["ln2"], x)
+    if c.moe_experts > 0:
+        ff, _ = mods["moe"].apply(params["moe"], h)
+    else:
+        h = mods["ff_in"].apply(params["ff_in"], h)
+        h = ACTIVATIONS[c.activation](h)
+        ff = mods["ff_out"].apply(params["ff_out"], h)
+    return x + ff.astype(x.dtype), {"k": new_k, "v": new_v}
+
+
+def _forward_chunk(model: Transformer, params, caches, ids, pos):
+    """Logits for a chunk: ids (B, S) at start position ``pos`` ->
+    ((B, S, vocab) f32, updated caches)."""
+    positions = pos + jnp.arange(ids.shape[1])
+    x = model.embed(params, ids, positions)
+    new_caches = []
+    for layer_params, cache in zip(params["blocks"], caches):
+        x, cache = _block_chunk(model, layer_params, cache, x, pos)
+        new_caches.append(cache)
+    return model.head_logits(params, x), new_caches
+
+
+def _sample(logits, temperature, key):
+    if temperature > 0:
+        key, sub = jax.random.split(key)
+        nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+    else:
+        nxt = jnp.argmax(logits, axis=-1)
+    return nxt.astype(jnp.int32), key
+
+
+def generate(model: Transformer, params, prompt: jax.Array,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             key: Optional[jax.Array] = None,
+             prompt_lens: Optional[jax.Array] = None,
+             pad_id: int = 0) -> jax.Array:
+    """Decode ``max_new_tokens`` after ``prompt`` (B, P) -> (B, P + N).
+
+    ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
+    given temperature (``key`` required).  With ragged prompts, right-pad to
+    a common P with ``pad_id`` and pass ``prompt_lens`` (B,); each row
+    starts generating at its own length (sequential path — generated
+    tokens, not pads, populate the cache for short rows).
+
+    Wrap in ``jax.jit`` (static: model, max_new_tokens, temperature) for
+    repeated use; shapes are static so recompiles only on new (B, P, N).
+    """
+    c = model.cfg
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > c.max_seq_len:
+        raise ValueError(f"prompt {p} + {max_new_tokens} new tokens exceeds "
+                         f"max_seq_len {c.max_seq_len}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    key = key if key is not None else jax.random.PRNGKey(0)
+    caches = init_kv_cache(model, b, total)
+    tokens = jnp.concatenate(
+        [prompt.astype(jnp.int32),
+         jnp.full((b, max_new_tokens), pad_id, jnp.int32)], axis=1)
+    ragged = prompt_lens is not None
+
+    def step(carry, pos):
+        tokens, caches, key = carry
+        ids_1 = lax.dynamic_slice(tokens, (0, pos), (b, 1))
+        logits, caches = _forward_chunk(model, params, caches, ids_1, pos)
+        nxt, key = _sample(logits[:, 0], temperature, key)
+        if ragged:
+            # rows whose prompt extends past pos+1 keep their prompt token
+            keep = (pos + 1) < prompt_lens
+            cur = lax.dynamic_slice(tokens, (0, pos + 1), (b, 1))[:, 0]
+            nxt = jnp.where(keep, cur, nxt)
+        tokens = lax.dynamic_update_slice(tokens, nxt[:, None], (0, pos + 1))
+        return (tokens, caches, key), None
+
+    if ragged:  # fully sequential: per-row start positions
+        start = 0
+    else:  # prefill: all P prompt positions in one parallel chunk
+        logits, caches = _forward_chunk(model, params, caches,
+                                        tokens[:, :p], 0)
+        first, key = _sample(logits[:, p - 1], temperature, key)
+        tokens = lax.dynamic_update_slice(tokens, first[:, None], (0, p))
+        start = p
+    if start < total - 1:
+        (tokens, _, _), _ = lax.scan(step, (tokens, caches, key),
+                                     jnp.arange(start, total - 1))
+    return tokens

@@ -129,6 +129,28 @@ class Transformer(Module):
             aux = jnp.zeros((), jnp.float32)
         return x + ff.astype(x.dtype), aux
 
+    def embed(self, params, ids: jax.Array, positions: jax.Array) -> jax.Array:
+        """Token + positional embedding -> (B, T, D) in compute dtype.
+        Single definition shared by the training forward and the KV-cache
+        decode path (models.generate), so they cannot drift."""
+        c = self.cfg
+        x = Embedding(c.vocab_size, c.d_model, c.param_dtype).apply(
+            params["embed"], ids)
+        x = x + Embedding(c.max_seq_len, c.d_model, c.param_dtype).apply(
+            params["pos"], positions)
+        return x.astype(c.compute_dtype)
+
+    def head_logits(self, params, x: jax.Array) -> jax.Array:
+        """Final LayerNorm + untied head -> f32 logits (shared with
+        models.generate, same drift argument as :meth:`embed`)."""
+        c = self.cfg
+        x = LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(
+            params["ln_f"], x)
+        logits = Linear(c.d_model, c.vocab_size, use_bias=False,
+                        param_dtype=c.param_dtype,
+                        compute_dtype=c.compute_dtype).apply(params["head"], x)
+        return logits.astype(jnp.float32)
+
     def apply(self, params, ids: jax.Array, return_aux: bool = False,
               **kwargs):
         """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or
@@ -145,12 +167,7 @@ class Transformer(Module):
             offset = jax.lax.axis_index(c.seq_axis) * t
         else:  # dense/flash see the full sequence locally
             offset = jnp.zeros((), jnp.int32)
-        positions = offset + jnp.arange(t)
-        x = Embedding(c.vocab_size, c.d_model, c.param_dtype).apply(
-            params["embed"], ids)
-        x = x + Embedding(c.max_seq_len, c.d_model, c.param_dtype).apply(
-            params["pos"], positions)
-        x = x.astype(c.compute_dtype)
+        x = self.embed(params, ids, offset + jnp.arange(t))
         block_fn = self._block
         if c.remat:
             block_fn = jax.checkpoint(block_fn, static_argnums=())
@@ -158,9 +175,5 @@ class Transformer(Module):
         for layer_params in params["blocks"]:
             x, aux = block_fn(layer_params, x)
             aux_total = aux_total + aux
-        x = LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(params["ln_f"], x)
-        logits = Linear(c.d_model, c.vocab_size, use_bias=False,
-                        param_dtype=c.param_dtype,
-                        compute_dtype=c.compute_dtype).apply(params["head"], x)
-        logits = logits.astype(jnp.float32)
+        logits = self.head_logits(params, x)
         return (logits, aux_total) if return_aux else logits
