@@ -5,4 +5,5 @@
 # Parallelism comes from the device mesh instead of mpiexec.
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --lr 0.001 --momentum 0.9 --batch_size 4 --nepochs 3

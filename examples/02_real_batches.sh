@@ -4,6 +4,7 @@
 # validation split evaluated every epoch, and structured metrics.
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --n_samples 10000 --no-full-batch --batch_size 256 --nepochs 10 \
     --lr 0.01 --lr_schedule cosine --warmup_steps 50 --grad_clip 1.0 \
     --val_fraction 0.1 --eval_every 1 --metrics_jsonl /tmp/metrics.jsonl

@@ -3,6 +3,7 @@
 # microbatch schedule, ppermute activation ring), bfloat16 matmuls.
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
     --optimizer adam --lr 1e-3 --compute_dtype bfloat16 \
     --n_layers 4 --dp 4 --pp 2

@@ -3,5 +3,6 @@
 # axis, token slots exchanged by all_to_all (GShard arrangement).
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
     --optimizer adam --lr 1e-3 --dp 4 --ep 2 --moe_experts 4

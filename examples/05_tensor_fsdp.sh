@@ -4,5 +4,6 @@
 # all-gathers/reduce-scatters.
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --dataset lm --no-full-batch --batch_size 16 --nepochs 1 \
     --optimizer adam --lr 1e-3 --dp 2 --tp 2 --fsdp 2

@@ -4,5 +4,6 @@
 # so context length scales with the number of chips.
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --dataset lm --seq_len 256 --no-full-batch --batch_size 8 --nepochs 1 \
     --optimizer adam --lr 1e-3 --dp 4 --sp 2

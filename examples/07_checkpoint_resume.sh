@@ -4,9 +4,11 @@
 set -euo pipefail
 CKPT=$(mktemp -d)
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --n_samples 1024 --no-full-batch --batch_size 64 --nepochs 2 \
     --checkpoint_dir "$CKPT" --checkpoint_every 8
 echo "--- resuming ---"
 python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --n_samples 1024 --no-full-batch --batch_size 64 --nepochs 4 \
     --checkpoint_dir "$CKPT" --resume

@@ -17,12 +17,44 @@ from __future__ import annotations
 import sys
 
 from .config import build_argparser, config_from_args
-from .train.trainer import Trainer
 from .utils.logging import log
+from .utils import platform as plat
+
+
+def _pin_platform(args) -> int:
+    """Bind the process to a JAX platform before any backend init.
+
+    Hang-proof by construction: ``cpu`` never touches an accelerator;
+    ``auto``/``tpu`` probe from a subprocess with a timeout (an exclusive
+    TPU tunnel that is already claimed *blocks* inside backend init rather
+    than erroring), and ``auto`` falls back to cpu while ``tpu`` exits with
+    a clear error.  Returns 0, or a nonzero exit code.
+    """
+    if args.platform == "cpu":
+        plat.pin("cpu", num_devices=args.num_devices)
+        return 0
+    info = plat.probe(timeout_s=args.probe_timeout, attempts=1, log=log)
+    if info and info["platform"] != "cpu":
+        log(f"accelerator: {info['n_devices']}x {info['device_kind']}")
+        plat.unpin_cpu()  # a stray JAX_PLATFORMS=cpu must not override the probe
+        return 0
+    if args.platform == "tpu":
+        log("ERROR: --platform tpu but no accelerator answered the probe "
+            f"within {args.probe_timeout:.0f}s (tunnel busy or absent); "
+            "rerun with --platform cpu [--num_devices N]")
+        return 2
+    log("no accelerator; using cpu")
+    plat.pin("cpu", num_devices=args.num_devices)
+    return 0
 
 
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    rc = _pin_platform(args)
+    if rc:
+        return rc
+    from .train.trainer import Trainer  # import after the platform pin
+
     cfg = config_from_args(args)
     trainer = Trainer(cfg)
     result = trainer.fit()

@@ -318,6 +318,22 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--hang_timeout", type=float, default=0.0,
                    help="abort with thread stacks if no step completes "
                         "within this many seconds (0 = off)")
+    # launch-path flags (consumed by cli.main before any JAX backend init;
+    # not part of TrainConfig).  The reference's launcher is mpiexec
+    # (README.md:12); ours is the JAX platform choice + device mesh.
+    p.add_argument("--platform", choices=["auto", "cpu", "tpu"],
+                   default="auto",
+                   help="JAX platform: cpu pins the host backend (hang-proof "
+                        "on images with an exclusive TPU tunnel), tpu fails "
+                        "fast if no accelerator answers, auto probes with a "
+                        "timeout and falls back to cpu")
+    p.add_argument("--num_devices", type=int, default=None,
+                   help="virtual CPU device count for SPMD runs without an "
+                        "accelerator (the role mpiexec -n N plays for the "
+                        "reference); only meaningful with --platform cpu")
+    p.add_argument("--probe_timeout", type=float, default=60.0,
+                   help="accelerator probe timeout in seconds for "
+                        "--platform auto/tpu")
     return p
 
 

@@ -17,6 +17,24 @@ import jax
 
 
 def is_leader() -> bool:
+    # jax.process_index() initializes the PJRT backend on first call — which
+    # can *block* on images with an exclusive TPU tunnel.  During the launch
+    # path (platform probing, before any backend exists) treat this process
+    # as the leader instead of touching the accelerator runtime; once
+    # training has initialized a backend the real process index is used, so
+    # multi-host leader-only logging is unaffected.
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except Exception:
+        # introspection API moved (JAX upgrade): be loud once rather than
+        # silently reintroducing the pre-init hang
+        print("WARNING: cannot determine JAX backend-init state; "
+              "leader check may initialize the backend", file=sys.stderr)
+        initialized = True
+    if not initialized:
+        return True
     return jax.process_index() == 0
 
 

@@ -1,0 +1,135 @@
+"""Explicit JAX platform selection (the launch-path analogue of choosing an
+MPI hostfile).
+
+The reference picks its "platform" implicitly: whatever hosts ``mpiexec -n N``
+was given (reference README.md:12).  A JAX process instead binds to a PJRT
+backend the first time any backend-touching API runs — and on shared or
+tunneled TPU images that first touch can *block indefinitely* while the
+runtime tries to claim an exclusive chip.  This module makes the choice
+explicit and hang-proof:
+
+* :func:`pin` — call before any JAX backend initialization to force the
+  process onto ``cpu`` (optionally with N virtual devices for SPMD testing,
+  SURVEY.md §4) or leave it on the accelerator path.
+* :func:`probe` — check accelerator availability from a *subprocess* with a
+  timeout, so a wedged TPU runtime can never hang the caller.
+
+Both are used by the CLI (``--platform``/``--num_devices``) and ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+PLATFORMS = ("auto", "cpu", "tpu")
+
+# Env var some TPU-tunnel images use to auto-register an exclusive PJRT
+# plugin at interpreter start; removing it before spawning helpers keeps
+# pure-CPU child processes off the tunnel entirely.
+_TUNNEL_ENV = "PALLAS_AXON_POOL_IPS"
+
+
+def force_host_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices (must run before backend init).
+
+    This is the launcher's replacement for ``mpiexec -n N`` when no
+    accelerator is present: SPMD code sees N devices on one host.  Any
+    pre-existing count in ``XLA_FLAGS`` is *replaced* — an explicit
+    ``--num_devices`` must win over a stale exported flag.
+    """
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def pin(platform: str = "auto", num_devices: Optional[int] = None) -> None:
+    """Pin this process's JAX platform.  Must run before backend init.
+
+    ``cpu`` applies a three-part guard (env var, plugin env removal, and a
+    post-import config update) because site hooks on some images re-register
+    accelerator plugins after plain ``JAX_PLATFORMS=cpu`` would have taken
+    effect.  ``tpu`` and ``auto`` leave the image's default backend order in
+    place (``auto`` = first available; ``tpu`` documents intent and lets the
+    caller pair it with :func:`probe` to fail fast instead of hanging).
+    """
+    if platform not in PLATFORMS:
+        raise ValueError(f"platform must be one of {PLATFORMS}, got {platform!r}")
+    if num_devices is not None and num_devices > 1:
+        force_host_device_count(num_devices)
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop(_TUNNEL_ENV, None)
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def unpin_cpu() -> None:
+    """Undo a stray CPU pin so a successful accelerator probe is honored.
+
+    A parent shell may still export ``JAX_PLATFORMS=cpu`` (old advice) while
+    an accelerator is available; without this, a ``--platform tpu`` run would
+    pass the probe and then silently train on CPU.
+    """
+    if os.environ.get("JAX_PLATFORMS", None) in ("cpu", ""):
+        os.environ.pop("JAX_PLATFORMS", None)
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            if jax.config.jax_platforms in ("cpu", ""):
+                jax.config.update("jax_platforms", None)
+        except Exception:
+            pass
+
+
+# Sentinel-prefixed so site-hook banners on the probed image cannot corrupt
+# the parse (only the PROBE_RESULT line is read).
+_PROBE_SRC = """
+import jax
+d = jax.devices()
+print("PROBE_RESULT", d[0].platform, d[0].device_kind, len(d), sep="|")
+"""
+
+
+def probe(timeout_s: float = 90.0, attempts: int = 1,
+          log=None) -> Optional[dict]:
+    """Probe accelerator availability from a subprocess.
+
+    Returns ``{"platform", "device_kind", "n_devices"}`` for the default
+    backend, or ``None`` if every attempt errors or times out (a wedged
+    exclusive-TPU tunnel manifests as a hang, not an error — hence the
+    subprocess + timeout).  The subprocess inherits the environment minus
+    any CPU pin, so it sees the accelerator the parent would.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    for attempt in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            if log:
+                log(f"platform probe attempt {attempt + 1}/{attempts}: "
+                    f"timed out after {timeout_s:.0f}s (tunnel wedged?)")
+            continue
+        if out.returncode == 0:
+            for line in out.stdout.splitlines():
+                if line.startswith("PROBE_RESULT|"):
+                    _, platform, kind, n = line.split("|", 3)
+                    return {"platform": platform, "device_kind": kind,
+                            "n_devices": int(n)}
+        if log:
+            tail = (out.stderr or out.stdout).strip().splitlines()[-1:] or [""]
+            log(f"platform probe attempt {attempt + 1}/{attempts}: "
+                f"rc={out.returncode} {tail[0][:200]}")
+    return None

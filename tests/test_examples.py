@@ -1,0 +1,53 @@
+"""The examples must actually run — the reference's one command works out
+of the box (reference README.md:12) and so must ours.
+
+Example 01 is the parity demo (the reference's exact job: 16-sample sklearn
+regression, full-batch-ish SGD, 3 epochs, dataParallelTraining_NN_MPI.py:242-255);
+it runs here end-to-end on the virtual 8-device CPU mesh via the CLI's
+``--platform cpu --num_devices 8`` launch path.
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _clean_env():
+    env = dict(os.environ)
+    # the scripts' own --platform cpu pin must be sufficient; give them the
+    # raw (axon-registered) environment, not the conftest's pre-pinned one
+    env.pop("JAX_PLATFORMS", None)
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def test_example_01_reference_parity_completes():
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "01_reference_parity.sh")],
+        capture_output=True, text=True, timeout=120, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stderr + out.stdout
+
+
+def test_cli_platform_tpu_fails_fast_when_unavailable():
+    """--platform tpu must error out quickly (exit 2), never hang."""
+    env = _clean_env()
+    # make the probe see no accelerator even on a healthy TPU host: point
+    # the subprocess at an empty platform list is not possible, so instead
+    # rely on the short timeout — on a host WITH a fast accelerator the
+    # probe succeeds and the run proceeds; either way, no hang.
+    out = subprocess.run(
+        [sys.executable, "-m", "neural_networks_parallel_training_with_mpi_tpu",
+         "--platform", "tpu", "--probe_timeout", "5", "--nepochs", "1"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=str(REPO),
+    )
+    assert out.returncode in (0, 2), out.stderr[-2000:]
+    if out.returncode == 2:
+        assert "no accelerator" in out.stdout + out.stderr
