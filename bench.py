@@ -388,10 +388,7 @@ def _run_child_cpu(config: str, n_devices: int = 1,
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if "host_platform_device_count" not in f]
-    env["XLA_FLAGS"] = " ".join(
-        flags + [f"--xla_force_host_platform_device_count={n_devices}"])
+    plat.force_host_device_count(n_devices, env=env)
     cmd = [sys.executable, __file__, "--config", config, "--platform", "cpu"]
     if not baseline:
         cmd.append("--no-baseline")
@@ -485,7 +482,13 @@ def main() -> int:
             rec = _run_child_cpu(name, n_devices=1,
                                  baseline=not args.no_baseline)
             if rec is None:
-                raise
+                if not args.all:
+                    raise
+                # --all: record the failure, keep the remaining configs
+                records.append({"metric": METRIC_NAMES[name], "value": None,
+                                "unit": "samples/sec",
+                                "error": f"{type(e).__name__}: {e}"})
+                continue
             log(f"[{name}] cpu-subprocess fallback: {rec['value']:,.0f} "
                 "samples/sec")
             records.append(rec)

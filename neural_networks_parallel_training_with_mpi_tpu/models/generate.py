@@ -129,6 +129,10 @@ def generate(model: Transformer, params, prompt: jax.Array,
                          f"max_seq_len {c.max_seq_len}")
     if temperature > 0 and key is None:
         raise ValueError("temperature sampling needs a PRNG key")
+    if max_new_tokens == 0:
+        # nothing to generate; the prefill path below would sample one token
+        # and clamp its write onto the last prompt column
+        return prompt.astype(jnp.int32)
     key = key if key is not None else jax.random.PRNGKey(0)
     caches = init_kv_cache(model, b, total)
     tokens = jnp.concatenate(

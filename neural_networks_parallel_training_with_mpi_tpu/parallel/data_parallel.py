@@ -139,11 +139,17 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
             loss = lax.psum(s, DATA_AXES) / total
             flat_params, unravel = ravel_pytree(state.params)
             flat_grads, _ = ravel_pytree(grads)
-            # opt-state slot length fixes the padded shard size (the local
-            # view inside shard_map is the per-device slice)
-            shard_len = jax.tree_util.tree_leaves(
-                state.opt_state)[-1].shape[0]
             n = data_axis_size(mesh)
+            # per-replica slice length, derived the same way
+            # zero1_opt_state pads: ceil(param_count / n).  (Deriving it
+            # from an opt-state leaf shape would silently break for any
+            # optimizer whose trailing leaf is not the flat buffer.)
+            shard_len = (flat_params.shape[0] + n - 1) // n
+            for leaf in jax.tree_util.tree_leaves(state.opt_state):
+                if leaf.ndim == 1:
+                    assert leaf.shape[0] == shard_len, (
+                        f"zero1 opt-state slot length {leaf.shape[0]} != "
+                        f"derived shard length {shard_len}")
             pad = shard_len * n - flat_params.shape[0]
             g_shard = lax.psum_scatter(
                 jnp.pad(flat_grads.astype(jnp.float32), (0, pad)),

@@ -401,6 +401,7 @@ class Trainer:
         loader = self.loader if data is None else ShardedLoader(
             self.mesh, data, self.cfg.batch_size, shuffle=False,
             seed=self.cfg.seed, full_batch=self.cfg.full_batch,
+            seq_axis="seq" if self.seq_parallel else None,
             batch_axes=self.batch_axes)
         params = self._eval_params()
         sums: Dict[str, float] = {}

@@ -15,6 +15,8 @@ from typing import Any, Dict, Optional, TextIO
 
 import jax
 
+_warned_init_state = False
+
 
 def is_leader() -> bool:
     # jax.process_index() initializes the PJRT backend on first call — which
@@ -23,6 +25,7 @@ def is_leader() -> bool:
     # as the leader instead of touching the accelerator runtime; once
     # training has initialized a backend the real process index is used, so
     # multi-host leader-only logging is unaffected.
+    global _warned_init_state
     try:
         from jax._src import xla_bridge
 
@@ -30,8 +33,10 @@ def is_leader() -> bool:
     except Exception:
         # introspection API moved (JAX upgrade): be loud once rather than
         # silently reintroducing the pre-init hang
-        print("WARNING: cannot determine JAX backend-init state; "
-              "leader check may initialize the backend", file=sys.stderr)
+        if not _warned_init_state:
+            _warned_init_state = True
+            print("WARNING: cannot determine JAX backend-init state; "
+                  "leader check may initialize the backend", file=sys.stderr)
         initialized = True
     if not initialized:
         return True

@@ -32,18 +32,23 @@ PLATFORMS = ("auto", "cpu", "tpu")
 _TUNNEL_ENV = "PALLAS_AXON_POOL_IPS"
 
 
-def force_host_device_count(n: int) -> None:
+def force_host_device_count(n: Optional[int], env=None) -> None:
     """Request ``n`` virtual CPU devices (must run before backend init).
 
     This is the launcher's replacement for ``mpiexec -n N`` when no
     accelerator is present: SPMD code sees N devices on one host.  Any
     pre-existing count in ``XLA_FLAGS`` is *replaced* — an explicit
-    ``--num_devices`` must win over a stale exported flag.
+    ``--num_devices`` must win over a stale exported flag; ``n=None``
+    strips a stale count without setting a new one.  ``env`` defaults to
+    ``os.environ`` (pass a dict to prepare a subprocess environment).
     """
-    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+    if env is None:
+        env = os.environ
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
              if "xla_force_host_platform_device_count" not in f]
-    flags.append(f"--xla_force_host_platform_device_count={n}")
-    os.environ["XLA_FLAGS"] = " ".join(flags)
+    if n is not None:
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
 
 
 def pin(platform: str = "auto", num_devices: Optional[int] = None) -> None:
@@ -58,7 +63,7 @@ def pin(platform: str = "auto", num_devices: Optional[int] = None) -> None:
     """
     if platform not in PLATFORMS:
         raise ValueError(f"platform must be one of {PLATFORMS}, got {platform!r}")
-    if num_devices is not None and num_devices > 1:
+    if num_devices is not None:
         force_host_device_count(num_devices)
     if platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"

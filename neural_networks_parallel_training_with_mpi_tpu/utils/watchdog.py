@@ -57,9 +57,12 @@ class HangWatchdog:
         try:
             yield
         finally:
-            self._suspended -= 1
+            # reset the heartbeat BEFORE un-suspending: the watchdog thread
+            # must never observe _suspended==0 with a beat that is stale
+            # from before the suspended phase
             if self._beat is not None:
                 self.pat()
+            self._suspended -= 1
 
     def _run(self) -> None:
         assert self.timeout_s is not None

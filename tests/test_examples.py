@@ -16,13 +16,15 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
 def _clean_env():
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        platform as plat,
+    )
+
     env = dict(os.environ)
     # the scripts' own --platform cpu pin must be sufficient; give them the
     # raw (axon-registered) environment, not the conftest's pre-pinned one
     env.pop("JAX_PLATFORMS", None)
-    flags = [f for f in env.get("XLA_FLAGS", "").split()
-             if "host_platform_device_count" not in f]
-    env["XLA_FLAGS"] = " ".join(flags)
+    plat.force_host_device_count(None, env=env)
     return env
 
 

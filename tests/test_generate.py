@@ -81,3 +81,22 @@ def test_generate_jits(lm):
     jitted = jax.jit(functools.partial(generate, model, max_new_tokens=4))
     out = jitted(params, jnp.zeros((1, 3), jnp.int32))
     assert out.shape == (1, 7)
+
+
+def test_zero_new_tokens_returns_prompt_unchanged():
+    # regression: the prefill path used to sample one token and clamp its
+    # write onto the last prompt column when max_new_tokens == 0
+    import numpy as np
+
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+
+    model = Transformer(TransformerConfig(vocab_size=17, max_seq_len=16,
+                                          n_layers=1, d_model=8, n_heads=2,
+                                          d_ff=16))
+    params = model.init(jax.random.PRNGKey(0))
+    prompt = jnp.arange(6, dtype=jnp.int32).reshape(1, 6) % 17
+    out = generate(model, params, prompt, max_new_tokens=0)
+    assert out.shape == prompt.shape
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
