@@ -86,12 +86,79 @@ def zero1_opt_state(optimizer: Optimizer, params: Pytree, mesh: Mesh,
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
 
+def zero1_shard_update(optimizer: Optimizer, state: TrainState,
+                       s, c, grads, mesh: Mesh,
+                       grad_clip: float = 0.0,
+                       extra_reduce_axes: Tuple[str, ...] = ()):
+    """The zero1 weight update, shared by the DP and DP x SP shard_map paths
+    (call inside ``shard_map``): reduce-scatter the flat gradient over the
+    data axes, clip by the *global* norm (psum of squared shard norms —
+    shard-local clipping would desynchronize replicas), update the local
+    1/N parameter slice with the local 1/N optimizer state, all-gather the
+    updated slices.
+
+    ``extra_reduce_axes`` lists additional mesh axes that shard loss terms
+    (e.g. ``('seq',)`` under sequence parallelism): counts/losses reduce
+    over them, and the scattered gradient shard is psum'd over them after
+    the data-axis reduce-scatter (the two reductions commute).
+    """
+    from jax.flatten_util import ravel_pytree
+
+    reduce_axes = DATA_AXES + tuple(extra_reduce_axes)
+    total = lax.psum(c, reduce_axes)
+    loss = lax.psum(s, reduce_axes) / total
+    flat_params, unravel = ravel_pytree(state.params)
+    flat_grads, _ = ravel_pytree(grads)
+    n = data_axis_size(mesh)
+    # per-replica slice length, derived the same way zero1_opt_state pads:
+    # ceil(param_count / n).  (Deriving it from an opt-state leaf shape
+    # would silently break for any optimizer whose trailing leaf is not
+    # the flat buffer.)
+    shard_len = (flat_params.shape[0] + n - 1) // n
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if leaf.ndim == 1:
+            assert leaf.shape[0] == shard_len, (
+                f"zero1 opt-state slot length {leaf.shape[0]} != "
+                f"derived shard length {shard_len}")
+    pad = shard_len * n - flat_params.shape[0]
+    g_shard = lax.psum_scatter(
+        jnp.pad(flat_grads.astype(jnp.float32), (0, pad)),
+        DATA_AXES, scatter_dimension=0, tiled=True)
+    if extra_reduce_axes:
+        g_shard = lax.psum(g_shard, tuple(extra_reduce_axes))
+    g_shard = g_shard / total
+    if grad_clip > 0:
+        # padding lanes are zero, so they contribute nothing to the norm
+        gsq = lax.psum(jnp.sum(jnp.square(g_shard)), DATA_AXES)
+        scale = jnp.minimum(1.0,
+                            grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
+        g_shard = g_shard * scale
+    idx = lax.axis_index(DATA_AXES)
+    p_shard = lax.dynamic_slice(
+        jnp.pad(flat_params, (0, pad)), (idx * shard_len,), (shard_len,))
+    new_p_shard, new_opt = optimizer.update(g_shard, state.opt_state,
+                                            p_shard)
+    flat_new = lax.all_gather(new_p_shard, DATA_AXES, axis=0,
+                              tiled=True)[:flat_params.shape[0]]
+    return TrainState(state.step + 1, unravel(flat_new), new_opt), loss
+
+
+def zero1_state_spec(optimizer: Optimizer) -> TrainState:
+    """shard_map in/out spec for a zero1-sharded TrainState: params
+    replicated, optimizer slots sharded over the data axes."""
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    return TrainState(step=P(), params=P(),
+                      opt_state=optimizer.state_specs(P(DATA_AXES)))
+
+
 def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
                     loss_name: str = "mse",
                     grad_reduction: str = "global_mean",
                     donate: bool = True,
                     accum_steps: int = 1,
-                    update_sharding: str = "replicated"
+                    update_sharding: str = "replicated",
+                    grad_clip: float = 0.0
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: (state, batch) -> (state, loss).
@@ -116,6 +183,12 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     memory and update FLOPs drop by the data-axis size.  Requires
     ``grad_reduction='global_mean'`` and opt state built by
     :func:`zero1_opt_state`.
+
+    ``grad_clip`` applies *global*-norm clipping on the zero1 path (norm
+    from a psum of squared shard norms — see :func:`zero1_shard_update`).
+    On the replicated path pass ``grad_clip=0`` and wrap the optimizer with
+    ``optim.with_clipping`` instead (there the full mean gradient is local,
+    so the wrapper's norm is already global).
     """
     if grad_reduction not in ("global_mean", "per_shard_mean"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
@@ -133,37 +206,8 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         s, c, grads = _accumulated_sum_and_grads(
             loss_fn, state.params, batch, accum_steps)
         if update_sharding == "zero1":
-            from jax.flatten_util import ravel_pytree
-
-            total = lax.psum(c, DATA_AXES)
-            loss = lax.psum(s, DATA_AXES) / total
-            flat_params, unravel = ravel_pytree(state.params)
-            flat_grads, _ = ravel_pytree(grads)
-            n = data_axis_size(mesh)
-            # per-replica slice length, derived the same way
-            # zero1_opt_state pads: ceil(param_count / n).  (Deriving it
-            # from an opt-state leaf shape would silently break for any
-            # optimizer whose trailing leaf is not the flat buffer.)
-            shard_len = (flat_params.shape[0] + n - 1) // n
-            for leaf in jax.tree_util.tree_leaves(state.opt_state):
-                if leaf.ndim == 1:
-                    assert leaf.shape[0] == shard_len, (
-                        f"zero1 opt-state slot length {leaf.shape[0]} != "
-                        f"derived shard length {shard_len}")
-            pad = shard_len * n - flat_params.shape[0]
-            g_shard = lax.psum_scatter(
-                jnp.pad(flat_grads.astype(jnp.float32), (0, pad)),
-                DATA_AXES, scatter_dimension=0, tiled=True) / total
-            idx = lax.axis_index(DATA_AXES)
-            p_shard = lax.dynamic_slice(
-                jnp.pad(flat_params, (0, pad)), (idx * shard_len,),
-                (shard_len,))
-            new_p_shard, new_opt = optimizer.update(g_shard, state.opt_state,
-                                                    p_shard)
-            flat_new = lax.all_gather(new_p_shard, DATA_AXES, axis=0,
-                                      tiled=True)[:flat_params.shape[0]]
-            new_params = unravel(flat_new)
-            return TrainState(state.step + 1, new_params, new_opt), loss
+            return zero1_shard_update(optimizer, state, s, c, grads, mesh,
+                                      grad_clip=grad_clip)
         if grad_reduction == "global_mean":
             total = lax.psum(c, DATA_AXES)
             grads = jax.tree_util.tree_map(
@@ -180,13 +224,8 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         return TrainState(state.step + 1, new_params, new_opt), loss
 
     batch_spec = P(DATA_AXES)
-    if update_sharding == "zero1":
-        if optimizer.state_specs is None:
-            raise ValueError(f"{optimizer.name} lacks state_specs")
-        opt_spec = optimizer.state_specs(P(DATA_AXES))
-        state_spec = TrainState(step=P(), params=P(), opt_state=opt_spec)
-    else:
-        state_spec = P()
+    state_spec = (zero1_state_spec(optimizer) if update_sharding == "zero1"
+                  else P())
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_spec, batch_spec),

@@ -72,7 +72,8 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                         aux_weight: float = 0.01,
                         donate: bool = True,
                         batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
-                        grad_clip: float = 0.0):
+                        grad_clip: float = 0.0,
+                        accum_steps: int = 1):
     """(state, batch) -> (state, metrics) jitted over data x fsdp x expert.
 
     ``metrics`` = {"loss": task loss, "aux": mean load-balance loss}.  The
@@ -101,7 +102,7 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         s, cnt = base(logits, batch["y"], batch.get("mask"))
         return s, (cnt, aux)
 
-    def shard_step(state: TrainState, batch: Batch):
+    def micro_grads(params, batch):
         def scalar(p):
             s, (cnt, aux) = local_fwd(p, batch)
             # aux is a per-shard mean-style scalar: average it over shards,
@@ -111,7 +112,38 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
             return s + aux_weight * aux * cnt, (s, cnt, aux)
 
         (_, (s, cnt, aux)), grads = jax.value_and_grad(
-            scalar, has_aux=True)(state.params)
+            scalar, has_aux=True)(params)
+        return s, cnt, aux, grads
+
+    def shard_step(state: TrainState, batch: Batch):
+        if accum_steps > 1:
+            micro = {}
+            for k, v in batch.items():
+                rows = v.shape[0]
+                if rows % accum_steps:
+                    raise ValueError(
+                        f"per-device batch rows {rows} (leaf {k!r}) not "
+                        f"divisible by accum_steps={accum_steps}")
+                micro[k] = v.reshape(
+                    (accum_steps, rows // accum_steps) + v.shape[1:])
+
+            def body(carry, mb):
+                cs, cc, ca, cg = carry
+                s, c, aux, g = micro_grads(state.params, mb)
+                cg = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), cg, g)
+                # aux is mean-style: accumulate count-weighted so the
+                # final aux metric is the token-weighted mean
+                return (cs + s, cc + c, ca + aux * c, cg), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32), zeros)
+            (s, cnt, aux_w, grads), _ = lax.scan(body, init, micro)
+            aux = aux_w / jnp.maximum(cnt, 1.0)
+        else:
+            s, cnt, aux, grads = micro_grads(state.params, batch)
         total = lax.psum(cnt, TOKEN_AXES)
         grads = jax.tree_util.tree_map_with_path(
             lambda path, g: lax.psum(

@@ -56,20 +56,72 @@ def batch_specs(batch: Batch) -> Pytree:
 def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                           loss_name: str = "mse",
                           example_batch: Optional[Batch] = None,
-                          donate: bool = True):
+                          donate: bool = True,
+                          accum_steps: int = 1):
     """(state, batch) -> (state, loss), global semantics, sharded by
-    annotation.  The loss is the exact masked global-batch mean."""
+    annotation.  The loss is the exact masked global-batch mean.
+
+    ``accum_steps > 1`` microbatches the global batch inside the step: rows
+    are split into ``accum`` congruence groups by a device-local reshape
+    (``(B, ...) -> (B/accum, accum, ...)`` keeps each device's contiguous
+    row block intact, so no resharding), and loss/grad *sums* accumulate
+    over a ``lax.scan`` before the single update — the unsplit math with
+    lower peak activation memory.
+    """
     if example_batch is None:
         raise ValueError("example_batch required to derive batch specs")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    import jax.numpy as jnp
+    from jax import lax
+
     base = losses_lib.get(loss_name)
+    if accum_steps > 1:
+        rows = next(iter(example_batch.values())).shape[0]
+        import numpy as np
+
+        data_size = int(np.prod([mesh.shape[a] for a in DATA_AXES]))
+        if rows % (accum_steps * data_size):
+            raise ValueError(
+                f"global batch {rows} not divisible by accum_steps="
+                f"{accum_steps} x data-axes size {data_size}")
+
+    def sum_and_grads(params, b):
+        def scalar(p):
+            pred = model.apply(p, b["x"])
+            return base(pred, b["y"], b.get("mask"))
+
+        (s, c), g = jax.value_and_grad(scalar, has_aux=True)(params)
+        return s, c, g
 
     def step_fn(state: TrainState, batch: Batch):
-        def scalar(p):
-            pred = model.apply(p, batch["x"])
-            s, c = base(pred, batch["y"], batch.get("mask"))
-            return s / c, c
+        if accum_steps > 1:
+            micro = {
+                k: v.reshape((v.shape[0] // accum_steps, accum_steps)
+                             + v.shape[1:]).swapaxes(0, 1)
+                for k, v in batch.items()
+            }
+            # keep the (now dim-1) batch dim on the data axes explicitly
+            micro = {k: jax.lax.with_sharding_constraint(
+                         v, NamedSharding(mesh, P(None, DATA_AXES)))
+                     for k, v in micro.items()}
 
-        (loss, _), grads = jax.value_and_grad(scalar, has_aux=True)(state.params)
+            def body(carry, mb):
+                cs, cc, cg = carry
+                s, c, g = sum_and_grads(state.params, mb)
+                cg = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), cg, g)
+                return (cs + s, cc + c, cg), None
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+                    zeros)
+            (s, c, grads), _ = lax.scan(body, init, micro)
+        else:
+            s, c, grads = sum_and_grads(state.params, batch)
+        loss = s / c
+        grads = jax.tree_util.tree_map(lambda g: g / c, grads)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), loss

@@ -1,0 +1,162 @@
+"""Explicit (shard_map-style) Megatron tensor parallelism for transformer
+blocks — the TP building block that composes with the pipeline's shard_map
+(parallel.pipeline) where GSPMD annotations (parallel.gspmd) cannot reach.
+
+The reference has no tensor parallelism (SURVEY.md §2.2: its model is a
+fully-replicated 13-param MLP, dataParallelTraining_NN_MPI.py:41-45); this
+module exists so pipeline x tensor meshes (DP x TP x PP) run as ONE SPMD
+program with every collective explicit:
+
+* **f / g operators** (Megatron's conjugate pair) as ``jax.custom_vjp`` so
+  the backward communication is unambiguous: ``f`` is identity forward /
+  psum backward (placed at a column-parallel layer's input — the partial
+  input-gradients from each tensor rank must be summed), ``g`` is psum
+  forward / identity backward (placed at a row-parallel layer's output).
+* **qkv column permutation**: the fused qkv weight is ``(d, 3d)`` laid out
+  ``[q | k | v]``; a contiguous tensor-axis slice of that would hand a rank
+  fragments of q and k from unrelated heads.  ``qkv_tp_permutation``
+  reorders columns to ``[q_r | k_r | v_r]`` per rank r (whole heads), so
+  the *sharded* layout is head-aligned while checkpoints stay
+  interchangeable with the dense model via the inverse permutation.
+* **tp_block_apply**: one pre-LN block with column-parallel qkv/ff_in,
+  local attention over ``n_heads / tp`` heads, and row-parallel
+  attn_out/ff_out — numerically the dense ``Transformer._block``
+  (models/transformer.py) up to split-matmul reassociation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.core import ACTIVATIONS, LayerNorm
+from ..parallel.sequence import attention_reference
+
+Pytree = Any
+TENSOR_AXIS = "tensor"
+
+
+def make_megatron_ops(axis: str = TENSOR_AXIS):
+    """The (f, g) conjugate operator pair.  Explicit ``custom_vjp`` rather
+    than relying on the transpose rule of ``lax.psum`` inside shard_map —
+    the backward collective is the correctness-critical part."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, None
+
+    def f_bwd(_, ct):
+        return (lax.psum(ct, axis),)
+
+    f.defvjp(f_fwd, f_bwd)
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis)
+
+    def g_fwd(x):
+        return lax.psum(x, axis), None
+
+    def g_bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(g_fwd, g_bwd)
+    return f, g
+
+
+def qkv_tp_permutation(d_model: int, n_heads: int, tp: int) -> np.ndarray:
+    """Column order mapping the fused ``[q | k | v]`` qkv weight to a layout
+    whose tensor-axis slice r is ``[q_heads_r | k_heads_r | v_heads_r]``."""
+    if n_heads % tp:
+        raise ValueError(f"n_heads={n_heads} not divisible by tp={tp}")
+    head_dim = d_model // n_heads
+    per = (n_heads // tp) * head_dim  # columns per rank per projection
+    cols = []
+    for r in range(tp):
+        for proj in range(3):  # q, k, v
+            base = proj * d_model + r * per
+            cols.extend(range(base, base + per))
+    return np.asarray(cols, dtype=np.int64)
+
+
+def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
+                inverse: bool = False) -> Pytree:
+    """Apply (or invert) the qkv column permutation on a blocks pytree —
+    works on both per-layer lists and pipeline-stacked leaves, since the
+    permuted dim is always the last."""
+    perm = qkv_tp_permutation(d_model, n_heads, tp)
+    if inverse:
+        perm = np.argsort(perm)
+
+    def fix(path, leaf):
+        names = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+        if "qkv" in names:
+            return jnp.take(leaf, perm, axis=-1)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, blocks)
+
+
+def validate_tp(cfg, tp: int) -> None:
+    for name, dim in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
+                      ("d_ff", cfg.d_ff)):
+        if dim % tp:
+            raise ValueError(f"{name}={dim} not divisible by tensor axis "
+                             f"size {tp}")
+
+
+def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
+                   axis: str = TENSOR_AXIS) -> jax.Array:
+    """One transformer block with the tensor dimension sharded over ``axis``
+    (call inside shard_map; ``layer_params`` are the LOCAL shards — qkv and
+    ff_in hold output-columns for this rank's heads/hidden units, attn_out
+    and ff_out hold the matching input-rows).
+
+    Mirrors ``Transformer._block`` (dense attention) exactly: pre-LN,
+    residual adds in the input dtype, activations in ``cfg.compute_dtype``.
+    """
+    f, g = make_megatron_ops(axis)
+    cdt = cfg.compute_dtype
+    heads_local = cfg.n_heads // tp
+    ln = LayerNorm(cfg.d_model, param_dtype=cfg.param_dtype)
+
+    # --- attention: column-parallel qkv, local heads, row-parallel out ---
+    h = ln.apply(layer_params["ln1"], x)
+    h = f(h)  # identity fwd; backward psums the partial input-grads
+    qkv = (h.astype(cdt) @ layer_params["qkv"]["w"].astype(cdt)
+           + layer_params["qkv"]["b"].astype(cdt))
+    b, t, _ = qkv.shape
+    q, k, v = jnp.split(qkv, 3, axis=-1)  # local layout is [q_r | k_r | v_r]
+    shape = (b, t, heads_local, cfg.head_dim)
+    out = attention_reference(q.reshape(shape), k.reshape(shape),
+                              v.reshape(shape), causal=True)
+    out = out.reshape(b, t, heads_local * cfg.head_dim)
+    partial = out @ layer_params["attn_out"]["w"].astype(cdt)
+    attn = g(partial) + layer_params["attn_out"]["b"].astype(cdt)
+    x = x + attn.astype(x.dtype)
+
+    # --- FFN: column-parallel in, row-parallel out ---
+    h = ln.apply(layer_params["ln2"], x)
+    h = f(h)
+    hh = (h.astype(cdt) @ layer_params["ff_in"]["w"].astype(cdt)
+          + layer_params["ff_in"]["b"].astype(cdt))
+    hh = ACTIVATIONS[cfg.activation](hh)
+    ff = (g(hh @ layer_params["ff_out"]["w"].astype(cdt))
+          + layer_params["ff_out"]["b"].astype(cdt))
+    return x + ff.astype(x.dtype)
+
+
+def tensor_sharded_block_paths() -> Tuple[Tuple[str, str], ...]:
+    """(submodule, leaf) pairs of block params that are SHARDED over the
+    tensor axis (everything else in a block — ln1/ln2, attn_out.b,
+    ff_out.b — is tensor-replicated with identical grads on every rank,
+    which the f operator's backward psum guarantees)."""
+    return (("qkv", "w"), ("qkv", "b"), ("ff_in", "w"), ("ff_in", "b"),
+            ("attn_out", "w"), ("ff_out", "w"))

@@ -30,6 +30,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -74,36 +75,72 @@ def unstack_blocks(stacked: Pytree) -> list:
 
 
 def init_pipeline_params(model: Transformer, key: jax.Array,
-                         n_stages: int) -> Pytree:
-    """``model.init`` then restack ``blocks`` for pipeline sharding."""
+                         n_stages: int, tp: int = 1) -> Pytree:
+    """``model.init`` then restack ``blocks`` for pipeline sharding.  With
+    ``tp > 1`` the fused qkv columns are permuted head-aligned so the
+    tensor-axis shards hold whole heads (parallel.megatron); checkpoints
+    then carry the permuted layout consistently, and ``unstack_blocks`` +
+    ``megatron.permute_qkv(inverse=True)`` recover the dense layout."""
     params = model.init(key)
     params = dict(params)
-    params["blocks"] = stack_blocks(params["blocks"], n_stages)
+    blocks = stack_blocks(params["blocks"], n_stages)
+    if tp > 1:
+        from . import megatron
+
+        c = model.cfg
+        blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp)
+    params["blocks"] = blocks
     return params
 
 
 def init_pipeline_state(model: Transformer, optimizer: Optimizer,
-                        key: jax.Array, n_stages: int) -> TrainState:
-    params = init_pipeline_params(model, key, n_stages)
+                        key: jax.Array, n_stages: int,
+                        tp: int = 1) -> TrainState:
+    params = init_pipeline_params(model, key, n_stages, tp)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=optimizer.init(params))
 
 
-def pipeline_param_specs(params: Pytree) -> Pytree:
+def _block_path_names(path) -> Tuple[str, ...]:
+    return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def pipeline_param_specs(params: Pytree, tp: int = 1) -> Pytree:
     """PartitionSpec tree: stacked blocks sharded over 'pipe' (dim 0),
     embed/pos/ln_f/head replicated (they live on every stage; their grads are
-    psum'd over 'pipe' so replicas stay identical)."""
+    psum'd over 'pipe' so replicas stay identical).  With ``tp > 1``,
+    Megatron column/row dims of the block weights additionally shard over
+    'tensor' (stacked leaves are (n_stages, layers_per_stage, ...), so the
+    tensor dim sits at index 2 or 3)."""
+
+    def block_spec(path, leaf):
+        if tp <= 1:
+            return P(PIPE_AXIS)
+        names = _block_path_names(path)
+        col = "qkv" in names or "ff_in" in names
+        row = "attn_out" in names or "ff_out" in names
+        ndim = len(np.shape(leaf))
+        if names[-1] == "w" and col and ndim == 4:
+            return P(PIPE_AXIS, None, None, "tensor")
+        if names[-1] == "w" and row and ndim == 4:
+            return P(PIPE_AXIS, None, "tensor", None)
+        if names[-1] == "b" and col and ndim == 3:
+            return P(PIPE_AXIS, None, "tensor")
+        return P(PIPE_AXIS)
+
     return {
-        k: jax.tree_util.tree_map(
-            lambda _: P(PIPE_AXIS) if k == "blocks" else P(), v)
+        k: (jax.tree_util.tree_map_with_path(block_spec, v) if k == "blocks"
+            else jax.tree_util.tree_map(lambda _: P(), v))
         for k, v in params.items()
     }
 
 
 def shard_pipeline_state(state: TrainState, mesh: Mesh,
                          optimizer: Optimizer) -> TrainState:
-    """Place the state on the mesh: blocks pipe-sharded, rest replicated."""
-    pspecs = pipeline_param_specs(state.params)
+    """Place the state on the mesh: blocks pipe-sharded (x tensor-sharded
+    on a DP x TP x PP mesh), rest replicated."""
+    tp = int(mesh.shape.get("tensor", 1))
+    pspecs = pipeline_param_specs(state.params, tp)
     ospecs = (optimizer.state_specs(pspecs) if optimizer.state_specs
               else jax.tree_util.tree_map(lambda _: P(), state.opt_state))
     specs = TrainState(step=P(), params=pspecs, opt_state=ospecs)
@@ -136,6 +173,7 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
     """
     c = model.cfg
     n_stages = int(mesh.shape[PIPE_AXIS])
+    tp = int(mesh.shape.get("tensor", 1))
     if n_stages < 2:
         raise ValueError("pipeline needs mesh axis 'pipe' > 1; use the plain "
                          "spmd/data_parallel step otherwise")
@@ -150,14 +188,31 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
         raise NotImplementedError("MoE + pipeline composition is not wired "
                                   "yet (aux loss would be dropped); use "
                                   "parallel.expert for MoE models")
+    if tp > 1:
+        from . import megatron
 
-    def stage_apply(stage_params, x):
-        # stage_params leaves: (layers_per_stage, ...); scan = the stage body
-        def body(h, layer_params):
-            h, _aux = model._block(layer_params, h)  # dense FFN: aux == 0
-            return h, None
-        out, _ = lax.scan(body, x, stage_params)
-        return out
+        megatron.validate_tp(c, tp)
+        if c.attention != "dense":
+            raise NotImplementedError(
+                f"pipeline x tensor runs dense attention over local heads; "
+                f"attention={c.attention!r} is not wired on this path")
+
+    if tp > 1:
+        from . import megatron
+
+        def stage_apply(stage_params, x):
+            def body(h, layer_params):
+                return megatron.tp_block_apply(c, layer_params, h, tp), None
+            out, _ = lax.scan(body, x, stage_params)
+            return out
+    else:
+        def stage_apply(stage_params, x):
+            # stage_params leaves: (layers_per_stage, ...); scan = stage body
+            def body(h, layer_params):
+                h, _aux = model._block(layer_params, h)  # dense FFN: aux == 0
+                return h, None
+            out, _ = lax.scan(body, x, stage_params)
+            return out
 
     def embed(params, ids_mb):
         t = ids_mb.shape[-1]
@@ -233,9 +288,29 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
         if grad_clip > 0:
             sq = {k: sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                          for l in jax.tree_util.tree_leaves(v))
-                  for k, v in grads.items()}
-            gsq = sum(v for k, v in sq.items() if k != "blocks") \
-                + lax.psum(sq["blocks"], PIPE_AXIS)
+                  for k, v in grads.items() if k != "blocks"}
+            # blocks: pipe-sharded; with TP, Megatron col/row leaves are
+            # additionally tensor-sharded while ln/row-bias leaves are
+            # tensor-replicated (identical grads per rank — not summed)
+            blk_t = jnp.zeros((), jnp.float32)
+            blk_r = jnp.zeros((), jnp.float32)
+            for path, g in jax.tree_util.tree_flatten_with_path(
+                    grads["blocks"])[0]:
+                term = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                names = _block_path_names(path)
+                col = "qkv" in names or "ff_in" in names
+                row = "attn_out" in names or "ff_out" in names
+                t_sharded = tp > 1 and ((col and names[-1] in ("w", "b"))
+                                        or (row and names[-1] == "w"))
+                if t_sharded:
+                    blk_t = blk_t + term
+                else:
+                    blk_r = blk_r + term
+            gsq = sum(sq.values()) + lax.psum(blk_r, PIPE_AXIS)
+            if tp > 1:
+                gsq = gsq + lax.psum(blk_t, (PIPE_AXIS, "tensor"))
+            else:
+                gsq = gsq + lax.psum(blk_t, PIPE_AXIS)
             scale = jnp.minimum(
                 1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
             grads = jax.tree_util.tree_map(
@@ -247,8 +322,9 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
 
     # shard_map specs must mirror the state placement exactly
     dummy = jax.eval_shape(
-        lambda: init_pipeline_params(model, jax.random.PRNGKey(0), n_stages))
-    pspecs = pipeline_param_specs(dummy)
+        lambda: init_pipeline_params(model, jax.random.PRNGKey(0), n_stages,
+                                     tp))
+    pspecs = pipeline_param_specs(dummy, tp)
     ospecs = (optimizer.state_specs(pspecs) if optimizer.state_specs
               else None)
     if ospecs is None:
@@ -271,7 +347,8 @@ def run_one_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                  ) -> Tuple[TrainState, jax.Array]:
     """Convenience for dry-runs and tests: init, place, one pipelined step."""
     n_stages = int(mesh.shape[PIPE_AXIS])
-    state = init_pipeline_state(model, optimizer, key, n_stages)
+    state = init_pipeline_state(model, optimizer, key, n_stages,
+                                tp=int(mesh.shape.get("tensor", 1)))
     state = shard_pipeline_state(state, mesh, optimizer)
     placed = {k: jax.device_put(
         jnp.asarray(v), NamedSharding(mesh, P(DATA_AXES)))

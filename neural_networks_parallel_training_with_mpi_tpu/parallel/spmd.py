@@ -20,7 +20,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import losses as losses_lib
 from ..ops.optim import Optimizer
 from ..train.state import TrainState
-from .data_parallel import DATA_AXES, _accumulated_sum_and_grads
+from .data_parallel import (
+    DATA_AXES,
+    _accumulated_sum_and_grads,
+    zero1_shard_update,
+    zero1_state_spec,
+)
 
 Pytree = Any
 Batch = Dict[str, jax.Array]
@@ -44,7 +49,9 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                          seq_axis: Optional[str] = None,
                          donate: bool = True,
                          example_batch: Optional[Batch] = None,
-                         accum_steps: int = 1):
+                         accum_steps: int = 1,
+                         update_sharding: str = "replicated",
+                         grad_clip: float = 0.0):
     """(state, batch) -> (state, loss) jitted over data x seq axes.
 
     ``seq_axis`` should be set iff the model's attention is ring/ulysses and
@@ -56,10 +63,20 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     local sequence) and accumulates loss/grad sums before the single psum +
     update — the same math as the unsplit step in exact arithmetic, with
     ulp-level f32 differences from the reassociated summation order.
+
+    ``update_sharding='zero1'`` shards the weight update + optimizer state
+    over the *data* axes exactly as in ``data_parallel.make_train_step``
+    (the state stays replicated over 'seq'; the scattered gradient shard is
+    additionally psum'd over 'seq').  ``grad_clip`` is the zero1 global-norm
+    clip; on the replicated path wrap the optimizer in ``optim.with_clipping``
+    instead.
     """
+    if update_sharding not in ("replicated", "zero1"):
+        raise ValueError(f"unknown update_sharding {update_sharding!r}")
     base = losses_lib.get(loss_name)
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
-    reduce_axes = DATA_AXES + ((seq_axis,) if use_seq else ())
+    extra = (seq_axis,) if use_seq else ()
+    reduce_axes = DATA_AXES + extra
 
     def loss_sum(params, batch):
         pred = model.apply(params, batch["x"])
@@ -68,6 +85,10 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     def shard_step(state: TrainState, batch: Batch):
         s, c, grads = _accumulated_sum_and_grads(
             loss_sum, state.params, batch, accum_steps)
+        if update_sharding == "zero1":
+            return zero1_shard_update(optimizer, state, s, c, grads, mesh,
+                                      grad_clip=grad_clip,
+                                      extra_reduce_axes=extra)
         total = lax.psum(c, reduce_axes)
         grads = jax.tree_util.tree_map(
             lambda g: lax.psum(g, reduce_axes) / total, grads)
@@ -79,10 +100,12 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     if example_batch is None:
         raise ValueError("example_batch required to derive per-leaf specs")
     specs = batch_specs(example_batch, seq_axis if use_seq else None)
+    state_spec = (zero1_state_spec(optimizer)
+                  if update_sharding == "zero1" else P())
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
-        in_specs=(P(), specs),
-        out_specs=(P(), P()),
+        in_specs=(state_spec, specs),
+        out_specs=(state_spec, P()),
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -37,16 +37,29 @@ class Trainer:
         self.seq_parallel = self.mesh.shape.get("seq", 1) > 1
         self.pipeline = self.mesh.shape.get("pipe", 1) > 1
         self.expert = self.mesh.shape.get("expert", 1) > 1
-        # GSPMD (jit + sharding annotations) when params are sharded;
-        # explicit shard_map otherwise
-        self.gspmd = (self.mesh.shape.get("tensor", 1) > 1
-                      or self.mesh.shape.get("fsdp", 1) > 1)
+        self.tensor = self.mesh.shape.get("tensor", 1) > 1
+        # strategy -> step builder:
+        #   pipe (x tensor)      -> parallel.pipeline shard_map (explicit
+        #                           Megatron TP inside the stages, DP x TP x PP)
+        #   tensor/fsdp (no pipe)-> parallel.gspmd (jit + annotations)
+        #   seq                  -> parallel.spmd shard_map (ring attention)
+        #   expert               -> parallel.expert shard_map (all_to_all)
+        self.gspmd = (not self.pipeline
+                      and (self.tensor or self.mesh.shape.get("fsdp", 1) > 1))
+        unwired = [name for name, on in
+                   (("seq", self.seq_parallel),
+                    ("fsdp", self.mesh.shape.get("fsdp", 1) > 1),
+                    ("expert", self.expert)) if on]
+        if self.pipeline and unwired:
+            raise NotImplementedError(
+                f"pipe composes with data + tensor axes; got pipe x "
+                f"{unwired} — compose parallel.* step builders directly")
         exclusive = [name for name, on in
                      (("seq", self.seq_parallel), ("tensor/fsdp", self.gspmd),
-                      ("pipe", self.pipeline), ("expert", self.expert)) if on]
+                      ("expert", self.expert)) if on]
         if len(exclusive) > 1:
             raise NotImplementedError(
-                f"Trainer wires one non-data parallelism style at a time, "
+                f"these axes are wired one at a time (plus data/pipe), "
                 f"got {exclusive}; compose parallel.* step builders directly "
                 "for mixed meshes")
         if self.pipeline and cfg.model.arch != "transformer":
@@ -66,16 +79,11 @@ class Trainer:
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
         self.zero1 = cfg.update_sharding == "zero1"
-        if self.zero1 and (self.gspmd or self.seq_parallel or self.pipeline
-                           or self.expert):
+        if self.zero1 and (self.gspmd or self.pipeline or self.expert):
             raise NotImplementedError(
-                "update_sharding='zero1' is wired into the pure-DP "
-                "shard_map path only (fsdp/tensor axes already shard "
-                "state on the GSPMD path)")
-        if self.zero1 and cfg.grad_clip:
-            raise NotImplementedError(
-                "grad_clip with update_sharding='zero1' would clip by the "
-                "local shard's norm; use the replicated path for clipping")
+                "update_sharding='zero1' is wired into the shard_map DP "
+                "and DP x seq paths (fsdp/tensor axes already shard state "
+                "on the GSPMD path)")
         if self.zero1 and cfg.grad_reduction != "global_mean":
             raise ValueError("update_sharding='zero1' implies global_mean "
                              "gradient semantics")
@@ -117,24 +125,24 @@ class Trainer:
             cfg.lr_schedule, cfg.lr,
             total_steps=cfg.nepochs * max(self.loader.steps_per_epoch, 1),
             warmup_steps=cfg.warmup_steps, min_lr=cfg.min_lr)
-        # pipeline/expert steps clip inside the step (their grad leaves are
-        # axis-sharded; optim.with_clipping's shard-local norm would be
-        # wrong there — see make_pipeline_train_step / make_moe_train_step)
-        step_clips = self.pipeline or self.expert
+        # pipeline/expert/zero1 steps clip inside the step (their grad
+        # leaves are axis-sharded; optim.with_clipping's shard-local norm
+        # would be wrong there — see make_pipeline_train_step /
+        # make_moe_train_step / zero1_shard_update)
+        step_clips = self.pipeline or self.expert or self.zero1
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
-        if cfg.accum_steps > 1 and (self.gspmd or self.pipeline
-                                    or self.expert):
-            raise NotImplementedError(
-                "accum_steps > 1 is wired into the shard_map DP and DP x "
-                "seq paths; the GSPMD/pipeline/expert steps run "
-                "unaccumulated")
         if self.pipeline:
             from ..parallel import pipeline as pp
 
+            # accumulation folds into the GPipe schedule: accum_steps x
+            # more microbatches per step (smaller microbatches, same
+            # single optimizer update — and a smaller bubble fraction)
+            n_stages = int(self.mesh.shape["pipe"])
             self.train_step = pp.make_pipeline_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                n_microbatches=n_stages * cfg.accum_steps,
                 grad_clip=cfg.grad_clip)
             # eval runs the *dense* model on pipe-gathered params
             # (_eval_params); same math, no pipelining needed off the hot path
@@ -146,7 +154,7 @@ class Trainer:
 
             moe_step = ep_lib.make_moe_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
-                grad_clip=cfg.grad_clip)
+                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
 
             def train_step(state, batch):
                 state, metrics = moe_step(state, batch)
@@ -163,7 +171,9 @@ class Trainer:
             self.train_step = spmd.make_spmd_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
                 seq_axis="seq", example_batch=example,
-                accum_steps=cfg.accum_steps)
+                accum_steps=cfg.accum_steps,
+                update_sharding=cfg.update_sharding,
+                grad_clip=cfg.grad_clip if self.zero1 else 0.0)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -174,7 +184,7 @@ class Trainer:
             example = next(iter(self.loader.epoch(0)))
             self.train_step = gspmd.make_gspmd_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
-                example_batch=example)
+                example_batch=example, accum_steps=cfg.accum_steps)
             self.eval_step = gspmd.make_gspmd_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -184,7 +194,8 @@ class Trainer:
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
                 grad_reduction=cfg.grad_reduction,
                 accum_steps=cfg.accum_steps,
-                update_sharding=cfg.update_sharding)
+                update_sharding=cfg.update_sharding,
+                grad_clip=cfg.grad_clip if self.zero1 else 0.0)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
@@ -201,7 +212,8 @@ class Trainer:
 
             state = pp.init_pipeline_state(
                 self.model, self.optimizer, prng.init_key(self.cfg.seed),
-                int(self.mesh.shape["pipe"]))
+                int(self.mesh.shape["pipe"]),
+                tp=int(self.mesh.shape.get("tensor", 1)))
             self.state = pp.shard_pipeline_state(state, self.mesh,
                                                  self.optimizer)
             return self.state
@@ -394,7 +406,15 @@ class Trainer:
         from ..parallel import pipeline as pp
 
         params = dict(jax.device_get(self.state.params))
-        params["blocks"] = pp.unstack_blocks(params["blocks"])
+        blocks = params["blocks"]
+        tp = int(self.mesh.shape.get("tensor", 1))
+        if tp > 1:  # undo the head-aligned qkv column permutation
+            from ..parallel import megatron
+
+            c = self.model.cfg
+            blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp,
+                                          inverse=True)
+        params["blocks"] = pp.unstack_blocks(blocks)
         return jax.device_put(params, NamedSharding(self.mesh, P()))
 
     def evaluate(self, data: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, float]:

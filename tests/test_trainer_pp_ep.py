@@ -61,12 +61,17 @@ def test_trainer_expert_requires_moe_model():
         Trainer(cfg)
 
 
-def test_trainer_rejects_mixed_styles():
+def test_trainer_rejects_unwired_mixed_styles():
     cfg = _lm_cfg(data=2, pipe=2, expert=2)
     cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
                                     moe_expert_axis="expert")
-    with pytest.raises(NotImplementedError, match="one non-data"):
+    with pytest.raises(NotImplementedError, match="pipe composes with"):
         Trainer(cfg)
+    cfg2 = _lm_cfg(data=2, seq=2, expert=2)
+    cfg2.model = dataclasses.replace(cfg2.model, moe_experts=4,
+                                     moe_expert_axis="expert")
+    with pytest.raises(NotImplementedError, match="one at a time"):
+        Trainer(cfg2)
 
 
 def test_cli_ep_flag_wires_moe():
