@@ -206,6 +206,39 @@ METRIC_NAMES = {
 }
 
 
+def timed_chain(step, state, batch, n: int, sync_every: int = 0):
+    """Dispatch n chained steps and time to the final loss VALUE.
+    device_get is the sync: on the tunneled-TPU backend block_until_ready
+    can resolve before the chain has executed (observed: apparent MFU >
+    100%), but the loss value cannot exist until every prior step ran.
+    A single timed chain measures n*step + a constant (host round-trip to
+    the device, ~65 ms through the tunnel, plus the final transfer);
+    callers time two chain lengths and difference to cancel the constant.
+
+    ``sync_every`` bounds the async dispatch queue (block_until_ready every
+    K steps).  Required on the virtual-CPU mesh: a deep queue of tiny
+    8-device programs can starve XLA:CPU's collective rendezvous past its
+    fatal 40 s termination timeout.  Leave 0 on TPU — the local sync is
+    ~free on CPU but would re-introduce the tunnel round trip into the
+    differenced timing on TPU.  Returns (seconds, new_state, loss_value)."""
+    import jax
+
+    t0 = time.perf_counter()
+    loss = None
+    for i in range(n):
+        state, loss = step(state, batch)
+        if sync_every and (i + 1) % sync_every == 0:
+            jax.block_until_ready(loss)
+    val = float(jax.device_get(loss))
+    return time.perf_counter() - t0, state, val
+
+
+def _chain_sync_every() -> int:
+    import jax
+
+    return 0 if jax.default_backend() == "tpu" else 25
+
+
 def bench_framework(config_name: str) -> dict:
     import jax
     import jax.numpy as jnp
@@ -239,20 +272,23 @@ def bench_framework(config_name: str) -> dict:
     rng = np.random.default_rng(0)
     batch = shd.shard_batch(mesh, cfg["make_batch"](rng, batch_size))
 
+    sync = _chain_sync_every()
     t0 = time.perf_counter()
-    for _ in range(WARMUP_STEPS):
-        state, loss = step(state, batch)
-    jax.block_until_ready(loss)
+    _, state, _ = timed_chain(step, state, batch, WARMUP_STEPS, sync)
     log(f"[{config_name}] compile+warmup: {time.perf_counter() - t0:.1f}s")
 
-    steps = cfg["measure_steps"]
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, loss = step(state, batch)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # two chain lengths, differenced (see timed_chain)
+    n1 = cfg["measure_steps"]
+    n2 = 3 * n1
+    t1, state, _ = timed_chain(step, state, batch, n1, sync)
+    t2, state, loss_val = timed_chain(step, state, batch, n2, sync)
+    dt = max(t2 - t1, 1e-9)
+    steps = n2 - n1
+    if t2 <= t1:  # noise floor (sub-ms configs on a local backend)
+        dt, steps = t2, n2
     sps = batch_size * steps / dt
     step_ms = dt / steps * 1e3
+    log(f"[{config_name}] final loss {loss_val:.5f}")
 
     # MFU: matmul/conv FLOPs for one optimizer step = fwd + ~2x fwd for the
     # backward, over every chip's peak.
@@ -432,6 +468,73 @@ def run_scaling_sweep(out_path: str = "BENCH_SCALING.json") -> None:
         log(f"scaling sweep -> {out_path}")
 
 
+def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
+    """Flash (Pallas, fwd + Mosaic bwd kernels) vs dense (XLA) attention:
+    full train-step time on the tiny-LM config at growing sequence lengths.
+    Flash's advantage is O(T) memory and skipped above-diagonal blocks, so
+    the gap should widen with T (VERDICT r1 item 5's comparison)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    devices = jax.devices()
+    mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    cd = jnp.bfloat16 if on_tpu else jnp.float32
+    results = []
+    # CPU: dense-only mechanism smoke at one short length (flash reports
+    # null there — interpret mode is not a perf number); TPU: the real sweep
+    n_dev = len(devices)
+    for seq in ((256, 512, 1024) if on_tpu else (256,)):
+        b = max(1, (8192 if on_tpu else 512) // seq)
+        b = ((b + n_dev - 1) // n_dev) * n_dev  # rows divide the data axes
+        row = {"seq": seq, "batch": b}
+        for att in ("dense", "flash"):
+            if att == "flash" and not on_tpu:
+                row["flash_ms"] = None  # interpret mode: not a perf number
+                continue
+            model = Transformer(TransformerConfig(
+                vocab_size=2048, max_seq_len=seq, n_layers=2, d_model=256,
+                n_heads=8, d_ff=1024, attention=att, compute_dtype=cd))
+            opt = optim.sgd(lr=1e-4, momentum=0.9)
+            state = dp.replicate_state(
+                TrainState.create(model, opt, prng.init_key(0)), mesh)
+            step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                      "global_mean")
+            rng = np.random.default_rng(0)
+            batch = shd.shard_batch(mesh, {
+                "x": rng.integers(0, 2048, (b, seq)).astype(np.int32),
+                "y": rng.integers(0, 2048, (b, seq)).astype(np.int32),
+                "mask": np.ones((b,), np.float32)})
+
+            sync = _chain_sync_every()
+            _, state, _ = timed_chain(step, state, batch, 3, sync)  # compile
+            t1, state, _ = timed_chain(step, state, batch, 10, sync)
+            t2, state, _ = timed_chain(step, state, batch, 30, sync)
+            row[f"{att}_ms"] = round(max(t2 - t1, 1e-9) / 20 * 1e3, 3)
+        if row.get("dense_ms") and row.get("flash_ms"):
+            row["flash_speedup"] = round(row["dense_ms"] / row["flash_ms"], 3)
+        log(f"[attention] {row}")
+        results.append(row)
+    with open(out_path, "w") as f:
+        json.dump({"platform": devices[0].platform,
+                   "device_kind": devices[0].device_kind,
+                   "results": results}, f, indent=2)
+    log(f"attention comparison -> {out_path}")
+
+
 def resolve_platform(requested: str) -> str:
     """Return 'cpu' or 'accel' after a hang-proof subprocess probe."""
     if requested == "cpu":
@@ -458,6 +561,9 @@ def main() -> int:
                     help="bench all five configs, write BENCH_FULL.json")
     ap.add_argument("--scaling", action="store_true",
                     help="1..8 virtual-device sweep, write BENCH_SCALING.json")
+    ap.add_argument("--attention", action="store_true",
+                    help="flash vs dense attention step-time comparison, "
+                         "write BENCH_ATTENTION.json")
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     args = ap.parse_args()
@@ -469,6 +575,9 @@ def main() -> int:
     choice = resolve_platform(args.platform)
     if choice == "cpu":
         plat.pin("cpu")
+
+    if args.attention:  # after platform resolution: touches the backend
+        bench_attention()
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
     records = []

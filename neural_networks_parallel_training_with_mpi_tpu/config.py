@@ -84,7 +84,7 @@ class DataConfig:
     differently-normalized data).
     """
 
-    dataset: str = "regression"  # regression | mnist | cifar10 | lm | wide_regression
+    dataset: str = "regression"  # regression | wide_regression | digits | mnist | cifar10 | lm
     n_samples: Optional[int] = None  # None = per-dataset default (16 for regression)
     n_features: int = 2
     noise: float = 1.0
@@ -137,6 +137,9 @@ class ModelConfig:
     # all_to_all dispatch)
     moe_experts: int = 0
     moe_expert_axis: Optional[str] = None
+    # per-expert slot count = ceil(factor * group_tokens / n_experts);
+    # tokens over capacity fall through the residual (models/moe.py)
+    moe_capacity_factor: float = 1.25
 
 
 @dataclass
@@ -261,7 +264,8 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="zero1 = shard optimizer state + weight update "
                         "across the data axes (reduce-scatter/all-gather)")
     p.add_argument("--dataset",
-                   choices=["regression", "wide_regression", "mnist", "cifar10", "lm"],
+                   choices=["regression", "wide_regression", "digits",
+                            "mnist", "cifar10", "lm"],
                    default="regression")
     p.add_argument("--n_samples", type=int, default=None,
                    help="dataset size (default: per-dataset)")
@@ -305,6 +309,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
     p.add_argument("--moe_experts", type=int, default=0,
                    help="MoE experts per FFN (transformer only; 0 = dense)")
+    p.add_argument("--moe_capacity_factor", type=float, default=None,
+                   help="per-expert slot count = ceil(factor * group_tokens "
+                        "/ n_experts); overflow tokens fall through residual "
+                        "(default 1.25)")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=0)
     _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
@@ -383,8 +391,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                             n_heads=args.n_heads, d_ff=args.d_ff,
                             vocab_size=args.vocab_size,
                             max_seq_len=max(args.seq_len, 512))
-    if args.dataset in ("mnist", "cifar10"):
+    if args.dataset in ("mnist", "cifar10", "digits"):
         cfg.loss = "cross_entropy"
+    if args.dataset == "digits":
+        # real 8x8 sklearn digits (the zero-egress real-data quality run)
+        cfg.model = dataclasses.replace(
+            cfg.model, arch="mlp", in_features=64, hidden=(64, 32),
+            out_features=10)
     if args.dataset == "mnist":
         cfg.model = dataclasses.replace(
             cfg.model, arch="mlp", in_features=784, hidden=(256, 128),
@@ -410,6 +423,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         cfg.model.attention = args.attention
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
+    if args.moe_capacity_factor is not None:
+        cfg.model.moe_capacity_factor = args.moe_capacity_factor
     if args.ep > 1:
         # expert-sharded MoE: route token slots over the 'expert' axis
         cfg.model.moe_expert_axis = "expert"

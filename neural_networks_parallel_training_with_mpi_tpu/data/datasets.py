@@ -48,6 +48,23 @@ def regression_dataset(n_samples: int = 16, n_features: int = 2,
     return {"x": x, "y": y}
 
 
+def digits_dataset(seed: int = 0, do_standardize: bool = True) -> Arrays:
+    """sklearn ``load_digits`` — 1797 REAL 8x8 handwritten-digit images,
+    bundled with sklearn (no network; the only real classification dataset
+    available under zero egress).  Shapes mirror the MNIST pipeline at 1/12
+    resolution: x (N, 64) float32, y (N,) int32.  Rows are shuffled
+    deterministically by ``seed`` so train/val splits are class-balanced."""
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    x = d.data.astype(np.float32)
+    y = d.target.astype(np.int32)
+    if do_standardize:
+        x = standardize(x)
+    order = np.random.default_rng(seed).permutation(len(x))
+    return {"x": x[order], "y": y[order]}
+
+
 def _load_idx_images(path: Path) -> Optional[np.ndarray]:
     """Minimal IDX reader for locally-present MNIST files (no download)."""
     import gzip
@@ -167,6 +184,8 @@ def build_dataset(cfg: DataConfig, data_dir: Optional[str] = None) -> Arrays:
     if cfg.dataset == "wide_regression":
         return regression_dataset(cfg.n_samples or 1_000_000, cfg.n_features,
                                   cfg.noise, cfg.seed, cfg.standardize)
+    if cfg.dataset == "digits":
+        return digits_dataset(cfg.seed, cfg.standardize)
     if cfg.dataset == "mnist":
         return mnist_dataset(data_dir, cfg.seed,
                              n_samples=cfg.n_samples or 60_000)

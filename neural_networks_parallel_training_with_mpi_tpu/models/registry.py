@@ -32,6 +32,7 @@ def build_model(cfg: ModelConfig) -> Module:
             d_ff=cfg.d_ff, attention=cfg.attention, param_dtype=pdt,
             compute_dtype=cdt, remat=cfg.remat,
             moe_experts=cfg.moe_experts,
-            moe_expert_axis=cfg.moe_expert_axis)
+            moe_expert_axis=cfg.moe_expert_axis,
+            moe_capacity_factor=cfg.moe_capacity_factor)
         return Transformer(tc)
     raise ValueError(f"unknown arch {cfg.arch!r}")

@@ -16,10 +16,12 @@ provides:
   declines.
 
 Both run in interpreter mode on CPU (tests, SURVEY.md §4's fake-device
-strategy) and compiled on TPU.  The backward pass of flash_attention
-recomputes attention block-paired (same tiling, no (T, T) buffer) in plain
-JAX — XLA fuses it well; a hand-written Mosaic backward is a later
-optimization.
+strategy) and compiled through Mosaic on TPU.  The backward pass of
+flash_attention is also Pallas: the forward additionally emits the per-row
+logsumexp, and two backward kernels (dq; dk+dv) recompute the probability
+blocks from (q, k, lse) in VMEM — the standard FlashAttention-2 backward
+split, no (T, T) buffer anywhere.  ``_blocked_attention_reference`` keeps
+the same math in plain JAX as the cross-check for tests.
 """
 
 from __future__ import annotations
@@ -50,11 +52,11 @@ def _interpret_default() -> bool:
 # Flash attention
 # ==========================================================================
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                       block_k: int, seq_len: int, causal: bool,
                       scale: float):
     """Grid: (batch*heads, T // block_q).  Refs (block-local):
-    q (1, block_q, D), k/v (1, T, D), o (1, block_q, D)."""
+    q (1, block_q, D), k/v (1, T, D), o (1, block_q, D), lse (1, block_q)."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
     d = q.shape[-1]
@@ -94,31 +96,46 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
-def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                   block_q: int, block_k: int,
-                   interpret: Optional[bool]) -> jax.Array:
-    """q/k/v: (B, T, H, D) -> (B, T, H, D)."""
-    b, t, h, d = q.shape
-    scale = 1.0 / (d ** 0.5)
+def _heads_major(x: jax.Array) -> jax.Array:
+    """(B, T, H, D) -> (B*H, T, D): contiguous per-head rows for kernels."""
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _heads_minor(x: jax.Array, b: int, h: int) -> jax.Array:
+    """(B*H, T, D) -> (B, T, H, D)."""
+    _, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _resolve_blocks(t: int, block_q: int, block_k: int):
     block_q = min(block_q, t)
     block_k = min(block_k, t)
     if t % block_q or t % block_k:
         raise ValueError(f"seq_len {t} not divisible by blocks "
                          f"({block_q}, {block_k})")
+    return block_q, block_k
+
+
+def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
+                   block_q: int, block_k: int,
+                   interpret: Optional[bool]):
+    """q/k/v: (B, T, H, D) -> out (B, T, H, D), lse (B*H, T) float32."""
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(t, block_q, block_k)
     if interpret is None:
         interpret = _interpret_default()
-    # (B, T, H, D) -> (B*H, T, D): contiguous per-head rows for the kernel
-    qh = q.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    kh = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vh = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    qh, kh, vh = _heads_major(q), _heads_major(k), _heads_major(v)
 
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
                                block_k=block_k, seq_len=t, causal=causal,
                                scale=scale)
     mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, t // block_q),
         in_specs=[
@@ -126,12 +143,17 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), **mem),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0), **mem),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
-                               **mem),
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ],
         interpret=interpret,
     )(qh, kh, vh)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _heads_minor(out, b, h), lse
 
 
 def _blocked_attention_reference(q, k, v, causal: bool, block_k: int):
@@ -174,28 +196,181 @@ def _blocked_attention_reference(q, k, v, causal: bool, block_k: int):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+# --------------------------------------------------------------------------
+# Backward kernels (FlashAttention-2 split: one kernel accumulates dq over
+# k-blocks, one accumulates dk/dv over q-blocks; p is recomputed from
+# (q, k, lse), delta = rowsum(do * o) is precomputed outside).
+# --------------------------------------------------------------------------
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_q: int, block_k: int, seq_len: int,
+                         causal: bool, scale: float):
+    """Grid: (B*H, T // block_q).  q/do/dq blocks (1, block_q, D); k/v full
+    rows (1, T, D); lse/delta blocks (1, block_q) float32."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0].astype(jnp.float32)[:, None]        # (Bq, 1)
+    delta = delta_ref[0].astype(jnp.float32)[:, None]
+    d = q.shape[-1]
+    num_k_blocks = seq_len // block_k
+    if causal:
+        hi = lax.min(num_k_blocks,
+                     lax.div((qi + 1) * block_q + block_k - 1, block_k))
+    else:
+        hi = num_k_blocks
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 0)
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, block_k: int,
+                          seq_len: int, causal: bool, scale: float):
+    """Grid: (B*H, T // block_k).  k/v/dk/dv blocks (1, block_k, D);
+    q/do full rows (1, T, D); lse/delta full rows (1, T) float32."""
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                      # (Bk, D)
+    v = v_ref[0].astype(jnp.float32)
+    lse_row = lse_ref[0].astype(jnp.float32)              # (T,)
+    delta_row = delta_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    num_q_blocks = seq_len // block_q
+    # causal: k-block kj only feeds q rows >= kj*block_k
+    lo = lax.div(kj * block_k, block_q) if causal else 0
+    k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32,
+                                                (block_q, block_k), 1)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lax.dynamic_slice(lse_row, (i * block_q,), (block_q,))[:, None]
+        delta = lax.dynamic_slice(delta_row, (i * block_q,),
+                                  (block_q,))[:, None]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (Bq, Bk)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, num_q_blocks, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
+                    block_k: int, interpret: Optional[bool]):
+    b, t, h, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q, block_k = _resolve_blocks(t, block_q, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    qh, kh, vh = _heads_major(q), _heads_major(k), _heads_major(v)
+    doh = _heads_major(g)
+    # delta_i = sum_j p_ij * dp_ij = rowsum(do * o): one fused elementwise
+    # reduce in XLA, shared by both kernels
+    delta = (doh.astype(jnp.float32)
+             * _heads_major(out).astype(jnp.float32)).sum(-1)  # (BH, T)
+
+    mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
+    row = dict(block_q=block_q, block_k=block_k, seq_len=t, causal=causal,
+               scale=scale)
+    full = lambda spec_t: pl.BlockSpec((1, spec_t, d),
+                                       lambda bh, i: (bh, 0, 0), **mem)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **row),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
+            full(t), full(t),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
+                               **mem),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **row),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            full(t),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
+            full(t),
+            pl.BlockSpec((1, t), lambda bh, j: (bh, 0), **mem),
+            pl.BlockSpec((1, t), lambda bh, j: (bh, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, doh, lse, delta)
+    return (_heads_minor(dq, b, h), _heads_minor(dk, b, h),
+            _heads_minor(dv, b, h))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, block_q: int = 128,
                     block_k: int = 128,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Blocked attention, Pallas forward.  q/k/v: (B, T, H, D)."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    """Blocked attention, Pallas forward + Pallas backward.
+    q/k/v: (B, T, H, D)."""
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _blocked_attention_reference(q_, k_, v_, causal,
-                                                        min(block_k,
-                                                            q.shape[1])),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

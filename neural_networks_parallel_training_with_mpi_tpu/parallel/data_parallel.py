@@ -198,6 +198,12 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         raise ValueError("update_sharding='zero1' implies the exact "
                          "global-mean gradient; per_shard_mean is a "
                          "replicated-path-only compatibility mode")
+    if grad_clip > 0 and update_sharding != "zero1":
+        raise ValueError(
+            "grad_clip is only applied inside the zero1 update (its "
+            "gradient is shard-scattered there); on the replicated path "
+            "the full mean gradient is local — wrap the optimizer with "
+            "optim.with_clipping instead of silently not clipping")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     loss_fn = make_loss_fn(model, loss_name)

@@ -73,6 +73,11 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     """
     if update_sharding not in ("replicated", "zero1"):
         raise ValueError(f"unknown update_sharding {update_sharding!r}")
+    if grad_clip > 0 and update_sharding != "zero1":
+        raise ValueError(
+            "grad_clip is only applied inside the zero1 update; on the "
+            "replicated path wrap the optimizer with optim.with_clipping "
+            "instead of silently not clipping")
     base = losses_lib.get(loss_name)
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     extra = (seq_axis,) if use_seq else ()

@@ -144,9 +144,12 @@ class Trainer:
                 self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
                 n_microbatches=n_stages * cfg.accum_steps,
                 grad_clip=cfg.grad_clip)
-            # eval runs the *dense* model on pipe-gathered params
-            # (_eval_params); same math, no pipelining needed off the hot path
-            self.eval_step = dp.make_eval_step(
+            # eval runs the ring schedule forward-only on the pipe-sharded
+            # params in place — multi-host safe, no host gather
+            # natural microbatch count: accumulation is a gradient-only
+            # concept — folding accum_steps in here would only add padding
+            # waste on small validation batches
+            self.eval_step = pp.make_pipeline_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
         elif self.expert:
@@ -258,6 +261,40 @@ class Trainer:
         if self.pipeline:
             from ..parallel import pipeline as pp
 
+            # the TP qkv column permutation is shape-preserving, so a
+            # checkpoint written under a different tensor-axis size is
+            # undetectable from the pytree alone — meta.json records it
+            # (checkpoint.save extra_meta) and we re-permute here
+            tp = int(self.mesh.shape.get("tensor", 1))
+            meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
+            saved_tp = int(meta.get("qkv_tp", tp))
+            if saved_tp != tp:
+                from ..parallel import megatron
+
+                c = self.model.cfg
+
+                def fix(tree):
+                    """Re-permute a params-shaped pytree (params itself and
+                    each optimizer slot — momentum/mu/nu mirror the param
+                    layout and carry the same permutation)."""
+                    if not (isinstance(tree, dict) and "blocks" in tree):
+                        return tree  # e.g. the optimizer's step counter
+                    tree = dict(tree)
+                    b = tree["blocks"]
+                    if saved_tp > 1:
+                        b = megatron.permute_qkv(b, c.d_model, c.n_heads,
+                                                 saved_tp, inverse=True)
+                    if tp > 1:
+                        b = megatron.permute_qkv(b, c.d_model, c.n_heads, tp)
+                    tree["blocks"] = b
+                    return tree
+
+                opt_state = restored.opt_state
+                if isinstance(opt_state, tuple):  # SGDState/AdamState
+                    opt_state = type(opt_state)(*(fix(f) for f in opt_state))
+                restored = TrainState(step=restored.step,
+                                      params=fix(restored.params),
+                                      opt_state=opt_state)
             self.state = pp.shard_pipeline_state(restored, self.mesh,
                                                  self.optimizer)
         elif self.expert:
@@ -284,12 +321,19 @@ class Trainer:
         if self.cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
+            # record the (shape-preserving, hence otherwise undetectable)
+            # TP qkv permutation so maybe_resume can reconcile a different
+            # tensor-axis size
+            extra = ({"qkv_tp": int(self.mesh.shape.get("tensor", 1))}
+                     if self.pipeline else None)
             if self.cfg.async_checkpoint and not final:
-                ckpt.save_async(self.cfg.checkpoint_dir, self.state)
+                ckpt.save_async(self.cfg.checkpoint_dir, self.state,
+                                extra_meta=extra)
             else:
                 if final:  # drain in-flight writes before the last snapshot
                     ckpt.wait_pending()
-                ckpt.save(self.cfg.checkpoint_dir, self.state)
+                ckpt.save(self.cfg.checkpoint_dir, self.state,
+                          extra_meta=extra)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -394,11 +438,10 @@ class Trainer:
         return result
 
     def _eval_params(self):
-        """Params in the layout the eval step expects.  The pipelined state
-        keeps blocks stage-stacked and pipe-sharded; eval runs the dense
-        model, so gather them to host, unstack, and re-place replicated
-        (single-host path — pipelined multi-host eval would need its own
-        pipelined eval step)."""
+        """Params in the *dense* (per-layer, unpermuted) layout — used for
+        checkpoint interop and tests, NOT by :meth:`evaluate` (the pipelined
+        eval step consumes the pipe-sharded params in place, so this
+        single-host gather is off the eval path entirely)."""
         if not self.pipeline:
             return self.state.params
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -423,7 +466,10 @@ class Trainer:
             seed=self.cfg.seed, full_batch=self.cfg.full_batch,
             seq_axis="seq" if self.seq_parallel else None,
             batch_axes=self.batch_axes)
-        params = self._eval_params()
+        # every eval step (dense, gspmd, moe, pipelined) consumes the train
+        # state's own layout in place — no gather; _eval_params is only for
+        # checkpoint interop / dense export
+        params = self.state.params
         sums: Dict[str, float] = {}
         totals: Dict[str, float] = {}
         for batch in loader.epoch(0):

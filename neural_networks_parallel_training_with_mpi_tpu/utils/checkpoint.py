@@ -69,8 +69,14 @@ def _snapshot_dirs(d: Path):
     return sorted(out)
 
 
-def save(directory: str, state: TrainState, keep: int = 3) -> Path:
+def save(directory: str, state: TrainState, keep: int = 3,
+         extra_meta: Optional[dict] = None) -> Path:
     """Write ``<directory>/ckpt-<step>/``; prune to the newest ``keep``.
+
+    ``extra_meta`` is merged into ``meta.json`` — callers record layout
+    facts the pytree itself cannot express (e.g. the pipeline path's
+    tensor-axis qkv column permutation, which is shape-preserving and
+    therefore undetectable at restore time without metadata).
 
     Safe for sharded (non-addressable) state: falls back to orbax, where
     every process participates and writes its own shards — callers must
@@ -82,7 +88,7 @@ def save(directory: str, state: TrainState, keep: int = 3) -> Path:
     target = d / f"{_CKPT_PREFIX}{step}"
     if _is_fully_addressable(state):
         if jax.process_index() == 0:
-            _write_npz(d, step, jax.device_get(state), keep)
+            _write_npz(d, step, jax.device_get(state), keep, extra_meta)
             return target
     else:  # multi-host sharded: orbax shard-parallel write
         import orbax.checkpoint as ocp
@@ -92,14 +98,15 @@ def save(directory: str, state: TrainState, keep: int = 3) -> Path:
                        jax.tree_util.tree_map(lambda x: x, state))
         if jax.process_index() == 0:
             (target / "meta.json").write_text(json.dumps(
-                {"step": step, "format": "orbax"}))
+                {"step": step, "format": "orbax", **(extra_meta or {})}))
     if keep and jax.process_index() == 0:
         for _, old in _snapshot_dirs(d)[:-keep]:
             shutil.rmtree(old, ignore_errors=True)
     return target
 
 
-def _write_npz(d: Path, step: int, host_state: Any, keep: int) -> None:
+def _write_npz(d: Path, step: int, host_state: Any, keep: int,
+               extra_meta: Optional[dict] = None) -> None:
     """Serialized (lock-held) atomic npz snapshot write + pruning; runs on
     the caller's thread (sync save) or the writer thread (async save)."""
     with _write_lock:
@@ -113,7 +120,7 @@ def _write_npz(d: Path, step: int, host_state: Any, keep: int) -> None:
                                        for i, l in enumerate(leaves)})
         (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
         (tmp / "meta.json").write_text(json.dumps(
-            {"step": step, "format": "npz"}))
+            {"step": step, "format": "npz", **(extra_meta or {})}))
         if target.exists():
             shutil.rmtree(target)
         tmp.rename(target)
@@ -122,7 +129,8 @@ def _write_npz(d: Path, step: int, host_state: Any, keep: int) -> None:
                 shutil.rmtree(old, ignore_errors=True)
 
 
-def save_async(directory: str, state: TrainState, keep: int = 3) -> None:
+def save_async(directory: str, state: TrainState, keep: int = 3,
+               extra_meta: Optional[dict] = None) -> None:
     """Non-blocking save: snapshot device state to host now, write npz on a
     background thread so the train loop keeps dispatching steps (checkpoint
     I/O overlaps compute instead of stalling it — the reference, which has
@@ -138,7 +146,7 @@ def save_async(directory: str, state: TrainState, keep: int = 3) -> None:
     if err:
         raise RuntimeError("previous async checkpoint write failed") from err[0]
     if not _is_fully_addressable(state):
-        save(directory, state, keep)
+        save(directory, state, keep, extra_meta)
         return
     if jax.process_index() != 0:
         return
@@ -147,7 +155,7 @@ def save_async(directory: str, state: TrainState, keep: int = 3) -> None:
 
     def work():
         try:
-            _write_npz(Path(directory), step, host_state, keep)
+            _write_npz(Path(directory), step, host_state, keep, extra_meta)
         except BaseException as e:  # surfaced on the next save/wait call
             with _err_lock:
                 _async_errors.append(e)
@@ -172,6 +180,26 @@ def wait_pending() -> None:
 def latest_step(directory: str) -> Optional[int]:
     snaps = _snapshot_dirs(Path(directory))
     return snaps[-1][0] if snaps else None
+
+
+def read_meta(directory: str, step: Optional[int] = None) -> Optional[dict]:
+    """meta.json of the newest (or a specific) snapshot; None when the
+    directory has no snapshot or a legacy layout without metadata."""
+    d = Path(directory)
+    snaps = _snapshot_dirs(d)
+    if not snaps:
+        return None
+    if step is not None:
+        match = [p for s, p in snaps if s == step]
+        if not match:
+            return None
+        path = match[0]
+    else:
+        path = snaps[-1][1]
+    try:
+        return json.loads((path / "meta.json").read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def restore(directory: str, template: Optional[TrainState] = None,

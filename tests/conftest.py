@@ -22,8 +22,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + f" --xla_force_host_platform_device_count={_N_DEVICES}"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-# keep any axon PJRT plugin from being touched in test workers
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# keep any axon PJRT plugin from being touched in test workers (stash the
+# tunnel config so the opt-in TPU smoke test can restore it in a child)
+_axon_ips = os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+if _axon_ips is not None:
+    os.environ["_SAVED_PALLAS_AXON_POOL_IPS"] = _axon_ips
 
 import jax  # noqa: E402
 

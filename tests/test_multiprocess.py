@@ -1,0 +1,76 @@
+"""Real 2-process ``jax.distributed`` world on localhost (VERDICT r1 item 6).
+
+The rest of the suite exercises multi-*device* SPMD on one process; this
+test exercises multi-*process* world formation — the part of the stack the
+reference gets from ``mpiexec`` (README.md:12) and ``MPI.COMM_WORLD``
+(dataParallelTraining_NN_MPI.py:61-63).  Two OS processes, 2 virtual CPU
+devices each, gloo collectives over localhost: world_setup, barrier,
+broadcast_host_array, per-host data loading, a jitted DP train step over
+the 4-device global mesh, and an orbax shard-parallel checkpoint round
+trip — see distributed_child.py for the phase list.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+CHILD = Path(__file__).with_name("distributed_child.py")
+TIMEOUT_S = float(os.environ.get("MULTIPROC_TEST_TIMEOUT", "300"))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_world(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # child sets its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(CHILD.parent.parent) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(CHILD), str(pid), "2", str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(CHILD.parent.parent))
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=TIMEOUT_S)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"2-process world did not complete in {TIMEOUT_S:.0f}s "
+                    "(world formation hang?)")
+
+    reports = []
+    for rc, out, err in outs:
+        assert rc == 0, f"child rc={rc}\nstdout: {out[-1500:]}\nstderr: {err[-2500:]}"
+        rec = None
+        for line in reversed(out.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        assert rec is not None, f"no JSON from child: {out[-500:]}"
+        reports.append(rec)
+
+    assert {r["process_index"] for r in reports} == {0, 1}
+    for r in reports:
+        assert r["ok"] and r["broadcast_ok"] and r["replicas_ok"] \
+            and r["checkpoint_ok"], r
+    # both hosts computed the identical loss trajectory (one logical job)
+    assert reports[0]["losses"] == reports[1]["losses"]

@@ -111,3 +111,114 @@ def test_pipeline_multiple_steps_decrease_loss():
         state, loss = step(state, placed)
     assert float(loss) < float(first)
     assert int(state.step) == 11
+
+
+def test_bubble_fraction_accounting():
+    """More microbatches -> smaller bubble; accounting matches the scan
+    length the step actually runs (n_mb + n_stages - 1 ticks)."""
+    assert pp.schedule_ticks(4, 4) == 7
+    assert pp.bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    # accum_steps folding (Trainer: n_mb = n_stages * accum) shrinks it
+    assert pp.bubble_fraction(4, 16) == pytest.approx(3 / 19)
+    assert pp.bubble_fraction(4, 16) < pp.bubble_fraction(4, 4)
+    assert pp.bubble_fraction(2, 64) < 0.02
+
+
+def test_pipeline_eval_matches_dense_eval():
+    """The forward-only ring schedule on pipe-sharded params must produce
+    the same loss/accuracy as the dense model on gathered params."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+    )
+
+    model = tiny_model(4)
+    mesh = make_mesh(MeshConfig(data=2, pipe=2),
+                     devices=jax.devices("cpu")[:4])
+    opt = optim.sgd(lr=1e-2)
+    state = pp.init_pipeline_state(model, opt, prng.init_key(0), 2)
+    state = pp.shard_pipeline_state(state, mesh, opt)
+    batch = lm_batch(8)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    placed = {k: jax.device_put(jnp.asarray(v),
+                                NamedSharding(mesh, P(("data", "fsdp"))))
+              for k, v in batch.items()}
+    eval_step = pp.make_pipeline_eval_step(model, mesh, "cross_entropy",
+                                           with_accuracy=True)
+    got = jax.device_get(eval_step(state.params, placed))
+
+    dense_params = dict(jax.device_get(state.params))
+    dense_params["blocks"] = pp.unstack_blocks(dense_params["blocks"])
+    dense_eval = dp.make_eval_step(model, mesh, "cross_entropy",
+                                   with_accuracy=True)
+    rep = jax.device_put(dense_params, NamedSharding(mesh, P()))
+    want = jax.device_get(dense_eval(rep, placed))
+
+    assert float(got["count"]) == float(want["count"])
+    np.testing.assert_allclose(float(got["loss"]), float(want["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(got["accuracy"]),
+                               float(want["accuracy"]), rtol=1e-5)
+
+
+def test_pipeline_remat_matches_no_remat():
+    """cfg.remat re-materializes stage activations in the backward; the
+    trajectory must be identical to the stored-activation path."""
+    import dataclasses as dc
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=2),
+                     devices=jax.devices("cpu")[:4])
+    batch = lm_batch(8)
+    results = []
+    for remat in (False, True):
+        model = Transformer(dc.replace(tiny_model(4).cfg, remat=remat))
+        opt = optim.sgd(lr=1e-2)
+        state, loss = pp.run_one_step(model, opt, mesh, batch,
+                                      prng.init_key(0))
+        results.append((float(jax.device_get(loss)),
+                        jax.device_get(state.params)))
+    assert results[0][0] == pytest.approx(results[1][0], rel=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a, np.float32),
+                                                np.asarray(b, np.float32),
+                                                rtol=1e-6, atol=1e-7),
+        results[0][1], results[1][1])
+
+
+def test_pipeline_eval_pads_non_divisible_batch():
+    """A validation batch whose per-shard rows don't divide into the
+    schedule's microbatches is padded with mask-0 rows — same metrics as the
+    dense eval on the unpadded batch (the small-val-set case that must not
+    crash: VERDICT r1 review)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+    )
+
+    model = tiny_model(4)
+    mesh = make_mesh(MeshConfig(data=2, pipe=2),
+                     devices=jax.devices("cpu")[:4])
+    opt = optim.sgd(lr=1e-2)
+    state = pp.init_pipeline_state(model, opt, prng.init_key(0), 2)
+    state = pp.shard_pipeline_state(state, mesh, opt)
+    batch = lm_batch(6)  # per data-shard: 3 rows, n_mb=2 -> pad 1
+    placed = {k: jax.device_put(jnp.asarray(v),
+                                NamedSharding(mesh, P(("data", "fsdp"))))
+              for k, v in batch.items()}
+    eval_step = pp.make_pipeline_eval_step(model, mesh, "cross_entropy",
+                                           with_accuracy=True)
+    got = jax.device_get(eval_step(state.params, placed))
+
+    dense_params = dict(jax.device_get(state.params))
+    dense_params["blocks"] = pp.unstack_blocks(dense_params["blocks"])
+    rep = jax.device_put(dense_params, NamedSharding(mesh, P()))
+    dense_eval = dp.make_eval_step(model, mesh, "cross_entropy",
+                                   with_accuracy=True)
+    want = jax.device_get(dense_eval(rep, placed))
+
+    assert float(got["count"]) == float(want["count"])  # pads not counted
+    np.testing.assert_allclose(float(got["loss"]), float(want["loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(got["accuracy"]),
+                               float(want["accuracy"]), rtol=1e-5)

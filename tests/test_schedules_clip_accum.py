@@ -151,11 +151,14 @@ def test_trainer_with_schedule_clip_accum(tmp_path):
     assert count == result["steps"]
 
 
-def test_trainer_rejects_accum_on_gspmd_path():
+def test_trainer_accum_on_gspmd_path_trains():
+    """Round 2 lifted the round-1 guard: accumulation is wired on the GSPMD
+    path (trajectory parity vs unaccumulated is pinned in
+    tests/test_composition.py::TestAccumulation)."""
     cfg = TrainConfig(
-        nepochs=1, accum_steps=2,
+        nepochs=1, accum_steps=2, full_batch=False, batch_size=32,
         data=DataConfig(dataset="regression", n_samples=64),
         mesh=MeshConfig(data=4, fsdp=2),
     )
-    with pytest.raises(NotImplementedError, match="accum_steps"):
-        Trainer(cfg)
+    r = Trainer(cfg).fit()
+    assert np.isfinite(r["final_loss"])

@@ -1,0 +1,91 @@
+"""Child process for the compiled-Pallas TPU smoke test.
+
+Runs OUTSIDE the conftest CPU pin: the image's sitecustomize points JAX at
+the axon TPU tunnel, so `jax.default_backend()` is 'tpu' when a chip is
+reachable.  Compiles flash_attention (forward + the two Mosaic backward
+kernels) and fused_layernorm through Mosaic and checks them against the
+plain-JAX reference math in the same process.  Prints one JSON line;
+the parent asserts on it (or skips when the probe fails/times out).
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skip": f"backend={jax.default_backend()}"}))
+        return 0
+
+    from neural_networks_parallel_training_with_mpi_tpu.ops.pallas_kernels import (
+        flash_attention, fused_layernorm,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+        attention_reference,
+    )
+
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 256, 4, 64
+    mk = lambda: jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    report = {"device_kind": jax.devices()[0].device_kind}
+
+    # forward, compiled through Mosaic (interpret=False)
+    out = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, True, 128, 128, False)
+    )(q, k, v)
+    ref = attention_reference(q, k, v, causal=True)
+    report["fwd_max_err"] = float(jnp.abs(out - ref).max())
+
+    # backward: both Mosaic bwd kernels, vs autodiff of the dense reference
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, True, 128, 128, False) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (attention_reference(q, k, v, causal=True) ** 2).sum()
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for name, a, bb in zip(("dq", "dk", "dv"), g_flash, g_ref):
+        denom = float(jnp.abs(bb).max()) or 1.0
+        report[f"bwd_{name}_rel_err"] = float(jnp.abs(a - bb).max()) / denom
+
+    # bf16 forward (the bench path): loose check against f32 reference
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out_bf16 = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, True, 128, 128, False)
+    )(qb, kb, vb)
+    report["fwd_bf16_max_err"] = float(
+        jnp.abs(out_bf16.astype(jnp.float32) - ref).max())
+
+    # fused layernorm, compiled
+    x = jnp.asarray(rng.standard_normal((8, 128, 256)), jnp.float32)
+    scale = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((256,)), jnp.float32)
+    y = jax.jit(lambda x, s, b: fused_layernorm(x, s, b, interpret=False))(
+        x, scale, bias)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    y_ref = (x - mean) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+    report["ln_max_err"] = float(jnp.abs(y - y_ref).max())
+
+    report["ok"] = (
+        report["fwd_max_err"] < 2e-3
+        and report["bwd_dq_rel_err"] < 2e-3
+        and report["bwd_dk_rel_err"] < 2e-3
+        and report["bwd_dv_rel_err"] < 2e-3
+        and report["fwd_bf16_max_err"] < 5e-2
+        and report["ln_max_err"] < 2e-3
+    )
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
