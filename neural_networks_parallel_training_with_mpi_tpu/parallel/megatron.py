@@ -113,7 +113,7 @@ def validate_tp(cfg, tp: int) -> None:
 
 
 def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
-                   axis: str = TENSOR_AXIS) -> jax.Array:
+                   axis: str = TENSOR_AXIS, attention_fn=None) -> jax.Array:
     """One transformer block with the tensor dimension sharded over ``axis``
     (call inside shard_map; ``layer_params`` are the LOCAL shards — qkv and
     ff_in hold output-columns for this rank's heads/hidden units, attn_out
@@ -121,11 +121,21 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
 
     Mirrors ``Transformer._block`` (dense attention) exactly: pre-LN,
     residual adds in the input dtype, activations in ``cfg.compute_dtype``.
+
+    ``attention_fn(q, k, v) -> out`` (all (B, T_local, H_local, Dh))
+    overrides the attention impl — this is the TP x SP composition point:
+    pass ``parallel.sequence.ring_attention`` bound to the 'seq' axis and
+    the block runs Megatron-sharded matmuls with ring attention over the
+    sequence shards (heads split over 'tensor', sequence over 'seq').
+    Default: dense attention over the full local sequence.
     """
     f, g = make_megatron_ops(axis)
     cdt = cfg.compute_dtype
     heads_local = cfg.n_heads // tp
     ln = LayerNorm(cfg.d_model, param_dtype=cfg.param_dtype)
+    if attention_fn is None:
+        attention_fn = lambda q, k, v: attention_reference(q, k, v,
+                                                           causal=True)
 
     # --- attention: column-parallel qkv, local heads, row-parallel out ---
     h = ln.apply(layer_params["ln1"], x)
@@ -135,8 +145,7 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     b, t, _ = qkv.shape
     q, k, v = jnp.split(qkv, 3, axis=-1)  # local layout is [q_r | k_r | v_r]
     shape = (b, t, heads_local, cfg.head_dim)
-    out = attention_reference(q.reshape(shape), k.reshape(shape),
-                              v.reshape(shape), causal=True)
+    out = attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
     out = out.reshape(b, t, heads_local * cfg.head_dim)
     partial = out @ layer_params["attn_out"]["w"].astype(cdt)
     attn = g(partial) + layer_params["attn_out"]["b"].astype(cdt)
@@ -151,6 +160,20 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     ff = (g(hh @ layer_params["ff_out"]["w"].astype(cdt))
           + layer_params["ff_out"]["b"].astype(cdt))
     return x + ff.astype(x.dtype)
+
+
+def path_names(path) -> Tuple[str, ...]:
+    """Key path -> tuple of string names (dict keys / sequence indices)."""
+    return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def is_tensor_sharded(names: Tuple[str, ...]) -> bool:
+    """Whether a block leaf (by its key-path names) is SHARDED over the
+    tensor axis.  THE single consult point for the TP layout — the pipeline
+    and sp_tp spec builders and their grad-clip norm partitioning all call
+    this, so a layout change cannot desynchronize them."""
+    return any(sub in names and names[-1] == leaf
+               for sub, leaf in tensor_sharded_block_paths())
 
 
 def tensor_sharded_block_paths() -> Tuple[Tuple[str, str], ...]:

@@ -119,18 +119,15 @@ def init_pipeline_state(model: Transformer, optimizer: Optimizer,
 
 
 def _block_path_names(path) -> Tuple[str, ...]:
-    return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+    from . import megatron
+
+    return megatron.path_names(path)
 
 
 def _tp_sharded(names: Tuple[str, ...]) -> bool:
-    """Whether a block leaf (by its key path) is sharded over 'tensor'.
-    Single source of truth: megatron.tensor_sharded_block_paths — the spec
-    builder and the grad-clip norm partitioning below both consult it, so
-    a TP-layout change cannot desynchronize them."""
     from . import megatron
 
-    return any(sub in names and names[-1] == leaf
-               for sub, leaf in megatron.tensor_sharded_block_paths())
+    return megatron.is_tensor_sharded(names)
 
 
 def pipeline_param_specs(params: Pytree, tp: int = 1) -> Pytree:

@@ -134,3 +134,230 @@ def run_one_step(model, optimizer: Optimizer, mesh: Mesh, state: TrainState,
                                 seq_axis if use_seq else None,
                                 donate=False, example_batch=placed)
     return step(state, placed)
+
+
+# ---------------------------------------------------------------------------
+# DP x SP x TP: Megatron tensor sharding + ring attention in one shard_map
+# ---------------------------------------------------------------------------
+
+def sp_tp_param_specs(params: Pytree) -> Pytree:
+    """shard_map PartitionSpecs for a dense (per-layer) transformer param
+    tree with the block matmuls Megatron-sharded over 'tensor' (column
+    layers split the output dim, row layers the input dim — single source
+    of truth for WHICH leaves: megatron.is_tensor_sharded) and
+    embed/pos/ln_f/head replicated."""
+    from . import megatron
+
+    def block_spec(path, leaf):
+        names = megatron.path_names(path)
+        if not megatron.is_tensor_sharded(names):
+            return P()
+        col = "qkv" in names or "ff_in" in names
+        ndim = len(jnp.shape(leaf))
+        if names[-1] == "w" and ndim == 2:
+            return P(None, "tensor") if col else P("tensor", None)
+        if names[-1] == "b" and ndim == 1:
+            return P("tensor")
+        raise ValueError(f"unexpected tensor-sharded leaf {names}")
+
+    return {
+        k: (jax.tree_util.tree_map_with_path(block_spec, v) if k == "blocks"
+            else jax.tree_util.tree_map(lambda _: P(), v))
+        for k, v in params.items()
+    }
+
+
+def init_sp_tp_state(model, optimizer: Optimizer, key, tp: int) -> TrainState:
+    """Dense init + head-aligned qkv column permutation (so each tensor
+    shard holds whole heads; inverse permutation restores the dense
+    layout — same convention as the pipeline path)."""
+    from . import megatron
+
+    params = model.init(key)
+    if tp > 1:
+        c = model.cfg
+        params = dict(params)
+        params["blocks"] = megatron.permute_qkv(params["blocks"], c.d_model,
+                                                c.n_heads, tp)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=optimizer.init(params))
+
+
+def shard_sp_tp_state(state: TrainState, mesh: Mesh,
+                      optimizer: Optimizer) -> TrainState:
+    pspecs = sp_tp_param_specs(state.params)
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    specs = TrainState(step=P(), params=pspecs,
+                       opt_state=optimizer.state_specs(pspecs))
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
+                   attention_impl: str):
+    """Shared SP x TP local forward: embed with the shard's global position
+    offset, Megatron blocks with sequence-sharded attention, replicated
+    LN + head.  Reuses Transformer.embed/head_logits so the composed path
+    cannot drift from the dense model."""
+    from . import megatron
+    from .sequence import ring_attention, ulysses_attention
+
+    c = model.cfg
+    if attention_impl == "ring":
+        attn = lambda q, k, v: ring_attention(q, k, v, axis=seq_axis,
+                                              causal=True)
+    elif attention_impl == "ulysses":
+        attn = lambda q, k, v: ulysses_attention(q, k, v, axis=seq_axis,
+                                                 causal=True)
+    else:
+        raise ValueError(f"SP x TP needs a seq-sharded attention impl, "
+                         f"got {attention_impl!r}")
+    b, t = ids.shape
+    offset = lax.axis_index(seq_axis) * t
+    x = model.embed(params, ids, offset + jnp.arange(t))
+
+    def block_fn(layer_params, h):
+        return megatron.tp_block_apply(c, layer_params, h, tp,
+                                       attention_fn=attn)
+
+    if c.remat:
+        block_fn = jax.checkpoint(block_fn)
+    for layer_params in params["blocks"]:
+        x = block_fn(layer_params, x)
+    return model.head_logits(params, x)
+
+
+def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
+                          loss_name: str = "cross_entropy",
+                          seq_axis: str = "seq",
+                          attention_impl: str = "ring",
+                          donate: bool = True,
+                          example_batch: Optional[Batch] = None,
+                          accum_steps: int = 1,
+                          grad_clip: float = 0.0):
+    """(state, batch) -> (state, loss) over a data x seq x tensor mesh:
+    Megatron column/row-sharded block matmuls (heads over 'tensor') with
+    ring/ulysses attention (sequence over 'seq') in ONE shard_map program —
+    the Megatron-LM TP + context-parallelism composition, TPU-native.
+
+    Gradient reduction: one psum over (data..., seq) for every leaf.
+    Tensor-sharded leaves own their shard's gradient locally; tensor-
+    replicated leaves (LN/row-bias/embed/head) receive IDENTICAL gradients
+    on every tensor rank because the f operator's backward psums the
+    partial input-gradients (megatron.make_megatron_ops) — so no reduction
+    over 'tensor' is needed anywhere.
+
+    The reference has neither strategy (SURVEY.md §2.2); this is added
+    TPU-native capability pinned by trajectory-parity tests
+    (tests/test_composition.py).
+    """
+    if example_batch is None:
+        raise ValueError("example_batch required to derive per-leaf specs")
+    from . import megatron
+
+    tp = int(mesh.shape.get("tensor", 1))
+    sp = int(mesh.shape.get(seq_axis, 1))
+    if tp < 2 or sp < 2:
+        raise ValueError(f"SP x TP needs tensor>1 and {seq_axis}>1; got "
+                         f"tensor={tp}, {seq_axis}={sp} — use the plain "
+                         "spmd/gspmd paths otherwise")
+    megatron.validate_tp(model.cfg, tp)
+    if model.cfg.moe_experts > 0:
+        raise NotImplementedError("SP x TP with an MoE FFN is not wired")
+    if attention_impl == "ulysses" and (model.cfg.n_heads // tp) % sp:
+        raise ValueError(
+            f"ulysses under TP redistributes the {model.cfg.n_heads // tp} "
+            f"local heads over {seq_axis}={sp}: not divisible")
+    base = losses_lib.get(loss_name)
+    reduce_axes = DATA_AXES + (seq_axis,)
+
+    def loss_sum(params, batch):
+        logits = _sp_tp_forward(model, params, batch["x"], tp, seq_axis,
+                                attention_impl)
+        return base(logits, batch["y"], batch.get("mask"))
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    pspecs = sp_tp_param_specs(dummy)
+
+    # which leaves hold only a tensor shard of their gradient (their
+    # squared norms need a psum over 'tensor' before the global clip norm;
+    # replicated leaves carry identical full grads on every tensor rank)
+    leaf_sharded = [any(e is not None for e in s)
+                    for s in jax.tree_util.tree_leaves(
+                        pspecs, is_leaf=lambda x: isinstance(x, P))]
+
+    def clip(grads):
+        sq_r = jnp.zeros((), jnp.float32)
+        sq_t = jnp.zeros((), jnp.float32)
+        for g, sharded in zip(jax.tree_util.tree_leaves(grads), leaf_sharded):
+            term = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            sq_t, sq_r = (sq_t + term, sq_r) if sharded else (sq_t,
+                                                              sq_r + term)
+        gsq = sq_r + lax.psum(sq_t, "tensor")
+        scale = jnp.minimum(1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq),
+                                                         1e-12))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+    def shard_step(state: TrainState, batch: Batch):
+        s, c, grads = _accumulated_sum_and_grads(
+            loss_sum, state.params, batch, accum_steps)
+        total = lax.psum(c, reduce_axes)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, reduce_axes) / total, grads)
+        loss = lax.psum(s, reduce_axes) / total
+        if grad_clip > 0:
+            grads = clip(grads)
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), loss
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs for SP x TP")
+    state_spec = TrainState(step=P(), params=pspecs,
+                            opt_state=optimizer.state_specs(pspecs))
+    bspecs = batch_specs(example_batch, seq_axis)
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(state_spec, bspecs),
+        out_specs=(state_spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_sp_tp_eval_step(model, mesh: Mesh, loss_name: str = "cross_entropy",
+                         with_accuracy: bool = False, seq_axis: str = "seq",
+                         attention_impl: str = "ring"):
+    """(sp-tp-sharded params, batch) -> metrics; same contract as
+    data_parallel.make_eval_step, params consumed in place."""
+    base = losses_lib.get(loss_name)
+    tp = int(mesh.shape.get("tensor", 1))
+    reduce_axes = DATA_AXES + (seq_axis,)
+
+    def shard_eval(params, batch):
+        logits = _sp_tp_forward(model, params, batch["x"], tp, seq_axis,
+                                attention_impl)
+        s, c = base(logits, batch["y"], batch.get("mask"))
+        total = lax.psum(c, reduce_axes)
+        out = {"loss": lax.psum(s, reduce_axes) / total, "count": total}
+        if with_accuracy:
+            hs, hc = losses_lib.accuracy(logits, batch["y"],
+                                         batch.get("mask"))
+            ex_total = lax.psum(hc, DATA_AXES)
+            acc = lax.psum(hs, DATA_AXES) / ex_total
+            out["accuracy"] = lax.pmean(acc, seq_axis)
+            out["example_count"] = ex_total
+        return out
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    pspecs = sp_tp_param_specs(dummy)
+    mapped = jax.shard_map(
+        shard_eval, mesh=mesh,
+        in_specs=(pspecs, batch_specs({"x": jnp.zeros((1, 2), jnp.int32),
+                                       "y": jnp.zeros((1, 2), jnp.int32),
+                                       "mask": jnp.zeros((1,))}, seq_axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

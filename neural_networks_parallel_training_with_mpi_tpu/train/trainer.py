@@ -44,33 +44,41 @@ class Trainer:
         #   tensor/fsdp (no pipe)-> parallel.gspmd (jit + annotations)
         #   seq                  -> parallel.spmd shard_map (ring attention)
         #   expert               -> parallel.expert shard_map (all_to_all)
-        self.gspmd = (not self.pipeline
-                      and (self.tensor or self.mesh.shape.get("fsdp", 1) > 1))
+        #   seq x tensor        -> parallel.spmd sp_tp shard_map (Megatron
+        #                          matmuls + ring/ulysses attention)
+        fsdp_on = self.mesh.shape.get("fsdp", 1) > 1
+        self.sp_tp = (self.seq_parallel and self.tensor
+                      and not (self.pipeline or self.expert or fsdp_on))
+        self.gspmd = (not self.pipeline and not self.sp_tp
+                      and (self.tensor or fsdp_on))
         unwired = [name for name, on in
                    (("seq", self.seq_parallel),
-                    ("fsdp", self.mesh.shape.get("fsdp", 1) > 1),
+                    ("fsdp", fsdp_on),
                     ("expert", self.expert)) if on]
         if self.pipeline and unwired:
             raise NotImplementedError(
                 f"pipe composes with data + tensor axes; got pipe x "
                 f"{unwired} — compose parallel.* step builders directly")
         exclusive = [name for name, on in
-                     (("seq", self.seq_parallel), ("tensor/fsdp", self.gspmd),
+                     (("seq", self.seq_parallel and not self.sp_tp),
+                      ("tensor/fsdp", self.gspmd),
                       ("expert", self.expert)) if on]
         if len(exclusive) > 1:
             raise NotImplementedError(
-                f"these axes are wired one at a time (plus data/pipe), "
-                f"got {exclusive}; compose parallel.* step builders directly "
-                "for mixed meshes")
+                f"wired combinations: one of seq/tensor/fsdp/expert alone, "
+                f"pipe x tensor, or seq x tensor (all x data); got "
+                f"{exclusive} — compose parallel.* step builders directly "
+                "for other mixes")
         if self.pipeline and cfg.model.arch != "transformer":
             raise ValueError("pipe axis > 1 requires the transformer model")
         if self.expert and (cfg.model.arch != "transformer"
                             or cfg.model.moe_experts <= 0):
             raise ValueError("expert axis > 1 requires a transformer with "
                              "moe_experts > 0 (--moe_experts)")
-        if (self.pipeline or self.expert) and cfg.grad_reduction != "global_mean":
-            raise ValueError("pipeline/expert steps always use global_mean "
-                             "gradient semantics")
+        if ((self.pipeline or self.expert or self.sp_tp)
+                and cfg.grad_reduction != "global_mean"):
+            raise ValueError("pipeline/expert/seq-x-tensor steps always use "
+                             "global_mean gradient semantics")
         if (cfg.model.arch == "transformer"
                 and cfg.model.attention in ("ring", "ulysses")
                 and not self.seq_parallel):
@@ -79,7 +87,8 @@ class Trainer:
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
         self.zero1 = cfg.update_sharding == "zero1"
-        if self.zero1 and (self.gspmd or self.pipeline or self.expert):
+        if self.zero1 and (self.gspmd or self.pipeline or self.expert
+                           or self.sp_tp):
             raise NotImplementedError(
                 "update_sharding='zero1' is wired into the shard_map DP "
                 "and DP x seq paths (fsdp/tensor axes already shard state "
@@ -129,7 +138,8 @@ class Trainer:
         # leaves are axis-sharded; optim.with_clipping's shard-local norm
         # would be wrong there — see make_pipeline_train_step /
         # make_moe_train_step / zero1_shard_update)
-        step_clips = self.pipeline or self.expert or self.zero1
+        step_clips = (self.pipeline or self.expert or self.zero1
+                      or self.sp_tp)
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
@@ -167,6 +177,19 @@ class Trainer:
             self.eval_step = ep_lib.make_moe_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
+        elif self.sp_tp:
+            from ..parallel import spmd
+
+            example = next(iter(self.loader.epoch(0)))
+            self.train_step = spmd.make_sp_tp_train_step(
+                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                seq_axis="seq", attention_impl=cfg.model.attention,
+                example_batch=example, accum_steps=cfg.accum_steps,
+                grad_clip=cfg.grad_clip)
+            self.eval_step = spmd.make_sp_tp_eval_step(
+                self.model, self.mesh, loss_name=cfg.loss,
+                with_accuracy=(cfg.loss == "cross_entropy"),
+                seq_axis="seq", attention_impl=cfg.model.attention)
         elif self.seq_parallel:
             from ..parallel import spmd
 
@@ -231,6 +254,15 @@ class Trainer:
             self.state = dp.place_zero1_state(host, self.mesh,
                                               self.optimizer)
             return self.state
+        if self.sp_tp:
+            from ..parallel import spmd
+
+            state = spmd.init_sp_tp_state(
+                self.model, self.optimizer, prng.init_key(self.cfg.seed),
+                int(self.mesh.shape["tensor"]))
+            self.state = spmd.shard_sp_tp_state(state, self.mesh,
+                                                self.optimizer)
+            return self.state
         state = TrainState.create(self.model, self.optimizer,
                                   prng.init_key(self.cfg.seed))
         if self.expert:
@@ -258,45 +290,17 @@ class Trainer:
         restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
         if restored is None:
             return 0
+        restored = self._reconcile_qkv_tp(ckpt, restored)
         if self.pipeline:
             from ..parallel import pipeline as pp
 
-            # the TP qkv column permutation is shape-preserving, so a
-            # checkpoint written under a different tensor-axis size is
-            # undetectable from the pytree alone — meta.json records it
-            # (checkpoint.save extra_meta) and we re-permute here
-            tp = int(self.mesh.shape.get("tensor", 1))
-            meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
-            saved_tp = int(meta.get("qkv_tp", tp))
-            if saved_tp != tp:
-                from ..parallel import megatron
-
-                c = self.model.cfg
-
-                def fix(tree):
-                    """Re-permute a params-shaped pytree (params itself and
-                    each optimizer slot — momentum/mu/nu mirror the param
-                    layout and carry the same permutation)."""
-                    if not (isinstance(tree, dict) and "blocks" in tree):
-                        return tree  # e.g. the optimizer's step counter
-                    tree = dict(tree)
-                    b = tree["blocks"]
-                    if saved_tp > 1:
-                        b = megatron.permute_qkv(b, c.d_model, c.n_heads,
-                                                 saved_tp, inverse=True)
-                    if tp > 1:
-                        b = megatron.permute_qkv(b, c.d_model, c.n_heads, tp)
-                    tree["blocks"] = b
-                    return tree
-
-                opt_state = restored.opt_state
-                if isinstance(opt_state, tuple):  # SGDState/AdamState
-                    opt_state = type(opt_state)(*(fix(f) for f in opt_state))
-                restored = TrainState(step=restored.step,
-                                      params=fix(restored.params),
-                                      opt_state=opt_state)
             self.state = pp.shard_pipeline_state(restored, self.mesh,
                                                  self.optimizer)
+        elif self.sp_tp:
+            from ..parallel import spmd
+
+            self.state = spmd.shard_sp_tp_state(restored, self.mesh,
+                                                self.optimizer)
         elif self.expert:
             from ..parallel import expert as ep_lib
 
@@ -314,6 +318,53 @@ class Trainer:
             self.state = dp.replicate_state(restored, self.mesh)
         return int(jax.device_get(self.state.step))
 
+    def _reconcile_qkv_tp(self, ckpt, restored: TrainState) -> TrainState:
+        """The TP qkv column permutation is shape-preserving, so a
+        checkpoint written under a different tensor-axis size is
+        undetectable from the pytree alone — meta.json records it
+        (checkpoint.save extra_meta) and we re-permute here, for params
+        AND every optimizer slot (momentum/mu/nu mirror the param layout
+        and carry the same permutation).  Runs on EVERY resume path: only
+        the explicit shard_map TP layouts (pipeline, seq x tensor) use the
+        permutation — plain DP/SP/GSPMD trainers expect the dense column
+        order, so a checkpoint from a permuted layout must be unpermuted
+        even when this trainer has no tensor axis at all.  Missing metadata
+        means a dense-layout save (every save records qkv_tp since round 2;
+        only the explicit-TP layouts ever set it > 1), so the default is 1
+        — NOT the current tp, which would silently treat a dense checkpoint
+        as already permuted when resuming INTO a TP layout."""
+        tp = (int(self.mesh.shape.get("tensor", 1))
+              if (self.pipeline or self.sp_tp) else 1)
+        meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
+        saved_tp = int(meta.get("qkv_tp", 1))
+        if saved_tp == tp:
+            return restored
+        if not (isinstance(restored.params, dict)
+                and "blocks" in restored.params):
+            return restored  # non-transformer state carries no permutation
+        from ..parallel import megatron
+
+        c = self.model.cfg
+
+        def fix(tree):
+            if not (isinstance(tree, dict) and "blocks" in tree):
+                return tree  # e.g. the optimizer's step counter
+            tree = dict(tree)
+            b = tree["blocks"]
+            if saved_tp > 1:
+                b = megatron.permute_qkv(b, c.d_model, c.n_heads,
+                                         saved_tp, inverse=True)
+            if tp > 1:
+                b = megatron.permute_qkv(b, c.d_model, c.n_heads, tp)
+            tree["blocks"] = b
+            return tree
+
+        opt_state = restored.opt_state
+        if isinstance(opt_state, tuple):  # SGDState/AdamState
+            opt_state = type(opt_state)(*(fix(f) for f in opt_state))
+        return TrainState(step=restored.step, params=fix(restored.params),
+                          opt_state=opt_state)
+
     def save(self, final: bool = False) -> None:
         # every process calls in: checkpoint.save is leader-only for
         # addressable state and shard-parallel (orbax) for TP/FSDP state
@@ -323,9 +374,9 @@ class Trainer:
 
             # record the (shape-preserving, hence otherwise undetectable)
             # TP qkv permutation so maybe_resume can reconcile a different
-            # tensor-axis size
-            extra = ({"qkv_tp": int(self.mesh.shape.get("tensor", 1))}
-                     if self.pipeline else None)
+            # tensor-axis size; dense layouts record 1 explicitly
+            extra = {"qkv_tp": (int(self.mesh.shape.get("tensor", 1))
+                                if (self.pipeline or self.sp_tp) else 1)}
             if self.cfg.async_checkpoint and not final:
                 ckpt.save_async(self.cfg.checkpoint_dir, self.state,
                                 extra_meta=extra)
@@ -439,9 +490,21 @@ class Trainer:
 
     def _eval_params(self):
         """Params in the *dense* (per-layer, unpermuted) layout — used for
-        checkpoint interop and tests, NOT by :meth:`evaluate` (the pipelined
-        eval step consumes the pipe-sharded params in place, so this
+        checkpoint interop and tests, NOT by :meth:`evaluate` (every eval
+        step consumes the train state's own layout in place, so this
         single-host gather is off the eval path entirely)."""
+        if self.sp_tp:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import megatron
+
+            tp = int(self.mesh.shape.get("tensor", 1))
+            params = dict(jax.device_get(self.state.params))
+            if tp > 1:
+                c = self.model.cfg
+                params["blocks"] = megatron.permute_qkv(
+                    params["blocks"], c.d_model, c.n_heads, tp, inverse=True)
+            return jax.device_put(params, NamedSharding(self.mesh, P()))
         if not self.pipeline:
             return self.state.params
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -79,8 +79,9 @@ def toy_parity() -> dict:
         "reference_final_mse": round(theirs, 4),
         "epochs": epochs,
         # both stacks converge to the same noise floor (measured: 0.2918 ==
-        # 0.2918); the margin only covers init-lottery variation
-        "pass": bool(ours <= 1.5 * theirs + 1.0),
+        # 0.2918); the margin only covers init-lottery variation — a real
+        # convergence regression (e.g. predicting the mean, MSE ~1+) fails
+        "pass": bool(ours <= 1.1 * theirs + 0.02),
     }
 
 

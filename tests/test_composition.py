@@ -51,10 +51,14 @@ def _dense_params(trainer):
     return jax.device_get(trainer._eval_params())
 
 
-def _assert_params_close(pa, pb, rtol=2e-4, atol=1e-4):
-    # atol is lr-scale: the composed meshes change matmul/accumulation
-    # reduction order, and Adam's 1/sqrt(v) normalization amplifies those
-    # float32 grad diffs to ~lr-sized (1e-3 * steps) param deltas.
+# TP/accumulation meshes change matmul/reduction order, and Adam's
+# 1/sqrt(v) normalization amplifies those float32 grad diffs to ~lr-sized
+# (1e-3 * steps) param deltas — composed-mesh callers pass this; the
+# default stays tight so same-reduction-order pins keep their teeth.
+LOOSE_ATOL = 1e-4
+
+
+def _assert_params_close(pa, pb, rtol=2e-4, atol=1e-6):
     la = jax.tree_util.tree_leaves(pa)
     lb = jax.tree_util.tree_leaves(pb)
     assert len(la) == len(lb)
@@ -83,8 +87,10 @@ class TestPipelineTensor:
                                                    rel=2e-4)
         assert r_3d["final_loss"] == pytest.approx(r_dp["final_loss"],
                                                    rel=2e-4)
-        _assert_params_close(_dense_params(t_3d), _dense_params(t_pp))
-        _assert_params_close(_dense_params(t_3d), _dense_params(t_dp))
+        _assert_params_close(_dense_params(t_3d), _dense_params(t_pp),
+                             atol=LOOSE_ATOL)
+        _assert_params_close(_dense_params(t_3d), _dense_params(t_dp),
+                             atol=LOOSE_ATOL)
 
     def test_tp_block_params_are_tensor_sharded(self):
         t = Trainer(_lm_cfg(nepochs=1, data=2, tensor=2, pipe=2))
@@ -130,7 +136,9 @@ class TestZero1:
         tr = Trainer(cfg("replicated"))
         rr = tr.fit()
         assert rz["final_loss"] == pytest.approx(rr["final_loss"], rel=1e-4)
-        _assert_params_close(tz.state.params, tr.state.params)
+        # zero1 flattens/scatters the update (different reduction order)
+        _assert_params_close(tz.state.params, tr.state.params,
+                             atol=LOOSE_ATOL)
 
     def test_zero1_seq_opt_state_sharded_over_data_only(self):
         c = _lm_cfg(nepochs=1, data=4, seq=2)
@@ -150,7 +158,7 @@ class TestZero1:
 # --------------------------------------------------------------------------
 
 class TestAccumulation:
-    def _parity(self, make_cfg, atol=1e-4, rel=2e-4):
+    def _parity(self, make_cfg, atol=LOOSE_ATOL, rel=2e-4):
         t1 = Trainer(make_cfg(1))
         r1 = t1.fit()
         t2 = Trainer(make_cfg(2))
@@ -222,3 +230,104 @@ class TestTpCheckpointResume:
         # consistent with the re-permuted params)
         r = t_pp.fit()
         assert np.isfinite(r["final_loss"])
+
+
+# --------------------------------------------------------------------------
+# DP x SP x TP (Megatron matmuls + ring attention in one shard_map)
+# --------------------------------------------------------------------------
+
+class TestSeqTensor:
+    def test_dp_sp_tp_matches_dp(self):
+        t_dp = Trainer(_lm_cfg(data=8))
+        r_dp = t_dp.fit()
+        cfg = _lm_cfg(data=2, seq=2, tensor=2)
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        t_3d = Trainer(cfg)
+        assert t_3d.sp_tp and not t_3d.gspmd and not t_3d.pipeline
+        r_3d = t_3d.fit()
+        assert np.isfinite(r_3d["final_loss"])
+        assert r_3d["final_loss"] == pytest.approx(r_dp["final_loss"],
+                                                   rel=2e-4)
+        _assert_params_close(_dense_params(t_3d), _dense_params(t_dp),
+                             atol=LOOSE_ATOL)
+
+    def test_sp_tp_params_are_tensor_sharded(self):
+        cfg = _lm_cfg(nepochs=1, data=2, seq=2, tensor=2)
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        t = Trainer(cfg)
+        t.init_state()
+        qkv_w = t.state.params["blocks"][0]["qkv"]["w"]  # (d, 3d)
+        local = qkv_w.addressable_shards[0].data.shape
+        assert local[1] * 2 == qkv_w.shape[1]  # columns over 'tensor'
+        assert local[0] == qkv_w.shape[0]
+
+    def test_sp_tp_eval_matches_train_layout(self):
+        cfg = _lm_cfg(nepochs=1, data=2, seq=2, tensor=2)
+        cfg.data = dataclasses.replace(cfg.data, val_fraction=0.25)
+        cfg.eval_every = 1
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        r = Trainer(cfg).fit()
+        assert np.isfinite(r["val_loss"])
+        assert 0.0 <= r["val_accuracy"] <= 1.0
+
+    def test_sp_tp_checkpoint_resume_to_dense_tp1(self, tmp_path):
+        d = str(tmp_path / "ck")
+        cfg = _lm_cfg(nepochs=1, data=2, seq=2, tensor=2)
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        cfg.checkpoint_dir = d
+        t = Trainer(cfg)
+        t.fit()
+        want = _dense_params(t)
+
+        cfg2 = _lm_cfg(nepochs=2, data=4, seq=2)
+        cfg2.model = dataclasses.replace(cfg2.model, attention="ring")
+        cfg2.checkpoint_dir = d
+        cfg2.resume = True
+        t2 = Trainer(cfg2)
+        t2.init_state()
+        assert t2.maybe_resume() > 0
+        _assert_params_close(jax.device_get(t2.state.params), want,
+                             rtol=0, atol=0)
+
+    def test_sp_tp_grad_clip_matches_dp_clip(self):
+        # low threshold so the clip engages; tensor-aware global norm must
+        # reproduce the optimizer-level clip on the plain DP path
+        def cfg(mesh_kw, att):
+            c = _lm_cfg(**mesh_kw)
+            c.grad_clip = 0.5
+            c.model = dataclasses.replace(c.model, attention=att)
+            return c
+
+        t_dp = Trainer(cfg(dict(data=8), "dense"))
+        r_dp = t_dp.fit()
+        t_st = Trainer(cfg(dict(data=2, seq=2, tensor=2), "ring"))
+        r_st = t_st.fit()
+        assert r_st["final_loss"] == pytest.approx(r_dp["final_loss"],
+                                                   rel=2e-4)
+        _assert_params_close(_dense_params(t_st), _dense_params(t_dp),
+                             atol=LOOSE_ATOL)
+
+
+def test_dense_checkpoint_resumes_into_tp_layout(tmp_path):
+    """The review's failure direction: a dense-layout save (qkv_tp=1 in
+    meta) resumed INTO a seq x tensor trainer must be permuted on the way
+    in — defaulting missing/1 metadata to the current tp would silently
+    skip it and hand shard 0 all of q plus half of k."""
+    d = str(tmp_path / "ck")
+    cfg = _lm_cfg(nepochs=1, data=8)
+    cfg.checkpoint_dir = d
+    t_dense = Trainer(cfg)
+    t_dense.fit()
+    want = _dense_params(t_dense)
+
+    cfg2 = _lm_cfg(nepochs=2, data=2, seq=2, tensor=2)
+    cfg2.model = dataclasses.replace(cfg2.model, attention="ring")
+    cfg2.checkpoint_dir = d
+    cfg2.resume = True
+    t_tp = Trainer(cfg2)
+    t_tp.init_state()
+    assert t_tp.maybe_resume() > 0
+    # _dense_params un-permutes; round trip must be exact
+    _assert_params_close(_dense_params(t_tp), want, rtol=0, atol=0)
+    r = t_tp.fit()
+    assert np.isfinite(r["final_loss"])
