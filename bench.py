@@ -74,16 +74,12 @@ def peak_flops(device_kind: str) -> float | None:
 # ---------------------------------------------------------------------------
 # Workload configs (BASELINE.json's five).  Each entry:
 #   batch, measure_steps, baseline_steps, loss, make_model(compute_dtype),
-#   make_batch(rng, B) -> dict of numpy arrays, flops(B) -> matmul FLOPs per
-#   *forward*, torch_baseline(B) -> (model, x, y, loss_fn)
+#   make_batch(rng, B) -> dict of numpy arrays.  FLOPs accounting lives on
+#   the models themselves (Module.fwd_flops) — no per-config formulas here.
 # ---------------------------------------------------------------------------
 
 _LM = dict(vocab=2048, seq=256, d_model=256, n_layers=4, n_heads=8, d_ff=1024)
 _WIDE = dict(in_features=32, width=512, depth=4)
-
-
-def _mlp_flops(batch: int, dims) -> float:
-    return float(2 * batch * sum(a * b for a, b in zip(dims[:-1], dims[1:])))
 
 
 def _regression_batch(rng, batch, in_features):
@@ -119,39 +115,24 @@ def _make_config(name):
             batch=16, measure_steps=200, baseline_steps=200, loss="mse",
             make_model=lambda cd: MLP(2, (3,), 1, compute_dtype=cd),
             make_batch=lambda rng, B: _regression_batch(rng, B, 2),
-            flops=lambda B: _mlp_flops(B, (2, 3, 1)),
         )
     if name == "wide":
         d = _WIDE
-        dims = (d["in_features"],) + (d["width"],) * d["depth"] + (1,)
         return dict(
             batch=8192, measure_steps=20, baseline_steps=5, loss="mse",
             make_model=lambda cd: wide_mlp(in_features=d["in_features"],
                                            width=d["width"], depth=d["depth"],
                                            compute_dtype=cd),
             make_batch=lambda rng, B: _regression_batch(rng, B, d["in_features"]),
-            flops=lambda B: _mlp_flops(B, dims),
         )
     if name == "mnist":
-        dims = (784, 256, 128, 10)
         return dict(
             batch=4096, measure_steps=50, baseline_steps=10,
             loss="cross_entropy",
             make_model=lambda cd: mnist_mlp(compute_dtype=cd),
             make_batch=lambda rng, B: _class_batch(rng, B, 784, 10),
-            flops=lambda B: _mlp_flops(B, dims),
         )
     if name == "cifar":
-        def conv_flops(B):
-            f = 0.0
-            h = w = 32
-            cin = 3
-            for cout in (32, 64):
-                f += 2 * B * h * w * 9 * cin * cout  # 3x3 SAME conv
-                h, w, cin = h // 2, w // 2, cout
-            f += _mlp_flops(B, (64 * 8 * 8, 128, 10))
-            return f
-
         def make_batch(rng, B):
             return {
                 "x": rng.standard_normal((B, 32, 32, 3)).astype(np.float32),
@@ -164,17 +145,9 @@ def _make_config(name):
             loss="cross_entropy",
             make_model=lambda cd: ConvNet(compute_dtype=cd),
             make_batch=make_batch,
-            flops=conv_flops,
         )
     if name == "lm":
         c = _LM
-
-        def lm_flops(B):
-            S, d, L, V, ff = c["seq"], c["d_model"], c["n_layers"], c["vocab"], c["d_ff"]
-            per_layer = 2 * B * S * d * (3 * d) + 2 * B * S * d * d  # qkv + out
-            per_layer += 2 * (2 * B * S * d * ff)                    # ffn in+out
-            per_layer += 2 * (2 * B * S * S * d)                     # scores + values
-            return float(L * per_layer + 2 * B * S * d * V)          # + lm head
 
         def make_batch(rng, B):
             return {
@@ -192,7 +165,7 @@ def _make_config(name):
         return dict(
             batch=32, measure_steps=20, baseline_steps=3,
             loss="cross_entropy",
-            make_model=make_model, make_batch=make_batch, flops=lm_flops,
+            make_model=make_model, make_batch=make_batch,
         )
     raise ValueError(f"unknown config {name!r}")
 
@@ -270,7 +243,8 @@ def bench_framework(config_name: str) -> dict:
 
     batch_size = cfg["batch"]
     rng = np.random.default_rng(0)
-    batch = shd.shard_batch(mesh, cfg["make_batch"](rng, batch_size))
+    raw_batch = cfg["make_batch"](rng, batch_size)
+    batch = shd.shard_batch(mesh, raw_batch)
 
     sync = _chain_sync_every()
     t0 = time.perf_counter()
@@ -291,12 +265,13 @@ def bench_framework(config_name: str) -> dict:
     log(f"[{config_name}] final loss {loss_val:.5f}")
 
     # MFU: matmul/conv FLOPs for one optimizer step = fwd + ~2x fwd for the
-    # backward, over every chip's peak.
-    train_flops = 3.0 * cfg["flops"](batch_size)
+    # backward, over every chip's peak.  Single source: Module.fwd_flops.
+    fwd = model.fwd_flops(raw_batch["x"].shape)
+    train_flops = None if fwd is None else 3.0 * fwd
     kind = devices[0].device_kind
     peak = peak_flops(kind) if on_tpu else None
     mfu = (train_flops / (dt / steps) / (peak * len(devices))
-           if peak else None)
+           if peak and train_flops is not None else None)
     log(f"[{config_name}] {steps} steps in {dt:.3f}s -> {sps:,.0f} samples/sec"
         f" ({step_ms:.2f} ms/step"
         + (f", MFU {mfu:.1%}" if mfu is not None else "") + ")")

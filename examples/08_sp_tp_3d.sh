@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# 3D mesh: data x sequence x tensor.  Megatron column/row-parallel block
+# matmuls (attention heads + FFN hidden units sharded over 'tensor') with
+# ring attention over 'seq' — one shard_map program; the Megatron-LM
+# TP + context-parallelism composition.  Trajectory parity with plain DP
+# is pinned by tests/test_composition.py::TestSeqTensor.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --seq_len 128 --no-full-batch --batch_size 8 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --dp 2 --sp 2 --tp 2 --grad_clip 1.0

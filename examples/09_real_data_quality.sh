@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Real data, real quality bar: sklearn's bundled load_digits (1797 actual
+# 8x8 handwritten digit images — zero egress) trained to >95% held-out
+# accuracy with periodic validation.  `python quality.py` runs this plus
+# the reference-workload convergence-parity check and writes QUALITY.json.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset digits --no-full-batch --batch_size 128 --nepochs 30 \
+    --optimizer adam --lr 3e-3 --val_fraction 0.2 --eval_every 10

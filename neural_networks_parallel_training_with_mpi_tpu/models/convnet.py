@@ -53,3 +53,15 @@ class ConvNet(Module):
 
     def apply(self, params, x, **kwargs):
         return self.net.apply(params, x, **kwargs)
+
+    def fwd_flops(self, x_shape):
+        batch = x_shape[0]
+        h, w = self.image_hw
+        cin = self.in_channels
+        f = 0.0
+        for cout in self.channels:
+            f += 2.0 * batch * h * w * 9 * cin * cout  # 3x3 SAME conv
+            h, w, cin = h // 2, w // 2, cout           # then 2x2 avg-pool
+        dims = (cin * h * w, self.hidden, self.n_classes)
+        f += 2.0 * batch * sum(a * b for a, b in zip(dims, dims[1:]))
+        return f

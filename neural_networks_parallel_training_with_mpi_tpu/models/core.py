@@ -47,6 +47,15 @@ class Module:
         params = self.init(key if key is not None else jax.random.PRNGKey(0))
         return sum(p.size for p in jax.tree_util.tree_leaves(params))
 
+    def fwd_flops(self, x_shape: Tuple[int, ...]) -> Optional[float]:
+        """Matmul/conv FLOPs of one forward pass on a batch of shape
+        ``x_shape`` (2 x MACs; elementwise ops excluded — they are noise
+        next to the matmuls on the MXU).  None = unaccounted architecture.
+        One optimizer step is conventionally ``3 x fwd_flops`` (forward +
+        ~2x for the backward).  Single source for bench.py's MFU and the
+        Trainer's achieved-FLOPs metric."""
+        return None
+
 
 def _uniform(key: jax.Array, shape: Tuple[int, ...], bound: float,
              dtype: jnp.dtype) -> jax.Array:

@@ -52,6 +52,13 @@ class MLP(Module):
     def apply(self, params, x, **kwargs):
         return self.net.apply(params, x, **kwargs)
 
+    def fwd_flops(self, x_shape):
+        dims = (self.in_features,) + tuple(self.hidden) + (self.out_features,)
+        batch = 1
+        for s in x_shape[:-1]:
+            batch *= s
+        return float(2 * batch * sum(a * b for a, b in zip(dims, dims[1:])))
+
 
 def reference_mlp(param_dtype=jnp.float32) -> MLP:
     """The reference's exact architecture: 2 -> 3 (ReLU) -> 1."""

@@ -151,6 +151,21 @@ class Transformer(Module):
                         compute_dtype=c.compute_dtype).apply(params["head"], x)
         return logits.astype(jnp.float32)
 
+    def fwd_flops(self, x_shape):
+        """(B, T) token batch.  qkv/out/ffn/attention matmuls + LM head;
+        with MoE, each token still runs exactly one expert FFN (top-1
+        Switch routing) plus the router matmul."""
+        c = self.cfg
+        b, t = x_shape
+        d, ff, v = c.d_model, c.d_ff, c.vocab_size
+        per_layer = 2.0 * b * t * d * (3 * d)   # qkv projection
+        per_layer += 2.0 * b * t * d * d        # attention out projection
+        per_layer += 2.0 * (2.0 * b * t * t * d)  # scores + values
+        per_layer += 2.0 * (2.0 * b * t * d * ff)  # FFN in + out
+        if c.moe_experts > 0:
+            per_layer += 2.0 * b * t * d * c.moe_experts  # router
+        return float(c.n_layers * per_layer + 2.0 * b * t * d * v)
+
     def apply(self, params, ids: jax.Array, return_aux: bool = False,
               **kwargs):
         """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or

@@ -118,18 +118,6 @@ def init_pipeline_state(model: Transformer, optimizer: Optimizer,
                       opt_state=optimizer.init(params))
 
 
-def _block_path_names(path) -> Tuple[str, ...]:
-    from . import megatron
-
-    return megatron.path_names(path)
-
-
-def _tp_sharded(names: Tuple[str, ...]) -> bool:
-    from . import megatron
-
-    return megatron.is_tensor_sharded(names)
-
-
 def pipeline_param_specs(params: Pytree, tp: int = 1) -> Pytree:
     """PartitionSpec tree: stacked blocks sharded over 'pipe' (dim 0),
     embed/pos/ln_f/head replicated (they live on every stage; their grads are
@@ -138,11 +126,13 @@ def pipeline_param_specs(params: Pytree, tp: int = 1) -> Pytree:
     'tensor' (stacked leaves are (n_stages, layers_per_stage, ...), so the
     tensor dim sits at index 2 or 3)."""
 
+    from . import megatron
+
     def block_spec(path, leaf):
         if tp <= 1:
             return P(PIPE_AXIS)
-        names = _block_path_names(path)
-        if not _tp_sharded(names):
+        names = megatron.path_names(path)
+        if not megatron.is_tensor_sharded(names):
             return P(PIPE_AXIS)
         # which dim carries 'tensor': col weights split the output dim
         # (last), row weights the input dim (2 — after the (stage, layer)
@@ -374,11 +364,13 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
             # tensor-replicated (identical grads per rank — not summed)
             blk_t = jnp.zeros((), jnp.float32)
             blk_r = jnp.zeros((), jnp.float32)
+            from . import megatron
+
             for path, g in jax.tree_util.tree_flatten_with_path(
                     grads["blocks"])[0]:
                 term = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                names = _block_path_names(path)
-                if tp > 1 and _tp_sharded(names):
+                names = megatron.path_names(path)
+                if tp > 1 and megatron.is_tensor_sharded(names):
                     blk_t = blk_t + term
                 else:
                     blk_r = blk_r + term

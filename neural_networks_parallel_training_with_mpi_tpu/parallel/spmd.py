@@ -328,9 +328,14 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
 
 def make_sp_tp_eval_step(model, mesh: Mesh, loss_name: str = "cross_entropy",
                          with_accuracy: bool = False, seq_axis: str = "seq",
-                         attention_impl: str = "ring"):
+                         attention_impl: str = "ring",
+                         example_batch: Optional[Batch] = None):
     """(sp-tp-sharded params, batch) -> metrics; same contract as
-    data_parallel.make_eval_step, params consumed in place."""
+    data_parallel.make_eval_step, params consumed in place.
+    ``example_batch`` fixes the shard_map in_specs pytree (key set + leaf
+    ranks), like every other step builder here."""
+    if example_batch is None:
+        raise ValueError("example_batch required to derive per-leaf specs")
     base = losses_lib.get(loss_name)
     tp = int(mesh.shape.get("tensor", 1))
     reduce_axes = DATA_AXES + (seq_axis,)
@@ -354,9 +359,7 @@ def make_sp_tp_eval_step(model, mesh: Mesh, loss_name: str = "cross_entropy",
     pspecs = sp_tp_param_specs(dummy)
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,
-        in_specs=(pspecs, batch_specs({"x": jnp.zeros((1, 2), jnp.int32),
-                                       "y": jnp.zeros((1, 2), jnp.int32),
-                                       "mask": jnp.zeros((1,))}, seq_axis)),
+        in_specs=(pspecs, batch_specs(example_batch, seq_axis)),
         out_specs=P(),
         check_vma=False,
     )

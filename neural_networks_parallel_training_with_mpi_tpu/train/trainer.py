@@ -189,7 +189,8 @@ class Trainer:
             self.eval_step = spmd.make_sp_tp_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
-                seq_axis="seq", attention_impl=cfg.model.attention)
+                seq_axis="seq", attention_impl=cfg.model.attention,
+                example_batch=example)
         elif self.seq_parallel:
             from ..parallel import spmd
 
@@ -475,6 +476,12 @@ class Trainer:
                   "steps": step,
                   "samples_per_sec": thr.samples_per_sec,
                   **timer.stats()}
+        # achieved model FLOPs/s (fwd + ~2x bwd per optimizer step), from
+        # the model's own accounting — None for unaccounted architectures
+        sample_shape = (1,) + tuple(self.data["x"].shape[1:])
+        fps = self.model.fwd_flops(sample_shape)
+        if fps is not None:
+            result["model_flops_per_sec"] = 3.0 * fps * thr.samples_per_sec
         # post-training held-out eval (the reference's :227-236 intent);
         # reuse the periodic eval when it already ran at this exact step
         if self.val_data is not None:

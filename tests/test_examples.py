@@ -53,3 +53,13 @@ def test_cli_platform_tpu_fails_fast_when_unavailable():
     assert out.returncode in (0, 2), out.stderr[-2000:]
     if out.returncode == 2:
         assert "no accelerator" in out.stdout + out.stderr
+
+
+def test_example_08_sp_tp_completes():
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "08_sp_tp_3d.sh")],
+        capture_output=True, text=True, timeout=240, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stderr + out.stdout
