@@ -132,6 +132,10 @@ class ModelConfig:
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
+    # transformer: lax.scan over stacked blocks — compile time stops
+    # growing with n_layers (plain DP/SP paths; pipeline/TP own their
+    # stacking)
+    scan_layers: bool = False
     # MoE FFN (transformer only): 0 = dense.  moe_expert_axis is set to
     # 'expert' when the mesh's expert axis is >1 (parallel.expert wires the
     # all_to_all dispatch)
@@ -309,6 +313,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--ep", type=int, default=1, help="expert-parallel axis size")
     p.add_argument("--moe_experts", type=int, default=0,
                    help="MoE experts per FFN (transformer only; 0 = dense)")
+    _add_bool_flag(p, "scan-layers", False,
+                   "lax.scan over stacked transformer blocks (compile time "
+                   "independent of depth; plain DP/SP paths)")
     p.add_argument("--moe_capacity_factor", type=float, default=None,
                    help="per-expert slot count = ceil(factor * group_tokens "
                         "/ n_experts); overflow tokens fall through residual "
@@ -386,7 +393,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
                             dtype=args.dtype,
                             compute_dtype=args.compute_dtype or args.dtype,
-                            remat=args.remat,
+                            remat=args.remat, scan_layers=args.scan_layers,
                             n_layers=args.n_layers, d_model=args.d_model,
                             n_heads=args.n_heads, d_ff=args.d_ff,
                             vocab_size=args.vocab_size,

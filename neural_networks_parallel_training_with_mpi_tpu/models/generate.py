@@ -96,10 +96,35 @@ def _forward_chunk(model: Transformer, params, caches, ids, pos):
     return model.head_logits(params, x), new_caches
 
 
-def _sample(logits, temperature, key):
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Mask logits outside the top-k / nucleus-p candidate sets to -inf.
+    Static control flow only (both knobs are trace-time constants), so the
+    decode step stays one compiled program."""
+    neg = jnp.finfo(logits.dtype).min
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest prefix with cumulative mass >= top_p; the shifted mask
+        # always keeps the most-probable token
+        keep_sorted = jnp.roll(cum < top_p, 1, axis=-1).at[..., 0].set(True)
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, -neg),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, neg, logits)
+    return logits
+
+
+def _sample(logits, temperature, key, top_k: int = 0, top_p: float = 1.0):
     if temperature > 0:
         key, sub = jax.random.split(key)
-        nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        # temperature FIRST, then the nucleus: top_p must measure the mass
+        # of the distribution actually being sampled (top_k is monotone in
+        # the logits, so its candidate set is temperature-invariant)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        nxt = jax.random.categorical(sub, logits, axis=-1)
     else:
         nxt = jnp.argmax(logits, axis=-1)
     return nxt.astype(jnp.int32), key
@@ -107,19 +132,24 @@ def _sample(logits, temperature, key):
 
 def generate(model: Transformer, params, prompt: jax.Array,
              max_new_tokens: int, *, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0,
              key: Optional[jax.Array] = None,
              prompt_lens: Optional[jax.Array] = None,
              pad_id: int = 0) -> jax.Array:
     """Decode ``max_new_tokens`` after ``prompt`` (B, P) -> (B, P + N).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
-    given temperature (``key`` required).  With ragged prompts, right-pad to
-    a common P with ``pad_id`` and pass ``prompt_lens`` (B,); each row
-    starts generating at its own length (sequential path — generated
-    tokens, not pads, populate the cache for short rows).
+    given temperature (``key`` required), optionally restricted to the
+    ``top_k`` most likely tokens and/or the smallest nucleus with
+    cumulative probability ``top_p`` (both static; 0 / 1.0 disable).
+    With ragged prompts, right-pad to a common P with ``pad_id`` and pass
+    ``prompt_lens`` (B,); each row starts generating at its own length
+    (sequential path — generated tokens, not pads, populate the cache for
+    short rows).
 
-    Wrap in ``jax.jit`` (static: model, max_new_tokens, temperature) for
-    repeated use; shapes are static so recompiles only on new (B, P, N).
+    Wrap in ``jax.jit`` (static: model, max_new_tokens, temperature,
+    top_k, top_p) for repeated use; shapes are static so recompiles only
+    on new (B, P, N).
     """
     c = model.cfg
     b, p = prompt.shape
@@ -134,6 +164,16 @@ def generate(model: Transformer, params, prompt: jax.Array,
         # and clamp its write onto the last prompt column
         return prompt.astype(jnp.int32)
     key = key if key is not None else jax.random.PRNGKey(0)
+    if c.scan_layers:
+        # decode walks layers with per-layer caches; unstack the scanned
+        # (n_layers, ...) block leaves back to a per-layer list (slices of
+        # the same buffers — no copy under jit)
+        params = dict(params)
+        stacked = params["blocks"]
+        params["blocks"] = [
+            jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+            for i in range(c.n_layers)
+        ]
     caches = init_kv_cache(model, b, total)
     tokens = jnp.concatenate(
         [prompt.astype(jnp.int32),
@@ -144,7 +184,7 @@ def generate(model: Transformer, params, prompt: jax.Array,
         tokens, caches, key = carry
         ids_1 = lax.dynamic_slice(tokens, (0, pos), (b, 1))
         logits, caches = _forward_chunk(model, params, caches, ids_1, pos)
-        nxt, key = _sample(logits[:, 0], temperature, key)
+        nxt, key = _sample(logits[:, 0], temperature, key, top_k, top_p)
         if ragged:
             # rows whose prompt extends past pos+1 keep their prompt token
             keep = (pos + 1) < prompt_lens
@@ -158,7 +198,8 @@ def generate(model: Transformer, params, prompt: jax.Array,
     else:  # prefill: all P prompt positions in one parallel chunk
         logits, caches = _forward_chunk(model, params, caches,
                                         tokens[:, :p], 0)
-        first, key = _sample(logits[:, p - 1], temperature, key)
+        first, key = _sample(logits[:, p - 1], temperature, key, top_k,
+                             top_p)
         tokens = lax.dynamic_update_slice(tokens, first[:, None], (0, p))
         start = p
     if start < total - 1:

@@ -33,6 +33,7 @@ def build_model(cfg: ModelConfig) -> Module:
             compute_dtype=cdt, remat=cfg.remat,
             moe_experts=cfg.moe_experts,
             moe_expert_axis=cfg.moe_expert_axis,
-            moe_capacity_factor=cfg.moe_capacity_factor)
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            scan_layers=cfg.scan_layers)
         return Transformer(tc)
     raise ValueError(f"unknown arch {cfg.arch!r}")

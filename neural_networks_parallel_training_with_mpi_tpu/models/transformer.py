@@ -37,6 +37,14 @@ class TransformerConfig:
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
     remat: bool = False                # jax.checkpoint each block (HBM <-> FLOPs)
+    # lax.scan over a stacked block pytree (leaves (n_layers, ...)) instead
+    # of a Python loop: XLA traces/compiles ONE block body regardless of
+    # depth, so compile time and program size stop growing with n_layers —
+    # the TPU-idiomatic layout for deep models.  Changes the param treedef
+    # (stacked vs per-layer list); composes with remat (checkpoint the
+    # scan body) but not with the pipeline/TP layouts, which own their own
+    # stacking/sharding.
+    scan_layers: bool = False
     # MoE FFN (models.moe): 0 experts = dense FFN.  With ``moe_expert_axis``
     # set, apply() must run inside a shard_map binding that mesh axis and
     # expert params sharded over it (parallel.expert wires the train step).
@@ -96,6 +104,9 @@ class Transformer(Module):
         for i in range(c.n_layers):
             bkeys = jax.random.split(keys[i], len(mods))
             blocks.append({name: m.init(k) for (name, m), k in zip(mods.items(), bkeys)})
+        if c.scan_layers:  # stacked layout: leaves (n_layers, ...)
+            blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                            *blocks)
         return {
             "embed": embed.init(keys[-3]),
             "pos": pos.init(keys[-2]),
@@ -187,8 +198,17 @@ class Transformer(Module):
         if c.remat:
             block_fn = jax.checkpoint(block_fn, static_argnums=())
         aux_total = jnp.zeros((), jnp.float32)
-        for layer_params in params["blocks"]:
-            x, aux = block_fn(layer_params, x)
-            aux_total = aux_total + aux
+        if c.scan_layers:
+            def body(carry, layer_params):
+                h, aux_sum = carry
+                h, aux = block_fn(layer_params, h)
+                return (h, aux_sum + aux), None
+
+            (x, aux_total), _ = jax.lax.scan(body, (x, aux_total),
+                                             params["blocks"])
+        else:
+            for layer_params in params["blocks"]:
+                x, aux = block_fn(layer_params, x)
+                aux_total = aux_total + aux
         logits = self.head_logits(params, x)
         return (logits, aux_total) if return_aux else logits

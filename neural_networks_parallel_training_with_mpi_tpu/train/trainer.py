@@ -107,6 +107,12 @@ class Trainer:
                 "grad_reduction='per_shard_mean' (the reference's :188-197 "
                 "semantics) is only available on the pure-DP shard_map path; "
                 "GSPMD global semantics always compute the exact global mean")
+        if cfg.model.scan_layers and (self.pipeline or self.gspmd
+                                      or self.sp_tp or self.expert):
+            raise ValueError(
+                "scan_layers stacks blocks for a depth-independent compile "
+                "on the plain DP / DP x seq paths; the pipeline/TP/expert "
+                "layouts own their own stacking and sharding")
         self.model = build_model(cfg.model)
         if self.seq_parallel and cfg.model.arch != "transformer":
             raise ValueError("seq axis > 1 requires the transformer model")
@@ -482,6 +488,16 @@ class Trainer:
         fps = self.model.fwd_flops(sample_shape)
         if fps is not None:
             result["model_flops_per_sec"] = 3.0 * fps * thr.samples_per_sec
+        # peak device memory where the backend reports it (TPU HBM; {} on
+        # CPU) — the observability the reference's prints never had.
+        # PROCESS-lifetime high-water mark (the runtime never resets it),
+        # so a second fit() in one process inherits the first's peak —
+        # hence the explicit key name.
+        mem = profiling.device_memory_stats()
+        peaks = [v.get("peak_bytes_in_use") for v in mem.values()
+                 if "peak_bytes_in_use" in v]
+        if peaks:
+            result["process_peak_memory_bytes"] = max(peaks)
         # post-training held-out eval (the reference's :227-236 intent);
         # reuse the periodic eval when it already ran at this exact step
         if self.val_data is not None:

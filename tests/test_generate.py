@@ -100,3 +100,35 @@ def test_zero_new_tokens_returns_prompt_unchanged():
     out = generate(model, params, prompt, max_new_tokens=0)
     assert out.shape == prompt.shape
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_top_k_one_equals_greedy(lm):
+    """top_k=1 sampling must reproduce greedy argmax regardless of
+    temperature (only one candidate survives the filter)."""
+    model, params = lm
+    prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+    greedy = generate(model, params, prompt, 6)
+    topk1 = generate(model, params, prompt, 6, temperature=1.5, top_k=1,
+                     key=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(topk1), np.asarray(greedy))
+
+
+def test_top_p_keeps_most_probable_token(lm):
+    """A tiny top_p must always keep the argmax candidate (the shifted
+    nucleus mask guarantees a non-empty set) -> equals greedy."""
+    model, params = lm
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    greedy = generate(model, params, prompt, 5)
+    nucleus = generate(model, params, prompt, 5, temperature=1.0,
+                       top_p=1e-6, key=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+
+
+def test_top_k_p_sampling_stays_in_vocab(lm):
+    model, params = lm
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, params, prompt, 8, temperature=1.0, top_k=8,
+                   top_p=0.9, key=jax.random.PRNGKey(0))
+    toks = np.asarray(out)
+    assert toks.shape == (1, 12)
+    assert (toks >= 0).all() and (toks < model.cfg.vocab_size).all()
