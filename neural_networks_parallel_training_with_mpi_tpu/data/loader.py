@@ -21,6 +21,8 @@ Every yielded batch is a dict pytree ``{"x", "y", "mask"}`` of
 from __future__ import annotations
 
 import math
+import queue
+import threading
 from typing import Dict, Iterator, Optional
 
 import jax
@@ -31,6 +33,50 @@ from ..parallel import sharding as shd
 
 Arrays = Dict[str, np.ndarray]
 
+_DONE = object()
+
+
+def _thread_prefetch(gen: Iterator[Arrays], depth: int) -> Iterator[Arrays]:
+    """Run ``gen`` (pure numpy work) on a daemon thread, ``depth`` items
+    ahead.  Exceptions re-raise on the consumer thread.  When the consumer
+    abandons the iterator early (``next(iter(epoch(0)))`` example-batch
+    grabs, early breaks), generator close sets the stop event and the
+    worker exits within its put-poll interval — no parked threads, no
+    pinned batches."""
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def work():
+        try:
+            for item in gen:
+                while True:
+                    if stop.is_set():
+                        return
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — handed to the consumer
+            q.put(e)
+            return
+        q.put(_DONE)
+
+    threading.Thread(target=work, daemon=True,
+                     name="loader-prefetch").start()
+    try:
+        while True:
+            item = q.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
 
 class ShardedLoader:
     def __init__(self, mesh: Mesh, data: Arrays, batch_size: int,
@@ -39,9 +85,12 @@ class ShardedLoader:
                  multi_host: Optional[bool] = None,
                  seq_axis: Optional[str] = None,
                  backend: str = "numpy",
-                 batch_axes: Optional[tuple] = None):
+                 batch_axes: Optional[tuple] = None,
+                 prefetch: int = 2):
         if remainder not in ("pad", "drop"):
             raise ValueError("remainder must be 'pad' or 'drop'")
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
         if backend not in ("numpy", "native", "auto"):
             raise ValueError("backend must be 'numpy', 'native' or 'auto'")
         self.mesh = mesh
@@ -63,6 +112,7 @@ class ShardedLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.remainder = remainder
+        self.prefetch = prefetch
         self.multi_host = (jax.process_count() > 1 if multi_host is None
                            else multi_host)
         # native (C++) shuffle+gather+prefetch path: batch assembly overlaps
@@ -104,19 +154,31 @@ class ShardedLoader:
         """Yield device-placed global batches for one epoch.  ``start_step``
         skips already-trained batches when resuming mid-epoch (the order is
         deterministic per (seed, epoch), so a resumed run sees the identical
-        remaining batches)."""
+        remaining batches).
+
+        Host-side batch assembly (index gather over the dataset arrays)
+        runs ``prefetch`` batches ahead on a daemon thread so it overlaps
+        device compute — the Python-path analogue of the native (C++)
+        loader's worker pool; device placement stays on the caller's
+        thread (single-threaded JAX API use)."""
         if self._native is not None:
             for batch in self._native.epoch(epoch, start_batch=start_step):
                 yield self._place(batch)
             return
+        host = self._host_batches(epoch, start_step)
+        if self.prefetch > 0:
+            host = _thread_prefetch(host, self.prefetch)
+        for batch in host:
+            yield self._place(batch)
+
+    def _host_batches(self, epoch: int, start_step: int) -> Iterator[Arrays]:
         order = self._epoch_order(epoch)
         bs = self.batch_size
         for step in range(start_step, self.steps_per_epoch):
             idx = order[step * bs: (step + 1) * bs]
             if self.remainder == "drop" and len(idx) < bs:
                 break
-            batch = {k: v[idx] for k, v in self.data.items()}
-            yield self._place(batch)
+            yield {k: v[idx] for k, v in self.data.items()}
 
     def _place(self, batch: Arrays) -> Dict[str, jax.Array]:
         padded = {}

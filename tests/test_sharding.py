@@ -58,3 +58,70 @@ def test_shard_batch_places_on_data_axis(mesh8):
     assert len(placed.addressable_shards) == 8
     assert placed.addressable_shards[0].data.shape == (2, 2)
     np.testing.assert_array_equal(np.asarray(placed), x)
+
+
+def test_loader_prefetch_yields_identical_batches(mesh8):
+    """The threaded host-side prefetcher is a pure pipelining change: batch
+    contents and order must be identical to the synchronous path."""
+    from neural_networks_parallel_training_with_mpi_tpu.data.loader import (
+        ShardedLoader,
+    )
+
+    rng = np.random.default_rng(0)
+    data = {"x": rng.standard_normal((40, 4)).astype(np.float32),
+            "y": rng.standard_normal((40, 1)).astype(np.float32)}
+    mk = lambda pf: ShardedLoader(mesh8, data, 16, shuffle=True, seed=3,
+                                  prefetch=pf)
+    for epoch in range(2):
+        sync_batches = list(mk(0).epoch(epoch))
+        pre_batches = list(mk(3).epoch(epoch))
+        assert len(sync_batches) == len(pre_batches)
+        for a, b in zip(sync_batches, pre_batches):
+            for k in a:
+                np.testing.assert_array_equal(np.asarray(a[k]),
+                                              np.asarray(b[k]))
+
+
+def test_loader_prefetch_propagates_worker_errors(mesh8):
+    from neural_networks_parallel_training_with_mpi_tpu.data.loader import (
+        _thread_prefetch,
+    )
+
+    def boom():
+        yield {"x": np.zeros((2, 2))}
+        raise RuntimeError("worker exploded")
+
+    it = _thread_prefetch(boom(), depth=2)
+    next(it)
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        next(it)
+
+
+def test_loader_prefetch_worker_exits_on_abandon(mesh8):
+    """Abandoning the iterator (the Trainer's example-batch grab) must
+    release the worker thread instead of parking it forever."""
+    import threading
+    import time
+
+    from neural_networks_parallel_training_with_mpi_tpu.data.loader import (
+        ShardedLoader,
+    )
+
+    rng = np.random.default_rng(0)
+    data = {"x": rng.standard_normal((64, 4)).astype(np.float32),
+            "y": rng.standard_normal((64, 1)).astype(np.float32)}
+    loader = ShardedLoader(mesh8, data, 8, shuffle=False, prefetch=2)
+    before = {t.name for t in threading.enumerate()}
+    it = loader.epoch(0)
+    next(it)   # worker started, queue filling
+    it.close()  # abandon -> GeneratorExit -> stop event
+    deadline = time.time() + 3.0
+    while time.time() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "loader-prefetch" and t.name not in before]
+        if not any(t.is_alive() for t in alive):
+            break
+        time.sleep(0.05)
+    assert not [t for t in threading.enumerate()
+                if t.name == "loader-prefetch" and t.is_alive()], \
+        "prefetch worker still parked after iterator close"
