@@ -251,8 +251,13 @@ def bench_framework(config_name: str) -> dict:
     _, state, _ = timed_chain(step, state, batch, WARMUP_STEPS, sync)
     log(f"[{config_name}] compile+warmup: {time.perf_counter() - t0:.1f}s")
 
-    # two chain lengths, differenced (see timed_chain)
+    # two chain lengths, differenced (see timed_chain).  measure_steps is
+    # sized for the TPU; the CPU fallback runs the same workload 1000x
+    # slower, so scale the chains down there (it is a smoke/mechanism
+    # number, not the driver's headline)
     n1 = cfg["measure_steps"]
+    if not on_tpu:
+        n1 = max(3, n1 // 4)
     n2 = 3 * n1
     t1, state, _ = timed_chain(step, state, batch, n1, sync)
     t2, state, loss_val = timed_chain(step, state, batch, n2, sync)
