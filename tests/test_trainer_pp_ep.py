@@ -67,10 +67,12 @@ def test_trainer_rejects_unwired_mixed_styles():
                                     moe_expert_axis="expert")
     with pytest.raises(NotImplementedError, match="pipe composes with"):
         Trainer(cfg)
+    # seq x tensor is wired since round 2 (parallel.spmd sp_tp); seq x
+    # expert remains an unwired mix
     cfg2 = _lm_cfg(data=2, seq=2, expert=2)
     cfg2.model = dataclasses.replace(cfg2.model, moe_experts=4,
                                      moe_expert_axis="expert")
-    with pytest.raises(NotImplementedError, match="one at a time"):
+    with pytest.raises(NotImplementedError, match="wired combinations"):
         Trainer(cfg2)
 
 
