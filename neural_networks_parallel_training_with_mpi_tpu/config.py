@@ -156,7 +156,7 @@ class TrainConfig:
     batch_size: int = 4        # honored (reference parses but ignores it — bug B1)
     nepochs: int = 3
     full_batch: bool = True    # reference behavior: one full-shard batch per epoch (:146)
-    optimizer: str = "sgd"     # sgd | adam | adamw
+    optimizer: str = "sgd"     # sgd | adam | adamw | lion
     weight_decay: float = 0.0
     # lr schedule over optimizer steps (ops.schedules); "constant" = the
     # reference's fixed lr.  total_steps is derived from nepochs x
@@ -248,7 +248,8 @@ def build_argparser() -> argparse.ArgumentParser:
     # ignoring an explicit --batch_size
     _add_bool_flag(p, "full-batch", None,
                    "one full-dataset batch per epoch (reference behavior)")
-    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw"], default="sgd")
+    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw", "lion"],
+                   default="sgd")
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--lr_schedule", choices=["constant", "cosine", "linear"],
                    default="constant")

@@ -155,6 +155,51 @@ def adamw(lr: LR, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     return adam(lr, b1, b2, eps, weight_decay, decoupled=True)
 
 
+class LionState(NamedTuple):
+    count: jax.Array
+    momentum: Pytree
+
+
+def lion(lr: LR, b1: float = 0.9, b2: float = 0.99,
+         weight_decay: float = 0.0) -> Optimizer:
+    """Lion (EvoLved Sign Momentum, Chen et al. 2023): the update is the
+    SIGN of a b1-interpolated momentum, the state a single f32 slot —
+    half Adam's optimizer memory, and the sign makes the update magnitude
+    uniform across params (weight decay is decoupled, as in the paper).
+    TPU-friendly: elementwise sign/interp fuse into the update kernel."""
+
+    def init(params: Pytree) -> LionState:
+        return LionState(jnp.zeros((), jnp.int32),
+                         jax.tree_util.tree_map(
+                             lambda p: jnp.zeros_like(p, jnp.float32),
+                             params))
+
+    def update(grads: Pytree, state: LionState, params: Pytree):
+        lr_t = _lr_at(lr, state.count)
+
+        def step(p, m, g):
+            g32 = g.astype(jnp.float32)
+            upd = jnp.sign(b1 * m + (1 - b1) * g32)
+            if weight_decay:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return p - (lr_t * upd).astype(p.dtype)
+
+        new_params = jax.tree_util.tree_map(step, params, state.momentum,
+                                            grads)
+        new_m = jax.tree_util.tree_map(
+            lambda m, g: b2 * m + (1 - b2) * g.astype(jnp.float32),
+            state.momentum, grads)
+        return new_params, LionState(state.count + 1, new_m)
+
+    def state_specs(ps):
+        from jax.sharding import PartitionSpec
+
+        return LionState(PartitionSpec(), ps)
+
+    return Optimizer(init, update, f"lion(lr={lr})",
+                     state_specs=state_specs)
+
+
 def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
     """Clip gradients by global L2 norm before the wrapped update.
 
@@ -182,6 +227,8 @@ def make(name: str, lr: LR, momentum: float = 0.0,
         opt = adam(lr, weight_decay=weight_decay)
     elif name == "adamw":
         opt = adamw(lr, weight_decay=weight_decay or 0.01)
+    elif name == "lion":
+        opt = lion(lr, weight_decay=weight_decay)
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     return with_clipping(opt, grad_clip)
