@@ -169,6 +169,9 @@ class TrainConfig:
     # 1 = off.  One accumulated update = one optimizer step.
     accum_steps: int = 1
     loss: str = "mse"          # mse | cross_entropy
+    # mix the one-hot CE target with uniform: (1-s)*onehot + s/C.  Applies
+    # to the TRAIN loss only (validation reports the unsmoothed loss)
+    label_smoothing: float = 0.0
     # how gradients are reduced across the data axis:
     #   global_mean    - exact gradient of the global-batch mean loss (default;
     #                    correct even with uneven/padded shards)
@@ -260,6 +263,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--accum_steps", type=int, default=1,
                    help="microbatch gradient-accumulation factor (DP path)")
     p.add_argument("--loss", choices=["mse", "cross_entropy"], default="mse")
+    p.add_argument("--label_smoothing", type=float, default=0.0,
+                   help="CE target smoothing s: (1-s)*onehot + s/C "
+                        "(train loss only)")
     p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)
@@ -369,7 +375,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         min_lr=args.min_lr,
         grad_clip=args.grad_clip,
         accum_steps=args.accum_steps,
-        loss=args.loss,
+        loss=args.loss, label_smoothing=args.label_smoothing,
         grad_reduction=args.grad_reduction,
         update_sharding=args.update_sharding,
         seed=args.seed,

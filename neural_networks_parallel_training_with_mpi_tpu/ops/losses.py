@@ -41,15 +41,24 @@ def mse(pred: jax.Array, target: jax.Array,
 
 
 def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
-                          mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+                          mask: Optional[jax.Array] = None,
+                          label_smoothing: float = 0.0
+                          ) -> Tuple[jax.Array, jax.Array]:
     """Cross-entropy (sum, count) with integer labels.  ``logits`` is
     ``(B, C)`` or ``(B, T, C)`` with ``labels`` ``(B,)`` / ``(B, T)``; for the
     sequence case the mask is broadcast over T (all tokens of a padded row are
-    masked)."""
+    masked).  ``label_smoothing`` mixes the one-hot target with the uniform
+    distribution: target = (1 - s) * onehot + s / C."""
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    nll = logz - gold  # (B,) or (B, T)
+    if label_smoothing > 0.0:
+        s = label_smoothing
+        # CE against the smoothed target distribution:
+        #   logz - [(1 - s) * gold + (s / C) * sum_c logit_c]
+        nll = logz - (1.0 - s) * gold - s * logits.mean(axis=-1)
+    else:
+        nll = logz - gold  # (B,) or (B, T)
     if nll.ndim > 1:
         if mask is not None:
             mask = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (nll.ndim - 1)),
@@ -76,6 +85,18 @@ LOSSES = {"mse": mse, "cross_entropy": softmax_cross_entropy}
 
 
 def get(name: str):
+    """Loss by name.  ``"cross_entropy@0.1"`` selects cross-entropy with
+    label smoothing 0.1 — the suffix form lets every step builder stay a
+    plain ``loss_name: str`` consumer (the Trainer composes the string
+    from ``--label_smoothing``; eval always uses the unsmoothed loss)."""
+    if "@" in name:
+        base, _, s = name.partition("@")
+        if base != "cross_entropy":
+            raise ValueError(f"label smoothing only applies to "
+                             f"cross_entropy, got {name!r}")
+        import functools
+
+        return functools.partial(LOSSES[base], label_smoothing=float(s))
     try:
         return LOSSES[name]
     except KeyError:

@@ -144,6 +144,19 @@ class Trainer:
         # leaves are axis-sharded; optim.with_clipping's shard-local norm
         # would be wrong there — see make_pipeline_train_step /
         # make_moe_train_step / zero1_shard_update)
+        if cfg.label_smoothing and cfg.loss != "cross_entropy":
+            raise ValueError("--label_smoothing applies to cross_entropy "
+                             f"only, not {cfg.loss!r}")
+        if not 0.0 <= cfg.label_smoothing < 1.0:
+            raise ValueError(
+                f"label_smoothing must be in [0, 1), got "
+                f"{cfg.label_smoothing} (s >= 1 puts non-positive weight "
+                "on the gold class; s < 0 silently disables smoothing)")
+        # smoothing applies to the TRAIN loss only; eval reports the
+        # unsmoothed loss (ops.losses.get's "@s" suffix form keeps every
+        # step builder a plain loss_name consumer)
+        train_loss = (f"{cfg.loss}@{cfg.label_smoothing}"
+                      if cfg.label_smoothing else cfg.loss)
         step_clips = (self.pipeline or self.expert or self.zero1
                       or self.sp_tp)
         self.optimizer = optim_lib.make(
@@ -157,7 +170,7 @@ class Trainer:
             # single optimizer update — and a smaller bubble fraction)
             n_stages = int(self.mesh.shape["pipe"])
             self.train_step = pp.make_pipeline_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 n_microbatches=n_stages * cfg.accum_steps,
                 grad_clip=cfg.grad_clip)
             # eval runs the ring schedule forward-only on the pipe-sharded
@@ -172,7 +185,7 @@ class Trainer:
             from ..parallel import expert as ep_lib
 
             moe_step = ep_lib.make_moe_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
 
             def train_step(state, batch):
@@ -188,7 +201,7 @@ class Trainer:
 
             example = next(iter(self.loader.epoch(0)))
             self.train_step = spmd.make_sp_tp_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 seq_axis="seq", attention_impl=cfg.model.attention,
                 example_batch=example, accum_steps=cfg.accum_steps,
                 grad_clip=cfg.grad_clip)
@@ -202,7 +215,7 @@ class Trainer:
 
             example = next(iter(self.loader.epoch(0)))
             self.train_step = spmd.make_spmd_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 seq_axis="seq", example_batch=example,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,
@@ -216,7 +229,7 @@ class Trainer:
 
             example = next(iter(self.loader.epoch(0)))
             self.train_step = gspmd.make_gspmd_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 example_batch=example, accum_steps=cfg.accum_steps)
             self.eval_step = gspmd.make_gspmd_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
@@ -224,7 +237,7 @@ class Trainer:
                 example_batch=example)
         else:
             self.train_step = dp.make_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=cfg.loss,
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 grad_reduction=cfg.grad_reduction,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,

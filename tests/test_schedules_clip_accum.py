@@ -162,3 +162,57 @@ def test_trainer_accum_on_gspmd_path_trains():
     )
     r = Trainer(cfg).fit()
     assert np.isfinite(r["final_loss"])
+
+
+def test_label_smoothing_loss_math():
+    """CE@s against the smoothed target: s=0 reduces to plain CE; s>0 on a
+    confident logit is strictly larger (uniform mass penalizes peaking)."""
+    from neural_networks_parallel_training_with_mpi_tpu.ops import losses
+
+    logits = jnp.asarray([[4.0, 0.0, 0.0, 0.0]])
+    labels = jnp.asarray([0])
+    plain = losses.get("cross_entropy")
+    smooth = losses.get("cross_entropy@0.2")
+    s0, c0 = plain(logits, labels)
+    s1, c1 = smooth(logits, labels)
+    assert float(c0) == float(c1) == 1.0
+    assert float(s1) > float(s0)
+    # closed form: logz - (1-s)*gold - s*mean(logits)
+    import numpy as np
+    logz = np.log(np.exp(4.0) + 3.0)
+    want = logz - 0.8 * 4.0 - 0.2 * 1.0
+    assert float(s1) == pytest.approx(want, rel=1e-6)
+
+
+def test_label_smoothing_trains_and_eval_unsmoothed():
+    cfg = TrainConfig(
+        nepochs=2, batch_size=32, full_batch=False, optimizer="adam",
+        lr=1e-3, loss="cross_entropy", label_smoothing=0.1,
+        data=DataConfig(dataset="digits", val_fraction=0.2),
+        model=ModelConfig(arch="mlp", in_features=64, hidden=(32,),
+                          out_features=10),
+        mesh=MeshConfig(data=8), eval_every=2,
+    )
+    r = Trainer(cfg).fit()
+    assert np.isfinite(r["final_loss"])
+    assert np.isfinite(r["val_loss"])  # eval path: plain CE
+
+
+def test_label_smoothing_rejects_mse():
+    cfg = TrainConfig(nepochs=1, label_smoothing=0.1,
+                      data=DataConfig(dataset="regression", n_samples=16),
+                      mesh=MeshConfig(data=8))
+    with pytest.raises(ValueError, match="label_smoothing"):
+        Trainer(cfg)
+
+
+def test_label_smoothing_rejects_out_of_range():
+    for bad in (-0.1, 1.0, 1.5):
+        cfg = TrainConfig(nepochs=1, loss="cross_entropy",
+                          label_smoothing=bad,
+                          data=DataConfig(dataset="digits"),
+                          model=ModelConfig(arch="mlp", in_features=64,
+                                            hidden=(32,), out_features=10),
+                          mesh=MeshConfig(data=8))
+        with pytest.raises(ValueError, match="label_smoothing"):
+            Trainer(cfg)
