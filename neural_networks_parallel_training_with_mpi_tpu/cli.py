@@ -48,11 +48,97 @@ def _pin_platform(args) -> int:
     return 0
 
 
+def _generate(args) -> int:
+    """Decode from a trained LM checkpoint: the inference entrypoint
+    (the reference has no inference path at all — its closest artifact is
+    the dead test block at dataParallelTraining_NN_MPI.py:227-236).
+
+    ``--generate "1,2,3"`` takes a comma-separated token-id prompt (this
+    framework ships no tokenizer — datasets are synthetic/byte-level) and
+    prints the continuation ids from models.generate's jitted KV-cache
+    decode."""
+    import jax
+    import jax.numpy as jnp
+
+    from .models.registry import build_model
+    from .models.generate import generate
+    from .train.state import TrainState
+    from .ops import optim as optim_lib
+    from .utils import checkpoint as ckpt, prng
+
+    cfg = config_from_args(args)
+    if cfg.model.arch != "transformer":
+        log("ERROR: --generate needs a transformer model (--dataset lm "
+            "or --arch transformer)")
+        return 2
+    # cheap input validation FIRST — before any model init or restore
+    try:
+        ids = [int(t) for t in args.generate.replace(" ", "").split(",") if t]
+    except ValueError:
+        log(f"ERROR: --generate expects comma-separated token ids, got "
+            f"{args.generate!r}")
+        return 2
+    if not ids or any(t < 0 or t >= cfg.model.vocab_size for t in ids):
+        log(f"ERROR: prompt ids must be in [0, {cfg.model.vocab_size}), "
+            f"got {args.generate!r}")
+        return 2
+    if len(ids) + args.max_new_tokens > cfg.model.max_seq_len:
+        log(f"ERROR: prompt ({len(ids)}) + max_new_tokens "
+            f"({args.max_new_tokens}) exceeds max_seq_len "
+            f"{cfg.model.max_seq_len} (raise --seq_len)")
+        return 2
+    if args.top_k > cfg.model.vocab_size:
+        log(f"ERROR: --top_k {args.top_k} > vocab_size "
+            f"{cfg.model.vocab_size}")
+        return 2
+
+    model = build_model(cfg.model)
+    if cfg.checkpoint_dir:
+        # only params matter for decoding; restore without a template so
+        # the training-time optimizer flags need not be repeated (the npz
+        # treedef is stored).  Orbax (multi-host sharded) snapshots DO need
+        # a template for target shardings — build one on demand.
+        try:
+            restored = ckpt.restore(cfg.checkpoint_dir, template=None)
+        except ValueError as e:
+            if "template" not in str(e):
+                log(f"ERROR: cannot restore {cfg.checkpoint_dir}: {e}")
+                return 2
+            opt = optim_lib.make(cfg.optimizer, cfg.lr, cfg.momentum,
+                                 cfg.weight_decay)
+            template = TrainState.create(model, opt, prng.init_key(cfg.seed))
+            try:
+                restored = ckpt.restore(cfg.checkpoint_dir, template)
+            except ValueError as e2:
+                log(f"ERROR: cannot restore {cfg.checkpoint_dir}: {e2} "
+                    "(orbax restore needs the training-time --optimizer)")
+                return 2
+        if restored is None:
+            log(f"ERROR: no checkpoint under {cfg.checkpoint_dir}")
+            return 2
+        params = restored.params
+        log(f"restored step {int(jax.device_get(restored.step))} from "
+            f"{cfg.checkpoint_dir}")
+    else:
+        log("note: no --checkpoint_dir; generating from a fresh init")
+        params = model.init(prng.init_key(cfg.seed))
+    prompt = jnp.asarray([ids], jnp.int32)
+    out = generate(model, params, prompt, args.max_new_tokens,
+                   temperature=args.temperature, top_k=args.top_k,
+                   top_p=args.top_p,
+                   key=jax.random.PRNGKey(cfg.seed))
+    toks = [int(t) for t in jax.device_get(out)[0]]
+    print(",".join(str(t) for t in toks))
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     rc = _pin_platform(args)
     if rc:
         return rc
+    if getattr(args, "generate", None) is not None:
+        return _generate(args)
     from .train.trainer import Trainer  # import after the platform pin
 
     cfg = config_from_args(args)

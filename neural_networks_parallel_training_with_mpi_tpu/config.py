@@ -266,6 +266,16 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="CE target smoothing s: (1-s)*onehot + s/C "
                         "(train loss only)")
+    # inference entrypoint (cli._generate): decode instead of training
+    p.add_argument("--generate", type=str, default=None, metavar="IDS",
+                   help="comma-separated prompt token ids; decode "
+                        "--max_new_tokens from the checkpoint (or a fresh "
+                        "init) instead of training")
+    p.add_argument("--max_new_tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 = sampled")
+    p.add_argument("--top_k", type=int, default=0)
+    p.add_argument("--top_p", type=float, default=1.0)
     p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)

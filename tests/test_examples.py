@@ -63,3 +63,34 @@ def test_example_08_sp_tp_completes():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done: final loss" in out.stderr + out.stdout
+
+
+def test_cli_generate_from_checkpoint(tmp_path):
+    """Train 1 epoch -> decode from the checkpoint via --generate: the
+    inference entrypoint (the reference has none; its closest artifact is
+    the dead test block at dataParallelTraining_NN_MPI.py:227-236)."""
+    ck = str(tmp_path / "ck")
+    common = ["--dataset", "lm", "--optimizer", "adam",
+              "--platform", "cpu", "--num_devices", "8",
+              "--checkpoint_dir", ck]
+    train = subprocess.run(
+        [sys.executable, "-m", "neural_networks_parallel_training_with_mpi_tpu",
+         *common, "--no-full-batch", "--batch_size", "32", "--nepochs", "1"],
+        capture_output=True, text=True, timeout=240, env=_clean_env(),
+        cwd=str(REPO))
+    assert train.returncode == 0, train.stderr[-2000:]
+    # decode WITHOUT repeating the training-time --optimizer: restore
+    # goes through the stored treedef, no template needed
+    gen = subprocess.run(
+        [sys.executable, "-m", "neural_networks_parallel_training_with_mpi_tpu",
+         "--dataset", "lm", "--platform", "cpu", "--num_devices", "8",
+         "--checkpoint_dir", ck,
+         "--generate", "10,20,30", "--max_new_tokens", "8",
+         "--temperature", "0.8", "--top_k", "20"],
+        capture_output=True, text=True, timeout=240, env=_clean_env(),
+        cwd=str(REPO))
+    assert gen.returncode == 0, gen.stderr[-2000:]
+    assert "restored step" in gen.stdout + gen.stderr
+    toks = [int(t) for t in gen.stdout.strip().splitlines()[-1].split(",")]
+    assert toks[:3] == [10, 20, 30] and len(toks) == 11
+    assert all(0 <= t < 256 for t in toks)
