@@ -144,6 +144,7 @@ class ModelConfig:
     # per-expert slot count = ceil(factor * group_tokens / n_experts);
     # tokens over capacity fall through the residual (models/moe.py)
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 routing
 
 
 @dataclass
@@ -333,6 +334,8 @@ def build_argparser() -> argparse.ArgumentParser:
     _add_bool_flag(p, "scan-layers", False,
                    "lax.scan over stacked transformer blocks (compile time "
                    "independent of depth; plain DP/SP paths)")
+    p.add_argument("--moe_top_k", type=int, default=1,
+                   help="experts per token: 1 = Switch, 2 = GShard top-2")
     p.add_argument("--moe_capacity_factor", type=float, default=None,
                    help="per-expert slot count = ceil(factor * group_tokens "
                         "/ n_experts); overflow tokens fall through residual "
@@ -449,6 +452,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         cfg.model.moe_experts = args.moe_experts
     if args.moe_capacity_factor is not None:
         cfg.model.moe_capacity_factor = args.moe_capacity_factor
+    cfg.model.moe_top_k = args.moe_top_k
     if args.ep > 1:
         # expert-sharded MoE: route token slots over the 'expert' axis
         cfg.model.moe_expert_axis = "expert"

@@ -57,6 +57,10 @@ class MoEFFN(Module):
     expert_axis: Optional[str] = None
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32
+    # 1 = Switch top-1 (combine weight = the chosen expert's raw prob);
+    # k>1 = GShard-style top-k (weights = the top-k probs renormalized,
+    # rank-0 choices claim expert queue slots before rank-1, etc.)
+    router_top_k: int = 1
 
     def init(self, key: jax.Array) -> Pytree:
         kg, k1, k2, k3, k4 = jax.random.split(key, 5)
@@ -74,33 +78,72 @@ class MoEFFN(Module):
 
     # ---- routing -------------------------------------------------------
 
+    def __post_init__(self):
+        if not 1 <= self.router_top_k <= self.n_experts:
+            raise ValueError(
+                f"router_top_k must be in [1, n_experts={self.n_experts}], "
+                f"got {self.router_top_k}")
+
     def _capacity(self, n_tokens: int) -> int:
         if self.capacity is not None:
             return self.capacity
-        return max(1, math.ceil(self.capacity_factor * n_tokens
-                                / self.n_experts))
+        # top-k demand is k assignments per token (GShard scales capacity
+        # by k; without this, default top-2 would drop >= 37% of
+        # assignments even under perfectly uniform load)
+        return max(1, math.ceil(self.capacity_factor * self.router_top_k
+                                * n_tokens / self.n_experts))
+
+    @staticmethod
+    def _assign_slots(onehot: jax.Array, cap: int, counts: jax.Array):
+        """Queue positions for one choice rank: each token's 0-based slot in
+        its expert's queue, offset by ``counts`` (slots already claimed by
+        earlier ranks).  Returns ((N, E, C) dispatch mask, updated counts)."""
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0
+               + counts[None, :]) * onehot               # (N, E)
+        pos_tok = pos.sum(-1)                            # (N,)
+        keep = (pos_tok < cap) & (onehot.sum(-1) > 0)
+        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap,
+                              dtype=jnp.float32)         # (N, C)
+        mask = (onehot[:, :, None] * slot[:, None, :]
+                * keep[:, None, None].astype(jnp.float32))
+        return mask, counts + onehot.sum(0)
 
     def _route(self, gate_params: Pytree, x: jax.Array, cap: int):
         """x: (N, d) -> dispatch (N, E, C) bool-ish, combine (N, E, C),
         aux scalar."""
-        e = self.n_experts
+        e, k = self.n_experts, self.router_top_k
         logits = jnp.matmul(x.astype(jnp.float32),
                             gate_params["w"].astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)            # (N, E)
-        expert_idx = jnp.argmax(probs, axis=-1)            # (N,)
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
-        gate_val = (probs * onehot).sum(-1)                # (N,)
-        # slot assignment: position of each token within its expert's queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot - onehot  # (N, E), 0-based
-        pos_tok = pos.sum(-1)                               # (N,)
-        keep = (pos_tok < cap) & (onehot.sum(-1) > 0)
-        slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap,
-                              dtype=jnp.float32)                 # (N, C)
-        dispatch = onehot[:, :, None] * slot[:, None, :]         # (N, E, C)
-        dispatch = dispatch * keep[:, None, None].astype(jnp.float32)
-        combine = dispatch * gate_val[:, None, None]
-        # Switch load-balance loss: E * sum_e f_e * p_e  (1.0 when uniform)
-        f_e = onehot.mean(0)
+        counts = jnp.zeros((e,), jnp.float32)
+        if k == 1:
+            # Switch: combine weight = the chosen expert's RAW probability
+            onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), e,
+                                    dtype=jnp.float32)
+            gate_val = (probs * onehot).sum(-1)            # (N,)
+            dispatch, _ = self._assign_slots(onehot, cap, counts)
+            combine = dispatch * gate_val[:, None, None]
+            top1 = onehot
+        else:
+            # GShard-style top-k: weights are the top-k probs renormalized;
+            # rank r claims expert queue slots after ranks < r (dropped
+            # tokens still consume their attempted position — keeps slot
+            # assignment one cumsum per rank instead of data-dependent)
+            top_p, top_i = jax.lax.top_k(probs, k)         # (N, k)
+            weights = top_p / jnp.maximum(
+                top_p.sum(-1, keepdims=True), 1e-9)
+            dispatch = jnp.zeros((x.shape[0], e, cap), jnp.float32)
+            combine = jnp.zeros_like(dispatch)
+            for r in range(k):
+                onehot = jax.nn.one_hot(top_i[:, r], e, dtype=jnp.float32)
+                mask, counts = self._assign_slots(onehot, cap, counts)
+                dispatch = dispatch + mask
+                combine = combine + mask * weights[:, r][:, None, None]
+                if r == 0:
+                    top1 = onehot
+        # load-balance loss on the primary assignment (Switch / GShard
+        # convention): E * sum_e f_e * p_e  (1.0 when uniform)
+        f_e = top1.mean(0)
         p_e = probs.mean(0)
         aux = e * jnp.sum(f_e * p_e)
         return dispatch, combine, aux

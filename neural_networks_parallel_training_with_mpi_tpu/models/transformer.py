@@ -52,6 +52,7 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_capacity: Optional[int] = None
     moe_expert_axis: Optional[str] = None
+    moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 routing
 
     @property
     def head_dim(self) -> int:
@@ -82,6 +83,7 @@ class Transformer(Module):
                 capacity_factor=c.moe_capacity_factor,
                 capacity=c.moe_capacity, activation=c.activation,
                 expert_axis=c.moe_expert_axis,
+                router_top_k=c.moe_top_k,
                 param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
         else:
             mods["ff_in"] = Linear(c.d_model, c.d_ff,
@@ -164,17 +166,19 @@ class Transformer(Module):
 
     def fwd_flops(self, x_shape):
         """(B, T) token batch.  qkv/out/ffn/attention matmuls + LM head;
-        with MoE, each token still runs exactly one expert FFN (top-1
-        Switch routing) plus the router matmul."""
+        with MoE, each token runs ``moe_top_k`` expert FFNs plus the
+        router matmul."""
         c = self.cfg
         b, t = x_shape
         d, ff, v = c.d_model, c.d_ff, c.vocab_size
         per_layer = 2.0 * b * t * d * (3 * d)   # qkv projection
         per_layer += 2.0 * b * t * d * d        # attention out projection
         per_layer += 2.0 * (2.0 * b * t * t * d)  # scores + values
-        per_layer += 2.0 * (2.0 * b * t * d * ff)  # FFN in + out
+        ffn = 2.0 * (2.0 * b * t * d * ff)      # FFN in + out per expert
         if c.moe_experts > 0:
+            ffn *= c.moe_top_k
             per_layer += 2.0 * b * t * d * c.moe_experts  # router
+        per_layer += ffn
         return float(c.n_layers * per_layer + 2.0 * b * t * d * v)
 
     def apply(self, params, ids: jax.Array, return_aux: bool = False,

@@ -116,3 +116,90 @@ def test_moe_training_decreases_loss():
         state, metrics = step(state, placed)
     assert float(metrics["loss"]) < float(first["loss"])
     assert np.isfinite(float(metrics["aux"]))
+
+
+# ---- top-k (GShard-style) routing ---------------------------------------
+
+
+def test_top2_of_two_experts_equals_soft_mixture():
+    """With n_experts=2 and ample capacity, top-2 routing touches EVERY
+    expert with renormalized-softmax weights — i.e. the exact soft mixture
+    sum_e p_e * expert_e(x).  Pins the whole dispatch/combine algebra."""
+    layer = MoEFFN(d_model=8, d_ff=16, n_experts=2, router_top_k=2,
+                   capacity_factor=4.0)
+    params = layer.init(prng.init_key(0))
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((6, 8)),
+                    jnp.float32)
+    y, _aux = layer.apply(params, x)
+
+    logits = x @ params["gate"]["w"]
+    probs = jax.nn.softmax(logits, axis=-1)          # (N, 2)
+    want = jnp.zeros_like(x)
+    for e_idx in range(2):
+        ep_params = jax.tree_util.tree_map(lambda w, i=e_idx: w[i],
+                                           params["experts"])
+        h = x @ ep_params["w_in"] + ep_params["b_in"]
+        h = jax.nn.gelu(h)
+        out = h @ ep_params["w_out"] + ep_params["b_out"]
+        want = want + probs[:, e_idx][:, None] * out
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_top2_combine_mass_sums_to_one():
+    """Ample capacity: every token's combine weights sum to 1 (renormalized
+    top-2), unlike Switch where the weight is the raw top-1 prob."""
+    layer = MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=2,
+                   capacity_factor=8.0)
+    params = layer.init(prng.init_key(1))
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((16, 8)),
+                    jnp.float32)
+    _, combine, _ = layer._route(params["gate"], x, layer._capacity(16))
+    mass = np.asarray(combine.sum(axis=(1, 2)))
+    np.testing.assert_allclose(mass, np.ones(16), rtol=1e-5)
+
+
+def test_top2_trainer_expert_parallel():
+    """top-2 MoE trains end to end on the DP x EP mesh (all_to_all slot
+    exchange carries both choices)."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    cfg = TrainConfig(
+        nepochs=1, batch_size=32, full_batch=False, shuffle=False,
+        loss="cross_entropy", optimizer="adam", lr=1e-3,
+        data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                        vocab_size=64),
+        model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                          n_heads=4, d_ff=64, vocab_size=64, max_seq_len=16,
+                          moe_experts=4, moe_expert_axis="expert",
+                          moe_top_k=2),
+        mesh=MeshConfig(data=4, expert=2),
+    )
+    r = Trainer(cfg).fit()
+    assert np.isfinite(r["final_loss"])
+
+
+def test_top2_default_capacity_keeps_full_mass():
+    """The default capacity scales with k (GShard), so uniform-ish load at
+    capacity_factor=1.25 keeps most of the 2N assignments."""
+    layer = MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=2)
+    params = layer.init(prng.init_key(2))
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((32, 8)),
+                    jnp.float32)
+    cap = layer._capacity(32)
+    assert cap >= 20  # ceil(1.25 * 2 * 32 / 4)
+    dispatch, _, _ = layer._route(params["gate"], x, cap)
+    # 2 assignments per token attempted; the k-scaled capacity keeps most
+    assert float(dispatch.sum()) >= 0.8 * 2 * 32
+
+
+def test_router_top_k_validated():
+    with pytest.raises(ValueError, match="router_top_k"):
+        MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=0)
+    with pytest.raises(ValueError, match="router_top_k"):
+        MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=8)
