@@ -579,4 +579,12 @@ class Trainer:
                 w = ec if k == "accuracy" else c  # per-example vs per-token
                 sums[k] = sums.get(k, 0.0) + float(v) * w
                 totals[k] = totals.get(k, 0.0) + w
-        return {k: v / totals[k] for k, v in sums.items()}
+        out = {k: v / totals[k] for k, v in sums.items()}
+        if self.cfg.loss == "cross_entropy" and "loss" in out:
+            # token-level perplexity (the LM community's headline number);
+            # clamp the exponent so a huge-but-finite loss can't overflow
+            # to inf (a NaN loss stays NaN — same signal as val_loss)
+            out["ppl"] = float(np.exp(min(out["loss"], 30.0))
+                               if not np.isnan(out["loss"])
+                               else float("nan"))
+        return out

@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 from neural_networks_parallel_training_with_mpi_tpu.config import (
-    DataConfig, MeshConfig, TrainConfig,
+    DataConfig, MeshConfig, ModelConfig, TrainConfig,
 )
 from neural_networks_parallel_training_with_mpi_tpu.data.datasets import (
     regression_dataset, train_val_split,
@@ -89,3 +89,21 @@ def test_digits_real_dataset():
     np.testing.assert_array_equal(d1["x"], d2["x"])
     # standardized: globally ~zero-mean unit-ish variance (fix of ref bug B4)
     assert abs(float(d1["x"].mean())) < 1e-4
+
+
+def test_lm_validation_reports_perplexity():
+    cfg = TrainConfig(
+        nepochs=1, batch_size=32, full_batch=False, optimizer="adam",
+        lr=1e-3, loss="cross_entropy", eval_every=1,
+        data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                        vocab_size=64, val_fraction=0.25),
+        model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                          n_heads=4, d_ff=64, vocab_size=64, max_seq_len=16),
+        mesh=MeshConfig(data=8),
+    )
+    r = Trainer(cfg).fit()
+    assert "val_ppl" in r
+    np.testing.assert_allclose(r["val_ppl"], np.exp(r["val_loss"]),
+                               rtol=1e-6)
+    # an untrained 64-vocab LM sits near uniform: ppl ~ vocab size
+    assert 20.0 < r["val_ppl"] < 100.0
