@@ -56,7 +56,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                       block_k: int, seq_len: int, causal: bool,
                       scale: float):
     """Grid: (batch*heads, T // block_q).  Refs (block-local):
-    q (1, block_q, D), k/v (1, T, D), o (1, block_q, D), lse (1, block_q)."""
+    q (1, block_q, D), k/v (1, T, D), o (1, block_q, D), lse (1, 1, block_q).
+
+    lse rides in a (BH, 1, T) layout: Mosaic requires the last two dims of
+    every block shape to be (8, 128)-divisible or equal to the array dims,
+    which a (1, block_q) block over (BH, T) violates (the leading 1 is a
+    grid dim).  With the singleton axis the block's trailing dims are
+    (1, block_q) against array dims (1, T) — legal."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
     d = q.shape[-1]
@@ -96,7 +102,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
 
 
 def _heads_major(x: jax.Array) -> jax.Array:
@@ -145,15 +151,15 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i), **mem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
         ],
         interpret=interpret,
     )(qh, kh, vh)
-    return _heads_minor(out, b, h), lse
+    return _heads_minor(out, b, h), lse.reshape(b * h, t)
 
 
 def _blocked_attention_reference(q, k, v, causal: bool, block_k: int):
@@ -206,12 +212,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_q: int, block_k: int, seq_len: int,
                          causal: bool, scale: float):
     """Grid: (B*H, T // block_q).  q/do/dq blocks (1, block_q, D); k/v full
-    rows (1, T, D); lse/delta blocks (1, block_q) float32."""
+    rows (1, T, D); lse/delta blocks (1, 1, block_q) float32 (the singleton
+    axis keeps the trailing block dims Mosaic-legal, see _flash_fwd_kernel)."""
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0].astype(jnp.float32)[:, None]        # (Bq, 1)
-    delta = delta_ref[0].astype(jnp.float32)[:, None]
+    lse = lse_ref[0, 0].astype(jnp.float32)[:, None]     # (Bq, 1)
+    delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
     d = q.shape[-1]
     num_k_blocks = seq_len // block_k
     if causal:
@@ -247,12 +254,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, block_k: int,
                           seq_len: int, causal: bool, scale: float):
     """Grid: (B*H, T // block_k).  k/v/dk/dv blocks (1, block_k, D);
-    q/do full rows (1, T, D); lse/delta full rows (1, T) float32."""
+    q/do full rows (1, T, D); lse/delta full rows (1, 1, T) float32."""
     kj = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)                      # (Bk, D)
     v = v_ref[0].astype(jnp.float32)
-    lse_row = lse_ref[0].astype(jnp.float32)              # (T,)
-    delta_row = delta_ref[0].astype(jnp.float32)
     d = k.shape[-1]
     num_q_blocks = seq_len // block_q
     # causal: k-block kj only feeds q rows >= kj*block_k
@@ -264,9 +269,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lax.dynamic_slice(lse_row, (i * block_q,), (block_q,))[:, None]
-        delta = lax.dynamic_slice(delta_row, (i * block_q,),
-                                  (block_q,))[:, None]
+        # slice from the refs (Mosaic lowers pl.ds ref reads; value-level
+        # lax.dynamic_slice has no TPU lowering rule)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -301,9 +309,12 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     qh, kh, vh = _heads_major(q), _heads_major(k), _heads_major(v)
     doh = _heads_major(g)
     # delta_i = sum_j p_ij * dp_ij = rowsum(do * o): one fused elementwise
-    # reduce in XLA, shared by both kernels
+    # reduce in XLA, shared by both kernels.  lse/delta travel as
+    # (BH, 1, T) so every block shape's trailing dims stay Mosaic-legal.
     delta = (doh.astype(jnp.float32)
              * _heads_major(out).astype(jnp.float32)).sum(-1)  # (BH, T)
+    lse3 = lse.reshape(b * h, 1, t)
+    delta3 = delta.reshape(b * h, 1, t)
 
     mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
     row = dict(block_q=block_q, block_k=block_k, seq_len=t, causal=causal,
@@ -317,14 +328,14 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
             full(t), full(t),
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0), **mem),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i), **mem),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i), **mem),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0),
                                **mem),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
         interpret=interpret,
-    )(qh, kh, vh, doh, lse, delta)
+    )(qh, kh, vh, doh, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **row),
@@ -334,8 +345,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
             full(t),
-            pl.BlockSpec((1, t), lambda bh, j: (bh, 0), **mem),
-            pl.BlockSpec((1, t), lambda bh, j: (bh, 0), **mem),
+            pl.BlockSpec((1, 1, t), lambda bh, j: (bh, 0, 0), **mem),
+            pl.BlockSpec((1, 1, t), lambda bh, j: (bh, 0, 0), **mem),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0), **mem),
@@ -346,7 +357,7 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(qh, kh, vh, doh, lse, delta)
+    )(qh, kh, vh, doh, lse3, delta3)
     return (_heads_minor(dq, b, h), _heads_minor(dk, b, h),
             _heads_minor(dv, b, h))
 

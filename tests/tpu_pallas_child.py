@@ -36,25 +36,32 @@ def main() -> int:
 
     report = {"device_kind": jax.devices()[0].device_kind}
 
-    # forward, compiled through Mosaic (interpret=False)
-    out = jax.jit(
-        lambda q, k, v: flash_attention(q, k, v, True, 128, 128, False)
-    )(q, k, v)
-    ref = attention_reference(q, k, v, causal=True)
-    report["fwd_max_err"] = float(jnp.abs(out - ref).max())
+    # f32 checks run under matmul precision 'highest': at the TPU default
+    # the MXU truncates f32 operands to bf16 in BOTH the kernel and the
+    # reference, and the two round differently (~5e-3 apart) — pinning
+    # precision makes the comparison test kernel MATH, not MXU rounding
+    # (measured: max err drops 5e-3 -> 1e-6 on a v5e)
+    with jax.default_matmul_precision("highest"):
+        # forward, compiled through Mosaic (interpret=False)
+        out = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, 128, 128, False)
+        )(q, k, v)
+        ref = attention_reference(q, k, v, causal=True)
+        report["fwd_max_err"] = float(jnp.abs(out - ref).max())
 
-    # backward: both Mosaic bwd kernels, vs autodiff of the dense reference
-    def loss_flash(q, k, v):
-        return (flash_attention(q, k, v, True, 128, 128, False) ** 2).sum()
+        # backward: both Mosaic bwd kernels, vs autodiff of the dense
+        # reference
+        def loss_flash(q, k, v):
+            return (flash_attention(q, k, v, True, 128, 128, False) ** 2).sum()
 
-    def loss_ref(q, k, v):
-        return (attention_reference(q, k, v, causal=True) ** 2).sum()
+        def loss_ref(q, k, v):
+            return (attention_reference(q, k, v, causal=True) ** 2).sum()
 
-    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
-    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
-    for name, a, bb in zip(("dq", "dk", "dv"), g_flash, g_ref):
-        denom = float(jnp.abs(bb).max()) or 1.0
-        report[f"bwd_{name}_rel_err"] = float(jnp.abs(a - bb).max()) / denom
+        g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, bb in zip(("dq", "dk", "dv"), g_flash, g_ref):
+            denom = float(jnp.abs(bb).max()) or 1.0
+            report[f"bwd_{name}_rel_err"] = float(jnp.abs(a - bb).max()) / denom
 
     # bf16 forward (the bench path): loose check against f32 reference
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
