@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# 3D mesh: data x expert x tensor — GShard's expert + model parallelism.
+# Megatron attention (heads sharded over 'tensor') with MoE expert FFNs
+# sharded over BOTH 'expert' (whole experts, all_to_all slot exchange) and
+# 'tensor' (each expert's hidden dim, psum combine).  One-step parity with
+# the dense MoE model is pinned by
+# tests/test_moe.py::test_expert_tensor_parallel_matches_dense.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --dp 2 --ep 2 --tp 2 --moe_experts 4 \
+    --grad_clip 1.0

@@ -44,6 +44,15 @@ class MoEFFN(Module):
       mesh axis (leading expert dim), and apply() must run inside a
       ``shard_map`` that binds the axis; slots travel by all_to_all.
 
+    ``tensor_axis`` additionally Megatron-shards every expert's FFN over
+    that mesh axis: the local ``w_in``/``b_in`` hold a column slice
+    (E_local, d, f/tp) of the hidden units, ``w_out`` the matching row
+    slice (E_local, f/tp, d), and the row-parallel output is psum'd over
+    the axis before ``b_out`` (replicated) is added — GShard's
+    expert + model parallelism.  Activations entering apply() must be
+    replicated over ``tensor_axis`` (parallel.expert's EP x TP step wires
+    the f/g conjugate ops so the backward collective is explicit).
+
     ``capacity`` is the per-routing-group per-expert slot count; default
     ``ceil(capacity_factor * group_tokens / n_experts)``.
     """
@@ -55,6 +64,7 @@ class MoEFFN(Module):
     capacity: Optional[int] = None
     activation: str = "gelu"
     expert_axis: Optional[str] = None
+    tensor_axis: Optional[str] = None
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32
     # 1 = Switch top-1 (combine weight = the chosen expert's raw prob);
@@ -152,14 +162,26 @@ class MoEFFN(Module):
 
     def _experts_ffn(self, ep: Pytree, slots: jax.Array) -> jax.Array:
         """slots: (E_local, S, d) -> (E_local, S, d); one batched einsum
-        pair per layer — E_local independent matmuls tiled onto the MXU."""
+        pair per layer — E_local independent matmuls tiled onto the MXU.
+
+        With ``tensor_axis``, the local ``w_in``/``b_in``/``w_out`` hold
+        Megatron column/row shards (hidden dim f/tp) and the row-parallel
+        partial output is psum'd over the axis before the replicated
+        ``b_out``; the f operator at entry makes the backward psum of the
+        input-cotangents explicit (megatron.make_megatron_ops)."""
         cdt = self.compute_dtype
+        if self.tensor_axis is not None:
+            from ..parallel.megatron import make_megatron_ops
+
+            f, g = make_megatron_ops(self.tensor_axis)
+            slots = f(slots)
         h = jnp.einsum("esd,edf->esf", slots.astype(cdt),
                        ep["w_in"].astype(cdt)) + ep["b_in"][:, None, :].astype(cdt)
         h = ACTIVATIONS[self.activation](h)
-        out = jnp.einsum("esf,efd->esd", h,
-                         ep["w_out"].astype(cdt)) + ep["b_out"][:, None, :].astype(cdt)
-        return out
+        out = jnp.einsum("esf,efd->esd", h, ep["w_out"].astype(cdt))
+        if self.tensor_axis is not None:
+            out = g(out)
+        return out + ep["b_out"][:, None, :].astype(cdt)
 
     def apply(self, params: Pytree, x: jax.Array, **kwargs
               ) -> Tuple[jax.Array, jax.Array]:

@@ -67,6 +67,60 @@ def shard_moe_state(state: TrainState, mesh: Mesh,
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
 
+def _moe_accumulate(micro_grads, params, batch: Batch, accum_steps: int):
+    """Shared MoE microbatch accumulation: split the per-device batch rows
+    into ``accum_steps`` microbatches and scan ``micro_grads`` over them,
+    summing loss/count/grads in f32 and count-weighting the mean-style aux
+    so the final aux is the token-weighted mean.  Returns
+    ``(loss_sum, count, aux, grads)`` exactly like a single ``micro_grads``
+    call (ulp-level f32 reassociation aside)."""
+    if accum_steps <= 1:
+        return micro_grads(params, batch)
+    micro = {}
+    for k, v in batch.items():
+        rows = v.shape[0]
+        if rows % accum_steps:
+            raise ValueError(
+                f"per-device batch rows {rows} (leaf {k!r}) not "
+                f"divisible by accum_steps={accum_steps}")
+        micro[k] = v.reshape(
+            (accum_steps, rows // accum_steps) + v.shape[1:])
+
+    def body(carry, mb):
+        cs, cc, ca, cg = carry
+        s, c, aux, g = micro_grads(params, mb)
+        cg = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(jnp.float32), cg, g)
+        return (cs + s, cc + c, ca + aux * c, cg), None
+
+    zeros = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+            jnp.zeros((), jnp.float32), zeros)
+    (s, cnt, aux_w, grads), _ = lax.scan(body, init, micro)
+    return s, cnt, aux_w / jnp.maximum(cnt, 1.0), grads
+
+
+def _global_norm_clip(grads: Pytree, grad_clip: float, clip_axes):
+    """Clip ``grads`` by the GLOBAL norm on a sharded layout:
+    ``clip_axes(path)`` names the mesh axes a leaf's gradient is sharded
+    over — its squared norm is psum'd over exactly those axes (grouped so
+    each distinct axis set costs one psum) before the norms combine into
+    the one true global norm every device agrees on."""
+    partial_sq: Dict[Tuple[str, ...], jax.Array] = {}
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        axes = tuple(clip_axes(path))
+        term = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        partial_sq[axes] = partial_sq.get(
+            axes, jnp.zeros((), jnp.float32)) + term
+    gsq = jnp.zeros((), jnp.float32)
+    for axes, sq in partial_sq.items():
+        gsq = gsq + (lax.psum(sq, axes) if axes else sq)
+    scale = jnp.minimum(1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
 def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                         loss_name: str = "cross_entropy",
                         aux_weight: float = 0.01,
@@ -116,34 +170,8 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         return s, cnt, aux, grads
 
     def shard_step(state: TrainState, batch: Batch):
-        if accum_steps > 1:
-            micro = {}
-            for k, v in batch.items():
-                rows = v.shape[0]
-                if rows % accum_steps:
-                    raise ValueError(
-                        f"per-device batch rows {rows} (leaf {k!r}) not "
-                        f"divisible by accum_steps={accum_steps}")
-                micro[k] = v.reshape(
-                    (accum_steps, rows // accum_steps) + v.shape[1:])
-
-            def body(carry, mb):
-                cs, cc, ca, cg = carry
-                s, c, aux, g = micro_grads(state.params, mb)
-                cg = jax.tree_util.tree_map(
-                    lambda a, b: a + b.astype(jnp.float32), cg, g)
-                # aux is mean-style: accumulate count-weighted so the
-                # final aux metric is the token-weighted mean
-                return (cs + s, cc + c, ca + aux * c, cg), None
-
-            zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-                    jnp.zeros((), jnp.float32), zeros)
-            (s, cnt, aux_w, grads), _ = lax.scan(body, init, micro)
-            aux = aux_w / jnp.maximum(cnt, 1.0)
-        else:
-            s, cnt, aux, grads = micro_grads(state.params, batch)
+        s, cnt, aux, grads = _moe_accumulate(micro_grads, state.params,
+                                             batch, accum_steps)
         total = lax.psum(cnt, TOKEN_AXES)
         grads = jax.tree_util.tree_map_with_path(
             lambda path, g: lax.psum(
@@ -152,20 +180,9 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
                    "aux": lax.pmean(aux, TOKEN_AXES)}
         if grad_clip > 0:
-            sq_sharded = jnp.zeros((), jnp.float32)
-            sq_rep = jnp.zeros((), jnp.float32)
-            for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
-                term = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                if _is_expert_path(path):
-                    sq_sharded = sq_sharded + term
-                else:
-                    sq_rep = sq_rep + term
-            gsq = sq_rep + lax.psum(sq_sharded, EXPERT_AXIS)
-            scale = jnp.minimum(
-                1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
-            grads = jax.tree_util.tree_map(
-                lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
-                grads)
+            grads = _global_norm_clip(
+                grads, grad_clip,
+                lambda path: (EXPERT_AXIS,) if _is_expert_path(path) else ())
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), metrics
@@ -206,6 +223,270 @@ def make_moe_eval_step(model: Transformer, mesh: Mesh,
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     pspecs = moe_param_specs(dummy)
+    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    mapped = jax.shard_map(
+        shard_eval, mesh=mesh,
+        in_specs=(pspecs, batch_specs),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+# ---------------------------------------------------------------------------
+# DP x EP x TP: Megatron attention + tensor-sharded experts (GShard's
+# expert + model parallelism) in one shard_map
+# ---------------------------------------------------------------------------
+
+TENSOR_AXIS = "tensor"
+
+
+def moe_tp_param_specs(params: Pytree) -> Pytree:
+    """shard_map PartitionSpecs for the transformer-with-MoE param tree on a
+    data x expert x tensor mesh:
+
+    * expert FFN weights: sharded over 'expert' (leading E dim) AND
+      Megatron-sharded over 'tensor' on the hidden dim f — ``w_in``
+      (E, d, f) column-parallel, ``b_in`` (E, f) with it, ``w_out``
+      (E, f, d) row-parallel; ``b_out`` (E, d) expert-sharded only (it adds
+      after the row-parallel psum).
+    * attention qkv/attn_out: the Megatron column/row layout
+      (megatron.is_tensor_sharded), replicated over 'expert'.
+    * gate, layernorms, embed/pos/ln_f/head: fully replicated.
+    """
+    from . import megatron
+
+    def spec(path, leaf):
+        names = megatron.path_names(path)
+        if _is_expert_path(path):
+            leaf_name = names[-1]
+            if leaf_name == "w_in":
+                return P(EXPERT_AXIS, None, TENSOR_AXIS)
+            if leaf_name == "b_in":
+                return P(EXPERT_AXIS, TENSOR_AXIS)
+            if leaf_name == "w_out":
+                return P(EXPERT_AXIS, TENSOR_AXIS, None)
+            if leaf_name == "b_out":
+                return P(EXPERT_AXIS)
+            raise ValueError(f"unexpected expert leaf {names}")
+        if megatron.is_tensor_sharded(names):
+            col = "qkv" in names or "ff_in" in names
+            ndim = len(jnp.shape(leaf))
+            if names[-1] == "w" and ndim == 2:
+                return (P(None, TENSOR_AXIS) if col
+                        else P(TENSOR_AXIS, None))
+            if names[-1] == "b" and ndim == 1:
+                return P(TENSOR_AXIS)
+            raise ValueError(f"unexpected tensor-sharded leaf {names}")
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def moe_tp_state_specs(optimizer: Optimizer, params: Pytree) -> TrainState:
+    pspecs = moe_tp_param_specs(params)
+    if optimizer.state_specs is None:
+        raise ValueError(f"{optimizer.name} lacks state_specs")
+    return TrainState(step=P(), params=pspecs,
+                      opt_state=optimizer.state_specs(pspecs))
+
+
+def init_moe_tp_state(model: Transformer, optimizer: Optimizer,
+                      key: jax.Array, tp: int) -> TrainState:
+    """Dense init + the head-aligned qkv column permutation (same
+    convention as the pipeline and sp_tp layouts; inverse restores the
+    dense column order for checkpoints)."""
+    from . import megatron
+
+    params = model.init(key)
+    if tp > 1:
+        c = model.cfg
+        params = dict(params)
+        params["blocks"] = megatron.permute_qkv(params["blocks"], c.d_model,
+                                                c.n_heads, tp)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=optimizer.init(params))
+
+
+def shard_moe_tp_state(state: TrainState, mesh: Mesh,
+                       optimizer: Optimizer) -> TrainState:
+    specs = moe_tp_state_specs(optimizer, state.params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
+
+
+def _validate_moe_tp(model: Transformer, mesh: Mesh):
+    from . import megatron
+
+    c = model.cfg
+    ep = int(mesh.shape.get(EXPERT_AXIS, 1))
+    tp = int(mesh.shape.get(TENSOR_AXIS, 1))
+    if ep < 2 or tp < 2:
+        raise ValueError(f"EP x TP needs expert>1 and tensor>1; got "
+                         f"expert={ep}, tensor={tp} — use the plain "
+                         "expert/gspmd paths otherwise")
+    if c.moe_experts <= 0:
+        raise ValueError("EP x TP requires a transformer with moe_experts "
+                         "> 0 (--moe_experts)")
+    if c.moe_experts % ep:
+        raise ValueError(f"{c.moe_experts} experts not divisible over "
+                         f"expert axis of size {ep}")
+    megatron.validate_tp(c, tp)
+    if c.attention != "dense":
+        raise ValueError("the EP x TP step runs Megatron attention over the "
+                         f"full local sequence; attention={c.attention!r} "
+                         "is not wired here")
+    if c.scan_layers:
+        raise ValueError("scan_layers is a plain-DP/SP layout; the EP x TP "
+                         "step owns its own per-layer loop")
+    return ep, tp
+
+
+def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
+                    tp: int):
+    """Local EP x TP forward inside shard_map: replicated embed, Megatron
+    blocks (heads over 'tensor') whose FFN is the expert+tensor-sharded
+    MoEFFN (slots over 'expert' by all_to_all, hidden dim over 'tensor'),
+    replicated LN + head.  Reuses Transformer.embed/head_logits so the
+    composed path cannot drift from the dense model."""
+    from ..models.moe import MoEFFN
+    from . import megatron
+
+    c = model.cfg
+    ffn = MoEFFN(
+        c.d_model, c.d_ff, c.moe_experts,
+        capacity_factor=c.moe_capacity_factor, capacity=c.moe_capacity,
+        activation=c.activation, expert_axis=EXPERT_AXIS,
+        tensor_axis=TENSOR_AXIS, router_top_k=c.moe_top_k,
+        param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
+
+    def ffn_fn(layer_params, h):
+        return ffn.apply(layer_params["moe"], h)
+
+    b, t = ids.shape
+    x = model.embed(params, ids, jnp.arange(t))
+
+    def block_fn(layer_params, h):
+        return megatron.tp_block_apply(c, layer_params, h, tp, ffn_fn=ffn_fn)
+
+    if c.remat:
+        block_fn = jax.checkpoint(block_fn)
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer_params in params["blocks"]:
+        x, aux = block_fn(layer_params, x)
+        aux_total = aux_total + aux
+    return model.head_logits(params, x), aux_total
+
+
+def _moe_tp_reduce_axes(path) -> Tuple[str, ...]:
+    """Gradient psum axes per leaf.  Token (batch) rows ride data x expert;
+    'tensor' NEVER appears: tensor-sharded leaves own their shard's grads
+    locally and tensor-replicated leaves get identical grads on every
+    tensor rank (the f/g conjugate ops guarantee it — megatron/moe)."""
+    return DATA_AXES if _is_expert_path(path) else TOKEN_AXES
+
+
+def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
+                           mesh: Mesh, loss_name: str = "cross_entropy",
+                           aux_weight: float = 0.01,
+                           donate: bool = True,
+                           batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
+                           grad_clip: float = 0.0,
+                           accum_steps: int = 1):
+    """(state, batch) -> (state, metrics) jitted over data x expert x tensor
+    — GShard's expert + model parallelism, TPU-native: Megatron-sharded
+    attention (heads over 'tensor'), expert FFNs sharded over BOTH 'expert'
+    (whole experts, all_to_all slot exchange) and 'tensor' (each expert's
+    hidden dim, psum combine).  The reference has neither strategy
+    (SURVEY.md §2.2); one-step parity vs the single-device dense-MoE model
+    is pinned by tests/test_moe.py::test_expert_tensor_parallel_matches_dense
+    and the Trainer wiring by tests/test_trainer_pp_ep.py.
+
+    ``grad_clip`` clips by the global norm with per-leaf shard accounting:
+    expert+tensor-sharded leaves psum their squared norms over
+    ('expert','tensor'), expert-only leaves over ('expert',), tensor-only
+    leaves over ('tensor',); replicated leaves carry full grads.
+    """
+    from . import megatron
+
+    ep, tp = _validate_moe_tp(model, mesh)
+    base = losses_lib.get(loss_name)
+
+    def local_fwd(params, batch):
+        logits, aux = _moe_tp_forward(model, params, batch["x"], tp)
+        s, cnt = base(logits, batch["y"], batch.get("mask"))
+        return s, (cnt, aux)
+
+    def micro_grads(params, batch):
+        def scalar(p):
+            s, (cnt, aux) = local_fwd(p, batch)
+            return s + aux_weight * aux * cnt, (s, cnt, aux)
+
+        (_, (s, cnt, aux)), grads = jax.value_and_grad(
+            scalar, has_aux=True)(params)
+        return s, cnt, aux, grads
+
+    def clip_axes(path) -> Tuple[str, ...]:
+        names = megatron.path_names(path)
+        if _is_expert_path(path):
+            if names[-1] == "b_out":
+                return (EXPERT_AXIS,)
+            return (EXPERT_AXIS, TENSOR_AXIS)
+        if megatron.is_tensor_sharded(names):
+            return (TENSOR_AXIS,)
+        return ()
+
+    def shard_step(state: TrainState, batch: Batch):
+        s, cnt, aux, grads = _moe_accumulate(micro_grads, state.params,
+                                             batch, accum_steps)
+        total = lax.psum(cnt, TOKEN_AXES)
+        grads = jax.tree_util.tree_map_with_path(
+            lambda path, g: lax.psum(g, _moe_tp_reduce_axes(path)) / total,
+            grads)
+        metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
+                   "aux": lax.pmean(aux, TOKEN_AXES)}
+        if grad_clip > 0:
+            grads = _global_norm_clip(grads, grad_clip, clip_axes)
+        new_params, new_opt = optimizer.update(grads, state.opt_state,
+                                               state.params)
+        return TrainState(state.step + 1, new_params, new_opt), metrics
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    state_specs = moe_tp_state_specs(optimizer, dummy)
+    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(state_specs, batch_specs),
+        out_specs=(state_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_moe_tp_eval_step(model: Transformer, mesh: Mesh,
+                          loss_name: str = "cross_entropy",
+                          with_accuracy: bool = True,
+                          batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+    """Jitted global-mean eval on the EP x TP layout, params consumed in
+    place: (params, batch) -> metrics."""
+    base = losses_lib.get(loss_name)
+    tp = int(mesh.shape.get(TENSOR_AXIS, 1))
+
+    def shard_eval(params, batch):
+        logits, _aux = _moe_tp_forward(model, params, batch["x"], tp)
+        s, c = base(logits, batch["y"], batch.get("mask"))
+        total = lax.psum(c, TOKEN_AXES)
+        out = {"loss": lax.psum(s, TOKEN_AXES) / total, "count": total}
+        if with_accuracy:
+            hs, hc = losses_lib.accuracy(logits, batch["y"],
+                                         batch.get("mask"))
+            ex_total = lax.psum(hc, TOKEN_AXES)
+            out["accuracy"] = lax.psum(hs, TOKEN_AXES) / ex_total
+            out["example_count"] = ex_total
+        return out
+
+    dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
+    pspecs = moe_tp_param_specs(dummy)
     batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,

@@ -113,7 +113,8 @@ def validate_tp(cfg, tp: int) -> None:
 
 
 def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
-                   axis: str = TENSOR_AXIS, attention_fn=None) -> jax.Array:
+                   axis: str = TENSOR_AXIS, attention_fn=None,
+                   ffn_fn=None):
     """One transformer block with the tensor dimension sharded over ``axis``
     (call inside shard_map; ``layer_params`` are the LOCAL shards — qkv and
     ff_in hold output-columns for this rank's heads/hidden units, attn_out
@@ -128,7 +129,13 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     the block runs Megatron-sharded matmuls with ring attention over the
     sequence shards (heads split over 'tensor', sequence over 'seq').
     Default: dense attention over the full local sequence.
-    """
+
+    ``ffn_fn(layer_params, h) -> (ff, aux)`` replaces the dense
+    column/row-parallel FFN — the TP x EP composition point: pass a
+    tensor+expert-sharded ``models.moe.MoEFFN.apply`` closure and the block
+    becomes a GShard expert layer with Megatron attention.  When set, the
+    block returns ``(x, aux)`` instead of ``x`` (the FFN owns its own f/g
+    placement; ``h`` is handed over tensor-replicated)."""
     f, g = make_megatron_ops(axis)
     cdt = cfg.compute_dtype
     heads_local = cfg.n_heads // tp
@@ -153,6 +160,9 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
 
     # --- FFN: column-parallel in, row-parallel out ---
     h = ln.apply(layer_params["ln2"], x)
+    if ffn_fn is not None:
+        ff, aux = ffn_fn(layer_params, h)
+        return x + ff.astype(x.dtype), aux
     h = f(h)
     hh = (h.astype(cdt) @ layer_params["ff_in"]["w"].astype(cdt)
           + layer_params["ff_in"]["b"].astype(cdt))

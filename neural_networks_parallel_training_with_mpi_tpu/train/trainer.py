@@ -46,10 +46,15 @@ class Trainer:
         #   expert               -> parallel.expert shard_map (all_to_all)
         #   seq x tensor        -> parallel.spmd sp_tp shard_map (Megatron
         #                          matmuls + ring/ulysses attention)
+        #   expert x tensor     -> parallel.expert moe_tp shard_map (Megatron
+        #                          attention + tensor-sharded experts)
         fsdp_on = self.mesh.shape.get("fsdp", 1) > 1
         self.sp_tp = (self.seq_parallel and self.tensor
                       and not (self.pipeline or self.expert or fsdp_on))
-        self.gspmd = (not self.pipeline and not self.sp_tp
+        self.ep_tp = (self.expert and self.tensor
+                      and not (self.pipeline or self.seq_parallel
+                               or fsdp_on))
+        self.gspmd = (not self.pipeline and not self.sp_tp and not self.ep_tp
                       and (self.tensor or fsdp_on))
         unwired = [name for name, on in
                    (("seq", self.seq_parallel),
@@ -62,13 +67,13 @@ class Trainer:
         exclusive = [name for name, on in
                      (("seq", self.seq_parallel and not self.sp_tp),
                       ("tensor/fsdp", self.gspmd),
-                      ("expert", self.expert)) if on]
+                      ("expert", self.expert and not self.ep_tp)) if on]
         if len(exclusive) > 1:
             raise NotImplementedError(
                 f"wired combinations: one of seq/tensor/fsdp/expert alone, "
-                f"pipe x tensor, or seq x tensor (all x data); got "
-                f"{exclusive} — compose parallel.* step builders directly "
-                "for other mixes")
+                f"pipe x tensor, seq x tensor, or expert x tensor (all x "
+                f"data); got {exclusive} — compose parallel.* step builders "
+                "directly for other mixes")
         if self.pipeline and cfg.model.arch != "transformer":
             raise ValueError("pipe axis > 1 requires the transformer model")
         if self.expert and (cfg.model.arch != "transformer"
@@ -79,6 +84,9 @@ class Trainer:
                 and cfg.grad_reduction != "global_mean"):
             raise ValueError("pipeline/expert/seq-x-tensor steps always use "
                              "global_mean gradient semantics")
+        if self.ep_tp and cfg.model.attention != "dense":
+            raise ValueError("expert x tensor runs Megatron attention over "
+                             "the full local sequence; use attention=dense")
         if (cfg.model.arch == "transformer"
                 and cfg.model.attention in ("ring", "ulysses")
                 and not self.seq_parallel):
@@ -179,6 +187,21 @@ class Trainer:
             # concept — folding accum_steps in here would only add padding
             # waste on small validation batches
             self.eval_step = pp.make_pipeline_eval_step(
+                self.model, self.mesh, loss_name=cfg.loss,
+                with_accuracy=(cfg.loss == "cross_entropy"))
+        elif self.ep_tp:
+            from ..parallel import expert as ep_lib
+
+            moe_step = ep_lib.make_moe_tp_train_step(
+                self.model, self.optimizer, self.mesh, loss_name=train_loss,
+                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
+
+            def train_step(state, batch):
+                state, metrics = moe_step(state, batch)
+                return state, metrics["loss"]
+
+            self.train_step = train_step
+            self.eval_step = ep_lib.make_moe_tp_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
         elif self.expert:
@@ -283,6 +306,15 @@ class Trainer:
             self.state = spmd.shard_sp_tp_state(state, self.mesh,
                                                 self.optimizer)
             return self.state
+        if self.ep_tp:
+            from ..parallel import expert as ep_lib
+
+            state = ep_lib.init_moe_tp_state(
+                self.model, self.optimizer, prng.init_key(self.cfg.seed),
+                int(self.mesh.shape["tensor"]))
+            self.state = ep_lib.shard_moe_tp_state(state, self.mesh,
+                                                   self.optimizer)
+            return self.state
         state = TrainState.create(self.model, self.optimizer,
                                   prng.init_key(self.cfg.seed))
         if self.expert:
@@ -321,6 +353,11 @@ class Trainer:
 
             self.state = spmd.shard_sp_tp_state(restored, self.mesh,
                                                 self.optimizer)
+        elif self.ep_tp:
+            from ..parallel import expert as ep_lib
+
+            self.state = ep_lib.shard_moe_tp_state(restored, self.mesh,
+                                                   self.optimizer)
         elif self.expert:
             from ..parallel import expert as ep_lib
 
@@ -354,7 +391,7 @@ class Trainer:
         — NOT the current tp, which would silently treat a dense checkpoint
         as already permuted when resuming INTO a TP layout."""
         tp = (int(self.mesh.shape.get("tensor", 1))
-              if (self.pipeline or self.sp_tp) else 1)
+              if (self.pipeline or self.sp_tp or self.ep_tp) else 1)
         meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
         saved_tp = int(meta.get("qkv_tp", 1))
         if saved_tp == tp:
@@ -396,7 +433,8 @@ class Trainer:
             # TP qkv permutation so maybe_resume can reconcile a different
             # tensor-axis size; dense layouts record 1 explicitly
             extra = {"qkv_tp": (int(self.mesh.shape.get("tensor", 1))
-                                if (self.pipeline or self.sp_tp) else 1)}
+                                if (self.pipeline or self.sp_tp
+                                    or self.ep_tp) else 1)}
             if self.cfg.async_checkpoint and not final:
                 ckpt.save_async(self.cfg.checkpoint_dir, self.state,
                                 extra_meta=extra)
@@ -529,7 +567,7 @@ class Trainer:
         checkpoint interop and tests, NOT by :meth:`evaluate` (every eval
         step consumes the train state's own layout in place, so this
         single-host gather is off the eval path entirely)."""
-        if self.sp_tp:
+        if self.sp_tp or self.ep_tp:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ..parallel import megatron

@@ -94,3 +94,13 @@ def test_cli_generate_from_checkpoint(tmp_path):
     toks = [int(t) for t in gen.stdout.strip().splitlines()[-1].split(",")]
     assert toks[:3] == [10, 20, 30] and len(toks) == 11
     assert all(0 <= t < 256 for t in toks)
+
+
+def test_example_10_expert_tensor_completes():
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "10_expert_tensor.sh")],
+        capture_output=True, text=True, timeout=240, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stderr + out.stdout

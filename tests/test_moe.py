@@ -203,3 +203,86 @@ def test_router_top_k_validated():
         MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=0)
     with pytest.raises(ValueError, match="router_top_k"):
         MoEFFN(d_model=8, d_ff=16, n_experts=4, router_top_k=8)
+
+
+# ---- EP x TP (tensor-sharded experts + Megatron attention) ---------------
+
+
+def test_expert_tensor_parallel_matches_dense():
+    """One DP x EP x TP train step == single-device dense-MoE step:
+    Megatron-sharded attention (heads over 'tensor') + experts sharded over
+    BOTH 'expert' (all_to_all) and 'tensor' (hidden-dim psum).  Generous
+    capacity so nothing drops; aux_weight=0 (per-shard aux means differ
+    from the global mean by design, as in the plain EP parity test)."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows = 8
+    capacity = rows * T  # no drops on any shard grouping
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=2, expert=2, tensor=2), devices=devs)
+    model = moe_model(expert_axis="expert", capacity=capacity)
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows)
+
+    state = ep.init_moe_tp_state(model, opt, prng.init_key(0), tp=2)
+    state = ep.shard_moe_tp_state(state, mesh, opt)
+    placed = {k: jax.device_put(jnp.asarray(v),
+                                NamedSharding(mesh, P(ep.TOKEN_AXES)))
+              for k, v in batch.items()}
+    step = ep.make_moe_tp_train_step(model, opt, mesh, aux_weight=0.0,
+                                     donate=False)
+    state, metrics = step(state, placed)
+
+    # single-device dense reference (same init, unpermuted layout)
+    model_dense = moe_model(expert_axis=None, capacity=capacity)
+    params = model_dense.init(prng.init_key(0))
+
+    def scalar(p):
+        logits = model_dense.apply(p, jnp.asarray(batch["x"]))
+        s, c = losses.softmax_cross_entropy(
+            logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+        return s / c, s / c
+
+    (loss_ref, _), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    ref_params, _ = opt.update(grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=1e-5, atol=1e-6)
+    got = dict(jax.device_get(state.params))
+    got["blocks"] = megatron.permute_qkv(got["blocks"], 32, 4, 2,
+                                         inverse=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+        got, jax.device_get(ref_params))
+
+
+def test_ep_tp_grad_clip_and_accum_run():
+    """EP x TP with global-norm clip + accumulation executes and trains."""
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=2, expert=2, tensor=2), devices=devs)
+    model = moe_model(expert_axis="expert")
+    opt = optim.adam(lr=3e-3)
+    batch = lm_batch(rows=16)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    state = ep.init_moe_tp_state(model, opt, prng.init_key(0), tp=2)
+    state = ep.shard_moe_tp_state(state, mesh, opt)
+    placed = {k: jax.device_put(jnp.asarray(v),
+                                NamedSharding(mesh, P(ep.TOKEN_AXES)))
+              for k, v in batch.items()}
+    step = ep.make_moe_tp_train_step(model, opt, mesh, aux_weight=0.01,
+                                     donate=False, grad_clip=1.0,
+                                     accum_steps=2)
+    state, first = step(state, placed)
+    for _ in range(10):
+        state, metrics = step(state, placed)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert np.isfinite(float(metrics["aux"]))

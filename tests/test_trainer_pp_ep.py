@@ -150,3 +150,29 @@ def test_bfloat16_training_runs():
 
     leaf = jax.tree_util.tree_leaves(t.state.params)[0]
     assert leaf.dtype == jnp.float32
+
+
+def test_trainer_expert_tensor_end_to_end():
+    """EP x TP through the Trainer: Megatron attention + tensor-sharded
+    experts on a data x expert x tensor mesh, eval + dense-layout export."""
+    cfg = _lm_cfg(data=2, expert=2, tensor=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    moe_expert_axis="expert")
+    t = Trainer(cfg)
+    assert t.ep_tp and t.expert and not t.gspmd
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+    # _eval_params undoes the qkv head-alignment permutation: same shapes
+    # and treedef as a dense init
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    dense = t.model.init(prng.init_key(cfg.seed))
+    got = jax.device_get(t._eval_params())
+    assert (jax.tree_util.tree_structure(got)
+            == jax.tree_util.tree_structure(dense))
+    for a, b in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(dense)):
+        assert a.shape == b.shape
