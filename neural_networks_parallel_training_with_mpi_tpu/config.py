@@ -320,9 +320,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--vocab_size", type=int, default=256)
     p.add_argument("--attention",
-                   choices=["dense", "flash", "ring", "ulysses"], default=None,
+                   choices=["dense", "flash", "ring", "ring_flash",
+                            "ulysses"], default=None,
                    help="attention impl (default: dense; ring when --sp > 1; "
-                        "flash = blocked pallas kernel)")
+                        "flash = blocked pallas kernel; ring_flash = ring "
+                        "with the pallas kernel per block)")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
@@ -439,11 +441,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         # sequence parallelism needs a seq-sharded attention impl
         cfg.model.attention = "ring"
     if args.attention:
-        if args.sp > 1 and args.attention not in ("ring", "ulysses"):
+        if args.sp > 1 and args.attention not in ("ring", "ring_flash",
+                                                  "ulysses"):
             raise SystemExit(
                 f"--attention {args.attention} cannot shard the sequence "
-                "axis; --sp > 1 needs ring or ulysses")
-        if args.sp <= 1 and args.attention in ("ring", "ulysses"):
+                "axis; --sp > 1 needs ring, ring_flash, or ulysses")
+        if args.sp <= 1 and args.attention in ("ring", "ring_flash",
+                                               "ulysses"):
             raise SystemExit(
                 f"--attention {args.attention} needs a sequence-sharded "
                 "mesh; pass --sp > 1 (or use dense/flash)")

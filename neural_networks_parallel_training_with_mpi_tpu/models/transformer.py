@@ -193,7 +193,7 @@ class Transformer(Module):
         """
         c = self.cfg
         b, t = ids.shape
-        if c.attention in ("ring", "ulysses"):  # seq-sharded: global offset
+        if c.attention in ("ring", "ring_flash", "ulysses"):  # seq-sharded: global offset
             offset = jax.lax.axis_index(c.seq_axis) * t
         else:  # dense/flash see the full sequence locally
             offset = jnp.zeros((), jnp.int32)

@@ -27,7 +27,7 @@ the same math in plain JAX as the cross-check for tests.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -300,7 +300,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
-                    block_k: int, interpret: Optional[bool]):
+                    block_k: int, interpret: Optional[bool],
+                    g_lse: Optional[jax.Array] = None):
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     block_q, block_k = _resolve_blocks(t, block_q, block_k)
@@ -311,8 +312,15 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     # delta_i = sum_j p_ij * dp_ij = rowsum(do * o): one fused elementwise
     # reduce in XLA, shared by both kernels.  lse/delta travel as
     # (BH, 1, T) so every block shape's trailing dims stay Mosaic-legal.
+    #
+    # A cotangent on the lse OUTPUT (flash_attention_with_lse) folds into
+    # the same kernels: d lse_i / d s_ij = p_ij, so
+    # ds_ij = p_ij * (dp_ij - delta_i + g_lse_i) — i.e. shift delta by
+    # -g_lse and nothing else changes (dv is lse-independent).
     delta = (doh.astype(jnp.float32)
              * _heads_major(out).astype(jnp.float32)).sum(-1)  # (BH, T)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     lse3 = lse.reshape(b * h, 1, t)
     delta3 = delta.reshape(b * h, 1, t)
 
@@ -385,6 +393,36 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = True, block_q: int = 128,
+                             block_k: int = 128,
+                             interpret: Optional[bool] = None
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """Like :func:`flash_attention` but also returns the per-row logsumexp
+    ``lse`` (B*H, T) float32 — the building block for blockwise/ring
+    composition (parallel.sequence.ring_flash_attention): partial outputs
+    from different K/V blocks merge exactly via their lse weights.  Both
+    outputs are differentiable; the lse cotangent rides the same Mosaic
+    backward kernels as a ``delta`` shift (see _flash_backward)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _fal_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _fal_bwd(causal, block_q, block_k, interpret, res, ct):
+    q, k, v, out, lse = res
+    g_out, g_lse = ct
+    return _flash_backward(q, k, v, out, lse, g_out, causal, block_q,
+                           block_k, interpret, g_lse=g_lse)
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
 
 
 # ==========================================================================

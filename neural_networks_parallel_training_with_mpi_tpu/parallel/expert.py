@@ -469,8 +469,8 @@ def make_moe_tp_eval_step(model: Transformer, mesh: Mesh,
                           batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
     """Jitted global-mean eval on the EP x TP layout, params consumed in
     place: (params, batch) -> metrics."""
+    _, tp = _validate_moe_tp(model, mesh)
     base = losses_lib.get(loss_name)
-    tp = int(mesh.shape.get(TENSOR_AXIS, 1))
 
     def shard_eval(params, batch):
         logits, _aux = _moe_tp_forward(model, params, batch["x"], tp)

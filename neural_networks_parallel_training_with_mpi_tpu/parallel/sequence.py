@@ -140,9 +140,82 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return to_seq(out)
 
 
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis: str = "seq", causal: bool = True,
+                         scale: Optional[float] = None,
+                         block_q: int = 128, block_k: int = 128,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Ring attention whose LOCAL block compute is the Pallas flash kernel
+    (ops.pallas_kernels) — blockwise ring attention with the hot loop on
+    the MXU instead of plain einsums.
+
+    Each ring step classifies the resident K/V block against this device's
+    Q shard (causal case): strictly-past blocks run the kernel unmasked,
+    the diagonal block runs it causally, strictly-future blocks are
+    skipped outright (zero output, -inf lse) — so unlike
+    :func:`ring_attention`, future blocks cost no FLOPs at all.  Partial
+    (out, lse) pairs merge exactly by logsumexp weighting; the merge is
+    plain JAX, so autodiff drives the kernel's custom backward
+    (flash_attention_with_lse) per block.
+
+    ``scale`` must be None/default: the kernel pins 1/sqrt(Dh).
+    """
+    b, t_local, h, d = q.shape
+    if scale is not None and abs(scale - d ** -0.5) > 1e-12:
+        raise ValueError("ring_flash_attention supports the default "
+                         "1/sqrt(head_dim) scale only")
+    from ..ops.pallas_kernels import flash_attention_with_lse
+
+    s = lax.axis_size(axis)
+    my_idx = lax.axis_index(axis)
+
+    def full_block(k_blk, v_blk):
+        return flash_attention_with_lse(q, k_blk, v_blk, False, block_q,
+                                        block_k, interpret)
+
+    def diag_block(k_blk, v_blk):
+        return flash_attention_with_lse(q, k_blk, v_blk, True, block_q,
+                                        block_k, interpret)
+
+    def skip_block(k_blk, v_blk):
+        return (jnp.zeros_like(q),
+                jnp.full((b * h, t_local), NEG_INF, jnp.float32))
+
+    def step(carry, step_idx):
+        o, lse, k_blk, v_blk = carry
+        blk_idx = (my_idx + step_idx) % s
+        if causal:
+            case = jnp.where(blk_idx == my_idx, 1,
+                             jnp.where(blk_idx < my_idx, 0, 2))
+            out_b, lse_b = lax.switch(case,
+                                      (full_block, diag_block, skip_block),
+                                      k_blk, v_blk)
+        else:
+            out_b, lse_b = full_block(k_blk, v_blk)
+        new_lse = jnp.logaddexp(lse, lse_b)                 # (B*H, T)
+        w_old = jnp.exp(lse - new_lse)
+        w_new = jnp.exp(lse_b - new_lse)
+
+        def rowscale(x, w):  # (B,T,H,D) * (B*H,T) -> row-weighted
+            return x * w.reshape(b, h, t_local).transpose(0, 2, 1)[..., None]
+
+        new_o = rowscale(o, w_old) + rowscale(out_b.astype(jnp.float32),
+                                              w_new)
+        perm = [(i, (i - 1) % s) for i in range(s)]
+        k_next = lax.ppermute(k_blk, axis, perm)
+        v_next = lax.ppermute(v_blk, axis, perm)
+        return (new_o, new_lse, k_next, v_next), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b * h, t_local), NEG_INF, jnp.float32)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(s))
+    return o.astype(q.dtype)
+
+
 ATTENTION_IMPLS = {
     "dense": attention_reference,
     "ring": ring_attention,
+    "ring_flash": ring_flash_attention,
     "ulysses": ulysses_attention,
 }
 
@@ -158,6 +231,9 @@ def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
         return flash_attention(q, k, v, causal)
     if impl == "ring":
         return ring_attention(q, k, v, axis=axis, causal=causal, scale=scale)
+    if impl == "ring_flash":
+        return ring_flash_attention(q, k, v, axis=axis, causal=causal,
+                                    scale=scale)
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis=axis, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -201,12 +201,19 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
     LN + head.  Reuses Transformer.embed/head_logits so the composed path
     cannot drift from the dense model."""
     from . import megatron
-    from .sequence import ring_attention, ulysses_attention
+    from .sequence import (
+        ring_attention,
+        ring_flash_attention,
+        ulysses_attention,
+    )
 
     c = model.cfg
     if attention_impl == "ring":
         attn = lambda q, k, v: ring_attention(q, k, v, axis=seq_axis,
                                               causal=True)
+    elif attention_impl == "ring_flash":
+        attn = lambda q, k, v: ring_flash_attention(q, k, v, axis=seq_axis,
+                                                    causal=True)
     elif attention_impl == "ulysses":
         attn = lambda q, k, v: ulysses_attention(q, k, v, axis=seq_axis,
                                                  causal=True)

@@ -84,11 +84,11 @@ class Trainer:
                 and cfg.grad_reduction != "global_mean"):
             raise ValueError("pipeline/expert/seq-x-tensor steps always use "
                              "global_mean gradient semantics")
-        if self.ep_tp and cfg.model.attention != "dense":
-            raise ValueError("expert x tensor runs Megatron attention over "
-                             "the full local sequence; use attention=dense")
+        # (expert x tensor's attention/divisibility invariants live in
+        # parallel.expert._validate_moe_tp — the single consult point,
+        # called by both step builders)
         if (cfg.model.arch == "transformer"
-                and cfg.model.attention in ("ring", "ulysses")
+                and cfg.model.attention in ("ring", "ring_flash", "ulysses")
                 and not self.seq_parallel):
             raise ValueError(
                 f"attention={cfg.model.attention!r} needs the 'seq' mesh "

@@ -6,6 +6,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from neural_networks_parallel_training_with_mpi_tpu.ops import (
+    pallas_kernels as pk,
+)
 from neural_networks_parallel_training_with_mpi_tpu.ops.pallas_kernels import (
     flash_attention, fused_layernorm,
 )
@@ -112,3 +115,50 @@ def test_pallas_backward_matches_blocked_reference_vjp():
     for name, a, b in zip(("dq", "dk", "dv"), got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-4, err_msg=name)
+
+
+def test_flash_attention_with_lse_value_and_grads():
+    """(out, lse) variant: both outputs and BOTH cotangent paths (the lse
+    cotangent rides the Mosaic backward as a delta shift) must match a
+    plain-JAX attention-with-lse reference."""
+    import jax.scipy.special as jsp
+
+    def ref_with_lse(q, k, v, causal):
+        d = q.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * d**-0.5
+        if causal:
+            t = q.shape[1]
+            mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+            s = jnp.where(mask[None, None], s, -1e30)
+        lse = jsp.logsumexp(s, axis=-1)                     # (B, H, T)
+        p = jnp.exp(s - lse[..., None])
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        b, t, h, _ = q.shape
+        return out, lse.reshape(b * h, t)
+
+    rng = np.random.default_rng(0)
+    b, t, h, d = 2, 16, 2, 8
+    mk = lambda: jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    for causal in (True, False):
+        o1, l1 = pk.flash_attention_with_lse(q, k, v, causal, 16, 16, True)
+        o2, l2 = ref_with_lse(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-5, atol=1e-5)
+
+        # nonlinear functions of BOTH outputs exercise g_out and g_lse
+        def loss(fn):
+            def f(q, k, v):
+                o, l = fn(q, k, v)
+                return (o ** 2).sum() + jnp.sin(l).sum()
+            return f
+
+        g1 = jax.grad(loss(lambda q, k, v: pk.flash_attention_with_lse(
+            q, k, v, causal, 16, 16, True)), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss(lambda q, k, v: ref_with_lse(q, k, v, causal)),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, bb in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-5, atol=2e-5)
