@@ -184,6 +184,10 @@ class TrainConfig:
     # axes (reduce-scatter grads, update 1/N slice, all-gather params) —
     # cross-replica weight-update sharding; pure-DP shard_map path only
     update_sharding: str = "replicated"  # replicated | zero1
+    # Megatron vocab parallelism on the seq x tensor path: embedding table
+    # and LM head sharded on the vocab dim, cross-entropy computed over the
+    # sharded logits (never materialized full) — parallel.megatron
+    vocab_parallel: bool = False
     seed: int = 0
     log_every: int = 1
     shuffle: bool = True
@@ -285,6 +289,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    default="replicated",
                    help="zero1 = shard optimizer state + weight update "
                         "across the data axes (reduce-scatter/all-gather)")
+    p.add_argument("--vocab_parallel", action="store_true",
+                   help="shard the embedding table + LM head on the vocab "
+                        "dim with sharded-softmax cross-entropy (seq x "
+                        "tensor meshes: --sp > 1 and --tp > 1)")
     p.add_argument("--dataset",
                    choices=["regression", "wide_regression", "digits",
                             "mnist", "cifar10", "lm"],
@@ -393,6 +401,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         loss=args.loss, label_smoothing=args.label_smoothing,
         grad_reduction=args.grad_reduction,
         update_sharding=args.update_sharding,
+        vocab_parallel=args.vocab_parallel,
         seed=args.seed,
         shuffle=args.shuffle,
         checkpoint_dir=args.checkpoint_dir,

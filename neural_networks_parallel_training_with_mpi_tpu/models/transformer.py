@@ -142,6 +142,19 @@ class Transformer(Module):
             aux = jnp.zeros((), jnp.float32)
         return x + ff.astype(x.dtype), aux
 
+    def add_pos(self, params, x_tokens: jax.Array,
+                positions: jax.Array) -> jax.Array:
+        """Positional embedding + compute-dtype cast on an already-looked-up
+        token embedding — the non-vocab half of :meth:`embed`, shared with
+        the vocab-parallel path (parallel.spmd) where the token lookup is
+        table-sharded but THIS part must stay identical to the dense
+        model."""
+        c = self.cfg
+        x = x_tokens + Embedding(c.max_seq_len, c.d_model,
+                                 c.param_dtype).apply(params["pos"],
+                                                      positions)
+        return x.astype(c.compute_dtype)
+
     def embed(self, params, ids: jax.Array, positions: jax.Array) -> jax.Array:
         """Token + positional embedding -> (B, T, D) in compute dtype.
         Single definition shared by the training forward and the KV-cache
@@ -149,16 +162,21 @@ class Transformer(Module):
         c = self.cfg
         x = Embedding(c.vocab_size, c.d_model, c.param_dtype).apply(
             params["embed"], ids)
-        x = x + Embedding(c.max_seq_len, c.d_model, c.param_dtype).apply(
-            params["pos"], positions)
-        return x.astype(c.compute_dtype)
+        return self.add_pos(params, x, positions)
+
+    def final_norm(self, params, x: jax.Array) -> jax.Array:
+        """The pre-head LayerNorm — the non-vocab half of
+        :meth:`head_logits`, shared with the vocab-parallel head (same
+        drift argument as :meth:`add_pos`)."""
+        c = self.cfg
+        return LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(
+            params["ln_f"], x)
 
     def head_logits(self, params, x: jax.Array) -> jax.Array:
         """Final LayerNorm + untied head -> f32 logits (shared with
         models.generate, same drift argument as :meth:`embed`)."""
         c = self.cfg
-        x = LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(
-            params["ln_f"], x)
+        x = self.final_norm(params, x)
         logits = Linear(c.d_model, c.vocab_size, use_bias=False,
                         param_dtype=c.param_dtype,
                         compute_dtype=c.compute_dtype).apply(params["head"], x)

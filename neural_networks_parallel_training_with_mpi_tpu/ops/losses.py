@@ -59,6 +59,16 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
         nll = logz - (1.0 - s) * gold - s * logits.mean(axis=-1)
     else:
         nll = logz - gold  # (B,) or (B, T)
+    return reduce_token_nll(nll, mask)
+
+
+def reduce_token_nll(nll: jax.Array, mask: Optional[jax.Array]
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Token-level (sum, count) reduction of a per-token loss ``nll``
+    ((B,) or (B, T, ...)) with a per-example mask broadcast over the token
+    dims — the tail of :func:`softmax_cross_entropy`, shared with the
+    vocab-parallel sharded cross-entropy (parallel.megatron) so the two
+    cannot disagree on mask semantics."""
     if nll.ndim > 1:
         if mask is not None:
             mask = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (nll.ndim - 1)),

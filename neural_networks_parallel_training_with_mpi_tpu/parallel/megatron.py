@@ -172,6 +172,98 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     return x + ff.astype(x.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Vocab parallelism: embedding table + LM head sharded on the vocab dim
+# ---------------------------------------------------------------------------
+
+def vocab_parallel_embed(table_local: jax.Array, ids: jax.Array,
+                         axis: str = TENSOR_AXIS) -> jax.Array:
+    """Embedding lookup with the (V, D) table row-sharded over ``axis``
+    (local shard (V/tp, D), contiguous blocks in rank order).  Each rank
+    contributes rows it owns (zeros elsewhere); one psum assembles the
+    full lookup.  The psum is the g operator (psum forward, identity
+    backward) — as everywhere in this module, the backward collective is
+    explicit rather than left to lax.psum's transpose under shard_map,
+    which over-counts by the axis size with check_vma=False.  The
+    identity-backward cotangent then scatters into the owning shard's
+    rows — the Megatron vocab-parallel embedding."""
+    _, g = make_megatron_ops(axis)
+    v_local = table_local.shape[0]
+    offset = lax.axis_index(axis) * v_local
+    local = ids - offset
+    in_shard = (local >= 0) & (local < v_local)
+    rows = jnp.take(table_local, jnp.clip(local, 0, v_local - 1), axis=0)
+    rows = jnp.where(in_shard[..., None], rows, 0.0)
+    return g(rows)
+
+
+def vocab_parallel_logits(x: jax.Array, head_w_local: jax.Array,
+                          axis: str = TENSOR_AXIS,
+                          compute_dtype=None) -> jax.Array:
+    """(..., D) @ (D, V/tp) -> LOCAL logits shard (..., V/tp), f32.  The f
+    operator makes the backward psum of x's partial cotangents explicit —
+    the full (..., V) logits are never materialized on one device."""
+    f, _ = make_megatron_ops(axis)
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        head_w_local = head_w_local.astype(compute_dtype)
+    return (f(x) @ head_w_local).astype(jnp.float32)
+
+
+def vocab_parallel_cross_entropy(logits_local: jax.Array, targets: jax.Array,
+                                 mask: jax.Array = None,
+                                 axis: str = TENSOR_AXIS):
+    """Softmax cross-entropy over vocab-sharded logits WITHOUT gathering
+    them: stable max via pmax (stop-gradient — softmax is shift-invariant),
+    denominator and target-logit each one psum over ``axis``.  Same
+    (loss_sum, count) contract as ops.losses.softmax_cross_entropy; the
+    sum/count are tensor-replicated so downstream global-mean reductions
+    need no 'tensor' axis, matching the Megatron invariant."""
+    _, g = make_megatron_ops(axis)
+    v_local = logits_local.shape[-1]
+    offset = lax.axis_index(axis) * v_local
+    m = lax.pmax(jax.lax.stop_gradient(logits_local).max(-1), axis)  # (...,)
+    e = jnp.exp(logits_local - m[..., None])
+    denom = g(e.sum(-1))
+    local_t = targets - offset
+    in_shard = (local_t >= 0) & (local_t < v_local)
+    idx = jnp.clip(local_t, 0, v_local - 1)
+    tgt_local = jnp.take_along_axis(logits_local, idx[..., None],
+                                    axis=-1)[..., 0]
+    tgt = g(jnp.where(in_shard, tgt_local, 0.0))
+    nll = m + jnp.log(denom) - tgt                                   # (...,)
+    from ..ops.losses import reduce_token_nll
+
+    return reduce_token_nll(nll, mask)
+
+
+def vocab_parallel_accuracy(logits_local: jax.Array, targets: jax.Array,
+                            mask: jax.Array = None,
+                            axis: str = TENSOR_AXIS):
+    """argmax over the sharded vocab: global max via pmax, then the
+    smallest global index attaining it via pmin (deterministic
+    tie-breaking, matching jnp.argmax's first-occurrence rule).  Same
+    EXAMPLE-level (correct_sum, count) contract as ops.losses.accuracy
+    (per-example mean over token dims, count = examples).  A metric, not a
+    loss: gradients are stopped at entry (pmax/pmin carry no
+    differentiation rule, and argmax has no useful one)."""
+    from ..ops.losses import _masked
+
+    logits_local = jax.lax.stop_gradient(logits_local)
+    v_local = logits_local.shape[-1]
+    offset = lax.axis_index(axis) * v_local
+    local_max = logits_local.max(-1)
+    global_max = lax.pmax(local_max, axis)
+    local_arg = jnp.argmax(logits_local, axis=-1) + offset
+    big = jnp.iinfo(jnp.int32).max
+    cand = jnp.where(local_max >= global_max, local_arg.astype(jnp.int32),
+                     big)
+    pred = lax.pmin(cand, axis)
+    hit = (pred == targets).astype(jnp.float32)
+    hit = hit.reshape(hit.shape[0], -1).mean(axis=-1)
+    return _masked(hit, mask)
+
+
 def path_names(path) -> Tuple[str, ...]:
     """Key path -> tuple of string names (dict keys / sequence indices)."""
     return tuple(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)

@@ -140,12 +140,17 @@ def run_one_step(model, optimizer: Optimizer, mesh: Mesh, state: TrainState,
 # DP x SP x TP: Megatron tensor sharding + ring attention in one shard_map
 # ---------------------------------------------------------------------------
 
-def sp_tp_param_specs(params: Pytree) -> Pytree:
+def sp_tp_param_specs(params: Pytree, vocab_parallel: bool = False) -> Pytree:
     """shard_map PartitionSpecs for a dense (per-layer) transformer param
     tree with the block matmuls Megatron-sharded over 'tensor' (column
     layers split the output dim, row layers the input dim — single source
     of truth for WHICH leaves: megatron.is_tensor_sharded) and
-    embed/pos/ln_f/head replicated."""
+    embed/pos/ln_f/head replicated.
+
+    ``vocab_parallel`` additionally row-shards the embedding table and
+    column-shards the LM head on the vocab dim (megatron.vocab_parallel_*),
+    so neither vocab-sized table nor the full (B, T, V) logits ever lives
+    replicated on a tensor rank."""
     from . import megatron
 
     def block_spec(path, leaf):
@@ -160,11 +165,16 @@ def sp_tp_param_specs(params: Pytree) -> Pytree:
             return P("tensor")
         raise ValueError(f"unexpected tensor-sharded leaf {names}")
 
-    return {
-        k: (jax.tree_util.tree_map_with_path(block_spec, v) if k == "blocks"
-            else jax.tree_util.tree_map(lambda _: P(), v))
-        for k, v in params.items()
-    }
+    def top_spec(k, v):
+        if k == "blocks":
+            return jax.tree_util.tree_map_with_path(block_spec, v)
+        if vocab_parallel and k == "embed":
+            return {"table": P("tensor", None)}
+        if vocab_parallel and k == "head":
+            return {"w": P(None, "tensor")}
+        return jax.tree_util.tree_map(lambda _: P(), v)
+
+    return {k: top_spec(k, v) for k, v in params.items()}
 
 
 def init_sp_tp_state(model, optimizer: Optimizer, key, tp: int) -> TrainState:
@@ -183,9 +193,9 @@ def init_sp_tp_state(model, optimizer: Optimizer, key, tp: int) -> TrainState:
                       opt_state=optimizer.init(params))
 
 
-def shard_sp_tp_state(state: TrainState, mesh: Mesh,
-                      optimizer: Optimizer) -> TrainState:
-    pspecs = sp_tp_param_specs(state.params)
+def shard_sp_tp_state(state: TrainState, mesh: Mesh, optimizer: Optimizer,
+                      vocab_parallel: bool = False) -> TrainState:
+    pspecs = sp_tp_param_specs(state.params, vocab_parallel)
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
     specs = TrainState(step=P(), params=pspecs,
@@ -194,12 +204,30 @@ def shard_sp_tp_state(state: TrainState, mesh: Mesh,
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
 
+def _validate_vocab_parallel(model, tp: int, loss_name: str):
+    if model.cfg.vocab_size % tp:
+        raise ValueError(f"vocab_size={model.cfg.vocab_size} not divisible "
+                         f"by tensor axis size {tp}")
+    if loss_name != "cross_entropy":
+        raise ValueError(
+            "vocab_parallel computes softmax cross-entropy over the "
+            f"sharded logits; got loss {loss_name!r} (label smoothing is "
+            "not wired on the sharded loss)")
+
+
 def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
-                   attention_impl: str):
+                   attention_impl: str, vocab_parallel: bool = False):
     """Shared SP x TP local forward: embed with the shard's global position
     offset, Megatron blocks with sequence-sharded attention, replicated
     LN + head.  Reuses Transformer.embed/head_logits so the composed path
-    cannot drift from the dense model."""
+    cannot drift from the dense model.
+
+    With ``vocab_parallel`` the embedding lookup rides
+    megatron.vocab_parallel_embed (table row-sharded on vocab, one psum)
+    and the return value is the LOCAL logits shard (B, T_local, V/tp) from
+    megatron.vocab_parallel_logits — pair it with
+    vocab_parallel_cross_entropy/accuracy; the full logits are never
+    materialized."""
     from . import megatron
     from .sequence import (
         ring_attention,
@@ -222,7 +250,15 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
                          f"got {attention_impl!r}")
     b, t = ids.shape
     offset = lax.axis_index(seq_axis) * t
-    x = model.embed(params, ids, offset + jnp.arange(t))
+    positions = offset + jnp.arange(t)
+    if vocab_parallel:
+        # only the token-table lookup is sharded; the pos add + dtype cast
+        # stay the model's own (Transformer.add_pos) so they cannot drift
+        x = model.add_pos(
+            params, megatron.vocab_parallel_embed(params["embed"]["table"],
+                                                  ids), positions)
+    else:
+        x = model.embed(params, ids, positions)
 
     def block_fn(layer_params, h):
         return megatron.tp_block_apply(c, layer_params, h, tp,
@@ -232,6 +268,12 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
         block_fn = jax.checkpoint(block_fn)
     for layer_params in params["blocks"]:
         x = block_fn(layer_params, x)
+    if vocab_parallel:
+        # only the head matmul is sharded; the pre-head LayerNorm is the
+        # model's own (Transformer.final_norm)
+        return megatron.vocab_parallel_logits(
+            model.final_norm(params, x), params["head"]["w"],
+            compute_dtype=c.compute_dtype)
     return model.head_logits(params, x)
 
 
@@ -242,7 +284,8 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
                           donate: bool = True,
                           example_batch: Optional[Batch] = None,
                           accum_steps: int = 1,
-                          grad_clip: float = 0.0):
+                          grad_clip: float = 0.0,
+                          vocab_parallel: bool = False):
     """(state, batch) -> (state, loss) over a data x seq x tensor mesh:
     Megatron column/row-sharded block matmuls (heads over 'tensor') with
     ring/ulysses attention (sequence over 'seq') in ONE shard_map program —
@@ -276,16 +319,27 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
         raise ValueError(
             f"ulysses under TP redistributes the {model.cfg.n_heads // tp} "
             f"local heads over {seq_axis}={sp}: not divisible")
-    base = losses_lib.get(loss_name)
     reduce_axes = DATA_AXES + (seq_axis,)
 
-    def loss_sum(params, batch):
-        logits = _sp_tp_forward(model, params, batch["x"], tp, seq_axis,
-                                attention_impl)
-        return base(logits, batch["y"], batch.get("mask"))
+    if vocab_parallel:
+        _validate_vocab_parallel(model, tp, loss_name)
+
+        def loss_sum(params, batch):
+            logits_local = _sp_tp_forward(model, params, batch["x"], tp,
+                                          seq_axis, attention_impl,
+                                          vocab_parallel=True)
+            return megatron.vocab_parallel_cross_entropy(
+                logits_local, batch["y"], batch.get("mask"))
+    else:
+        base = losses_lib.get(loss_name)
+
+        def loss_sum(params, batch):
+            logits = _sp_tp_forward(model, params, batch["x"], tp, seq_axis,
+                                    attention_impl)
+            return base(logits, batch["y"], batch.get("mask"))
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
-    pspecs = sp_tp_param_specs(dummy)
+    pspecs = sp_tp_param_specs(dummy, vocab_parallel)
 
     # which leaves hold only a tensor shard of their gradient (their
     # squared norms need a psum over 'tensor' before the global clip norm;
@@ -336,26 +390,41 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
 def make_sp_tp_eval_step(model, mesh: Mesh, loss_name: str = "cross_entropy",
                          with_accuracy: bool = False, seq_axis: str = "seq",
                          attention_impl: str = "ring",
-                         example_batch: Optional[Batch] = None):
+                         example_batch: Optional[Batch] = None,
+                         vocab_parallel: bool = False):
     """(sp-tp-sharded params, batch) -> metrics; same contract as
     data_parallel.make_eval_step, params consumed in place.
     ``example_batch`` fixes the shard_map in_specs pytree (key set + leaf
     ranks), like every other step builder here."""
     if example_batch is None:
         raise ValueError("example_batch required to derive per-leaf specs")
-    base = losses_lib.get(loss_name)
+    from . import megatron
+
     tp = int(mesh.shape.get("tensor", 1))
     reduce_axes = DATA_AXES + (seq_axis,)
+    if vocab_parallel:
+        _validate_vocab_parallel(model, tp, loss_name)
+    else:
+        base = losses_lib.get(loss_name)
 
     def shard_eval(params, batch):
         logits = _sp_tp_forward(model, params, batch["x"], tp, seq_axis,
-                                attention_impl)
-        s, c = base(logits, batch["y"], batch.get("mask"))
+                                attention_impl,
+                                vocab_parallel=vocab_parallel)
+        if vocab_parallel:
+            s, c = megatron.vocab_parallel_cross_entropy(
+                logits, batch["y"], batch.get("mask"))
+        else:
+            s, c = base(logits, batch["y"], batch.get("mask"))
         total = lax.psum(c, reduce_axes)
         out = {"loss": lax.psum(s, reduce_axes) / total, "count": total}
         if with_accuracy:
-            hs, hc = losses_lib.accuracy(logits, batch["y"],
-                                         batch.get("mask"))
+            if vocab_parallel:
+                hs, hc = megatron.vocab_parallel_accuracy(
+                    logits, batch["y"], batch.get("mask"))
+            else:
+                hs, hc = losses_lib.accuracy(logits, batch["y"],
+                                             batch.get("mask"))
             ex_total = lax.psum(hc, DATA_AXES)
             acc = lax.psum(hs, DATA_AXES) / ex_total
             out["accuracy"] = lax.pmean(acc, seq_axis)
@@ -363,7 +432,7 @@ def make_sp_tp_eval_step(model, mesh: Mesh, loss_name: str = "cross_entropy",
         return out
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
-    pspecs = sp_tp_param_specs(dummy)
+    pspecs = sp_tp_param_specs(dummy, vocab_parallel)
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,
         in_specs=(pspecs, batch_specs(example_batch, seq_axis)),

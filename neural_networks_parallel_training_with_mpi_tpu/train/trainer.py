@@ -87,6 +87,11 @@ class Trainer:
         # (expert x tensor's attention/divisibility invariants live in
         # parallel.expert._validate_moe_tp — the single consult point,
         # called by both step builders)
+        if cfg.vocab_parallel and not self.sp_tp:
+            raise ValueError(
+                "--vocab_parallel shards the embedding/head over 'tensor' "
+                "on the seq x tensor path (--sp > 1 and --tp > 1); other "
+                "layouts keep them replicated")
         if (cfg.model.arch == "transformer"
                 and cfg.model.attention in ("ring", "ring_flash", "ulysses")
                 and not self.seq_parallel):
@@ -227,12 +232,14 @@ class Trainer:
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 seq_axis="seq", attention_impl=cfg.model.attention,
                 example_batch=example, accum_steps=cfg.accum_steps,
-                grad_clip=cfg.grad_clip)
+                grad_clip=cfg.grad_clip,
+                vocab_parallel=cfg.vocab_parallel)
             self.eval_step = spmd.make_sp_tp_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
                 seq_axis="seq", attention_impl=cfg.model.attention,
-                example_batch=example)
+                example_batch=example,
+                vocab_parallel=cfg.vocab_parallel)
         elif self.seq_parallel:
             from ..parallel import spmd
 
@@ -303,8 +310,9 @@ class Trainer:
             state = spmd.init_sp_tp_state(
                 self.model, self.optimizer, prng.init_key(self.cfg.seed),
                 int(self.mesh.shape["tensor"]))
-            self.state = spmd.shard_sp_tp_state(state, self.mesh,
-                                                self.optimizer)
+            self.state = spmd.shard_sp_tp_state(
+                state, self.mesh, self.optimizer,
+                vocab_parallel=self.cfg.vocab_parallel)
             return self.state
         if self.ep_tp:
             from ..parallel import expert as ep_lib
@@ -351,8 +359,9 @@ class Trainer:
         elif self.sp_tp:
             from ..parallel import spmd
 
-            self.state = spmd.shard_sp_tp_state(restored, self.mesh,
-                                                self.optimizer)
+            self.state = spmd.shard_sp_tp_state(
+                restored, self.mesh, self.optimizer,
+                vocab_parallel=self.cfg.vocab_parallel)
         elif self.ep_tp:
             from ..parallel import expert as ep_lib
 

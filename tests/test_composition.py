@@ -331,3 +331,47 @@ def test_dense_checkpoint_resumes_into_tp_layout(tmp_path):
     _assert_params_close(_dense_params(t_tp), want, rtol=0, atol=0)
     r = t_tp.fit()
     assert np.isfinite(r["final_loss"])
+
+
+class TestVocabParallel:
+    def test_sp_tp_vocab_parallel_matches_dense_head(self):
+        """Same seq x tensor job with and without --vocab_parallel: the
+        sharded-softmax loss and the trained weights must match (identical
+        math, different collective placement)."""
+        cfg = _lm_cfg(data=2, seq=2, tensor=2)
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        t_rep = Trainer(cfg)
+        r_rep = t_rep.fit()
+
+        cfg_vp = _lm_cfg(data=2, seq=2, tensor=2)
+        cfg_vp.model = dataclasses.replace(cfg_vp.model, attention="ring")
+        cfg_vp.vocab_parallel = True
+        t_vp = Trainer(cfg_vp)
+        assert t_vp.sp_tp
+        r_vp = t_vp.fit()
+        assert np.isfinite(r_vp["final_loss"])
+        assert r_vp["final_loss"] == pytest.approx(r_rep["final_loss"],
+                                                   rel=2e-4)
+        _assert_params_close(_dense_params(t_vp), _dense_params(t_rep),
+                             atol=LOOSE_ATOL)
+        # the live state really is vocab-sharded
+        emb = t_vp.state.params["embed"]["table"]
+        assert emb.addressable_shards[0].data.shape[0] * 2 == emb.shape[0]
+        head = t_vp.state.params["head"]["w"]
+        assert head.addressable_shards[0].data.shape[1] * 2 == head.shape[1]
+
+    def test_vocab_parallel_eval_and_accuracy(self):
+        cfg = _lm_cfg(data=2, seq=2, tensor=2)
+        cfg.model = dataclasses.replace(cfg.model, attention="ring")
+        cfg.vocab_parallel = True
+        cfg.data = dataclasses.replace(cfg.data, val_fraction=0.25)
+        cfg.eval_every = 1
+        r = Trainer(cfg).fit()
+        assert np.isfinite(r["val_loss"])
+        assert 0.0 <= r["val_accuracy"] <= 1.0
+
+    def test_vocab_parallel_requires_sp_tp(self):
+        cfg = _reg_cfg()
+        cfg.vocab_parallel = True
+        with pytest.raises(ValueError, match="vocab_parallel"):
+            Trainer(cfg)
