@@ -132,6 +132,11 @@ class ModelConfig:
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
+    # what jax.checkpoint may SAVE under --remat (models.core.make_remat):
+    #   full          save nothing, recompute everything (max HBM saving)
+    #   dots          save matmul outputs (skip recomputing MXU work)
+    #   dots_no_batch save only batch-free matmul outputs (weights-side)
+    remat_policy: str = "full"
     # transformer: lax.scan over stacked blocks — compile time stops
     # growing with n_layers (plain DP/SP paths; pipeline/TP own their
     # stacking)
@@ -157,7 +162,7 @@ class TrainConfig:
     batch_size: int = 4        # honored (reference parses but ignores it — bug B1)
     nepochs: int = 3
     full_batch: bool = True    # reference behavior: one full-shard batch per epoch (:146)
-    optimizer: str = "sgd"     # sgd | adam | adamw | lion
+    optimizer: str = "sgd"     # sgd | adam | adamw | lion | adafactor
     weight_decay: float = 0.0
     # lr schedule over optimizer steps (ops.schedules); "constant" = the
     # reference's fixed lr.  total_steps is derived from nepochs x
@@ -256,7 +261,8 @@ def build_argparser() -> argparse.ArgumentParser:
     # ignoring an explicit --batch_size
     _add_bool_flag(p, "full-batch", None,
                    "one full-dataset batch per epoch (reference behavior)")
-    p.add_argument("--optimizer", choices=["sgd", "adam", "adamw", "lion"],
+    p.add_argument("--optimizer",
+                   choices=["sgd", "adam", "adamw", "lion", "adafactor"],
                    default="sgd")
     p.add_argument("--weight_decay", type=float, default=0.0)
     p.add_argument("--lr_schedule", choices=["constant", "cosine", "linear"],
@@ -320,6 +326,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="matmul/activation dtype (default: same as --dtype)")
     _add_bool_flag(p, "remat", False,
                    "rematerialize transformer blocks (jax.checkpoint)")
+    p.add_argument("--remat_policy",
+                   choices=["full", "dots", "dots_no_batch"],
+                   default="full",
+                   help="what --remat may save: full = recompute all, "
+                        "dots = keep matmul outputs, dots_no_batch = keep "
+                        "batch-free matmul outputs")
     # transformer size knobs (BASELINE.json config #5 sweeps)
     p.add_argument("--n_layers", type=int, default=2)
     p.add_argument("--d_model", type=int, default=128)
@@ -424,7 +436,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
                             dtype=args.dtype,
                             compute_dtype=args.compute_dtype or args.dtype,
-                            remat=args.remat, scan_layers=args.scan_layers,
+                            remat=args.remat,
+                            remat_policy=args.remat_policy,
+                            scan_layers=args.scan_layers,
                             n_layers=args.n_layers, d_model=args.d_model,
                             n_heads=args.n_heads, d_ff=args.d_ff,
                             vocab_size=args.vocab_size,

@@ -227,3 +227,28 @@ class AvgPool2D(Module):
         return jax.lax.reduce_window(
             x, 0.0, jax.lax.add, (1, self.window, self.window, 1),
             (1, s, s, 1), "VALID") / float(self.window * self.window)
+
+_REMAT_POLICIES = {
+    # what jax.checkpoint may SAVE between forward and backward:
+    "full": None,  # nothing — recompute the whole block (max HBM saving)
+    "dots": "dots_saveable",  # keep matmul outputs (skip re-running the MXU)
+    "dots_no_batch": "dots_with_no_batch_dims_saveable",
+}
+
+
+def make_remat(policy: str = "full"):
+    """``jax.checkpoint`` bound to a named save policy (config
+    ``--remat_policy``) — the HBM <-> recompute-FLOPs dial every
+    block-remat site shares, so the policy vocabulary cannot drift
+    between the DP/SP, SP x TP, EP x TP and pipeline paths."""
+    import jax
+
+    try:
+        name = _REMAT_POLICIES[policy]
+    except KeyError:
+        raise ValueError(f"unknown remat policy {policy!r}; have "
+                         f"{sorted(_REMAT_POLICIES)}") from None
+    if name is None:
+        return jax.checkpoint
+    pol = getattr(jax.checkpoint_policies, name)
+    return lambda fn: jax.checkpoint(fn, policy=pol)

@@ -31,6 +31,7 @@ def build_model(cfg: ModelConfig) -> Module:
             n_layers=cfg.n_layers, d_model=cfg.d_model, n_heads=cfg.n_heads,
             d_ff=cfg.d_ff, attention=cfg.attention, param_dtype=pdt,
             compute_dtype=cdt, remat=cfg.remat,
+            remat_policy=cfg.remat_policy,
             moe_experts=cfg.moe_experts,
             moe_expert_axis=cfg.moe_expert_axis,
             moe_capacity_factor=cfg.moe_capacity_factor,

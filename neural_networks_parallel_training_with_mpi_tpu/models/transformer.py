@@ -37,6 +37,7 @@ class TransformerConfig:
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
     remat: bool = False                # jax.checkpoint each block (HBM <-> FLOPs)
+    remat_policy: str = "full"         # full | dots | dots_no_batch (models.core.make_remat)
     # lax.scan over a stacked block pytree (leaves (n_layers, ...)) instead
     # of a Python loop: XLA traces/compiles ONE block body regardless of
     # depth, so compile time and program size stop growing with n_layers —
@@ -218,7 +219,9 @@ class Transformer(Module):
         x = self.embed(params, ids, offset + jnp.arange(t))
         block_fn = self._block
         if c.remat:
-            block_fn = jax.checkpoint(block_fn, static_argnums=())
+            from .core import make_remat
+
+            block_fn = make_remat(c.remat_policy)(block_fn)
         aux_total = jnp.zeros((), jnp.float32)
         if c.scan_layers:
             def body(carry, layer_params):

@@ -87,6 +87,16 @@ def accuracy(logits: jax.Array, labels: jax.Array,
     """Correct-prediction (sum, count) — an eval metric, realizing the intent
     of the reference's dead validation code (:213-236)."""
     hit = (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+    return reduce_example_hits(hit, mask)
+
+
+def reduce_example_hits(hit: jax.Array, mask: Optional[jax.Array]
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Example-level (sum, count) reduction of a per-token hit tensor
+    ((B,) or (B, T, ...)): per-example mean over token dims, then the
+    per-example mask — the tail of :func:`accuracy`, shared with the
+    vocab-parallel sharded accuracy (parallel.megatron) so the two cannot
+    disagree on reduction semantics."""
     hit = hit.reshape(hit.shape[0], -1).mean(axis=-1)
     return _masked(hit, mask)
 

@@ -61,8 +61,12 @@ class Optimizer:
     name: str = "optimizer"
     # maps a param PartitionSpec tree -> an opt-state PartitionSpec tree;
     # per-param slots (momentum, mu, nu) inherit the param's sharding so
-    # TP/FSDP shard optimizer state exactly like the params they mirror
-    state_specs: Optional[Callable[[Pytree], Pytree]] = None
+    # TP/FSDP shard optimizer state exactly like the params they mirror.
+    # Signature: state_specs(pspecs, params=None) — optimizers whose state
+    # layout depends on leaf SHAPES (adafactor's factored slots) need the
+    # param tree; mirror-layout optimizers ignore it, and callers that
+    # cannot supply one (the zero1 flat-buffer path) pass None
+    state_specs: Optional[Callable[..., Pytree]] = None
 
 
 class SGDState(NamedTuple):
@@ -96,7 +100,7 @@ def sgd(lr: LR, momentum: float = 0.0, weight_decay: float = 0.0) -> Optimizer:
             params, step)
         return new_params, SGDState(state.count + 1, buf)
 
-    def state_specs(ps):
+    def state_specs(ps, params=None):
         from jax.sharding import PartitionSpec
 
         return SGDState(PartitionSpec(), ps)
@@ -140,7 +144,7 @@ def adam(lr: LR, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
         new_params = jax.tree_util.tree_map(step, params, mu_hat, nu_hat)
         return new_params, AdamState(count, mu, nu)
 
-    def state_specs(ps):
+    def state_specs(ps, params=None):
         from jax.sharding import PartitionSpec
 
         return AdamState(PartitionSpec(), ps, ps)
@@ -191,12 +195,145 @@ def lion(lr: LR, b1: float = 0.9, b2: float = 0.99,
             state.momentum, grads)
         return new_params, LionState(state.count + 1, new_m)
 
-    def state_specs(ps):
+    def state_specs(ps, params=None):
         from jax.sharding import PartitionSpec
 
         return LionState(PartitionSpec(), ps)
 
     return Optimizer(init, update, f"lion(lr={lr})",
+                     state_specs=state_specs)
+
+
+class AdafactorState(NamedTuple):
+    count: jax.Array
+    vr: Pytree  # row factor (shape p.shape[:-1]) for ndim>=2 leaves, else ()
+    vc: Pytree  # col factor (shape p.shape[:-2] + (p.shape[-1],)), else ()
+    v: Pytree   # full second moment for ndim<2 leaves, else () placeholder
+    mu: Pytree  # momentum (b1 > 0) mirroring params, else () placeholder
+
+
+def adafactor(lr: LR, b1: float = 0.0, decay_pow: float = 0.8,
+              eps1: float = 1e-30, eps2: float = 1e-3,
+              clip_threshold: float = 1.0, weight_decay: float = 0.0,
+              multiply_by_parameter_scale: bool = True) -> Optimizer:
+    """Adafactor (Shazeer & Stern 2018) — the TPU-era memory-factored
+    optimizer: for matrix-shaped leaves the second moment is stored as a
+    rank-1 outer product of row/column exponential averages (O(n+m) state
+    instead of O(nm); leading dims of >2-D leaves, e.g. stacked experts or
+    conv kernels, are treated as batch).  Increasing decay
+    ``b2_t = 1 - t^-decay_pow`` (no bias correction needed), update-RMS
+    clipping at ``clip_threshold``, and optional parameter-scale-relative
+    steps (``max(eps2, RMS(p)) * lr``).  ``b1 > 0`` adds a full first
+    moment applied to the scaled update, as in the paper's momentum
+    variant.
+
+    Sharding: factored stats are means over the factored (last two) dims,
+    so they are exact under GSPMD global-view layouts and under shard_map
+    layouts that shard only LEADING dims (DP replication, the expert
+    axis); the explicit TP layouts that slice inside matrices
+    (pipeline / seq x tensor / expert x tensor) would make the factor
+    means shard-local — the Trainer rejects those combinations."""
+
+    def _factored(p) -> bool:
+        return jnp.ndim(p) >= 2
+
+    def init(params: Pytree) -> AdafactorState:
+        z = lambda: jnp.zeros((), jnp.float32)
+        tm = jax.tree_util.tree_map
+        return AdafactorState(
+            jnp.zeros((), jnp.int32),
+            tm(lambda p: jnp.zeros(p.shape[:-1], jnp.float32)
+               if _factored(p) else z(), params),
+            tm(lambda p: jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)
+               if _factored(p) else z(), params),
+            tm(lambda p: z() if _factored(p)
+               else jnp.zeros(p.shape, jnp.float32), params),
+            tm(lambda p: jnp.zeros(p.shape, jnp.float32) if b1 else z(),
+               params),
+        )
+
+    def update(grads: Pytree, state: AdafactorState, params: Pytree):
+        lr_t = _lr_at(lr, state.count)
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        b2t = 1.0 - t ** (-decay_pow)
+
+        def one(p, g, r, c, v, m):
+            g32 = g.astype(jnp.float32)
+            g2 = g32 * g32 + eps1
+            if _factored(p):
+                r_new = b2t * r + (1 - b2t) * g2.mean(-1)
+                c_new = b2t * c + (1 - b2t) * g2.mean(-2)
+                # V ~ (R x C) / mean(R): the paper's minimal-KL rank-1
+                # reconstruction (mean over rows == mean over cols == the
+                # full mean, so either normalizer works)
+                vhat = (r_new[..., :, None] * c_new[..., None, :]
+                        / jnp.maximum(r_new.mean(-1, keepdims=True),
+                                      eps1)[..., None])
+                v_new = v
+            else:
+                v_new = b2t * v + (1 - b2t) * g2
+                vhat = v_new
+                r_new, c_new = r, c
+            # clamp: for never-updated rows (unused vocab/position entries)
+            # the rank-1 product r*c ~ eps1 * c underflows f32 subnormals
+            # and flushes to zero -> 0/0 NaN; the floor keeps u = 0 there
+            u = g32 / jnp.sqrt(jnp.maximum(vhat, eps1))
+            rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms_u / clip_threshold)
+            if multiply_by_parameter_scale:
+                scale = jnp.maximum(
+                    eps2, jnp.sqrt(jnp.mean(jnp.square(
+                        p.astype(jnp.float32)))))
+            else:
+                scale = jnp.asarray(1.0, jnp.float32)
+            step_v = lr_t * scale * u
+            if b1:
+                m_new = b1 * m + (1 - b1) * step_v
+                step_v = m_new
+            else:
+                m_new = m
+            if weight_decay:
+                step_v = step_v + (lr_t * weight_decay
+                                   * p.astype(jnp.float32))
+            return (p - step_v.astype(p.dtype), r_new, c_new, v_new, m_new)
+
+        tm = jax.tree_util.tree_map
+        out = tm(one, params, grads, state.vr, state.vc, state.v, state.mu)
+        pick = lambda i: tm(lambda _, o: o[i], params, out)
+        return pick(0), AdafactorState(count, pick(1), pick(2), pick(3),
+                                       pick(4))
+
+    def state_specs(ps, params=None):
+        from jax.sharding import PartitionSpec as P
+
+        if params is None:
+            raise ValueError(
+                "adafactor's state layout depends on param shapes; this "
+                "path passes no param tree (zero1's flat buffer cannot "
+                "carry factored stats) — use sgd/adam/adamw/lion here")
+        is_p = lambda x: isinstance(x, P)
+        tm = lambda f: jax.tree_util.tree_map(f, ps, params, is_leaf=is_p)
+
+        def pad(s, nd):
+            tup = tuple(s)
+            return tup + (None,) * (nd - len(tup))
+
+        def strip(tup):  # P(None) == P() semantically; normalize
+            while tup and tup[-1] is None:
+                tup = tup[:-1]
+            return tup
+
+        vr = tm(lambda s, p: P(*strip(pad(s, p.ndim)[:-1])) if p.ndim >= 2
+                else P())
+        vc = tm(lambda s, p: P(*strip(pad(s, p.ndim)[:-2]
+                                      + (pad(s, p.ndim)[-1],)))
+                if p.ndim >= 2 else P())
+        v = tm(lambda s, p: P() if p.ndim >= 2 else s)
+        mu = tm(lambda s, p: s if b1 else P())
+        return AdafactorState(P(), vr, vc, v, mu)
+
+    return Optimizer(init, update, f"adafactor(lr={lr},b1={b1})",
                      state_specs=state_specs)
 
 
@@ -229,6 +366,12 @@ def make(name: str, lr: LR, momentum: float = 0.0,
         opt = adamw(lr, weight_decay=weight_decay or 0.01)
     elif name == "lion":
         opt = lion(lr, weight_decay=weight_decay)
+    elif name == "adafactor":
+        # classic Adafactor: b1=0, no first moment — inheriting the CLI's
+        # --momentum (default 0.9, an SGD knob) would silently allocate a
+        # full-size momentum slot and forfeit the factored-memory point;
+        # the momentum variant stays available via optim.adafactor(b1=...)
+        opt = adafactor(lr, weight_decay=weight_decay)
     else:
         raise ValueError(f"unknown optimizer {name!r}")
     return with_clipping(opt, grad_clip)

@@ -57,7 +57,7 @@ def moe_state_specs(optimizer: Optimizer, params: Pytree) -> TrainState:
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
     return TrainState(step=P(), params=pspecs,
-                      opt_state=optimizer.state_specs(pspecs))
+                      opt_state=optimizer.state_specs(pspecs, params))
 
 
 def shard_moe_state(state: TrainState, mesh: Mesh,
@@ -288,7 +288,7 @@ def moe_tp_state_specs(optimizer: Optimizer, params: Pytree) -> TrainState:
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
     return TrainState(step=P(), params=pspecs,
-                      opt_state=optimizer.state_specs(pspecs))
+                      opt_state=optimizer.state_specs(pspecs, params))
 
 
 def init_moe_tp_state(model: Transformer, optimizer: Optimizer,
@@ -370,7 +370,9 @@ def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
         return megatron.tp_block_apply(c, layer_params, h, tp, ffn_fn=ffn_fn)
 
     if c.remat:
-        block_fn = jax.checkpoint(block_fn)
+        from ..models.core import make_remat
+
+        block_fn = make_remat(c.remat_policy)(block_fn)
     aux_total = jnp.zeros((), jnp.float32)
     for layer_params in params["blocks"]:
         x, aux = block_fn(layer_params, x)

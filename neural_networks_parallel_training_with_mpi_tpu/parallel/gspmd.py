@@ -45,7 +45,8 @@ def state_specs(model, params: Pytree, optimizer: Optimizer,
     ps = tp.param_specs(model, params, mesh)
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
-    return TrainState(step=P(), params=ps, opt_state=optimizer.state_specs(ps))
+    return TrainState(step=P(), params=ps,
+                      opt_state=optimizer.state_specs(ps, params))
 
 
 def batch_specs(batch: Batch) -> Pytree:

@@ -247,7 +247,7 @@ def vocab_parallel_accuracy(logits_local: jax.Array, targets: jax.Array,
     (per-example mean over token dims, count = examples).  A metric, not a
     loss: gradients are stopped at entry (pmax/pmin carry no
     differentiation rule, and argmax has no useful one)."""
-    from ..ops.losses import _masked
+    from ..ops.losses import reduce_example_hits
 
     logits_local = jax.lax.stop_gradient(logits_local)
     v_local = logits_local.shape[-1]
@@ -260,8 +260,7 @@ def vocab_parallel_accuracy(logits_local: jax.Array, targets: jax.Array,
                      big)
     pred = lax.pmin(cand, axis)
     hit = (pred == targets).astype(jnp.float32)
-    hit = hit.reshape(hit.shape[0], -1).mean(axis=-1)
-    return _masked(hit, mask)
+    return reduce_example_hits(hit, mask)
 
 
 def path_names(path) -> Tuple[str, ...]:

@@ -210,7 +210,9 @@ def _stage_fns(model: Transformer, tp: int):
             return h, None
 
     if c.remat:
-        block_body = jax.checkpoint(block_body)
+        from ..models.core import make_remat
+
+        block_body = make_remat(model.cfg.remat_policy)(block_body)
 
     def stage_apply(stage_params, x):
         # stage_params leaves: (layers_per_stage, ...); scan = stage body

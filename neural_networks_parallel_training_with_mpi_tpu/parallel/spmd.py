@@ -199,7 +199,8 @@ def shard_sp_tp_state(state: TrainState, mesh: Mesh, optimizer: Optimizer,
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
     specs = TrainState(step=P(), params=pspecs,
-                       opt_state=optimizer.state_specs(pspecs))
+                       opt_state=optimizer.state_specs(pspecs,
+                                                      state.params))
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
@@ -265,7 +266,9 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
                                        attention_fn=attn)
 
     if c.remat:
-        block_fn = jax.checkpoint(block_fn)
+        from ..models.core import make_remat
+
+        block_fn = make_remat(c.remat_policy)(block_fn)
     for layer_params in params["blocks"]:
         x = block_fn(layer_params, x)
     if vocab_parallel:
@@ -376,7 +379,7 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs for SP x TP")
     state_spec = TrainState(step=P(), params=pspecs,
-                            opt_state=optimizer.state_specs(pspecs))
+                            opt_state=optimizer.state_specs(pspecs, dummy))
     bspecs = batch_specs(example_batch, seq_axis)
     mapped = jax.shard_map(
         shard_step, mesh=mesh,

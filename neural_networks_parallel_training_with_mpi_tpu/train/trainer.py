@@ -92,6 +92,16 @@ class Trainer:
                 "--vocab_parallel shards the embedding/head over 'tensor' "
                 "on the seq x tensor path (--sp > 1 and --tp > 1); other "
                 "layouts keep them replicated")
+        if (cfg.optimizer == "adafactor"
+                and (self.pipeline or self.sp_tp or self.ep_tp
+                     or cfg.update_sharding == "zero1")):
+            raise ValueError(
+                "adafactor's factored stats are means over a param's last "
+                "two dims — exact under DP/SP/expert sharding and GSPMD "
+                "global-view layouts, but shard-local (wrong) on layouts "
+                "that slice inside matrices (pipe, seq x tensor, expert x "
+                "tensor) and unrepresentable in zero1's flat state; use "
+                "adam/adamw/lion/sgd there")
         if (cfg.model.arch == "transformer"
                 and cfg.model.attention in ("ring", "ring_flash", "ulysses")
                 and not self.seq_parallel):
