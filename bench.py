@@ -146,7 +146,7 @@ def _make_config(name):
             make_model=lambda cd: ConvNet(compute_dtype=cd),
             make_batch=make_batch,
         )
-    if name == "lm":
+    if name in ("lm", "moe"):
         c = _LM
 
         def make_batch(rng, B):
@@ -156,11 +156,12 @@ def _make_config(name):
                 "mask": np.ones((B,), np.float32),
             }
 
-        def make_model(cd):
+        def make_model(cd, moe=(name == "moe")):
             return Transformer(TransformerConfig(
                 vocab_size=c["vocab"], max_seq_len=c["seq"],
                 n_layers=c["n_layers"], d_model=c["d_model"],
-                n_heads=c["n_heads"], d_ff=c["d_ff"], compute_dtype=cd))
+                n_heads=c["n_heads"], d_ff=c["d_ff"], compute_dtype=cd,
+                moe_experts=_MOE_EXPERTS if moe else 0))
 
         return dict(
             batch=32, measure_steps=20, baseline_steps=3,
@@ -176,7 +177,12 @@ METRIC_NAMES = {
     "mnist": "mnist_mlp_train_samples_per_sec",
     "cifar": "cifar_convnet_train_samples_per_sec",
     "lm": "tiny_lm_train_samples_per_sec",
+    # extra (not in BASELINE.json's five): Switch top-1 MoE LM — 8 experts,
+    # same active per-token FLOPs as "lm"; its torch baseline is that
+    # iso-active-FLOPs dense LM (the standard MoE-vs-dense comparison)
+    "moe": "moe_lm_train_samples_per_sec",
 }
+_MOE_EXPERTS = 8
 
 
 def timed_chain(step, state, batch, n: int, sync_every: int = 0):
@@ -337,7 +343,11 @@ def bench_reference_baseline(config_name: str) -> float:
         x = torch.randn(B, 3, 32, 32)
         y = torch.randint(0, 10, (B,))
         loss_fn = torch.nn.CrossEntropyLoss()
-    elif config_name == "lm":
+    elif config_name in ("lm", "moe"):
+        # "moe": the routed Switch-MoE model's torch baseline is the dense
+        # LM with the SAME active per-token FLOPs (top-1 of E experts
+        # runs exactly one d_ff FFN per token) — the standard iso-FLOPs
+        # MoE-vs-dense comparison
         c = _LM
 
         class TorchLM(torch.nn.Module):
@@ -538,7 +548,8 @@ def main() -> int:
     ap.add_argument("--config", choices=sorted(METRIC_NAMES), default="wide")
     ap.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto")
     ap.add_argument("--all", action="store_true",
-                    help="bench all five configs, write BENCH_FULL.json")
+                    help="bench every config (BASELINE.json's five + the "
+                         "moe extra), write BENCH_FULL.json")
     ap.add_argument("--scaling", action="store_true",
                     help="1..8 virtual-device sweep, write BENCH_SCALING.json")
     ap.add_argument("--attention", action="store_true",
@@ -560,12 +571,26 @@ def main() -> int:
         bench_attention()
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
+    if args.all and choice == "cpu" and "moe" in configs:
+        # the routed-MoE dispatch einsums are MXU work; on the CPU fallback
+        # they take minutes/step — keep the fallback's turnaround honest
+        log("[moe] skipped on the cpu fallback (TPU-oriented extra; "
+            "run `bench.py --config moe` explicitly to measure it here)")
+        configs.remove("moe")
     records = []
     for name in configs:
         try:
             fw = bench_framework(name)
         except Exception as e:  # noqa: BLE001 — keep the harness alive
             log(f"[{name}] framework bench FAILED: {type(e).__name__}: {e}")
+            if name == "moe":
+                # same reason as the upfront skip: the routed-dispatch
+                # einsums take minutes/step on CPU — don't stall the sweep
+                log("[moe] not retried on the cpu fallback")
+                records.append({"metric": METRIC_NAMES[name], "value": None,
+                                "unit": "samples/sec",
+                                "error": f"{type(e).__name__}: {e}"})
+                continue
             # A process whose backend initialized cannot switch platforms;
             # retry the config in a CPU-pinned subprocess instead.
             rec = _run_child_cpu(name, n_devices=1,

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Byte-level LM on REAL text — any local file (here: this repo's README).
+# Zero-egress real-language training; `python quality.py` trains the full
+# documentation corpus to a held-out perplexity below the corpus's unigram
+# entropy bar (QUALITY.json).
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset text --text_file README.md --seq_len 128 \
+    --no-full-batch --batch_size 32 --nepochs 2 \
+    --optimizer adam --lr 3e-3 --val_fraction 0.1 --eval_every 2

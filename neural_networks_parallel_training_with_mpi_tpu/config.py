@@ -84,7 +84,10 @@ class DataConfig:
     differently-normalized data).
     """
 
-    dataset: str = "regression"  # regression | wide_regression | digits | mnist | cifar10 | lm
+    dataset: str = "regression"  # regression | wide_regression | digits | mnist | cifar10 | lm | text
+    # dataset='text': byte-level LM over this local file (zero-egress real
+    # text; data.datasets.text_dataset)
+    text_file: str = ""
     n_samples: Optional[int] = None  # None = per-dataset default (16 for regression)
     n_features: int = 2
     noise: float = 1.0
@@ -301,7 +304,7 @@ def build_argparser() -> argparse.ArgumentParser:
                         "tensor meshes: --sp > 1 and --tp > 1)")
     p.add_argument("--dataset",
                    choices=["regression", "wide_regression", "digits",
-                            "mnist", "cifar10", "lm"],
+                            "mnist", "cifar10", "lm", "text"],
                    default="regression")
     p.add_argument("--n_samples", type=int, default=None,
                    help="dataset size (default: per-dataset)")
@@ -338,6 +341,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--n_heads", type=int, default=4)
     p.add_argument("--d_ff", type=int, default=512)
     p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--text_file", default="",
+                   help="dataset=text: local file for byte-level LM "
+                        "training (zero-egress real text)")
     p.add_argument("--vocab_size", type=int, default=256)
     p.add_argument("--attention",
                    choices=["dense", "flash", "ring", "ring_flash",
@@ -432,6 +438,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                           n_features=args.n_features,
                           val_fraction=args.val_fraction,
                           seq_len=args.seq_len, vocab_size=args.vocab_size,
+                          text_file=args.text_file,
                           backend=args.data_backend)
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
                             dtype=args.dtype,
@@ -457,7 +464,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     if args.dataset == "cifar10":
         cfg.model = dataclasses.replace(cfg.model, arch="convnet",
                                         out_features=10)
-    if args.dataset == "lm":
+    if args.dataset in ("lm", "text"):
         cfg.loss = "cross_entropy"
         cfg.model.arch = "transformer"
     if args.sp > 1:

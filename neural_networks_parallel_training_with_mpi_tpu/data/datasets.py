@@ -151,6 +151,28 @@ def lm_dataset(seq_len: int = 128, vocab_size: int = 256, seed: int = 0,
     return {"x": tokens[:, :-1].copy(), "y": tokens[:, 1:].copy()}
 
 
+def text_dataset(text_file: str, seq_len: int = 128, vocab_size: int = 256,
+                 n_samples: Optional[int] = None) -> Arrays:
+    """Byte-level next-token LM windows over ANY local text file — the
+    zero-egress real-text path (the reference has no text/LM capability at
+    all; SURVEY.md §5.7).  Bytes are the tokens (vocab 256 covers them;
+    smaller vocabs fold via modulo, documented lossy).  Non-overlapping
+    (seq_len + 1)-byte windows, x/y shifted by one."""
+    p = Path(text_file)
+    if not p.exists():
+        raise FileNotFoundError(f"--text_file {text_file!r} does not exist")
+    raw = np.frombuffer(p.read_bytes(), dtype=np.uint8).astype(np.int32)
+    tokens = raw if vocab_size >= 256 else raw % vocab_size
+    n_avail = len(tokens) // (seq_len + 1)
+    if n_avail == 0:
+        raise ValueError(
+            f"{text_file}: {len(tokens)} bytes < one window of "
+            f"seq_len+1={seq_len + 1}")
+    n = min(n_samples, n_avail) if n_samples else n_avail
+    tokens = tokens[: n * (seq_len + 1)].reshape(n, seq_len + 1)
+    return {"x": tokens[:, :-1].copy(), "y": tokens[:, 1:].copy()}
+
+
 def train_val_split(data: Arrays, val_fraction: float,
                     seed: int = 0) -> Tuple[Arrays, Arrays]:
     """Deterministic shuffled train/validation split.
@@ -195,4 +217,9 @@ def build_dataset(cfg: DataConfig, data_dir: Optional[str] = None) -> Arrays:
     if cfg.dataset == "lm":
         return lm_dataset(cfg.seq_len, cfg.vocab_size, cfg.seed,
                           n_samples=cfg.n_samples or 2048, data_dir=data_dir)
+    if cfg.dataset == "text":
+        if not cfg.text_file:
+            raise ValueError("dataset='text' needs --text_file")
+        return text_dataset(cfg.text_file, cfg.seq_len, cfg.vocab_size,
+                            n_samples=cfg.n_samples)
     raise ValueError(f"unknown dataset {cfg.dataset!r}")

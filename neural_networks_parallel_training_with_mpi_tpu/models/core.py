@@ -241,8 +241,6 @@ def make_remat(policy: str = "full"):
     ``--remat_policy``) — the HBM <-> recompute-FLOPs dial every
     block-remat site shares, so the policy vocabulary cannot drift
     between the DP/SP, SP x TP, EP x TP and pipeline paths."""
-    import jax
-
     try:
         name = _REMAT_POLICIES[policy]
     except KeyError:

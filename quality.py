@@ -113,8 +113,63 @@ def digits_quality() -> dict:
     }
 
 
+def docs_lm_quality() -> dict:
+    """Byte-level LM on REAL text — this repo's own documentation corpus
+    (~100KB of English/markdown, zero egress).  The bar is self-calibrating:
+    held-out perplexity must beat the corpus's UNIGRAM perplexity (byte
+    frequency entropy), i.e. the model must have learned CONTEXT, not just
+    character frequencies."""
+    import math
+    import tempfile
+    from pathlib import Path
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    # anchor to the repo (this file's directory) — quality.py must work
+    # from any cwd
+    repo = Path(__file__).resolve().parent
+    corpus = b"".join(p.read_bytes() for p in sorted(repo.glob("*.md")))
+    counts = np.bincount(np.frombuffer(corpus, np.uint8), minlength=256)
+    probs = counts[counts > 0] / counts.sum()
+    unigram_ppl = math.exp(-(probs * np.log(probs)).sum())
+
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        f.write(corpus)
+        path = f.name
+    try:
+        cfg = TrainConfig(
+            lr=3e-3, nepochs=6, batch_size=64, full_batch=False,
+            optimizer="adam", loss="cross_entropy", log_every=0,
+            eval_every=6,
+            data=DataConfig(dataset="text", text_file=path, seq_len=128,
+                            val_fraction=0.1),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=64,
+                              n_heads=4, d_ff=256, vocab_size=256,
+                              max_seq_len=128),
+            mesh=MeshConfig(data=8),
+        )
+        res = Trainer(cfg).fit()
+    finally:
+        import os as _os
+
+        _os.unlink(path)
+    ppl = float(res.get("val_ppl", float("inf")))
+    return {
+        "config": "docs_text_lm_perplexity",
+        "val_ppl": round(ppl, 2),
+        "unigram_ppl_bar": round(unigram_ppl, 2),
+        "corpus_bytes": len(corpus),
+        "pass": bool(ppl < unigram_ppl),
+    }
+
+
 def main() -> int:
-    records = [toy_parity(), digits_quality()]
+    records = [toy_parity(), digits_quality(), docs_lm_quality()]
     with open("QUALITY.json", "w") as f:
         json.dump(records, f, indent=2)
     for r in records:

@@ -99,7 +99,17 @@ def test_cli_generate_from_checkpoint(tmp_path):
 def test_example_10_expert_tensor_completes():
     out = subprocess.run(
         ["bash", str(REPO / "examples" / "10_expert_tensor.sh")],
-        capture_output=True, text=True, timeout=240, env=_clean_env(),
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stderr + out.stdout
+
+
+def test_example_11_real_text_lm_completes():
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "11_real_text_lm.sh")],
+        capture_output=True, text=True, timeout=360, env=_clean_env(),
         cwd=str(REPO),
     )
     assert out.returncode == 0, out.stderr[-2000:]

@@ -107,3 +107,29 @@ def test_lm_validation_reports_perplexity():
                                rtol=1e-6)
     # an untrained 64-vocab LM sits near uniform: ppl ~ vocab size
     assert 20.0 < r["val_ppl"] < 100.0
+
+
+def test_text_dataset_windows(tmp_path):
+    """dataset='text': byte-level windows over a local file, x/y shifted."""
+    from neural_networks_parallel_training_with_mpi_tpu.data.datasets import (
+        text_dataset,
+    )
+
+    p = tmp_path / "corpus.txt"
+    payload = bytes(range(256)) * 4  # 1024 known bytes
+    p.write_bytes(payload)
+    d = text_dataset(str(p), seq_len=16, vocab_size=256)
+    assert d["x"].shape == (1024 // 17, 16)
+    np.testing.assert_array_equal(d["x"][0], np.arange(16))
+    np.testing.assert_array_equal(d["y"][0], np.arange(1, 17))
+    # y is x shifted by one within each window
+    np.testing.assert_array_equal(d["x"][:, 1:], d["y"][:, :-1])
+
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError):
+        text_dataset(str(tmp_path / "missing.txt"), seq_len=16)
+    with _pytest.raises(ValueError, match="one window"):
+        small = tmp_path / "small.txt"
+        small.write_bytes(b"hi")
+        text_dataset(str(small), seq_len=16)
