@@ -132,3 +132,38 @@ def test_top_k_p_sampling_stays_in_vocab(lm):
     toks = np.asarray(out)
     assert toks.shape == (1, 12)
     assert (toks >= 0).all() and (toks < model.cfg.vocab_size).all()
+
+
+def test_generate_sharded_matches_single_device(lm):
+    """DP-sharded batch decode == the plain single-placement decode,
+    greedy and sampled (same key => same tokens)."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+        generate_sharded,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    model, params = lm
+    mesh = make_mesh(MeshConfig(data=8), devices=jax.devices("cpu")[:8])
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(1, 32, (8, 4)), jnp.int32)
+
+    want = generate(model, params, prompt, 6)
+    got = generate_sharded(model, params, prompt, mesh, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    key = prng.init_key(7)
+    want_s = generate(model, params, prompt, 6, temperature=0.8, top_k=8,
+                      key=key)
+    got_s = generate_sharded(model, params, prompt, mesh, 6,
+                             temperature=0.8, top_k=8, key=key)
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(want_s))
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="not divisible"):
+        generate_sharded(model, params, prompt[:3], mesh, 2)
