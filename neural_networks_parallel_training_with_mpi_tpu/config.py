@@ -141,8 +141,8 @@ class ModelConfig:
     #   dots_no_batch save only batch-free matmul outputs (weights-side)
     remat_policy: str = "full"
     # transformer: lax.scan over stacked blocks — compile time stops
-    # growing with n_layers (plain DP/SP paths; pipeline/TP own their
-    # stacking)
+    # growing with n_layers (DP / DP x seq / seq x tensor paths; the
+    # pipeline/GSPMD/expert layouts own their stacking)
     scan_layers: bool = False
     # MoE FFN (transformer only): 0 = dense.  moe_expert_axis is set to
     # 'expert' when the mesh's expert axis is >1 (parallel.expert wires the

@@ -245,8 +245,12 @@ def generate_sharded(model: Transformer, params, prompt, mesh,
     ``prompt`` (B, P) with B divisible by the product of the mesh's
     ``batch_axes`` sizes; axes absent from the mesh are ignored.  Same
     sampling knobs as :func:`generate`."""
-    from ..parallel.sharding import replicated_sharding
+    from ..parallel.sharding import batch_sharding, replicated_sharding
 
+    if temperature > 0 and key is None:  # mirror generate()'s guard:
+        # defaulting the key here would make every "sampled" request
+        # silently deterministic
+        raise ValueError("temperature sampling needs a PRNG key")
     axes = tuple(a for a in batch_axes if a in mesh.shape)
     n = 1
     for a in axes:
@@ -262,9 +266,8 @@ def generate_sharded(model: Transformer, params, prompt, mesh,
     prompt = jax.device_put(jnp.asarray(prompt, jnp.int32), rows)
     if prompt_lens is not None:
         prompt_lens = jax.device_put(jnp.asarray(prompt_lens, jnp.int32),
-                                     jax.sharding.NamedSharding(
-                                         mesh, jax.sharding.PartitionSpec(
-                                             axes)))
+                                     batch_sharding(mesh, ndim=1,
+                                                    batch_axes=axes))
     if key is None:
         key = jax.random.PRNGKey(0)
     return run(params, prompt, prompt_lens, key)

@@ -43,8 +43,9 @@ class TransformerConfig:
     # depth, so compile time and program size stop growing with n_layers —
     # the TPU-idiomatic layout for deep models.  Changes the param treedef
     # (stacked vs per-layer list); composes with remat (checkpoint the
-    # scan body) but not with the pipeline/TP layouts, which own their own
-    # stacking/sharding.
+    # scan body) and with the seq x tensor path (parallel.spmd scans the
+    # Megatron block), but not with the pipeline/GSPMD/expert layouts,
+    # which own their own stacking/sharding.
     scan_layers: bool = False
     # MoE FFN (models.moe): 0 experts = dense FFN.  With ``moe_expert_axis``
     # set, apply() must run inside a shard_map binding that mesh axis and

@@ -159,10 +159,14 @@ def sp_tp_param_specs(params: Pytree, vocab_parallel: bool = False) -> Pytree:
             return P()
         col = "qkv" in names or "ff_in" in names
         ndim = len(jnp.shape(leaf))
-        if names[-1] == "w" and ndim == 2:
-            return P(None, "tensor") if col else P("tensor", None)
-        if names[-1] == "b" and ndim == 1:
-            return P("tensor")
+        # scan_layers stacks a leading (n_layers,) dim on every block leaf
+        # (replicated); the Megatron col/row dims shift right by one
+        if names[-1] == "w" and ndim in (2, 3):
+            lead = (None,) * (ndim - 2)
+            return (P(*lead, None, "tensor") if col
+                    else P(*lead, "tensor", None))
+        if names[-1] == "b" and ndim in (1, 2):
+            return P(*(None,) * (ndim - 1), "tensor")
         raise ValueError(f"unexpected tensor-sharded leaf {names}")
 
     def top_spec(k, v):
@@ -269,8 +273,14 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
         from ..models.core import make_remat
 
         block_fn = make_remat(c.remat_policy)(block_fn)
-    for layer_params in params["blocks"]:
-        x = block_fn(layer_params, x)
+    if c.scan_layers:
+        # stacked (n_layers, ...) block leaves: ONE compiled Megatron block
+        # body regardless of depth, same as the dense model's scan path
+        x, _ = lax.scan(lambda h, lp: (block_fn(lp, h), None), x,
+                        params["blocks"])
+    else:
+        for layer_params in params["blocks"]:
+            x = block_fn(layer_params, x)
     if vocab_parallel:
         # only the head matmul is sharded; the pre-head LayerNorm is the
         # model's own (Transformer.final_norm)

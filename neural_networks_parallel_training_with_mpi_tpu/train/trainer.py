@@ -131,11 +131,12 @@ class Trainer:
                 "semantics) is only available on the pure-DP shard_map path; "
                 "GSPMD global semantics always compute the exact global mean")
         if cfg.model.scan_layers and (self.pipeline or self.gspmd
-                                      or self.sp_tp or self.expert):
+                                      or self.expert):
             raise ValueError(
                 "scan_layers stacks blocks for a depth-independent compile "
-                "on the plain DP / DP x seq paths; the pipeline/TP/expert "
-                "layouts own their own stacking and sharding")
+                "on the plain DP / DP x seq / seq x tensor paths; the "
+                "pipeline/GSPMD/expert layouts own their own stacking and "
+                "sharding")
         self.model = build_model(cfg.model)
         if self.seq_parallel and cfg.model.arch != "transformer":
             raise ValueError("seq axis > 1 requires the transformer model")

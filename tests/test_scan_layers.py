@@ -166,3 +166,44 @@ def test_make_remat_rejects_unknown_policy():
 
     with pytest.raises(ValueError, match="unknown remat policy"):
         make_remat("everything")
+
+
+def test_scan_layers_on_sp_tp_matches_loop():
+    """scan_layers on the seq x tensor path: stacked Megatron blocks run
+    as ONE scanned block body; trajectory must match the per-layer-loop
+    sp_tp trainer on the same job."""
+    def run(scan):
+        cfg = TrainConfig(
+            nepochs=2, batch_size=32, full_batch=False, shuffle=False,
+            loss="cross_entropy", optimizer="adam", lr=1e-3,
+            data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=4, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=16, attention="ring",
+                              scan_layers=scan),
+            mesh=MeshConfig(data=2, seq=2, tensor=2),
+        )
+        t = Trainer(cfg)
+        assert t.sp_tp
+        r = t.fit()
+        params = jax.device_get(t._eval_params())
+        blocks = params["blocks"]
+        if scan:  # unstack for comparison with the per-layer layout
+            leaves = jax.tree_util.tree_leaves(blocks)
+            n = leaves[0].shape[0]
+            blocks = [jax.tree_util.tree_map(lambda x, i=i: x[i], blocks)
+                      for i in range(n)]
+        return r["final_loss"], blocks
+
+    loss_loop, blocks_loop = run(False)
+    loss_scan, blocks_scan = run(True)
+    assert loss_scan == pytest.approx(loss_loop, rel=1e-4)
+    # scan vs unrolled loop fuse differently; Adam amplifies the f32
+    # reassociation noise to ~1e-5-sized param deltas over 2 epochs (the
+    # same LOOSE tolerance story as tests/test_composition.py)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-3, atol=1e-4),
+        blocks_scan, blocks_loop)
