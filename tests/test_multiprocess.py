@@ -7,7 +7,9 @@ reference gets from ``mpiexec`` (README.md:12) and ``MPI.COMM_WORLD``
 devices each, gloo collectives over localhost: world_setup, barrier,
 broadcast_host_array, per-host data loading, a jitted DP train step over
 the 4-device global mesh, and an orbax shard-parallel checkpoint round
-trip — see distributed_child.py for the phase list.
+trip — see distributed_child.py for the phase list.  faulty_child.py adds
+the fault-injection side: a rank dies mid-training and the survivor must
+fail fast.
 """
 
 import json
@@ -20,6 +22,7 @@ from pathlib import Path
 import pytest
 
 CHILD = Path(__file__).with_name("distributed_child.py")
+FAULTY = Path(__file__).with_name("faulty_child.py")
 TIMEOUT_S = float(os.environ.get("MULTIPROC_TEST_TIMEOUT", "300"))
 
 
@@ -29,19 +32,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_world(tmp_path):
-    port = _free_port()
+def _spawn_pair(script: Path, args_for):
+    """Launch the two world processes of ``script`` and wait for both.
+    ``args_for(pid)`` -> the child's argv tail.  Returns
+    [(rc, stdout, stderr)] in pid order; fails the test on timeout
+    (killing both children) — the one env/timeout convention both
+    multiprocess tests share."""
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # child sets its own device count
+    env.pop("XLA_FLAGS", None)  # children set their own device count
     env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = str(CHILD.parent.parent) + os.pathsep + \
+    env["PYTHONPATH"] = str(script.parent.parent) + os.pathsep + \
         env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, str(CHILD), str(pid), "2", str(port),
-             str(tmp_path)],
+            [sys.executable, str(script)] + [str(a) for a in args_for(pid)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=str(CHILD.parent.parent))
+            env=env, cwd=str(script.parent.parent))
         for pid in range(2)
     ]
     outs = []
@@ -52,8 +58,14 @@ def test_two_process_world(tmp_path):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.fail(f"2-process world did not complete in {TIMEOUT_S:.0f}s "
-                    "(world formation hang?)")
+        pytest.fail(f"{script.name}: 2-process run did not complete in "
+                    f"{TIMEOUT_S:.0f}s (collective/world-formation hang?)")
+    return outs
+
+
+def test_two_process_world(tmp_path):
+    port = _free_port()
+    outs = _spawn_pair(CHILD, lambda pid: [pid, 2, port, tmp_path])
 
     reports = []
     for rc, out, err in outs:
@@ -74,3 +86,17 @@ def test_two_process_world(tmp_path):
             and r["checkpoint_ok"], r
     # both hosts computed the identical loss trajectory (one logical job)
     assert reports[0]["losses"] == reports[1]["losses"]
+
+
+def test_peer_death_fails_fast():
+    """Kill one rank mid-training; the survivor must exit within the
+    deadline — by a surfaced collective error (43) or the step-hang
+    watchdog (42) — instead of hanging forever in a collective (the
+    reference's failure mode: its gather at :185 has no timeout)."""
+    port = _free_port()
+    rcs = _spawn_pair(FAULTY, lambda pid: [pid, port])
+    survivor_rc = rcs[0][0]
+    assert rcs[1][0] == 1, f"victim should exit 1, got {rcs[1]}"
+    assert survivor_rc in (42, 43), (
+        f"survivor rc={survivor_rc} (42=watchdog, 43=surfaced error)\n"
+        f"stdout: {rcs[0][1][-800:]}\nstderr: {rcs[0][2][-800:]}")
