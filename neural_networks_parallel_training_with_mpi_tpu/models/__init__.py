@@ -11,9 +11,10 @@ from .mlp import MLP, reference_mlp
 from .convnet import ConvNet
 from .transformer import Transformer, TransformerConfig
 from .registry import build_model
+from .generate import generate, generate_sharded
 
 __all__ = [
     "Module", "Linear", "Sequential", "Activation", "Conv2D", "LayerNorm",
     "Embedding", "MLP", "reference_mlp", "ConvNet", "Transformer",
-    "TransformerConfig", "build_model",
+    "TransformerConfig", "build_model", "generate", "generate_sharded",
 ]
