@@ -196,7 +196,11 @@ class ShardedLoader:
             if self.seq_axis:
                 from ..parallel import spmd
 
-                return spmd.place_batch(self.mesh, padded, self.seq_axis)
+                # rows over ALL the loader's batch axes (incl. 'expert' on
+                # the MoE layouts) — the placement must match the step's
+                # in_specs or jit reshards every batch on the hot path
+                return spmd.place_batch(self.mesh, padded, self.seq_axis,
+                                        batch_axes=self.batch_axes)
             return shd.shard_batch(self.mesh, padded, self.batch_axes)
         # multi-host: slice out this process's contiguous row block
         total = padded["mask"].shape[0]

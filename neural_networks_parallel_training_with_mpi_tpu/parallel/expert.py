@@ -121,14 +121,48 @@ def _global_norm_clip(grads: Pytree, grad_clip: float, clip_axes):
         lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
+def _seq_active(mesh: Mesh, seq_axis) -> bool:
+    return seq_axis is not None and int(mesh.shape.get(seq_axis, 1)) > 1
+
+
+def _moe_batch_specs(batch_keys, token_axes, seq_axis) -> dict:
+    """Batch specs for the MoE paths: rows over the token axes; with an
+    active seq axis, x/y additionally shard dim 1 (mask stays per-row).
+
+    Unlike ``spmd.batch_specs`` this works from KEYS (the MoE builders
+    derive their shard_map specs before seeing a batch), so it cannot
+    inspect ranks — with seq active, only the (B, T) x/y + per-row mask
+    contract is derivable from names alone, and other keys are rejected
+    loudly here instead of failing inside shard_map tracing."""
+    if seq_axis:
+        extra = [k for k in batch_keys if k not in ("x", "y", "mask")]
+        if extra:
+            raise ValueError(
+                f"seq-sharded MoE specs are derived from key names and "
+                f"only know x/y (B, T) and mask (B,); got extra keys "
+                f"{extra} — pass specs explicitly or drop the keys")
+    specs = {}
+    for k in batch_keys:
+        if seq_axis and k != "mask":
+            specs[k] = P(token_axes, seq_axis)
+        else:
+            specs[k] = P(token_axes)
+    return specs
+
+
 def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                         loss_name: str = "cross_entropy",
                         aux_weight: float = 0.01,
                         donate: bool = True,
                         batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
                         grad_clip: float = 0.0,
-                        accum_steps: int = 1):
-    """(state, batch) -> (state, metrics) jitted over data x fsdp x expert.
+                        accum_steps: int = 1,
+                        seq_axis=None):
+    """(state, batch) -> (state, metrics) jitted over data x fsdp x expert
+    (x seq with ``seq_axis`` — long-context MoE: ring/ulysses attention
+    over 'seq' composed with the all_to_all expert dispatch; the model's
+    ``attention`` must then be a seq-sharded impl and every token
+    reduction additionally spans the seq axis).
 
     ``metrics`` = {"loss": task loss, "aux": mean load-balance loss}.  The
     model's ``moe_expert_axis`` must equal 'expert' when the mesh's expert
@@ -149,6 +183,12 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
     if c.moe_experts % max(ep, 1):
         raise ValueError(f"{c.moe_experts} experts not divisible over "
                          f"expert axis of size {ep}")
+    use_seq = _seq_active(mesh, seq_axis)
+    if use_seq and c.attention not in ("ring", "ring_flash", "ulysses"):
+        raise ValueError(f"seq axis active but model attention="
+                         f"{c.attention!r} is not seq-sharded")
+    token_axes = TOKEN_AXES + ((seq_axis,) if use_seq else ())
+    expert_axes = DATA_AXES + ((seq_axis,) if use_seq else ())
     base = losses_lib.get(loss_name)
 
     def local_fwd(params, batch):
@@ -172,13 +212,13 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
     def shard_step(state: TrainState, batch: Batch):
         s, cnt, aux, grads = _moe_accumulate(micro_grads, state.params,
                                              batch, accum_steps)
-        total = lax.psum(cnt, TOKEN_AXES)
+        total = lax.psum(cnt, token_axes)
         grads = jax.tree_util.tree_map_with_path(
             lambda path, g: lax.psum(
-                g, DATA_AXES if _is_expert_path(path) else TOKEN_AXES) / total,
-            grads)
-        metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
-                   "aux": lax.pmean(aux, TOKEN_AXES)}
+                g, expert_axes if _is_expert_path(path) else token_axes)
+            / total, grads)
+        metrics = {"loss": lax.psum(s, token_axes) / total,
+                   "aux": lax.pmean(aux, token_axes)}
         if grad_clip > 0:
             grads = _global_norm_clip(
                 grads, grad_clip,
@@ -189,7 +229,8 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     state_specs = moe_state_specs(optimizer, dummy)
-    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    batch_specs = _moe_batch_specs(batch_keys, TOKEN_AXES,
+                                   seq_axis if use_seq else None)
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_specs, batch_specs),
@@ -202,28 +243,38 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
 def make_moe_eval_step(model: Transformer, mesh: Mesh,
                        loss_name: str = "cross_entropy",
                        with_accuracy: bool = True,
-                       batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
+                       batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
+                       seq_axis=None):
     """Jitted global-mean eval mirroring the train step's layout:
     (params, batch) -> metrics.  Tokens reduce over all TOKEN_AXES (the
-    expert axis carries batch rows too)."""
+    expert axis carries batch rows too), plus ``seq_axis`` when active;
+    example-level accuracy averages the per-shard token accuracies over
+    the seq axis (each shard scores its own tokens — same convention as
+    the sp_tp eval)."""
+    use_seq = _seq_active(mesh, seq_axis)
+    token_axes = TOKEN_AXES + ((seq_axis,) if use_seq else ())
     base = losses_lib.get(loss_name)
 
     def shard_eval(params, batch):
         logits, _aux = model.apply(params, batch["x"], return_aux=True)
         s, c = base(logits, batch["y"], batch.get("mask"))
-        total = lax.psum(c, TOKEN_AXES)
-        out = {"loss": lax.psum(s, TOKEN_AXES) / total, "count": total}
+        total = lax.psum(c, token_axes)
+        out = {"loss": lax.psum(s, token_axes) / total, "count": total}
         if with_accuracy:
             hs, hc = losses_lib.accuracy(logits, batch["y"],
                                          batch.get("mask"))
             ex_total = lax.psum(hc, TOKEN_AXES)
-            out["accuracy"] = lax.psum(hs, TOKEN_AXES) / ex_total
+            acc = lax.psum(hs, TOKEN_AXES) / ex_total
+            if use_seq:
+                acc = lax.pmean(acc, seq_axis)
+            out["accuracy"] = acc
             out["example_count"] = ex_total
         return out
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     pspecs = moe_param_specs(dummy)
-    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    batch_specs = _moe_batch_specs(batch_keys, TOKEN_AXES,
+                                   seq_axis if use_seq else None)
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,
         in_specs=(pspecs, batch_specs),

@@ -31,16 +31,19 @@ Pytree = Any
 Batch = Dict[str, jax.Array]
 
 
-def batch_specs(batch: Batch, seq_axis: Optional[str]) -> Dict[str, P]:
-    """Per-leaf PartitionSpecs: dim 0 over the data axes; dim 1 over 'seq'
-    for rank>=2 leaves when sequence parallelism is on; mask stays dim-0."""
+def batch_specs(batch: Batch, seq_axis: Optional[str],
+                batch_axes: Tuple[str, ...] = DATA_AXES) -> Dict[str, P]:
+    """Per-leaf PartitionSpecs: dim 0 over ``batch_axes``; dim 1 over 'seq'
+    for rank>=2 leaves when sequence parallelism is on; mask stays dim-0.
+    The MoE layouts pass ``batch_axes`` including 'expert' (the expert
+    axis carries batch rows too — parallel.expert.TOKEN_AXES)."""
     specs = {}
     for k, v in batch.items():
         ndim = getattr(v, "ndim", len(getattr(v, "shape", ())))
         if k == "mask" or ndim < 2 or not seq_axis:
-            specs[k] = P(DATA_AXES)
+            specs[k] = P(batch_axes)
         else:
-            specs[k] = P(DATA_AXES, seq_axis)
+            specs[k] = P(batch_axes, seq_axis)
     return specs
 
 
@@ -116,8 +119,9 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
-def place_batch(mesh: Mesh, batch: Batch, seq_axis: Optional[str]) -> Batch:
-    specs = batch_specs(batch, seq_axis)
+def place_batch(mesh: Mesh, batch: Batch, seq_axis: Optional[str],
+                batch_axes: Tuple[str, ...] = DATA_AXES) -> Batch:
+    specs = batch_specs(batch, seq_axis, batch_axes)
     return {k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
             for k, v in batch.items()}
 

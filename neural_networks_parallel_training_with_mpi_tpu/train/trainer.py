@@ -48,12 +48,16 @@ class Trainer:
         #                          matmuls + ring/ulysses attention)
         #   expert x tensor     -> parallel.expert moe_tp shard_map (Megatron
         #                          attention + tensor-sharded experts)
+        #   seq x expert        -> parallel.expert shard_map with seq_axis
+        #                          (ring attention + all_to_all experts)
         fsdp_on = self.mesh.shape.get("fsdp", 1) > 1
         self.sp_tp = (self.seq_parallel and self.tensor
                       and not (self.pipeline or self.expert or fsdp_on))
         self.ep_tp = (self.expert and self.tensor
                       and not (self.pipeline or self.seq_parallel
                                or fsdp_on))
+        self.sp_ep = (self.seq_parallel and self.expert
+                      and not (self.pipeline or self.tensor or fsdp_on))
         self.gspmd = (not self.pipeline and not self.sp_tp and not self.ep_tp
                       and (self.tensor or fsdp_on))
         unwired = [name for name, on in
@@ -65,15 +69,17 @@ class Trainer:
                 f"pipe composes with data + tensor axes; got pipe x "
                 f"{unwired} — compose parallel.* step builders directly")
         exclusive = [name for name, on in
-                     (("seq", self.seq_parallel and not self.sp_tp),
+                     (("seq", self.seq_parallel and not self.sp_tp
+                       and not self.sp_ep),
                       ("tensor/fsdp", self.gspmd),
-                      ("expert", self.expert and not self.ep_tp)) if on]
+                      ("expert", self.expert and not self.ep_tp
+                       and not self.sp_ep)) if on]
         if len(exclusive) > 1:
             raise NotImplementedError(
                 f"wired combinations: one of seq/tensor/fsdp/expert alone, "
-                f"pipe x tensor, seq x tensor, or expert x tensor (all x "
-                f"data); got {exclusive} — compose parallel.* step builders "
-                "directly for other mixes")
+                f"pipe x tensor, seq x tensor, seq x expert, or expert x "
+                f"tensor (all x data); got {exclusive} — compose parallel.* "
+                "step builders directly for other mixes")
         if self.pipeline and cfg.model.arch != "transformer":
             raise ValueError("pipe axis > 1 requires the transformer model")
         if self.expert and (cfg.model.arch != "transformer"
@@ -223,9 +229,11 @@ class Trainer:
         elif self.expert:
             from ..parallel import expert as ep_lib
 
+            moe_seq = "seq" if self.sp_ep else None
             moe_step = ep_lib.make_moe_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
-                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
+                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps,
+                seq_axis=moe_seq)
 
             def train_step(state, batch):
                 state, metrics = moe_step(state, batch)
@@ -234,7 +242,8 @@ class Trainer:
             self.train_step = train_step
             self.eval_step = ep_lib.make_moe_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
-                with_accuracy=(cfg.loss == "cross_entropy"))
+                with_accuracy=(cfg.loss == "cross_entropy"),
+                seq_axis=moe_seq)
         elif self.sp_tp:
             from ..parallel import spmd
 

@@ -286,3 +286,57 @@ def test_ep_tp_grad_clip_and_accum_run():
         state, metrics = step(state, placed)
     assert float(metrics["loss"]) < float(first["loss"])
     assert np.isfinite(float(metrics["aux"]))
+
+
+def test_seq_expert_parallel_matches_dense():
+    """One DP x SP x EP train step == single-device dense-MoE step: ring
+    attention over 'seq' composed with all_to_all expert dispatch.
+    Generous capacity (no drops) and aux_weight=0, as in the other
+    layout-parity pins; ring's online softmax reassociates f32 sums, so
+    tolerances match the ring-attention parity tests."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    rows = 8
+    capacity = rows * T  # no drops on any shard grouping
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=2, seq=2, expert=2), devices=devs)
+    model_sp = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="ring", moe_experts=E, moe_capacity=capacity,
+        moe_expert_axis="expert"))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows)
+
+    state = TrainState.create(model_sp, opt, prng.init_key(0))
+    state = ep.shard_moe_state(state, mesh, opt)
+    placed = {}
+    for k, v in batch.items():
+        spec = (P(ep.TOKEN_AXES, "seq") if k != "mask"
+                else P(ep.TOKEN_AXES))
+        placed[k] = jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec))
+    step = ep.make_moe_train_step(model_sp, opt, mesh, aux_weight=0.0,
+                                  donate=False, seq_axis="seq")
+    state, metrics = step(state, placed)
+
+    model_dense = moe_model(expert_axis=None, capacity=capacity)
+    params = model_dense.init(prng.init_key(0))
+
+    def scalar(p):
+        logits = model_dense.apply(p, jnp.asarray(batch["x"]))
+        s, c = losses.softmax_cross_entropy(
+            logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+        return s / c, s / c
+
+    (loss_ref, _), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    ref_params, _ = opt.update(grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=2e-4, atol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        jax.device_get(state.params), jax.device_get(ref_params))

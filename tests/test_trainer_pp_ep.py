@@ -67,11 +67,10 @@ def test_trainer_rejects_unwired_mixed_styles():
                                     moe_expert_axis="expert")
     with pytest.raises(NotImplementedError, match="pipe composes with"):
         Trainer(cfg)
-    # seq x tensor is wired since round 2 (parallel.spmd sp_tp); seq x
-    # expert remains an unwired mix
-    cfg2 = _lm_cfg(data=2, seq=2, expert=2)
-    cfg2.model = dataclasses.replace(cfg2.model, moe_experts=4,
-                                     moe_expert_axis="expert")
+    # seq x tensor, seq x expert, and expert x tensor are wired (round 2);
+    # seq x fsdp remains an unwired mix
+    cfg2 = _lm_cfg(data=2, seq=2, fsdp=2)
+    cfg2.model = dataclasses.replace(cfg2.model, attention="ring")
     with pytest.raises(NotImplementedError, match="wired combinations"):
         Trainer(cfg2)
 
@@ -176,3 +175,18 @@ def test_trainer_expert_tensor_end_to_end():
     for a, b in zip(jax.tree_util.tree_leaves(got),
                     jax.tree_util.tree_leaves(dense)):
         assert a.shape == b.shape
+
+
+def test_trainer_seq_expert_end_to_end():
+    """SP x EP through the Trainer: ring attention over 'seq' composed with
+    the all_to_all expert dispatch — long-context MoE."""
+    cfg = _lm_cfg(data=2, seq=2, expert=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    moe_expert_axis="expert",
+                                    attention="ring")
+    t = Trainer(cfg)
+    assert t.sp_ep and t.expert and t.seq_parallel and not t.gspmd
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+    assert "val_accuracy" in result
