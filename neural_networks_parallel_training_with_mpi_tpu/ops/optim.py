@@ -229,10 +229,14 @@ def adafactor(lr: LR, b1: float = 0.0, decay_pow: float = 0.8,
 
     Sharding: factored stats are means over the factored (last two) dims,
     so they are exact under GSPMD global-view layouts and under shard_map
-    layouts that shard only LEADING dims (DP replication, the expert
-    axis); the explicit TP layouts that slice inside matrices
-    (pipeline / seq x tensor / expert x tensor) would make the factor
-    means shard-local — the Trainer rejects those combinations."""
+    layouts that replicate every leaf (plain DP).  Layouts that slice
+    *inside* matrices (pipeline / seq x tensor / expert x tensor) make the
+    factor means shard-local, and even the leading-dim expert slicing is
+    not exact: the update-RMS clip and ``multiply_by_parameter_scale``
+    RMS(p) are means over the WHOLE leaf, so on an expert-sharded stack
+    they cover only the local expert slice (EP-degree-dependent), and a
+    2-D expert-stacked bias (E, f) has its column factor averaged over the
+    sharded E dim.  The Trainer rejects all of these combinations."""
 
     def _factored(p) -> bool:
         return jnp.ndim(p) >= 2
@@ -309,9 +313,10 @@ def adafactor(lr: LR, b1: float = 0.0, decay_pow: float = 0.8,
 
         if params is None:
             raise ValueError(
-                "adafactor's state layout depends on param shapes; this "
-                "path passes no param tree (zero1's flat buffer cannot "
-                "carry factored stats) — use sgd/adam/adamw/lion here")
+                "adafactor's state layout depends on param shapes, but this "
+                "caller passed no param tree to state_specs (the zero1 flat "
+                "buffer and the pipeline spec paths call it one-arg) — use "
+                "sgd/adam/adamw/lion on those layouts")
         is_p = lambda x: isinstance(x, P)
         tm = lambda f: jax.tree_util.tree_map(f, ps, params, is_leaf=is_p)
 

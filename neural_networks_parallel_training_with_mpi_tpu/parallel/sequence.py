@@ -71,8 +71,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     accumulator ``o``.  Each of the S ring steps processes the K/V block that
     currently resides on this device, then rotates K/V one hop so every device
     sees every block after S steps.  Communication is S-1 ppermutes of one
-    local K/V block — no all-gather of the full sequence, which is what makes
-    context length scale linearly in devices.
+    local K/V block (the final block's compute is hoisted out of the scan so
+    no rotate-back hop is emitted) — no all-gather of the full sequence,
+    which is what makes context length scale linearly in devices.
     """
     b, t_local, h, d = q.shape
     scale = scale if scale is not None else d ** -0.5
@@ -84,8 +85,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
     o0 = jnp.zeros((b, t_local, h, d), jnp.float32)
 
-    def step(carry, step_idx):
-        m, l, o, k_blk, v_blk = carry
+    def merge(m, l, o, k_blk, v_blk, step_idx):
         # the block currently on this device originated at ring position:
         blk_idx = (my_idx + step_idx) % s
         k_pos = blk_idx * t_local + jnp.arange(t_local)
@@ -102,13 +102,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk,
                         preferred_element_type=jnp.float32)
         new_o = o * correction.transpose(0, 2, 1)[..., None] + pv
+        return new_m, new_l, new_o
+
+    def step(carry, step_idx):
+        m, l, o, k_blk, v_blk = carry
+        new_m, new_l, new_o = merge(m, l, o, k_blk, v_blk, step_idx)
         # rotate K/V to the next device (shift -1 so blk_idx advances by +1)
         perm = [(i, (i - 1) % s) for i in range(s)]
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
         return (new_m, new_l, new_o, k_next, v_next), None
 
-    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, k, v), jnp.arange(s))
+    # scan the first s-1 blocks (compute + rotate); the last resident block
+    # is merged outside the scan — its rotate-back hop would carry data no
+    # step ever reads
+    (m, l, o, k_last, v_last), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(s - 1))
+    m, l, o = merge(m, l, o, k_last, v_last, s - 1)
     l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (none in causal LM) -> 0 output
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -158,6 +168,18 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     plain JAX, so autodiff drives the kernel's custom backward
     (flash_attention_with_lse) per block.
 
+    The skip saves FLOPs, not ICI bandwidth: ``ppermute`` is collective
+    and uniform, so in the causal case a block still rides the ring
+    through ranks that will skip it (about half of all hops carry a
+    block its host never uses; rank s-1 needs every block, so the ring
+    cannot simply stop early).  The one universally dead hop — the final
+    iteration's rotate-back — is elided by hoisting the last block's
+    compute out of the scan.  Rerouting the causal dead hops would need a
+    per-step partial permutation schedule (s compiled variants); at the
+    ring sizes this framework targets the dead-hop cost is one K/V block
+    per step on neighbor ICI links that the skipped compute leaves idle
+    anyway, so the added compile complexity is not paid here.
+
     ``scale`` must be None/default: the kernel pins 1/sqrt(Dh).
     """
     b, t_local, h, d = q.shape
@@ -181,8 +203,7 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return (jnp.zeros_like(q),
                 jnp.full((b * h, t_local), NEG_INF, jnp.float32))
 
-    def step(carry, step_idx):
-        o, lse, k_blk, v_blk = carry
+    def merge(o, lse, k_blk, v_blk, step_idx):
         blk_idx = (my_idx + step_idx) % s
         if causal:
             case = jnp.where(blk_idx == my_idx, 1,
@@ -201,6 +222,11 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
         new_o = rowscale(o, w_old) + rowscale(out_b.astype(jnp.float32),
                                               w_new)
+        return new_o, new_lse
+
+    def step(carry, step_idx):
+        o, lse, k_blk, v_blk = carry
+        new_o, new_lse = merge(o, lse, k_blk, v_blk, step_idx)
         perm = [(i, (i - 1) % s) for i in range(s)]
         k_next = lax.ppermute(k_blk, axis, perm)
         v_next = lax.ppermute(v_blk, axis, perm)
@@ -208,7 +234,11 @@ def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     o0 = jnp.zeros(q.shape, jnp.float32)
     lse0 = jnp.full((b * h, t_local), NEG_INF, jnp.float32)
-    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(s))
+    # first s-1 blocks scan (compute + rotate); the final block merges
+    # outside the scan, eliding its dead rotate-back hop (docstring)
+    (o, lse, k_last, v_last), _ = lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(s - 1))
+    o, _ = merge(o, lse, k_last, v_last, s - 1)
     return o.astype(q.dtype)
 
 

@@ -99,15 +99,18 @@ class Trainer:
                 "on the seq x tensor path (--sp > 1 and --tp > 1); other "
                 "layouts keep them replicated")
         if (cfg.optimizer == "adafactor"
-                and (self.pipeline or self.sp_tp or self.ep_tp
+                and (self.pipeline or self.sp_tp or self.expert
                      or cfg.update_sharding == "zero1")):
             raise ValueError(
-                "adafactor's factored stats are means over a param's last "
-                "two dims — exact under DP/SP/expert sharding and GSPMD "
-                "global-view layouts, but shard-local (wrong) on layouts "
-                "that slice inside matrices (pipe, seq x tensor, expert x "
-                "tensor) and unrepresentable in zero1's flat state; use "
-                "adam/adamw/lion/sgd there")
+                "adafactor's stats are exact only where every leaf sees its "
+                "full matrix: DP/SP shard_map layouts and GSPMD global-view. "
+                "Layouts that slice inside matrices (pipe, seq x tensor, "
+                "expert x tensor) make the factor means shard-local; the "
+                "expert axis slices the stacked-expert leaves, so the "
+                "update-RMS clip / parameter-scale RMS(p) (whole-leaf "
+                "means) and the (E, f) bias column factor become "
+                "EP-degree-dependent; zero1's flat state cannot carry "
+                "factored stats at all. Use adam/adamw/lion/sgd there")
         if (cfg.model.arch == "transformer"
                 and cfg.model.attention in ("ring", "ring_flash", "ulysses")
                 and not self.seq_parallel):

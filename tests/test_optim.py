@@ -245,6 +245,31 @@ class TestAdafactor:
         with pytest.raises(ValueError, match="adafactor"):
             Trainer(cfg)
 
+    def test_trainer_rejects_expert_axis(self):
+        """The expert axis slices the stacked-expert leaves, making the
+        whole-leaf clip/param-scale RMS terms EP-degree-dependent (advisor
+        r2) — the Trainer rejects the combination up front."""
+        from neural_networks_parallel_training_with_mpi_tpu.config import (
+            DataConfig, MeshConfig, ModelConfig, TrainConfig,
+        )
+        from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+            Trainer,
+        )
+
+        cfg = TrainConfig(
+            nepochs=1, batch_size=32, full_batch=False,
+            loss="cross_entropy", optimizer="adafactor", lr=1e-2,
+            data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=16, moe_experts=4,
+                              moe_expert_axis="expert"),
+            mesh=MeshConfig(data=4, expert=2),
+        )
+        with pytest.raises(ValueError, match="adafactor"):
+            Trainer(cfg)
+
     def test_trains_on_gspmd_fsdp_mesh(self):
         """Factored state shards correctly through the GSPMD path (global
         view — factor means stay exact under any annotation)."""
