@@ -56,6 +56,9 @@ PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
 PROBE_BACKOFF_S = float(os.environ.get("BENCH_PROBE_BACKOFF", "45"))
 TPU_LATEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_TPU_LATEST.json")
+# CPU timing repetitions (min-of-k, both frameworks): the fallback host is a
+# single shared core, so transient load skews any single window by +-10%
+_CPU_TIMING_REPS = 3
 
 # Peak dense bf16 FLOPs/s per chip by device_kind substring (public specs).
 _PEAK_FLOPS = (
@@ -226,7 +229,7 @@ def _chain_sync_every() -> int:
     return 0 if jax.default_backend() == "tpu" else 25
 
 
-def bench_framework(config_name: str) -> dict:
+def bench_framework(config_name: str, batch_override: int | None = None) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -241,6 +244,8 @@ def bench_framework(config_name: str) -> dict:
     from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
     cfg = _make_config(config_name)
+    if batch_override:
+        cfg["batch"] = batch_override
     devices = jax.devices()
     log(f"[{config_name}] devices: {devices}")
     mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)), devices=devices)
@@ -268,17 +273,24 @@ def bench_framework(config_name: str) -> dict:
     # two chain lengths, differenced (see timed_chain).  measure_steps is
     # sized for the TPU; the CPU fallback runs the same workload 1000x
     # slower, so scale the chains down there (it is a smoke/mechanism
-    # number, not the driver's headline)
+    # number, not the driver's headline).  The pair is repeated and the
+    # fastest per-step time kept — min-of-k cancels transient host load
+    # (single shared core); the torch baseline gets the same treatment.
     n1 = cfg["measure_steps"]
     if not on_tpu:
         n1 = max(3, n1 // 4)
     n2 = 3 * n1
-    t1, state, _ = timed_chain(step, state, batch, n1, sync)
-    t2, state, loss_val = timed_chain(step, state, batch, n2, sync)
-    dt = max(t2 - t1, 1e-9)
-    steps = n2 - n1
-    if t2 <= t1:  # noise floor (sub-ms configs on a local backend)
-        dt, steps = t2, n2
+    best_dt, best_steps, loss_val = None, None, None
+    for _rep in range(1 if on_tpu else _CPU_TIMING_REPS):
+        t1, state, _ = timed_chain(step, state, batch, n1, sync)
+        t2, state, loss_val = timed_chain(step, state, batch, n2, sync)
+        dt = max(t2 - t1, 1e-9)
+        steps = n2 - n1
+        if t2 <= t1:  # noise floor (sub-ms configs on a local backend)
+            dt, steps = t2, n2
+        if best_dt is None or dt / steps < best_dt / best_steps:
+            best_dt, best_steps = dt, steps
+    dt, steps = best_dt, best_steps
     sps = batch_size * steps / dt
     step_ms = dt / steps * 1e3
     log(f"[{config_name}] final loss {loss_val:.5f}")
@@ -287,6 +299,8 @@ def bench_framework(config_name: str) -> dict:
     # backward, over every chip's peak.  Single source: Module.fwd_flops.
     fwd = model.fwd_flops(raw_batch["x"].shape)
     train_flops = None if fwd is None else 3.0 * fwd
+    param_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                      for l in jax.tree_util.tree_leaves(state.params))
     kind = devices[0].device_kind
     peak = peak_flops(kind) if on_tpu else None
     mfu = (train_flops / (dt / steps) / (peak * len(devices))
@@ -299,7 +313,7 @@ def bench_framework(config_name: str) -> dict:
         mfu=None if mfu is None else round(mfu, 4),
         platform=devices[0].platform, device_kind=kind,
         n_devices=len(devices), batch=batch_size,
-        train_flops_per_step=train_flops,
+        train_flops_per_step=train_flops, param_bytes=param_bytes,
     )
 
 
@@ -309,11 +323,12 @@ def bench_framework(config_name: str) -> dict:
 # single process, same nominal workload — re-expressed, not copied.
 # ---------------------------------------------------------------------------
 
-def bench_reference_baseline(config_name: str) -> float:
+def bench_reference_baseline(config_name: str,
+                             batch_override: int | None = None) -> float:
     import torch
 
     cfg = _make_config(config_name)
-    B = cfg["batch"]
+    B = batch_override or cfg["batch"]
     torch.manual_seed(0)
 
     def mlp(dims):
@@ -394,13 +409,17 @@ def bench_reference_baseline(config_name: str) -> float:
 
     one_step()  # warmup
     steps = cfg["baseline_steps"]
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        one_step()
-    dt = time.perf_counter() - t0
+    dt = None
+    for _rep in range(_CPU_TIMING_REPS):  # min-of-k, same as the framework
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            one_step()
+        dt = (time.perf_counter() - t0 if dt is None
+              else min(dt, time.perf_counter() - t0))
     sps = B * steps / dt
-    log(f"[{config_name}] reference baseline (torch cpu): {steps} steps in "
-        f"{dt:.3f}s -> {sps:,.0f} samples/sec")
+    log(f"[{config_name}] reference baseline (torch cpu): best of "
+        f"{_CPU_TIMING_REPS}x{steps} steps: {dt:.3f}s -> "
+        f"{sps:,.0f} samples/sec")
     return sps
 
 
@@ -413,17 +432,17 @@ def bench_reference_baseline(config_name: str) -> float:
 # ---------------------------------------------------------------------------
 
 def _run_child_cpu(config: str, n_devices: int = 1,
-                   baseline: bool = False, timeout: float = 900) -> dict | None:
+                   baseline: bool = False, timeout: float = 900,
+                   batch: int | None = None) -> dict | None:
     """Run one bench config in a CPU-pinned subprocess; return its JSON
     record (or None on failure).  A subprocess is required both for the
     mesh-size sweep (XLA device count is fixed at backend init) and for the
     accelerator-failure fallback (a process whose backend already
     initialized cannot switch platforms)."""
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    plat.force_host_device_count(n_devices, env=env)
+    env = _cpu_child_env(n_devices)
     cmd = [sys.executable, __file__, "--config", config, "--platform", "cpu"]
+    if batch:
+        cmd += ["--batch", str(batch)]
     if not baseline:
         cmd.append("--no-baseline")
     try:
@@ -443,34 +462,72 @@ def _run_child_cpu(config: str, n_devices: int = 1,
     return None
 
 
-def run_scaling_sweep(out_path: str = "BENCH_SCALING.json") -> None:
+def run_scaling_sweep(out_path: str = "BENCH_SCALING.json",
+                      per_device_batch: int = 1024) -> None:
+    """WEAK scaling on the virtual-CPU mesh: fixed per-device batch, 1->8
+    devices, so total work grows with n and the interesting number is the
+    work-normalized step-time inflation t_n / (n * t_1).  On this host all
+    virtual devices share ONE core, so ideal weak scaling is t_n = n * t_1
+    exactly; anything beyond 1.0 isolates the cost the framework ADDS when
+    the mesh grows — batch partitioning, the per-device gradient psum
+    (ring-allreduce bytes reported analytically per device), and XLA:CPU's
+    collective rendezvous.  This replaces the earlier strong-scaling sweep,
+    whose 8-devices-on-1-core efficiency number measured core contention,
+    not the framework (VERDICT r2 item 6)."""
     results = []
     for n in (1, 2, 4, 8):
-        rec = _run_child_cpu("wide", n_devices=n)
+        rec = _run_child_cpu("wide", n_devices=n, batch=per_device_batch * n)
         if rec is None:
             continue
         rec["n_devices"] = n
+        rec["per_device_batch"] = per_device_batch
+        pb = rec.get("param_bytes")
+        # ring all-reduce moves 2(n-1)/n * bytes per device per step
+        rec["allreduce_bytes_per_device"] = (
+            None if pb is None else int(2 * (n - 1) / n * pb))
         results.append(rec)
-        log(f"[scaling n={n}] {rec['value']:,.0f} samples/sec")
-    base = next((r["value"] for r in results if r["n_devices"] == 1), None)
+        log(f"[weak-scaling n={n}] {rec['step_ms']:.1f} ms/step "
+            f"(global batch {per_device_batch * n})")
+    base = next((r["step_ms"] for r in results if r["n_devices"] == 1), None)
     if base:
         for rec in results:
-            rec["efficiency_vs_1dev"] = round(
-                rec["value"] / (base * rec["n_devices"]), 3)
+            infl = rec["step_ms"] / (base * rec["n_devices"])
+            rec["work_normalized_inflation"] = round(infl, 3)
+            rec["framework_overhead_pct"] = round((infl - 1.0) * 100, 1)
+    ncpu = os.cpu_count() or 1
+    note = ("fixed per-device batch on 1..8 virtual CPU devices sharing "
+            f"{ncpu} host core(s): with one core, ideal is step_ms = n * "
+            "t_1 and work_normalized_inflation - 1 isolates partitioning + "
+            "collective overhead added by the framework")
+    if ncpu > 1:
+        note += ("; CAUTION: with multiple cores virtual devices run "
+                 "partly in parallel, deflating the inflation metric below "
+                 "its single-core meaning")
+    note += " (chip-count scaling needs real chips)"
     if results:
         with open(out_path, "w") as f:
-            json.dump({"config": "wide", "note":
-                       "virtual CPU devices share one host's cores; "
-                       "mechanism check, not chip scaling", "results": results},
-                      f, indent=2)
-        log(f"scaling sweep -> {out_path}")
+            json.dump({
+                "config": "wide", "mode": "weak_scaling",
+                "host_cpu_count": ncpu, "note": note,
+                "results": results}, f, indent=2)
+        log(f"weak-scaling sweep -> {out_path}")
 
 
 def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
-    """Flash (Pallas, fwd + Mosaic bwd kernels) vs dense (XLA) attention:
-    full train-step time on the tiny-LM config at growing sequence lengths.
-    Flash's advantage is O(T) memory and skipped above-diagonal blocks, so
-    the gap should widen with T (VERDICT r1 item 5's comparison)."""
+    """Attention implementation comparison, two parts (VERDICT r2 item 3):
+
+    1. **dense vs flash** (Pallas fwd + Mosaic bwd kernels) — full
+       train-step time at growing sequence lengths.  On TPU the kernels are
+       compiled and this is the real number; on the CPU fallback flash runs
+       in Pallas *interpret mode* at one short length — timings there
+       measure the emulation (marked ``interpret_mode: true``), but both
+       columns are filled so the comparison machinery itself is proven.
+    2. **ring vs ring_flash** — the same comparison with the sequence
+       sharded over a 'seq' mesh axis (ring attention, with the local block
+       compute dense or the Pallas kernel).  Needs >= 2 devices, so on a
+       single-chip TPU these rows record a skip reason; the CPU fallback
+       runs them on the virtual multi-device mesh.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -483,54 +540,134 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
         data_parallel as dp,
         mesh as mesh_lib,
         sharding as shd,
+        spmd,
     )
     from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
     from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
     devices = jax.devices()
-    mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)), devices=devices)
+    n_dev = len(devices)
     on_tpu = devices[0].platform not in ("cpu",)
     cd = jnp.bfloat16 if on_tpu else jnp.float32
+    rng = np.random.default_rng(0)
+    sync = _chain_sync_every()
+
+    def lm_cfg(seq, att, n_layers=2):
+        return TransformerConfig(
+            vocab_size=2048, max_seq_len=seq, n_layers=n_layers,
+            d_model=256 if on_tpu else 128, n_heads=8, d_ff=1024 if on_tpu
+            else 256, attention=att, compute_dtype=cd)
+
+    def time_step(step, state, batch, n1, n2):
+        _, state, _ = timed_chain(step, state, batch, 2, sync)  # compile
+        t1, state, _ = timed_chain(step, state, batch, n1, sync)
+        t2, state, _ = timed_chain(step, state, batch, n2, sync)
+        return round(max(t2 - t1, 1e-9) / (n2 - n1) * 1e3, 3)
+
     results = []
-    # CPU: dense-only mechanism smoke at one short length (flash reports
-    # null there — interpret mode is not a perf number); TPU: the real sweep
-    n_dev = len(devices)
-    for seq in ((256, 512, 1024) if on_tpu else (256,)):
-        b = max(1, (8192 if on_tpu else 512) // seq)
+    # ---- part 1: dense vs flash (DP mesh, full local sequence) ----
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n_dev), devices=devices)
+    n1, n2 = (10, 30) if on_tpu else (2, 6)
+    for seq in ((256, 512, 1024) if on_tpu else (128,)):
+        b = max(1, (8192 if on_tpu else 256) // seq)
         b = ((b + n_dev - 1) // n_dev) * n_dev  # rows divide the data axes
-        row = {"seq": seq, "batch": b}
+        row = {"seq": seq, "batch": b, "mode": "dense_vs_flash"}
+        if not on_tpu:
+            row["interpret_mode"] = True  # flash = Pallas emulation on CPU
         for att in ("dense", "flash"):
-            if att == "flash" and not on_tpu:
-                row["flash_ms"] = None  # interpret mode: not a perf number
-                continue
-            model = Transformer(TransformerConfig(
-                vocab_size=2048, max_seq_len=seq, n_layers=2, d_model=256,
-                n_heads=8, d_ff=1024, attention=att, compute_dtype=cd))
+            model = Transformer(lm_cfg(seq, att))
             opt = optim.sgd(lr=1e-4, momentum=0.9)
             state = dp.replicate_state(
                 TrainState.create(model, opt, prng.init_key(0)), mesh)
             step = dp.make_train_step(model, opt, mesh, "cross_entropy",
                                       "global_mean")
-            rng = np.random.default_rng(0)
             batch = shd.shard_batch(mesh, {
                 "x": rng.integers(0, 2048, (b, seq)).astype(np.int32),
                 "y": rng.integers(0, 2048, (b, seq)).astype(np.int32),
                 "mask": np.ones((b,), np.float32)})
-
-            sync = _chain_sync_every()
-            _, state, _ = timed_chain(step, state, batch, 3, sync)  # compile
-            t1, state, _ = timed_chain(step, state, batch, 10, sync)
-            t2, state, _ = timed_chain(step, state, batch, 30, sync)
-            row[f"{att}_ms"] = round(max(t2 - t1, 1e-9) / 20 * 1e3, 3)
+            row[f"{att}_ms"] = time_step(step, state, batch, n1, n2)
         if row.get("dense_ms") and row.get("flash_ms"):
             row["flash_speedup"] = round(row["dense_ms"] / row["flash_ms"], 3)
         log(f"[attention] {row}")
         results.append(row)
+
+    # ---- part 2: ring vs ring_flash (sequence sharded over 'seq') ----
+    sp = min(4, n_dev)
+    if sp < 2:
+        results.append({"mode": "ring_vs_ring_flash", "skipped":
+                        f"needs >= 2 devices for the 'seq' axis, have "
+                        f"{n_dev} (single tunneled chip)"})
+    else:
+        seq = 1024 if on_tpu else 256
+        b = 4 if on_tpu else 2
+        smesh = mesh_lib.make_mesh(MeshConfig(data=1, seq=sp),
+                                   devices=devices[:sp])
+        row = {"seq": seq, "batch": b, "seq_shards": sp,
+               "mode": "ring_vs_ring_flash"}
+        if not on_tpu:
+            row["interpret_mode"] = True
+        for att in ("ring", "ring_flash"):
+            model = Transformer(lm_cfg(seq, att))
+            opt = optim.sgd(lr=1e-4, momentum=0.9)
+            state = jax.device_put(
+                TrainState.create(model, opt, prng.init_key(0)),
+                jax.sharding.NamedSharding(
+                    smesh, jax.sharding.PartitionSpec()))
+            placed = spmd.place_batch(smesh, {
+                "x": rng.integers(0, 2048, (b, seq)).astype(np.int32),
+                "y": rng.integers(0, 2048, (b, seq)).astype(np.int32),
+                "mask": np.ones((b,), np.float32)}, "seq")
+            step = spmd.make_spmd_train_step(
+                model, opt, smesh, "cross_entropy", seq_axis="seq",
+                donate=False, example_batch=placed)
+            row[f"{att}_ms"] = time_step(step, state, placed, n1, n2)
+        if row.get("ring_ms") and row.get("ring_flash_ms"):
+            row["ring_flash_speedup"] = round(
+                row["ring_ms"] / row["ring_flash_ms"], 3)
+        log(f"[attention] {row}")
+        results.append(row)
+
     with open(out_path, "w") as f:
         json.dump({"platform": devices[0].platform,
                    "device_kind": devices[0].device_kind,
+                   "note": ("compiled kernels" if on_tpu else
+                            "CPU fallback: flash/ring_flash run in Pallas "
+                            "interpret mode — fills the comparison columns "
+                            "but measures the emulation, not kernel perf"),
                    "results": results}, f, indent=2)
     log(f"attention comparison -> {out_path}")
+
+
+def _cpu_child_env(n_devices: int) -> dict:
+    """The one place the CPU-child launch env is assembled (plugin env
+    stripping + platform pin + virtual device count) — every bench child
+    (scaling sweep, fallback retry, attention) goes through it."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    plat.force_host_device_count(n_devices, env=env)
+    return env
+
+
+def _run_attention_cpu_child(timeout: float = 1800) -> None:
+    """Run the attention comparison in a CPU child with 4 virtual devices:
+    the fallback parent has a single device, but the ring rows need a real
+    'seq' axis to rotate around."""
+    env = _cpu_child_env(4)
+    cmd = [sys.executable, __file__, "--attention-inproc",
+           "--platform", "cpu"]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                             timeout=timeout)
+    except subprocess.TimeoutExpired:
+        log(f"[attention child] timed out after {timeout:.0f}s")
+        return
+    if out.returncode != 0:
+        log(f"[attention child] FAILED:\n{out.stderr[-2000:]}")
+    else:
+        for line in out.stderr.strip().splitlines():
+            if "[attention]" in line or "->" in line:
+                log(line)
 
 
 def resolve_platform(requested: str) -> tuple[str, list]:
@@ -557,9 +694,14 @@ def resolve_platform(requested: str) -> tuple[str, list]:
             return "accel", history
         rec["outcome"] = ("cpu_only" if info else "timeout_or_error")
         history.append(rec)
-        if info is not None:
-            # a definitive cpu-only answer is an accelerator-less machine,
-            # not a wedged tunnel — no point burning the backoff schedule
+        # a cpu answer is definitive ("accelerator-less machine, stop
+        # probing") ONLY when no TPU-tunnel plugin is configured in the
+        # environment; with a tunnel configured, a fast cpu answer means
+        # the plugin errored at init (tunnel endpoint restarting) and may
+        # recover within the backoff window
+        tunnel_configured = ("PALLAS_AXON_POOL_IPS" in os.environ
+                             or "axon" in os.environ.get("JAX_PLATFORMS", ""))
+        if info is not None and not tunnel_configured:
             break
         if attempt < PROBE_ATTEMPTS:
             pause = attempt * PROBE_BACKOFF_S
@@ -616,15 +758,21 @@ def load_tpu_latest() -> dict | None:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", choices=sorted(METRIC_NAMES), default="wide")
+    ap.add_argument("--batch", type=int, default=0,
+                    help="override the config's global batch size "
+                         "(weak-scaling children use this)")
     ap.add_argument("--platform", choices=["auto", "cpu", "tpu"], default="auto")
     ap.add_argument("--all", action="store_true",
                     help="bench every config (BASELINE.json's five + the "
                          "moe extra), write BENCH_FULL.json")
     ap.add_argument("--scaling", action="store_true",
-                    help="1..8 virtual-device sweep, write BENCH_SCALING.json")
+                    help="weak-scaling sweep (fixed per-device batch, 1..8 "
+                         "virtual devices), write BENCH_SCALING.json")
     ap.add_argument("--attention", action="store_true",
-                    help="flash vs dense attention step-time comparison, "
-                         "write BENCH_ATTENTION.json")
+                    help="flash vs dense and ring vs ring_flash step-time "
+                         "comparison, write BENCH_ATTENTION.json")
+    ap.add_argument("--attention-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     args = ap.parse_args()
@@ -637,8 +785,17 @@ def main() -> int:
     if choice == "cpu":
         plat.pin("cpu")
 
-    if args.attention:  # after platform resolution: touches the backend
+    if args.attention_inproc:  # child entry: write the artifact and exit
         bench_attention()
+        print(json.dumps({"attention_artifact": "BENCH_ATTENTION.json"}))
+        return 0
+
+    if args.attention:  # after platform resolution: touches the backend
+        if choice == "cpu":
+            # the fallback parent has ONE device; ring needs a 'seq' axis
+            _run_attention_cpu_child()
+        else:
+            bench_attention()
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
     if args.all and choice == "cpu" and "moe" in configs:
@@ -650,7 +807,7 @@ def main() -> int:
     records = []
     for name in configs:
         try:
-            fw = bench_framework(name)
+            fw = bench_framework(name, batch_override=args.batch or None)
         except Exception as e:  # noqa: BLE001 — keep the harness alive
             log(f"[{name}] framework bench FAILED: {type(e).__name__}: {e}")
             if name == "moe":
@@ -679,7 +836,8 @@ def main() -> int:
             continue
         baseline_sps = None
         if not args.no_baseline:
-            baseline_sps = bench_reference_baseline(name)
+            baseline_sps = bench_reference_baseline(
+                name, batch_override=args.batch or None)
         records.append({
             "metric": METRIC_NAMES[name],
             "value": round(fw["samples_per_sec"], 1),
@@ -691,6 +849,8 @@ def main() -> int:
             "n_devices": fw["n_devices"],
             "mfu": fw["mfu"],
             "step_ms": round(fw["step_ms"], 3),
+            "batch": fw["batch"],
+            "param_bytes": fw["param_bytes"],
         })
 
     if args.all:
