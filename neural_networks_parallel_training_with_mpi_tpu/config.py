@@ -177,6 +177,11 @@ class TrainConfig:
     # microbatch gradient accumulation inside the jitted step (DP path);
     # 1 = off.  One accumulated update = one optimizer step.
     accum_steps: int = 1
+    # virtual stage-slices per pipeline device (interleaved schedule,
+    # parallel.pipeline): bubble fraction (pp-1)/(v*M + pp-1) instead of
+    # (pp-1)/(M + pp-1) at constant microbatch count; costs v ppermute
+    # hops per microbatch.  Requires n_layers % (v * pp) == 0; tp must be 1.
+    pp_interleave: int = 1
     loss: str = "mse"          # mse | cross_entropy
     # mix the one-hot CE target with uniform: (1-s)*onehot + s/C.  Applies
     # to the TRAIN loss only (validation reports the unsmoothed loss)
@@ -276,6 +281,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="global-norm gradient clipping (0 = off)")
     p.add_argument("--accum_steps", type=int, default=1,
                    help="microbatch gradient-accumulation factor (DP path)")
+    p.add_argument("--pp_interleave", type=int, default=1,
+                   help="virtual stage-slices per pipeline device "
+                        "(interleaved schedule: bubble / v at constant "
+                        "microbatch count; needs n_layers %% (v*pp) == 0)")
     p.add_argument("--loss", choices=["mse", "cross_entropy"], default="mse")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="CE target smoothing s: (1-s)*onehot + s/C "
@@ -416,6 +425,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         min_lr=args.min_lr,
         grad_clip=args.grad_clip,
         accum_steps=args.accum_steps,
+        pp_interleave=args.pp_interleave,
         loss=args.loss, label_smoothing=args.label_smoothing,
         grad_reduction=args.grad_reduction,
         update_sharding=args.update_sharding,

@@ -316,11 +316,14 @@ def generate_tp(model: Transformer, params, prompt, mesh,
     return run(params, prompt, prompt_lens, key)
 
 
-def pipeline_params_for_decode(params, model: Transformer):
+def pipeline_params_for_decode(params, model: Transformer,
+                               interleave: int = 1):
     """(stage, layer)-stacked pipeline params -> the per-layer list layout
-    :func:`generate_tp` consumes.  Plain jnp ops on the sharded arrays:
-    XLA reshards device-to-device (the pipe-sharded stack redistributes to
-    the tensor/replicated decode placement inside ``generate_tp``'s
+    :func:`generate_tp` consumes (``interleave`` must match the training
+    config's ``pp_interleave`` — the stack gains a leading virtual-stage
+    axis there).  Plain jnp ops on the sharded arrays: XLA reshards
+    device-to-device (the pipe-sharded stack redistributes to the
+    tensor/replicated decode placement inside ``generate_tp``'s
     device_put); no single-host gather (``Trainer._eval_params``) on the
     path.  The qkv head-alignment convention is shared between the
     pipeline and sp_tp layouts, so with the same tp degree the unstacked
@@ -328,5 +331,12 @@ def pipeline_params_for_decode(params, model: Transformer):
     from ..parallel.pipeline import unstack_blocks
 
     out = dict(params)
-    out["blocks"] = unstack_blocks(params["blocks"])
+    out["blocks"] = unstack_blocks(
+        params["blocks"], stack_ndims=3 if interleave > 1 else 2)
+    n_layers = model.cfg.n_layers
+    if len(out["blocks"]) != n_layers:
+        raise ValueError(
+            f"unstacked {len(out['blocks'])} layers but the model has "
+            f"{n_layers} — does `interleave={interleave}` match the "
+            "checkpoint's pp_interleave?")
     return out

@@ -128,6 +128,10 @@ class Trainer:
         if self.zero1 and cfg.grad_reduction != "global_mean":
             raise ValueError("update_sharding='zero1' implies global_mean "
                              "gradient semantics")
+        if cfg.pp_interleave > 1 and not self.pipeline:
+            raise ValueError("--pp_interleave needs the pipeline layout "
+                             "(--pp > 1); it schedules virtual stage-slices "
+                             "per pipeline device")
         if cfg.hang_timeout and not cfg.log_every:
             raise ValueError(
                 "--hang_timeout needs log_every > 0: the periodic loss "
@@ -205,7 +209,7 @@ class Trainer:
             self.train_step = pp.make_pipeline_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 n_microbatches=n_stages * cfg.accum_steps,
-                grad_clip=cfg.grad_clip)
+                grad_clip=cfg.grad_clip, interleave=cfg.pp_interleave)
             # eval runs the ring schedule forward-only on the pipe-sharded
             # params in place — multi-host safe, no host gather
             # natural microbatch count: accumulation is a gradient-only
@@ -213,7 +217,8 @@ class Trainer:
             # waste on small validation batches
             self.eval_step = pp.make_pipeline_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
-                with_accuracy=(cfg.loss == "cross_entropy"))
+                with_accuracy=(cfg.loss == "cross_entropy"),
+                interleave=cfg.pp_interleave)
         elif self.ep_tp:
             from ..parallel import expert as ep_lib
 
@@ -312,9 +317,11 @@ class Trainer:
             state = pp.init_pipeline_state(
                 self.model, self.optimizer, prng.init_key(self.cfg.seed),
                 int(self.mesh.shape["pipe"]),
-                tp=int(self.mesh.shape.get("tensor", 1)))
-            self.state = pp.shard_pipeline_state(state, self.mesh,
-                                                 self.optimizer)
+                tp=int(self.mesh.shape.get("tensor", 1)),
+                interleave=self.cfg.pp_interleave)
+            self.state = pp.shard_pipeline_state(
+                state, self.mesh, self.optimizer,
+                interleave=self.cfg.pp_interleave)
             return self.state
         if self.zero1:
             import jax.numpy as jnp
@@ -377,8 +384,9 @@ class Trainer:
         if self.pipeline:
             from ..parallel import pipeline as pp
 
-            self.state = pp.shard_pipeline_state(restored, self.mesh,
-                                                 self.optimizer)
+            self.state = pp.shard_pipeline_state(
+                restored, self.mesh, self.optimizer,
+                interleave=self.cfg.pp_interleave)
         elif self.sp_tp:
             from ..parallel import spmd
 
@@ -626,7 +634,8 @@ class Trainer:
             c = self.model.cfg
             blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp,
                                           inverse=True)
-        params["blocks"] = pp.unstack_blocks(blocks)
+        params["blocks"] = pp.unstack_blocks(
+            blocks, stack_ndims=3 if self.cfg.pp_interleave > 1 else 2)
         return jax.device_put(params, NamedSharding(self.mesh, P()))
 
     def evaluate(self, data: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, float]:
