@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Interleaved virtual-stage pipeline: 2 stage-slices per pipeline device
+# (--pp_interleave 2), so each microbatch circles the ppermute ring twice
+# and the warmup/drain bubble shrinks from (S-1)/(M+S-1) to
+# (S-1)/(2M+S-1) at the same microbatch count.  n_layers must divide by
+# pp * pp_interleave (here 4 = 2 * 2).
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 \
+    --n_layers 4 --dp 4 --pp 2 --pp_interleave 2

@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Native tensor-parallel serving: train a tiny LM on the 3-D
+# DP x SP x TP mesh (Megatron matmuls + ring attention), checkpoint it,
+# then decode the SP x TP checkpoint in its NATIVE layout with
+# models.generate_tp — Megatron-sharded blocks, head-sharded KV caches,
+# vocab-parallel Gumbel-max sampling; no host gather, no dense copy.
+# (The CLI's --generate also decodes the same checkpoint by reconciling
+# the layout to dense — shown last for comparison.)
+set -euo pipefail
+CKPT="$(mktemp -d)"
+trap 'rm -rf "$CKPT"' EXIT
+
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --seq_len 32 \
+    --dp 2 --sp 2 --tp 2 --checkpoint_dir "$CKPT"
+
+python - "$CKPT" <<'EOF'
+import sys
+
+import numpy as np
+
+from neural_networks_parallel_training_with_mpi_tpu.utils import platform as plat
+
+plat.pin("cpu", num_devices=8)
+import jax
+import jax.numpy as jnp
+
+from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+from neural_networks_parallel_training_with_mpi_tpu.models import (
+    Transformer, TransformerConfig, generate_tp,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel import mesh as mesh_lib
+from neural_networks_parallel_training_with_mpi_tpu.utils import checkpoint as ckpt
+
+restored = ckpt.restore(sys.argv[1], template=None)
+# must mirror the training run's model config (CLI defaults for
+# --dataset lm at --seq_len 32: max_seq_len = max(seq_len, 512))
+model = Transformer(TransformerConfig(vocab_size=256, max_seq_len=512,
+                                      n_layers=2, d_model=128, n_heads=4,
+                                      d_ff=512))
+mesh = mesh_lib.make_mesh(MeshConfig(data=2, tensor=2),
+                          devices=np.asarray(jax.devices()[:4]))
+prompt = jnp.asarray([[10, 20, 30], [40, 50, 60]], jnp.int32)
+out = generate_tp(model, restored.params, prompt, mesh, max_new_tokens=8)
+print("native TP decode:", np.asarray(out).tolist())
+EOF
+
+# the CLI path reconciles the same checkpoint to the dense layout:
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --seq_len 32 --checkpoint_dir "$CKPT" \
+    --generate "10,20,30" --max_new_tokens 8

@@ -48,6 +48,26 @@ def _pin_platform(args) -> int:
     return 0
 
 
+def _dense_decode_params(params, model, meta):
+    """Normalize a restored checkpoint into the dense per-layer layout the
+    KV-cache decoder expects.  Checkpoints from the explicit-TP layouts
+    (pipeline, seq x tensor) carry the head-aligned qkv column permutation
+    (recorded as ``qkv_tp`` in meta.json — shape-preserving, hence
+    undetectable from the pytree; same reconciliation the Trainer does on
+    resume) and pipeline checkpoints carry stage-stacked blocks (the stack
+    depth is inferable: a stacked qkv weight has 1 [(S, per)] or 2
+    [(v, S, per) interleaved] extra leading dims vs the dense 2-D leaf)."""
+    if not (isinstance(params, dict) and "blocks" in params):
+        return params
+    from .parallel.pipeline import dense_layer_blocks
+
+    params = dict(params)
+    params["blocks"] = dense_layer_blocks(
+        params["blocks"], model.cfg,
+        saved_tp=int((meta or {}).get("qkv_tp", 1)))
+    return params
+
+
 def _generate(args) -> int:
     """Decode from a trained LM checkpoint: the inference entrypoint
     (the reference has no inference path at all — its closest artifact is
@@ -116,7 +136,8 @@ def _generate(args) -> int:
         if restored is None:
             log(f"ERROR: no checkpoint under {cfg.checkpoint_dir}")
             return 2
-        params = restored.params
+        params = _dense_decode_params(restored.params, model,
+                                      ckpt.read_meta(cfg.checkpoint_dir))
         log(f"restored step {int(jax.device_get(restored.step))} from "
             f"{cfg.checkpoint_dir}")
     else:

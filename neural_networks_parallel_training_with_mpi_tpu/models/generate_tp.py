@@ -316,27 +316,29 @@ def generate_tp(model: Transformer, params, prompt, mesh,
     return run(params, prompt, prompt_lens, key)
 
 
-def pipeline_params_for_decode(params, model: Transformer,
-                               interleave: int = 1):
-    """(stage, layer)-stacked pipeline params -> the per-layer list layout
-    :func:`generate_tp` consumes (``interleave`` must match the training
-    config's ``pp_interleave`` — the stack gains a leading virtual-stage
-    axis there).  Plain jnp ops on the sharded arrays: XLA reshards
-    device-to-device (the pipe-sharded stack redistributes to the
-    tensor/replicated decode placement inside ``generate_tp``'s
-    device_put); no single-host gather (``Trainer._eval_params``) on the
-    path.  The qkv head-alignment convention is shared between the
-    pipeline and sp_tp layouts, so with the same tp degree the unstacked
-    params are already head-aligned for decode."""
-    from ..parallel.pipeline import unstack_blocks
+def pipeline_params_for_decode(params, model: Transformer):
+    """(stage, layer)-stacked pipeline params (plain or interleaved — the
+    stack depth is inferred from the leaf ndim) -> the per-layer list
+    layout :func:`generate_tp` consumes.  Plain jnp ops on the sharded
+    arrays: XLA reshards device-to-device (the pipe-sharded stack
+    redistributes to the tensor/replicated decode placement inside
+    ``generate_tp``'s device_put); no single-host gather
+    (``Trainer._eval_params``) on the path.  The qkv head-alignment
+    convention is shared between the pipeline and sp_tp layouts, so with
+    the same tp degree the unstacked params are already head-aligned for
+    decode."""
+    from ..parallel.pipeline import dense_layer_blocks
 
     out = dict(params)
-    out["blocks"] = unstack_blocks(
-        params["blocks"], stack_ndims=3 if interleave > 1 else 2)
+    # saved_tp=1: keep the head-aligned permutation — generate_tp consumes
+    # the NATIVE tp layout; only the stacking is flattened here
+    out["blocks"] = dense_layer_blocks(params["blocks"])
     n_layers = model.cfg.n_layers
-    if len(out["blocks"]) != n_layers:
+    if (not isinstance(out["blocks"], list)
+            or len(out["blocks"]) != n_layers):
         raise ValueError(
-            f"unstacked {len(out['blocks'])} layers but the model has "
-            f"{n_layers} — does `interleave={interleave}` match the "
-            "checkpoint's pp_interleave?")
+            f"expected a stacked pipeline blocks pytree flattening to "
+            f"{n_layers} layers; got "
+            f"{type(params['blocks']).__name__} -> "
+            f"{len(out['blocks']) if isinstance(out['blocks'], list) else 'non-list'}")
     return out

@@ -111,6 +111,38 @@ def unstack_blocks(stacked: Pytree, stack_ndims: int = 2) -> list:
             for i in range(n)]
 
 
+def infer_stack_ndims(blocks: Pytree) -> int:
+    """How many leading stack axes a transformer ``blocks`` pytree carries:
+    0 = per-layer list (dense), 1 = scan_layers ``(L, ...)`` stack,
+    2 = pipeline ``(S, per)``, 3 = interleaved ``(v, S, per)``.  Inferable
+    because every block's dense qkv weight is exactly 2-D — the single
+    layout probe shared by every checkpoint-reconciliation site."""
+    if not isinstance(blocks, dict):
+        return 0
+    return int(jnp.ndim(blocks["qkv"]["w"])) - 2
+
+
+def dense_layer_blocks(blocks: Pytree, model_cfg=None,
+                       saved_tp: int = 1) -> Pytree:
+    """Checkpoint ``blocks`` in ANY training layout -> the dense layout the
+    unpipelined model / KV-cache decoder consumes: undo the head-aligned
+    qkv column permutation (``saved_tp`` from checkpoint meta ``qkv_tp``;
+    needs ``model_cfg`` when > 1), then flatten pipeline /interleaved
+    stacks to the per-layer list (stack depth inferred from leaf ndim —
+    no layout flag to pass or get wrong).  A scan_layers ``(L, ...)``
+    stack is returned as-is: the dense model consumes it directly."""
+    if saved_tp > 1:
+        from . import megatron
+
+        blocks = megatron.permute_qkv(blocks, model_cfg.d_model,
+                                      model_cfg.n_heads, saved_tp,
+                                      inverse=True)
+    stack = infer_stack_ndims(blocks)
+    if stack >= 2:
+        return unstack_blocks(blocks, stack_ndims=stack)
+    return blocks
+
+
 def init_pipeline_params(model: Transformer, key: jax.Array,
                          n_stages: int, tp: int = 1,
                          interleave: int = 1) -> Pytree:

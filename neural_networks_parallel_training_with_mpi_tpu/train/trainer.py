@@ -607,35 +607,16 @@ class Trainer:
         checkpoint interop and tests, NOT by :meth:`evaluate` (every eval
         step consumes the train state's own layout in place, so this
         single-host gather is off the eval path entirely)."""
-        if self.sp_tp or self.ep_tp:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..parallel import megatron
-
-            tp = int(self.mesh.shape.get("tensor", 1))
-            params = dict(jax.device_get(self.state.params))
-            if tp > 1:
-                c = self.model.cfg
-                params["blocks"] = megatron.permute_qkv(
-                    params["blocks"], c.d_model, c.n_heads, tp, inverse=True)
-            return jax.device_put(params, NamedSharding(self.mesh, P()))
-        if not self.pipeline:
+        if not (self.pipeline or self.sp_tp or self.ep_tp):
             return self.state.params
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..parallel import pipeline as pp
 
         params = dict(jax.device_get(self.state.params))
-        blocks = params["blocks"]
-        tp = int(self.mesh.shape.get("tensor", 1))
-        if tp > 1:  # undo the head-aligned qkv column permutation
-            from ..parallel import megatron
-
-            c = self.model.cfg
-            blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp,
-                                          inverse=True)
-        params["blocks"] = pp.unstack_blocks(
-            blocks, stack_ndims=3 if self.cfg.pp_interleave > 1 else 2)
+        params["blocks"] = pp.dense_layer_blocks(
+            params["blocks"], self.model.cfg,
+            saved_tp=int(self.mesh.shape.get("tensor", 1)))
         return jax.device_put(params, NamedSharding(self.mesh, P()))
 
     def evaluate(self, data: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, float]:

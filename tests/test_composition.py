@@ -22,6 +22,9 @@ from neural_networks_parallel_training_with_mpi_tpu.config import (
 )
 from neural_networks_parallel_training_with_mpi_tpu.train.trainer import Trainer
 
+# integration-heavy: full lane only (core lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 def _lm_cfg(nepochs=2, **mesh_kw):
     return TrainConfig(

@@ -17,6 +17,9 @@ from neural_networks_parallel_training_with_mpi_tpu.parallel import expert as ep
 from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import make_mesh
 from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
+# integration-heavy: full lane only (core lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 VOCAB, T, E = 64, 8, 4
 
 

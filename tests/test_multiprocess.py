@@ -21,6 +21,9 @@ from pathlib import Path
 
 import pytest
 
+# integration-heavy: full lane only (core lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 CHILD = Path(__file__).with_name("distributed_child.py")
 FAULTY = Path(__file__).with_name("faulty_child.py")
 TIMEOUT_S = float(os.environ.get("MULTIPROC_TEST_TIMEOUT", "300"))

@@ -19,6 +19,9 @@ from pathlib import Path
 
 import pytest
 
+# needs the real chip (and burns its probe timeout when the tunnel is wedged)
+pytestmark = [pytest.mark.slow, pytest.mark.tpu]
+
 CHILD = Path(__file__).with_name("tpu_pallas_child.py")
 TIMEOUT_S = float(os.environ.get("TPU_SMOKE_TIMEOUT", "240"))
 

@@ -22,6 +22,9 @@ from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
 from neural_networks_parallel_training_with_mpi_tpu.train.trainer import Trainer
 from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
+# integration-heavy: full lane only (core lane: -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 def _cfgs(n_layers=4):
     base = TransformerConfig(vocab_size=64, max_seq_len=16, n_layers=n_layers,
