@@ -645,7 +645,10 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
                "mode": "ring_vs_ring_flash"}
         if not on_tpu:
             row["interpret_mode"] = True
-        for att in ("ring", "ring_flash"):
+        # striped_flash: balanced causal blocks (every device does half
+        # work every tick) — the wall-clock fix for lockstep causal rings;
+        # expected ~2x over ring_flash at scale on real chips
+        for att in ("ring", "ring_flash", "striped_flash"):
             model = Transformer(lm_cfg(seq, att))
             opt = optim.sgd(lr=1e-4, momentum=0.9)
             state = jax.device_put(
@@ -663,6 +666,9 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
         if row.get("ring_ms") and row.get("ring_flash_ms"):
             row["ring_flash_speedup"] = round(
                 row["ring_ms"] / row["ring_flash_ms"], 3)
+        if row.get("ring_flash_ms") and row.get("striped_flash_ms"):
+            row["striped_vs_ring_flash"] = round(
+                row["ring_flash_ms"] / row["striped_flash_ms"], 3)
         log(f"[attention] {row}")
         results.append(row)
 

@@ -356,10 +356,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--vocab_size", type=int, default=256)
     p.add_argument("--attention",
                    choices=["dense", "flash", "ring", "ring_flash",
-                            "ulysses"], default=None,
+                            "striped", "striped_flash", "ulysses"],
+                   default=None,
                    help="attention impl (default: dense; ring when --sp > 1; "
                         "flash = blocked pallas kernel; ring_flash = ring "
-                        "with the pallas kernel per block)")
+                        "with the pallas kernel per block; striped[_flash] "
+                        "= round-robin token stripes — balanced causal "
+                        "blocks, ~2x causal ring throughput at scale)")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
@@ -482,11 +485,14 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         cfg.model.attention = "ring"
     if args.attention:
         if args.sp > 1 and args.attention not in ("ring", "ring_flash",
+                                                  "striped", "striped_flash",
                                                   "ulysses"):
             raise SystemExit(
                 f"--attention {args.attention} cannot shard the sequence "
-                "axis; --sp > 1 needs ring, ring_flash, or ulysses")
+                "axis; --sp > 1 needs ring, ring_flash, striped, "
+                "striped_flash, or ulysses")
         if args.sp <= 1 and args.attention in ("ring", "ring_flash",
+                                               "striped", "striped_flash",
                                                "ulysses"):
             raise SystemExit(
                 f"--attention {args.attention} needs a sequence-sharded "

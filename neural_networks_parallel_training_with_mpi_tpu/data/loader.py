@@ -86,7 +86,8 @@ class ShardedLoader:
                  seq_axis: Optional[str] = None,
                  backend: str = "numpy",
                  batch_axes: Optional[tuple] = None,
-                 prefetch: int = 2):
+                 prefetch: int = 2,
+                 seq_permutation: Optional[np.ndarray] = None):
         if remainder not in ("pad", "drop"):
             raise ValueError("remainder must be 'pad' or 'drop'")
         if prefetch < 0:
@@ -99,7 +100,20 @@ class ShardedLoader:
         self.seq_axis = (seq_axis
                          if seq_axis and mesh.shape.get(seq_axis, 1) > 1
                          else None)
+        # reorders dim 1 of every rank>=2 leaf (inputs AND targets
+        # together, so per-token losses are unchanged): the
+        # striped-attention token layout (parallel.sequence.
+        # striped_permutation) — contiguous shard d then holds round-robin
+        # stripe d
+        self.seq_permutation = (np.asarray(seq_permutation)
+                                if seq_permutation is not None else None)
         self.data = {k: np.asarray(v) for k, v in data.items()}
+        if self.seq_permutation is not None:
+            # applied ONCE here (not per batch): the layout is static, and
+            # the native batcher below gathers from the permuted arrays too
+            self.data = {k: (v[:, self.seq_permutation] if v.ndim >= 2
+                             else v)
+                         for k, v in self.data.items()}
         lens = {k: v.shape[0] for k, v in self.data.items()}
         if len(set(lens.values())) != 1:
             raise ValueError(f"ragged dataset: {lens}")

@@ -48,12 +48,54 @@ def _interpret_default() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# Masking modes.  "causal" keeps k_pos <= q_pos (the standard triangle);
+# "causal_exclusive" keeps k_pos < q_pos — the striped-ring case
+# (parallel.sequence.striped_ring_flash_attention): with tokens laid out
+# round-robin over the ring, the block pair (my_rank, src_rank) is EXACTLY
+# the inclusive triangle when src <= my and the exclusive one when
+# src > my, so every ring step does half work on every device.  Exclusive
+# mode can leave a q-row with no attendable key (row 0 of the whole
+# shard): such rows exit with output 0 and lse = NEG_INF, which the ring
+# merge treats as "no contribution" — the same convention as its
+# skip_block.
+_MASK_MODES = ("none", "causal", "causal_exclusive")
+
+
+def _resolve_mask(causal: bool, mask_mode: Optional[str]) -> str:
+    mode = mask_mode if mask_mode is not None else (
+        "causal" if causal else "none")
+    if mode not in _MASK_MODES:
+        raise ValueError(f"mask_mode must be one of {_MASK_MODES}, "
+                         f"got {mode!r}")
+    return mode
+
+
 # ==========================================================================
 # Flash attention
 # ==========================================================================
 
+def _k_block_hi(mask: str, qi, block_q: int, block_k: int,
+                num_k_blocks: int):
+    """Exclusive upper bound on the k-block loop for one q-block: blocks
+    entirely above the (inclusive or exclusive) diagonal are never read."""
+    if mask == "none":
+        return num_k_blocks
+    # highest attendable k index: last q row is (qi+1)*Bq - 1; inclusive
+    # attends k <= that, exclusive k < that
+    last_k = (qi + 1) * block_q - (1 if mask == "causal" else 2)
+    return lax.min(num_k_blocks,
+                   lax.max(0, lax.div(last_k + block_k, block_k)))
+
+
+def _mask_scores(mask: str, s, q_pos, k_pos):
+    if mask == "none":
+        return s
+    keep = (k_pos <= q_pos) if mask == "causal" else (k_pos < q_pos)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
-                      block_k: int, seq_len: int, causal: bool,
+                      block_k: int, seq_len: int, mask: str,
                       scale: float):
     """Grid: (batch*heads, T // block_q).  Refs (block-local):
     q (1, block_q, D), k/v (1, T, D), o (1, block_q, D), lse (1, 1, block_q).
@@ -67,12 +109,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
     d = q.shape[-1]
     num_k_blocks = seq_len // block_k
-    if causal:
-        # highest k-block overlapping this q-block's last row
-        hi = lax.min(num_k_blocks,
-                     lax.div((qi + 1) * block_q + block_k - 1, block_k))
-    else:
-        hi = num_k_blocks
+    hi = _k_block_hi(mask, qi, block_q, block_k, num_k_blocks)
 
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 0)
@@ -84,10 +121,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # (Bq, Bk)
-        if causal:
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = _mask_scores(mask, s, q_pos, k_pos)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -101,8 +137,14 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = lax.fori_loop(0, hi, body, (acc0, m0, l0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    # exclusive mode can leave a row with NO attendable key (its m never
+    # left NEG_INF — every seen score was the mask fill, or the loop never
+    # ran): emit output 0 / lse NEG_INF, the ring merge's "no
+    # contribution" convention.  Inclusive/none modes never hit this.
+    empty = m < (NEG_INF * 0.5)
+    l_safe = jnp.where(empty, 1.0, l)
+    o_ref[0] = jnp.where(empty, 0.0, acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(empty, NEG_INF, m + jnp.log(l_safe))[:, 0]
 
 
 def _heads_major(x: jax.Array) -> jax.Array:
@@ -128,7 +170,8 @@ def _resolve_blocks(t: int, block_q: int, block_k: int):
 
 def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    block_q: int, block_k: int,
-                   interpret: Optional[bool]):
+                   interpret: Optional[bool],
+                   mask_mode: Optional[str] = None):
     """q/k/v: (B, T, H, D) -> out (B, T, H, D), lse (B*H, T) float32."""
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
@@ -138,7 +181,8 @@ def _flash_forward(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     qh, kh, vh = _heads_major(q), _heads_major(k), _heads_major(v)
 
     kernel = functools.partial(_flash_fwd_kernel, block_q=block_q,
-                               block_k=block_k, seq_len=t, causal=causal,
+                               block_k=block_k, seq_len=t,
+                               mask=_resolve_mask(causal, mask_mode),
                                scale=scale)
     mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
     out, lse = pl.pallas_call(
@@ -210,7 +254,7 @@ def _blocked_attention_reference(q, k, v, causal: bool, block_k: int):
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_q: int, block_k: int, seq_len: int,
-                         causal: bool, scale: float):
+                         mask: str, scale: float):
     """Grid: (B*H, T // block_q).  q/do/dq blocks (1, block_q, D); k/v full
     rows (1, T, D); lse/delta blocks (1, 1, block_q) float32 (the singleton
     axis keeps the trailing block dims Mosaic-legal, see _flash_fwd_kernel)."""
@@ -221,24 +265,23 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[0, 0].astype(jnp.float32)[:, None]
     d = q.shape[-1]
     num_k_blocks = seq_len // block_k
-    if causal:
-        hi = lax.min(num_k_blocks,
-                     lax.div((qi + 1) * block_q + block_k - 1, block_k))
-    else:
-        hi = num_k_blocks
+    hi = _k_block_hi(mask, qi, block_q, block_k, num_k_blocks)
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 0)
+    # exclusive mode marks no-key rows with lse = NEG_INF; exp(s - lse)
+    # would blow up there, and their true gradient is 0
+    live = lse > (NEG_INF * 0.5)
+    lse_safe = jnp.where(live, lse, 0.0)
 
     def body(j, dq_acc):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        k_pos = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = _mask_scores(mask, s, q_pos, k_pos)
+        p = jnp.where(live, jnp.exp(s - lse_safe), 0.0)   # (Bq, Bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
@@ -252,7 +295,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, block_q: int, block_k: int,
-                          seq_len: int, causal: bool, scale: float):
+                          seq_len: int, mask: str, scale: float):
     """Grid: (B*H, T // block_k).  k/v/dk/dv blocks (1, block_k, D);
     q/do full rows (1, T, D); lse/delta full rows (1, 1, T) float32."""
     kj = pl.program_id(1)
@@ -260,8 +303,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v = v_ref[0].astype(jnp.float32)
     d = k.shape[-1]
     num_q_blocks = seq_len // block_q
-    # causal: k-block kj only feeds q rows >= kj*block_k
-    lo = lax.div(kj * block_k, block_q) if causal else 0
+    # causal (either diagonal): k-block kj only feeds q rows >= kj*block_k
+    # (exclusive needs strictly greater — the shared bound just admits one
+    # nearly-masked extra block)
+    lo = 0 if mask == "none" else lax.div(kj * block_k, block_q)
     k_pos = kj * block_k + lax.broadcasted_iota(jnp.int32,
                                                 (block_q, block_k), 1)
 
@@ -277,11 +322,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.float32)[:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = i * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                              # (Bq, Bk)
+        q_pos = i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        s = _mask_scores(mask, s, q_pos, k_pos)
+        live = lse > (NEG_INF * 0.5)  # no-key rows: lse = NEG_INF, grad 0
+        p = jnp.where(live, jnp.exp(s - jnp.where(live, lse, 0.0)), 0.0)
         dv_acc = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -301,7 +346,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
                     block_k: int, interpret: Optional[bool],
-                    g_lse: Optional[jax.Array] = None):
+                    g_lse: Optional[jax.Array] = None,
+                    mask_mode: Optional[str] = None):
     b, t, h, d = q.shape
     scale = 1.0 / (d ** 0.5)
     block_q, block_k = _resolve_blocks(t, block_q, block_k)
@@ -325,8 +371,8 @@ def _flash_backward(q, k, v, out, lse, g, causal: bool, block_q: int,
     delta3 = delta.reshape(b * h, 1, t)
 
     mem = {} if not _HAS_PLTPU else {"memory_space": pltpu.VMEM}
-    row = dict(block_q=block_q, block_k=block_k, seq_len=t, causal=causal,
-               scale=scale)
+    row = dict(block_q=block_q, block_k=block_k, seq_len=t,
+               mask=_resolve_mask(causal, mask_mode), scale=scale)
     full = lambda spec_t: pl.BlockSpec((1, spec_t, d),
                                        lambda bh, i: (bh, 0, 0), **mem)
     dq = pl.pallas_call(
@@ -395,31 +441,39 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array,
                              causal: bool = True, block_q: int = 128,
                              block_k: int = 128,
-                             interpret: Optional[bool] = None
+                             interpret: Optional[bool] = None,
+                             mask_mode: Optional[str] = None
                              ) -> Tuple[jax.Array, jax.Array]:
     """Like :func:`flash_attention` but also returns the per-row logsumexp
     ``lse`` (B*H, T) float32 — the building block for blockwise/ring
     composition (parallel.sequence.ring_flash_attention): partial outputs
     from different K/V blocks merge exactly via their lse weights.  Both
     outputs are differentiable; the lse cotangent rides the same Mosaic
-    backward kernels as a ``delta`` shift (see _flash_backward)."""
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    backward kernels as a ``delta`` shift (see _flash_backward).
+
+    ``mask_mode`` overrides ``causal``: "none" / "causal" /
+    "causal_exclusive" (strictly-below-diagonal — the striped-ring block
+    case; rows with no attendable key return output 0 / lse NEG_INF)."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                          mask_mode)
 
 
-def _fal_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+def _fal_fwd(q, k, v, causal, block_q, block_k, interpret, mask_mode):
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                              mask_mode)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _fal_bwd(causal, block_q, block_k, interpret, res, ct):
+def _fal_bwd(causal, block_q, block_k, interpret, mask_mode, res, ct):
     q, k, v, out, lse = res
     g_out, g_lse = ct
     return _flash_backward(q, k, v, out, lse, g_out, causal, block_q,
-                           block_k, interpret, g_lse=g_lse)
+                           block_k, interpret, g_lse=g_lse,
+                           mask_mode=mask_mode)
 
 
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)

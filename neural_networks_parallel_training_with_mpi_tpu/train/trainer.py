@@ -112,12 +112,19 @@ class Trainer:
                 "EP-degree-dependent; zero1's flat state cannot carry "
                 "factored stats at all. Use adam/adamw/lion/sgd there")
         if (cfg.model.arch == "transformer"
-                and cfg.model.attention in ("ring", "ring_flash", "ulysses")
+                and cfg.model.attention in ("ring", "ring_flash", "ulysses",
+                                            "striped", "striped_flash")
                 and not self.seq_parallel):
             raise ValueError(
                 f"attention={cfg.model.attention!r} needs the 'seq' mesh "
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
+        if (cfg.model.attention in ("striped", "striped_flash")
+                and (self.sp_tp or self.sp_ep)):
+            raise NotImplementedError(
+                "striped attention is wired on the plain DP x SP path; the "
+                "seq x tensor / seq x expert steps use contiguous chunks "
+                "(ring/ring_flash/ulysses)")
         self.zero1 = cfg.update_sharding == "zero1"
         if self.zero1 and (self.gspmd or self.pipeline or self.expert
                            or self.sp_tp):
@@ -164,13 +171,25 @@ class Trainer:
         # the expert axis carries batch rows too (parallel.expert layout)
         self.batch_axes = (("data", "fsdp", "expert") if self.expert
                            else ("data", "fsdp"))
+        # striped attention: tokens reorder round-robin over the seq shards
+        # (balanced causal blocks — parallel.sequence.striped_permutation);
+        # the loader applies the permutation to inputs AND targets, so the
+        # per-token training loss is identical to the contiguous layout
+        self.seq_permutation = None
+        if (self.seq_parallel and cfg.model.arch == "transformer"
+                and cfg.model.attention in ("striped", "striped_flash")):
+            from ..parallel.sequence import striped_permutation
+
+            self.seq_permutation = striped_permutation(
+                cfg.data.seq_len, int(self.mesh.shape["seq"]))
         self.loader = ShardedLoader(
             self.mesh, self.data, cfg.batch_size, shuffle=cfg.shuffle,
             seed=cfg.seed, full_batch=cfg.full_batch,
             remainder=cfg.data.remainder,
             seq_axis="seq" if self.seq_parallel else None,
             batch_axes=self.batch_axes,
-            backend=cfg.data.backend)
+            backend=cfg.data.backend,
+            seq_permutation=self.seq_permutation)
         # schedule domain: optimizer steps = train steps (accumulation is
         # inside the step), known once the loader fixes steps-per-epoch
         lr = schedules.make(
@@ -624,7 +643,8 @@ class Trainer:
             self.mesh, data, self.cfg.batch_size, shuffle=False,
             seed=self.cfg.seed, full_batch=self.cfg.full_batch,
             seq_axis="seq" if self.seq_parallel else None,
-            batch_axes=self.batch_axes)
+            batch_axes=self.batch_axes,
+            seq_permutation=self.seq_permutation)
         # every eval step (dense, gspmd, moe, pipelined) consumes the train
         # state's own layout in place — no gather; _eval_params is only for
         # checkpoint interop / dense export
