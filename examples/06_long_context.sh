@@ -1,9 +1,13 @@
 #!/usr/bin/env bash
 # Sequence/context parallelism: the sequence dimension is sharded over the
 # 'seq' axis and attention runs as a ring (K/V blocks rotate by ppermute),
-# so context length scales with the number of chips.
+# so context length scales with the number of chips.  ATTENTION picks the
+# impl: ring (default here), ring_flash (Pallas kernel per block),
+# striped/striped_flash (round-robin token stripes — balanced causal
+# blocks, ~2x causal ring throughput at scale), or ulysses (all_to_all).
 set -euo pipefail
 python -m neural_networks_parallel_training_with_mpi_tpu \
     --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
     --dataset lm --seq_len 256 --no-full-batch --batch_size 8 --nepochs 1 \
-    --optimizer adam --lr 1e-3 --dp 4 --sp 2
+    --optimizer adam --lr 1e-3 --dp 4 --sp 2 \
+    --attention "${ATTENTION:-ring}"
