@@ -213,16 +213,9 @@ class Transformer(Module):
         """
         c = self.cfg
         b, t = ids.shape
-        if c.attention in ("striped", "striped_flash"):
-            # round-robin stripes: local index i is global position
-            # rank + i * axis_size (parallel.sequence.striped_permutation)
-            s = jax.lax.axis_size(c.seq_axis)
-            positions = jax.lax.axis_index(c.seq_axis) + jnp.arange(t) * s
-        elif c.attention in ("ring", "ring_flash", "ulysses"):
-            # contiguous chunks: global offset
-            positions = jax.lax.axis_index(c.seq_axis) * t + jnp.arange(t)
-        else:  # dense/flash see the full sequence locally
-            positions = jnp.arange(t)
+        from ..parallel.sequence import global_positions
+
+        positions = global_positions(c.attention, c.seq_axis, t)
         x = self.embed(params, ids, positions)
         block_fn = self._block
         if c.remat:

@@ -368,6 +368,24 @@ ATTENTION_IMPLS = {
 }
 
 
+SEQ_SHARDED_IMPLS = ("ring", "ring_flash", "striped", "striped_flash",
+                     "ulysses")
+
+
+def global_positions(impl: str, axis: str, t: int) -> jax.Array:
+    """Global token positions of this shard's ``t`` local indices under the
+    impl's data layout — THE single source of truth consumed by every
+    forward (models.transformer.apply, parallel.spmd._sp_tp_forward):
+    striped layouts hold round-robin stripes (local i on rank r is global
+    r + i*s, :func:`striped_permutation`), contiguous ring/ulysses layouts
+    hold chunks (global r*t + i), dense/flash see the full sequence."""
+    if impl in ("striped", "striped_flash"):
+        return lax.axis_index(axis) + jnp.arange(t) * lax.axis_size(axis)
+    if impl in ("ring", "ring_flash", "ulysses"):
+        return lax.axis_index(axis) * t + jnp.arange(t)
+    return jnp.arange(t)
+
+
 def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
                                causal: bool = True,
                                scale: Optional[float] = None) -> jax.Array:

@@ -239,27 +239,19 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
     materialized."""
     from . import megatron
     from .sequence import (
-        ring_attention,
-        ring_flash_attention,
-        ulysses_attention,
+        SEQ_SHARDED_IMPLS,
+        global_positions,
+        sequence_sharded_attention,
     )
 
     c = model.cfg
-    if attention_impl == "ring":
-        attn = lambda q, k, v: ring_attention(q, k, v, axis=seq_axis,
-                                              causal=True)
-    elif attention_impl == "ring_flash":
-        attn = lambda q, k, v: ring_flash_attention(q, k, v, axis=seq_axis,
-                                                    causal=True)
-    elif attention_impl == "ulysses":
-        attn = lambda q, k, v: ulysses_attention(q, k, v, axis=seq_axis,
-                                                 causal=True)
-    else:
-        raise ValueError(f"SP x TP needs a seq-sharded attention impl, "
-                         f"got {attention_impl!r}")
+    if attention_impl not in SEQ_SHARDED_IMPLS:
+        raise ValueError(f"SP x TP needs a seq-sharded attention impl "
+                         f"{SEQ_SHARDED_IMPLS}, got {attention_impl!r}")
+    attn = lambda q, k, v: sequence_sharded_attention(
+        attention_impl, q, k, v, axis=seq_axis, causal=True)
     b, t = ids.shape
-    offset = lax.axis_index(seq_axis) * t
-    positions = offset + jnp.arange(t)
+    positions = global_positions(attention_impl, seq_axis, t)
     if vocab_parallel:
         # only the token-table lookup is sharded; the pos add + dtype cast
         # stay the model's own (Transformer.add_pos) so they cannot drift

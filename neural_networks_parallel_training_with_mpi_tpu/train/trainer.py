@@ -111,19 +111,20 @@ class Trainer:
                 "means) and the (E, f) bias column factor become "
                 "EP-degree-dependent; zero1's flat state cannot carry "
                 "factored stats at all. Use adam/adamw/lion/sgd there")
+        from ..parallel.sequence import SEQ_SHARDED_IMPLS
+
         if (cfg.model.arch == "transformer"
-                and cfg.model.attention in ("ring", "ring_flash", "ulysses",
-                                            "striped", "striped_flash")
+                and cfg.model.attention in SEQ_SHARDED_IMPLS
                 and not self.seq_parallel):
             raise ValueError(
                 f"attention={cfg.model.attention!r} needs the 'seq' mesh "
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
         if (cfg.model.attention in ("striped", "striped_flash")
-                and (self.sp_tp or self.sp_ep)):
+                and self.sp_ep):
             raise NotImplementedError(
-                "striped attention is wired on the plain DP x SP path; the "
-                "seq x tensor / seq x expert steps use contiguous chunks "
+                "striped attention is wired on the DP x SP and seq x tensor "
+                "paths; the seq x expert step uses contiguous chunks "
                 "(ring/ring_flash/ulysses)")
         self.zero1 = cfg.update_sharding == "zero1"
         if self.zero1 and (self.gspmd or self.pipeline or self.expert

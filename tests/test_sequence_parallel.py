@@ -419,3 +419,31 @@ def test_trainer_striped_validation_matches_dense():
         results[att] = Trainer(cfg).fit()
     np.testing.assert_allclose(results["striped_flash"]["val_loss"],
                                results["dense"]["val_loss"], rtol=2e-4)
+
+
+def test_trainer_striped_on_sp_tp_matches_dense():
+    """Striped attention composed with Megatron TP (seq x tensor path):
+    same trajectory as dense DP."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig as MC, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    losses = {}
+    for att, mesh in (("dense", MC(data=8)),
+                      ("striped_flash", MC(data=2, seq=2, tensor=2))):
+        cfg = TrainConfig(
+            nepochs=2, batch_size=16, full_batch=False, shuffle=False,
+            loss="cross_entropy", optimizer="adam", lr=1e-3,
+            data=DataConfig(dataset="lm", n_samples=32, seq_len=32,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=32, attention=att),
+            mesh=mesh,
+        )
+        losses[att] = Trainer(cfg).fit()["final_loss"]
+    np.testing.assert_allclose(losses["striped_flash"], losses["dense"],
+                               rtol=5e-4)
