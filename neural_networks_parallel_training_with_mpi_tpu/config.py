@@ -180,7 +180,8 @@ class TrainConfig:
     # virtual stage-slices per pipeline device (interleaved schedule,
     # parallel.pipeline): bubble fraction (pp-1)/(v*M + pp-1) instead of
     # (pp-1)/(M + pp-1) at constant microbatch count; costs v ppermute
-    # hops per microbatch.  Requires n_layers % (v * pp) == 0; tp must be 1.
+    # hops per microbatch.  Requires n_layers % (v * pp) == 0; composes
+    # with the pipeline's Megatron tensor axis (DP x TP x PP).
     pp_interleave: int = 1
     loss: str = "mse"          # mse | cross_entropy
     # mix the one-hot CE target with uniform: (1-s)*onehot + s/C.  Applies

@@ -178,31 +178,37 @@ def pipeline_param_specs(params: Pytree, tp: int = 1,
     embed/pos/ln_f/head replicated (they live on every stage; their grads are
     psum'd over 'pipe' so replicas stay identical).  With ``tp > 1``,
     Megatron column/row dims of the block weights additionally shard over
-    'tensor' (stacked leaves are (n_stages, layers_per_stage, ...), so the
-    tensor dim sits at index 2 or 3)."""
+    'tensor' — they sit immediately after the stack dims, i.e. at index
+    nstack or nstack+1 where nstack is 2 for the plain (n_stages, per)
+    stack and 3 for the interleaved (v, n_stages, per) stack."""
 
     from . import megatron
 
-    blk = (P(PIPE_AXIS) if interleave == 1 else P(None, PIPE_AXIS))
+    # stack layouts: (n_stages, per, ...) or interleaved (v, n_stages,
+    # per, ...) — 'pipe' shards dim 0 or dim 1; with tp > 1 the Megatron
+    # col/row dims sit after the stack dims
+    nstack = 2 if interleave == 1 else 3
+    lead = (None,) * (nstack - 2)  # () or (None,) before PIPE
+    blk = P(*lead, PIPE_AXIS)
 
     def block_spec(path, leaf):
         if tp <= 1:
             return blk
         names = megatron.path_names(path)
         if not megatron.is_tensor_sharded(names):
-            return P(PIPE_AXIS)
+            return blk
         # which dim carries 'tensor': col weights split the output dim
-        # (last), row weights the input dim (2 — after the (stage, layer)
-        # stack dims), col biases their only feature dim
+        # (last), row weights the input dim (first after the stack dims),
+        # col biases their only feature dim
         col = "qkv" in names or "ff_in" in names
         ndim = len(np.shape(leaf))
-        if names[-1] == "w" and ndim == 4:
-            return (P(PIPE_AXIS, None, None, "tensor") if col
-                    else P(PIPE_AXIS, None, "tensor", None))
-        if names[-1] == "b" and ndim == 3:
-            return P(PIPE_AXIS, None, "tensor")
+        if names[-1] == "w" and ndim == nstack + 2:
+            return (P(*lead, PIPE_AXIS, None, None, "tensor") if col
+                    else P(*lead, PIPE_AXIS, None, "tensor", None))
+        if names[-1] == "b" and ndim == nstack + 1:
+            return P(*lead, PIPE_AXIS, None, "tensor")
         raise ValueError(f"unexpected tensor-sharded leaf {names} "
-                         f"ndim={ndim}")
+                         f"ndim={ndim} (stack dims {nstack})")
 
     return {
         k: (jax.tree_util.tree_map_with_path(block_spec, v) if k == "blocks"
@@ -313,10 +319,6 @@ def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
     if c.n_layers % (n_stages * interleave):
         raise ValueError(f"n_layers={c.n_layers} not divisible by "
                          f"{interleave} x {n_stages} virtual stages")
-    if interleave > 1 and tp > 1:
-        raise NotImplementedError(
-            "interleaved virtual stages are wired for tp=1; the Megatron "
-            "spec builder expects the (n_stages, per) stack")
     if c.moe_experts > 0:
         raise NotImplementedError("MoE + pipeline composition is not wired "
                                   "yet (aux loss would be dropped); use "

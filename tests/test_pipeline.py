@@ -158,6 +158,40 @@ def test_interleaved_matches_single_device(pipe, data, v, n_mb):
             got, ref)
 
 
+def test_interleaved_with_tensor_matches_single_device():
+    """Interleave composes with the pipeline's Megatron tensor axis
+    (DP x TP x PP with virtual stages): still a pure re-scheduling."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+
+    pipe, tp, v, n_mb = 2, 2, 2, 2
+    devs = jax.devices("cpu")[: pipe * tp * 2]
+    mesh = make_mesh(MeshConfig(data=2, pipe=pipe, tensor=tp), devices=devs)
+    model = tiny_model(pipe * v)
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows=2 * n_mb * 2)
+
+    state, loss = pp.run_one_step(model, opt, mesh, batch, prng.init_key(0),
+                                  n_microbatches=n_mb, interleave=v)
+
+    params = model.init(prng.init_key(0))
+    ref_loss, ref_params = reference_step(model, opt, params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+    got_stack = megatron.permute_qkv(
+        jax.device_get(state.params["blocks"]), model.cfg.d_model,
+        model.cfg.n_heads, tp, inverse=True)
+    got_blocks = pp.unstack_blocks(got_stack, stack_ndims=3)
+    ref_blocks = jax.device_get(ref_params["blocks"])
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)
+
+
 def test_interleaved_matches_gpipe_trajectory():
     """interleave=2 and the plain ring compute the SAME math (GPipe
     semantics) — multi-step trajectories agree to float tolerance."""
