@@ -184,7 +184,9 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         raise ValueError(f"{c.moe_experts} experts not divisible over "
                          f"expert axis of size {ep}")
     use_seq = _seq_active(mesh, seq_axis)
-    if use_seq and c.attention not in ("ring", "ring_flash", "ulysses"):
+    from .sequence import SEQ_SHARDED_IMPLS
+
+    if use_seq and c.attention not in SEQ_SHARDED_IMPLS:
         raise ValueError(f"seq axis active but model attention="
                          f"{c.attention!r} is not seq-sharded")
     token_axes = TOKEN_AXES + ((seq_axis,) if use_seq else ())

@@ -120,12 +120,6 @@ class Trainer:
                 f"attention={cfg.model.attention!r} needs the 'seq' mesh "
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
-        if (cfg.model.attention in ("striped", "striped_flash")
-                and self.sp_ep):
-            raise NotImplementedError(
-                "striped attention is wired on the DP x SP and seq x tensor "
-                "paths; the seq x expert step uses contiguous chunks "
-                "(ring/ring_flash/ulysses)")
         self.zero1 = cfg.update_sharding == "zero1"
         if self.zero1 and (self.gspmd or self.pipeline or self.expert
                            or self.sp_tp):

@@ -291,12 +291,18 @@ def test_ep_tp_grad_clip_and_accum_run():
     assert np.isfinite(float(metrics["aux"]))
 
 
-def test_seq_expert_parallel_matches_dense():
-    """One DP x SP x EP train step == single-device dense-MoE step: ring
-    attention over 'seq' composed with all_to_all expert dispatch.
-    Generous capacity (no drops) and aux_weight=0, as in the other
-    layout-parity pins; ring's online softmax reassociates f32 sums, so
-    tolerances match the ring-attention parity tests."""
+@pytest.mark.parametrize("attention", ["ring", "striped_flash"])
+def test_seq_expert_parallel_matches_dense(attention):
+    """One DP x SP x EP train step == single-device dense-MoE step:
+    ring/striped attention over 'seq' composed with all_to_all expert
+    dispatch.  The striped variant feeds the striped-permuted batch
+    (routing groups are drop-free at generous capacity, hence
+    order-invariant).  aux_weight=0, as in the other layout-parity pins;
+    the online softmax reassociates f32 sums, so tolerances match the
+    ring-attention parity tests."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+        striped_permutation,
+    )
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from neural_networks_parallel_training_with_mpi_tpu.train.state import (
@@ -309,15 +315,20 @@ def test_seq_expert_parallel_matches_dense():
     mesh = make_mesh(MeshConfig(data=2, seq=2, expert=2), devices=devs)
     model_sp = Transformer(TransformerConfig(
         vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
-        d_ff=64, attention="ring", moe_experts=E, moe_capacity=capacity,
+        d_ff=64, attention=attention, moe_experts=E, moe_capacity=capacity,
         moe_expert_axis="expert"))
     opt = optim.sgd(lr=0.1, momentum=0.9)
     batch = lm_batch(rows)
+    feed = batch
+    if attention == "striped_flash":
+        perm = striped_permutation(T, 2)
+        feed = {k: (v[:, perm] if v.ndim >= 2 else v)
+                for k, v in batch.items()}
 
     state = TrainState.create(model_sp, opt, prng.init_key(0))
     state = ep.shard_moe_state(state, mesh, opt)
     placed = {}
-    for k, v in batch.items():
+    for k, v in feed.items():
         spec = (P(ep.TOKEN_AXES, "seq") if k != "mask"
                 else P(ep.TOKEN_AXES))
         placed[k] = jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec))
