@@ -694,25 +694,126 @@ def _cpu_child_env(n_devices: int) -> dict:
     return env
 
 
-def _run_attention_cpu_child(timeout: float = 1800) -> None:
-    """Run the attention comparison in a CPU child with 4 virtual devices:
-    the fallback parent has a single device, but the ring rows need a real
-    'seq' axis to rotate around."""
-    env = _cpu_child_env(4)
-    cmd = [sys.executable, __file__, "--attention-inproc",
-           "--platform", "cpu"]
+def _run_flag_cpu_child(flag: str, n_devices: int,
+                        timeout: float = 1800) -> None:
+    """Run a comparison sub-benchmark (--attention-inproc /
+    --decode-inproc) in a CPU child with a virtual multi-device mesh: the
+    fallback parent has a single device, but ring/tensor axes need >= 2."""
+    env = _cpu_child_env(n_devices)
+    cmd = [sys.executable, __file__, flag, "--platform", "cpu"]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, env=env,
                              timeout=timeout)
     except subprocess.TimeoutExpired:
-        log(f"[attention child] timed out after {timeout:.0f}s")
+        log(f"[{flag} child] timed out after {timeout:.0f}s")
         return
     if out.returncode != 0:
-        log(f"[attention child] FAILED:\n{out.stderr[-2000:]}")
+        log(f"[{flag} child] FAILED:\n{out.stderr[-2000:]}")
     else:
         for line in out.stderr.strip().splitlines():
-            if "[attention]" in line or "->" in line:
+            if "->" in line or "[attention]" in line:
                 log(line)
+
+
+def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
+    """Serving throughput: KV-cache decode tokens/sec for the three decode
+    paths — single-stream dense (`models.generate`), batch-parallel
+    sharded (`generate_sharded`, params replicated / rows sharded), and
+    tensor-parallel native (`generate_tp`, Megatron blocks + head-sharded
+    caches + vocab-parallel sampling).  On the CPU fallback this is a
+    mechanism check at tiny shapes; on TPU the numbers are real."""
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models import (
+        Transformer, TransformerConfig, generate, generate_sharded,
+        generate_tp,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+        mesh as mesh_lib,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    cd = jnp.bfloat16 if on_tpu else jnp.float32
+    c = (_LM if on_tpu else
+         dict(vocab=256, seq=128, d_model=128, n_layers=2, n_heads=8,
+              d_ff=256))
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=cd))
+    params = model.init(prng.init_key(0))
+    rng = np.random.default_rng(0)
+    new_tokens = 64 if on_tpu else 16
+    p_len = 16 if on_tpu else 8
+
+    def time_decode(fn, batch):
+        prompt = jnp.asarray(rng.integers(0, c["vocab"], (batch, p_len)),
+                             jnp.int32)
+        # sync the warmup: async dispatch would bleed the compile/first-run
+        # into the (single, on TPU) timed rep
+        jax.block_until_ready(fn(prompt))
+        best = None
+        for _ in range(1 if on_tpu else _CPU_TIMING_REPS):
+            t0 = time.perf_counter()
+            out = fn(prompt)
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return round(batch * new_tokens / best, 1)
+
+    results = {"new_tokens": new_tokens, "prompt_len": p_len,
+               "n_devices": n_dev}
+    jitted = jax.jit(lambda pr: generate(model, params, pr, new_tokens))
+    results["dense_tokens_per_sec"] = time_decode(jitted, 4)
+    if n_dev >= 2:
+        from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
+            replicated_sharding,
+        )
+
+        dmesh = mesh_lib.make_mesh(MeshConfig(data=n_dev), devices=devices)
+        # place params ONCE outside the timed loop (generate_sharded's own
+        # device_put is then a no-op) — the dense path bakes params into
+        # its jitted closure, so the comparison must not charge the
+        # sharded paths a per-call weight broadcast
+        params_repl = jax.device_put(params, replicated_sharding(dmesh))
+        results["sharded_batch"] = 4 * n_dev
+        results["sharded_tokens_per_sec"] = time_decode(
+            lambda pr: generate_sharded(model, params_repl, pr, dmesh,
+                                        new_tokens), 4 * n_dev)
+    if n_dev >= 4 and c["n_heads"] % 2 == 0:
+        from jax.sharding import NamedSharding
+
+        from neural_networks_parallel_training_with_mpi_tpu.parallel.spmd import (
+            sp_tp_param_specs,
+        )
+
+        tmesh = mesh_lib.make_mesh(MeshConfig(data=n_dev // 2, tensor=2),
+                                   devices=devices)
+        tpp = dict(params)
+        tpp["blocks"] = megatron.permute_qkv(params["blocks"], c["d_model"],
+                                             c["n_heads"], 2)
+        tspecs = sp_tp_param_specs(tpp, vocab_parallel=True)
+        tpp = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(tmesh, s)), tpp,
+            tspecs)
+        results["tp_batch"] = 2 * (n_dev // 2)
+        results["tp_tokens_per_sec"] = time_decode(
+            lambda pr: generate_tp(model, tpp, pr, tmesh, new_tokens,
+                                   vocab_parallel=True), 2 * (n_dev // 2))
+    results["platform"] = devices[0].platform
+    results["device_kind"] = devices[0].device_kind
+    if not on_tpu:
+        results["note"] = ("CPU fallback mechanism check at tiny shapes; "
+                           "TPU runs produce the real numbers")
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    log(f"decode comparison -> {out_path}: {results}")
 
 
 def resolve_platform(requested: str) -> tuple[str, list]:
@@ -818,6 +919,12 @@ def main() -> int:
                          "comparison, write BENCH_ATTENTION.json")
     ap.add_argument("--attention-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--decode", action="store_true",
+                    help="serving decode tokens/sec comparison (dense vs "
+                         "batch-sharded vs tensor-parallel), write "
+                         "BENCH_DECODE.json")
+    ap.add_argument("--decode-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     args = ap.parse_args()
@@ -834,13 +941,22 @@ def main() -> int:
         bench_attention()
         print(json.dumps({"attention_artifact": "BENCH_ATTENTION.json"}))
         return 0
+    if args.decode_inproc:
+        bench_decode()
+        print(json.dumps({"decode_artifact": "BENCH_DECODE.json"}))
+        return 0
 
     if args.attention:  # after platform resolution: touches the backend
         if choice == "cpu":
             # the fallback parent has ONE device; ring needs a 'seq' axis
-            _run_attention_cpu_child()
+            _run_flag_cpu_child("--attention-inproc", 4)
         else:
             bench_attention()
+    if args.decode:
+        if choice == "cpu":
+            _run_flag_cpu_child("--decode-inproc", 8)
+        else:
+            bench_decode()
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
     if args.all and choice == "cpu":

@@ -291,7 +291,8 @@ def test_ep_tp_grad_clip_and_accum_run():
     assert np.isfinite(float(metrics["aux"]))
 
 
-@pytest.mark.parametrize("attention", ["ring", "striped_flash"])
+@pytest.mark.parametrize("attention",
+                         ["ring", "striped", "striped_flash"])
 def test_seq_expert_parallel_matches_dense(attention):
     """One DP x SP x EP train step == single-device dense-MoE step:
     ring/striped attention over 'seq' composed with all_to_all expert
@@ -320,7 +321,7 @@ def test_seq_expert_parallel_matches_dense(attention):
     opt = optim.sgd(lr=0.1, momentum=0.9)
     batch = lm_batch(rows)
     feed = batch
-    if attention == "striped_flash":
+    if attention.startswith("striped"):
         perm = striped_permutation(T, 2)
         feed = {k: (v[:, perm] if v.ndim >= 2 else v)
                 for k, v in batch.items()}
