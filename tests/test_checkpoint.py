@@ -102,3 +102,36 @@ def test_trainer_resume_continues_exactly(tmp_path):
             np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
         jax.device_get(straight.state.params),
         jax.device_get(second.state.params))
+
+
+def test_interleaved_pipeline_resume_continues_exactly(tmp_path):
+    """Checkpoint + resume on the interleaved (v, n_stages, per) pipeline
+    stack: straight-through training == checkpointed + resumed training,
+    weight for weight."""
+    def cfg(nepochs, ckpt_dir=None, resume=False):
+        return TrainConfig(
+            lr=1e-3, nepochs=nepochs, full_batch=False, batch_size=16,
+            shuffle=True, seed=5, checkpoint_dir=ckpt_dir, resume=resume,
+            log_every=0, optimizer="adam", loss="cross_entropy",
+            pp_interleave=2,
+            mesh=MeshConfig(data=4, pipe=2),
+            data=DataConfig(dataset="lm", n_samples=32, seq_len=16,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=4, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=16))
+
+    straight = Trainer(cfg(4))
+    straight.fit()
+
+    d = str(tmp_path / "ck")
+    Trainer(cfg(2, d)).fit()
+    second = Trainer(cfg(4, d, resume=True))
+    second.init_state()
+    second.fit()
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        jax.device_get(straight.state.params),
+        jax.device_get(second.state.params))
