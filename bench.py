@@ -1019,9 +1019,25 @@ def main() -> int:
         })
 
     if args.all:
-        with open("BENCH_FULL.json", "w") as f:
+        out = "BENCH_FULL.json"
+        # error records carry no 'platform' key — treat them as cpu-like,
+        # or a sweep with one failed config would bypass the guard
+        if all(r.get("platform") in (None, "cpu") for r in records):
+            try:  # never clobber a real-chip sweep with fallback rows
+                with open(out) as f:
+                    prior = json.load(f)
+                if (isinstance(prior, list)
+                        and any(isinstance(r, dict)
+                                and r.get("platform") not in (None, "cpu")
+                                for r in prior)):
+                    out = "BENCH_FULL_CPU.json"
+                    log("existing BENCH_FULL.json holds a real-chip "
+                        "sweep; cpu fallback writes " + out)
+            except (OSError, ValueError):
+                pass
+        with open(out, "w") as f:
             json.dump(records, f, indent=2)
-        log("all configs -> BENCH_FULL.json")
+        log(f"all configs -> {out}")
 
     save_tpu_latest(records)
 
