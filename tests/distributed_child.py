@@ -125,6 +125,31 @@ def main() -> int:
     assert np.array_equal(np.asarray(p0), np.asarray(r0))
     report["checkpoint_ok"] = True
 
+    # ---- cross-host sequence parallelism: ring attention whose ppermute
+    # hops cross the process boundary (the 'seq' axis pairs device k of
+    # host 0 with device k of host 1 via an interleaved device order) ----
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import spmd
+
+    inter = np.asarray(devices).reshape(n, 2).T.reshape(-1)  # seq spans hosts
+    mesh_sp = make_mesh(MeshConfig(data=2, seq=n), devices=inter)
+    seq_len = 16 * n
+    model_sp = Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=seq_len, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64, attention="ring"))
+    tok = np.random.default_rng(1).integers(0, 64, (4, seq_len + 1))
+    sp_batch = {"x": tok[:, :-1].astype(np.int32),
+                "y": tok[:, 1:].astype(np.int32),
+                "mask": np.ones((4,), np.float32)}
+    state_sp = TrainState.create(model_sp, opt, prng.init_key(0))
+    _, loss_sp = spmd.run_one_step(model_sp, opt, mesh_sp, state_sp,
+                                   sp_batch, loss_name="cross_entropy")
+    report["sp_loss"] = round(float(jax.device_get(loss_sp)), 8)
+    assert np.isfinite(report["sp_loss"]), report["sp_loss"]
+    report["sp_ok"] = True
+
     distributed.barrier("done")
     report["ok"] = True
     print(json.dumps(report), flush=True)

@@ -86,9 +86,12 @@ def test_two_process_world(tmp_path):
     assert {r["process_index"] for r in reports} == {0, 1}
     for r in reports:
         assert r["ok"] and r["broadcast_ok"] and r["replicas_ok"] \
-            and r["checkpoint_ok"], r
+            and r["checkpoint_ok"] and r["sp_ok"], r
     # both hosts computed the identical loss trajectory (one logical job)
     assert reports[0]["losses"] == reports[1]["losses"]
+    # ...including the cross-host ring-attention step (seq axis spans the
+    # process boundary, so its ppermute hops ride the gloo backend)
+    assert reports[0]["sp_loss"] == reports[1]["sp_loss"]
 
 
 def test_peer_death_fails_fast():
