@@ -150,6 +150,32 @@ def main() -> int:
     assert np.isfinite(report["sp_loss"]), report["sp_loss"]
     report["sp_ok"] = True
 
+    # ---- cross-host tensor parallelism: GSPMD Megatron sharding with the
+    # 'tensor' axis spanning the hosts — the partitioner's all-reduces run
+    # over the distributed backend ------------------------------------------
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import gspmd
+
+    mesh_tp = make_mesh(MeshConfig(data=2, tensor=n), devices=inter)
+    model_tp = Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=16, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="dense"))
+    opt_tp = optim.adam(lr=1e-3)
+    state_tp = TrainState.create(model_tp, opt_tp, prng.init_key(0))
+    state_tp = gspmd.shard_state(model_tp, state_tp, opt_tp, mesh_tp)
+    tok2 = np.random.default_rng(2).integers(0, 64, (4, 17))
+    batch_tp = gspmd.shard_batch(mesh_tp, {
+        "x": tok2[:, :-1].astype(np.int32),
+        "y": tok2[:, 1:].astype(np.int32),
+        "mask": np.ones((4,), np.float32)})
+    step_tp = gspmd.make_gspmd_train_step(model_tp, opt_tp, mesh_tp,
+                                          "cross_entropy",
+                                          example_batch=batch_tp,
+                                          donate=False)
+    _, loss_tp = step_tp(state_tp, batch_tp)
+    report["tp_loss"] = round(float(jax.device_get(loss_tp)), 8)
+    assert np.isfinite(report["tp_loss"]), report["tp_loss"]
+    report["tp_ok"] = True
+
     distributed.barrier("done")
     report["ok"] = True
     print(json.dumps(report), flush=True)

@@ -90,8 +90,12 @@ def test_two_process_world(tmp_path):
     # both hosts computed the identical loss trajectory (one logical job)
     assert reports[0]["losses"] == reports[1]["losses"]
     # ...including the cross-host ring-attention step (seq axis spans the
-    # process boundary, so its ppermute hops ride the gloo backend)
+    # process boundary, so its ppermute hops ride the gloo backend) and
+    # the cross-host Megatron TP step (partitioner-inserted all-reduces)
     assert reports[0]["sp_loss"] == reports[1]["sp_loss"]
+    for r in reports:
+        assert r["tp_ok"], r
+    assert reports[0]["tp_loss"] == reports[1]["tp_loss"]
 
 
 def test_peer_death_fails_fast():
