@@ -104,7 +104,7 @@ def _tp_block_chunk(cfg, lp, cache, x, pos, heads_local: int,
 
 
 def _sharded_sample(logits_local, temperature: float, key,
-                    axis: str = TENSOR_AXIS) -> jax.Array:
+                    axis: str = TENSOR_AXIS, top_k: int = 0) -> jax.Array:
     """One token per row from vocab-SHARDED logits (B, V/tp), exact:
 
     * greedy — global argmax via pmax, smallest-index tie-break via pmin
@@ -112,16 +112,30 @@ def _sharded_sample(logits_local, temperature: float, key,
     * temperature — Gumbel-max: per-rank iid Gumbel noise on the local
       slice (key folded with the rank index so no two ranks share noise),
       then the same global argmax.  argmax_i(l_i/T + g_i) ~ Categorical
-      (softmax(l/T)) exactly.
+      (softmax(l/T)) exactly;
+    * ``top_k > 0`` — the candidate set is restricted WITHOUT gathering
+      the logits row: each rank takes its local top-k (at most k global
+      winners can live on one shard), an all_gather of those tp*k scalars
+      per row yields the global k-th value, and everything below it masks
+      out before the Gumbel noise.  Matches ``generate._filter_logits``'s
+      ``logits < kth -> -inf`` rule exactly (ties at the threshold kept).
     """
     v_local = logits_local.shape[-1]
     rank = lax.axis_index(axis)
     offset = rank * v_local
     scores = logits_local.astype(jnp.float32)
     if temperature > 0:
+        scaled = scores / temperature
+        if top_k > 0:
+            k_eff = min(top_k, v_local)
+            local_top = lax.top_k(scaled, k_eff)[0]          # (B, k)
+            # (B, tp*k) of candidate maxima — tiny; never the logits row
+            all_top = lax.all_gather(local_top, axis, axis=-1, tiled=True)
+            kth = lax.top_k(all_top, top_k)[0][..., -1:]     # global k-th
+            scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
         g = jax.random.gumbel(jax.random.fold_in(key, rank),
-                              logits_local.shape, jnp.float32)
-        scores = scores / temperature + g
+                              scaled.shape, jnp.float32)
+        scores = scaled + g
     local_max = scores.max(-1)
     global_max = lax.pmax(local_max, axis)
     local_arg = jnp.argmax(scores, axis=-1).astype(jnp.int32) + offset
@@ -154,12 +168,15 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
     if vocab_parallel and c.vocab_size % tp:
         raise ValueError(f"vocab_size={c.vocab_size} not divisible by "
                          f"tp={tp}")
-    if vocab_parallel and (top_k > 0 or 0.0 < top_p < 1.0):
+    if vocab_parallel and 0.0 < top_p < 1.0:
         raise NotImplementedError(
-            "top_k/top_p need a global view of the logits row; with "
-            "vocab_parallel the row is never materialized — use greedy or "
-            "plain temperature sampling here, or decode with "
+            "top_p needs a sorted cumulative view of the full logits row; "
+            "with vocab_parallel the row is never materialized — use "
+            "greedy, temperature, or top_k sampling here (top_k works "
+            "shard-locally + a tp*k all_gather), or decode with "
             "vocab_parallel=False (replicated head)")
+    if vocab_parallel and top_k > c.vocab_size:
+        raise ValueError(f"top_k={top_k} > vocab_size={c.vocab_size}")
 
     def embed(params, ids, positions):
         if vocab_parallel:
@@ -179,7 +196,8 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
 
     def sample(logits_2d, key):
         if vocab_parallel:
-            return _sharded_sample(logits_2d, temperature, key)
+            return _sharded_sample(logits_2d, temperature, key,
+                                   top_k=top_k)
         return _full_sample(logits_2d, temperature, key, top_k, top_p)
 
     def forward_chunk(params, caches, ids, pos):
@@ -264,10 +282,13 @@ def generate_tp(model: Transformer, params, prompt, mesh,
     attn_out/ff_out row-sharded over 'tensor'; embed/head vocab-sharded
     when ``vocab_parallel``).  No host gather, no dense param copy.
 
-    Sampling knobs as in ``generate.generate``; with ``vocab_parallel``
-    only greedy and plain temperature are available (top_k/top_p would
-    need the full logits row).  ``prompt`` rows shard over ``batch_axes``
-    (axes absent from the mesh are ignored).
+    Sampling knobs as in ``generate.generate``; with ``vocab_parallel``,
+    greedy, temperature, and top_k are available (top_k restricts the
+    candidate set via local top-k + a tp*k all_gather of scalars — the
+    full logits row is still never materialized); top_p would need a
+    sorted cumulative view of the whole row and is rejected.  ``prompt``
+    rows shard over ``batch_axes`` (axes absent from the mesh are
+    ignored).
     """
     c = model.cfg
     b, p = prompt.shape

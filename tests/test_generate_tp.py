@@ -127,13 +127,37 @@ def test_gumbel_max_matches_categorical_distribution(lm, tp_mesh):
     assert abs(counts[top] - probs[top]) < 4 * se + 1e-3
 
 
-def test_vocab_parallel_rejects_topk(lm, tp_mesh):
+def test_vocab_parallel_rejects_top_p(lm, tp_mesh):
     model, params = lm
-    with pytest.raises(NotImplementedError, match="top_k"):
+    with pytest.raises(NotImplementedError, match="top_p"):
         generate_tp(model, _tp_params(model, params, 4),
                     jnp.zeros((4, 2), jnp.int32), tp_mesh, 4,
-                    temperature=1.0, top_k=3, key=jax.random.PRNGKey(0),
+                    temperature=1.0, top_p=0.9, key=jax.random.PRNGKey(0),
                     vocab_parallel=True)
+
+
+def test_vocab_parallel_top_k_stays_in_dense_candidate_set(lm, tp_mesh):
+    """Sharded top-k sampling (local top-k + tp*k all_gather threshold):
+    every sampled token must lie in the DENSE top-k set of its context's
+    logits row, across seeds; the stream is seed-deterministic."""
+    model, params = lm
+    tpp = _tp_params(model, params, 4)
+    prompt = jnp.asarray(np.full((4, 3), 9), jnp.int32)
+    k = 5
+    logits = model.apply(params, prompt)[:, -1]
+    allowed = set(np.asarray(
+        jax.lax.top_k(logits[0], k)[1]).tolist())  # rows identical
+    for s in range(8):
+        out = generate_tp(model, tpp, prompt, tp_mesh, 1, temperature=1.0,
+                          top_k=k, key=jax.random.PRNGKey(s),
+                          vocab_parallel=True)
+        for tok in np.asarray(out[:, -1]).tolist():
+            assert tok in allowed, (tok, allowed)
+    a = generate_tp(model, tpp, prompt, tp_mesh, 4, temperature=1.0,
+                    top_k=k, key=jax.random.PRNGKey(3), vocab_parallel=True)
+    b = generate_tp(model, tpp, prompt, tp_mesh, 4, temperature=1.0,
+                    top_k=k, key=jax.random.PRNGKey(3), vocab_parallel=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_scan_layers_checkpoint_decodes(tp_mesh):
