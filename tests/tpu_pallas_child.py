@@ -63,6 +63,35 @@ def main() -> int:
             denom = float(jnp.abs(bb).max()) or 1.0
             report[f"bwd_{name}_rel_err"] = float(jnp.abs(a - bb).max()) / denom
 
+        # exclusive-diagonal mode (striped ring blocks): compiled through
+        # Mosaic, vs a strict-lower-triangle masked reference; the no-key
+        # row 0 must come back exactly 0 with zero gradient
+        from neural_networks_parallel_training_with_mpi_tpu.ops.pallas_kernels import (
+            flash_attention_with_lse,
+        )
+
+        out_ex, lse_ex = jax.jit(
+            lambda q, k, v: flash_attention_with_lse(
+                q, k, v, True, 128, 128, False, "causal_exclusive")
+        )(q, k, v)
+        scale_a = 1.0 / np.sqrt(d)
+        s_ref = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale_a
+        mask = (jnp.arange(t)[None, :] < jnp.arange(t)[:, None])[None, None]
+        probs = jax.nn.softmax(jnp.where(mask, s_ref, -1e30), axis=-1)
+        ref_ex = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        report["excl_max_err"] = float(
+            jnp.abs(out_ex[:, 1:] - ref_ex[:, 1:]).max())
+        report["excl_row0_zero"] = bool(
+            jnp.all(out_ex[:, 0] == 0.0))
+
+        def loss_ex(q, k, v):
+            o, _ = flash_attention_with_lse(q, k, v, True, 128, 128, False,
+                                            "causal_exclusive")
+            return (o ** 2).sum()
+
+        gq_ex = jax.jit(jax.grad(loss_ex))(q, k, v)
+        report["excl_grad_finite"] = bool(jnp.isfinite(gq_ex).all())
+
     # bf16 forward (the bench path): loose check against f32 reference
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
     out_bf16 = jax.jit(
@@ -87,6 +116,9 @@ def main() -> int:
         and report["bwd_dq_rel_err"] < 2e-3
         and report["bwd_dk_rel_err"] < 2e-3
         and report["bwd_dv_rel_err"] < 2e-3
+        and report["excl_max_err"] < 2e-3
+        and report["excl_row0_zero"]
+        and report["excl_grad_finite"]
         and report["fwd_bf16_max_err"] < 5e-2
         and report["ln_max_err"] < 2e-3
     )
