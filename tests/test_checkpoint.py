@@ -104,6 +104,7 @@ def test_trainer_resume_continues_exactly(tmp_path):
         jax.device_get(second.state.params))
 
 
+@pytest.mark.slow  # trains three Trainers end-to-end
 def test_interleaved_pipeline_resume_continues_exactly(tmp_path):
     """Checkpoint + resume on the interleaved (v, n_stages, per) pipeline
     stack: straight-through training == checkpointed + resumed training,

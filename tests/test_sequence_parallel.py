@@ -358,6 +358,7 @@ def test_transformer_striped_flash_matches_dense(seq_mesh):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # trainer-level integration
 def test_trainer_striped_matches_dense_trajectory():
     """End-to-end: --attention striped_flash on a DP x SP mesh trains the
     SAME trajectory as dense attention on plain DP (the loader's stripe
@@ -391,6 +392,7 @@ def test_trainer_striped_matches_dense_trajectory():
                                rtol=2e-4)
 
 
+@pytest.mark.slow  # trainer-level integration
 def test_trainer_striped_validation_matches_dense():
     """Validation must see the stripe permutation too (advisor-caught r3
     regression: the val loader once fed contiguous tokens to a model
@@ -421,6 +423,7 @@ def test_trainer_striped_validation_matches_dense():
                                results["dense"]["val_loss"], rtol=2e-4)
 
 
+@pytest.mark.slow  # trainer-level integration
 def test_trainer_striped_on_sp_tp_matches_dense():
     """Striped attention composed with Megatron TP (seq x tensor path):
     same trajectory as dense DP."""
