@@ -210,6 +210,15 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
         return x, new_caches
 
     def shard_decode(params, prompt, lens, key):
+        # Independent draws per DATA shard: the key arrives shard_map-
+        # replicated (in_spec P()), so without this fold identical prompts
+        # in different batch shards would decode identical continuations.
+        # Only the batch axes fold here — the 'tensor' axis must NOT (the
+        # sampled token must stay replicated across tensor ranks; the
+        # per-rank fold for vocab-sharded Gumbel noise lives inside
+        # _sharded_sample).
+        for a in batch_axes:
+            key = jax.random.fold_in(key, lax.axis_index(a))
         b, p = prompt.shape
         total = p + max_new_tokens
         caches = init_tp_kv_cache(model, b, total, tp)
@@ -337,23 +346,46 @@ def generate_tp(model: Transformer, params, prompt, mesh,
     return run(params, prompt, prompt_lens, key)
 
 
-def pipeline_params_for_decode(params, model: Transformer):
+def pipeline_params_for_decode(params, model: Transformer,
+                               qkv_tp: Optional[int] = None,
+                               decode_tp: Optional[int] = None):
     """(stage, layer)-stacked pipeline params (plain or interleaved — the
     stack depth is inferred from the leaf ndim) -> the per-layer list
     layout :func:`generate_tp` consumes.  Plain jnp ops on the sharded
     arrays: XLA reshards device-to-device (the pipe-sharded stack
     redistributes to the tensor/replicated decode placement inside
     ``generate_tp``'s device_put); no single-host gather
-    (``Trainer._eval_params``) on the path.  The qkv head-alignment
-    convention is shared between the pipeline and sp_tp layouts, so with
-    the same tp degree the unstacked params are already head-aligned for
-    decode."""
+    (``Trainer._eval_params``) on the path.
+
+    The qkv head-alignment convention is shared between the pipeline and
+    sp_tp layouts, but the column *permutation* is tp-DEGREE-dependent:
+    a checkpoint permuted for tp=2 decoded on a tensor=4 mesh would emit
+    silently wrong tokens.  Pass ``qkv_tp`` (the checkpoint meta's value,
+    as ``cli._dense_decode_params`` does) and ``decode_tp``
+    (``mesh.shape['tensor']`` of the decode mesh): when they differ the
+    blocks are re-permuted (inverse of the saved permutation, then the
+    decode mesh's).  Omitting either keeps the historical same-degree
+    assumption — only safe when caller guarantees the degrees match."""
+    from ..parallel import megatron
     from ..parallel.pipeline import dense_layer_blocks
 
     out = dict(params)
-    # saved_tp=1: keep the head-aligned permutation — generate_tp consumes
-    # the NATIVE tp layout; only the stacking is flattened here
-    out["blocks"] = dense_layer_blocks(params["blocks"])
+    if (qkv_tp is not None and decode_tp is not None
+            and int(qkv_tp) != int(decode_tp)):
+        # undo the saved permutation via the one place that owns that rule
+        # (dense_layer_blocks, parallel/pipeline.py), then re-permute for
+        # the decode mesh's degree
+        c = model.cfg
+        out["blocks"] = dense_layer_blocks(params["blocks"], c,
+                                           saved_tp=int(qkv_tp))
+        if int(decode_tp) > 1:
+            out["blocks"] = megatron.permute_qkv(
+                out["blocks"], c.d_model, c.n_heads, int(decode_tp))
+    else:
+        # degrees match (or caller vouches): keep the head-aligned
+        # permutation — generate_tp consumes the NATIVE tp layout; only
+        # the stacking is flattened here
+        out["blocks"] = dense_layer_blocks(params["blocks"])
     n_layers = model.cfg.n_layers
     if (not isinstance(out["blocks"], list)
             or len(out["blocks"]) != n_layers):

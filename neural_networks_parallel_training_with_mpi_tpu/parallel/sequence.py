@@ -352,9 +352,10 @@ def striped_ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     (o, lse, k_last, v_last), _ = lax.scan(
         step, (o0, lse0, k, v), jnp.arange(s - 1))
     o, lse = merge(o, lse, k_last, v_last, s - 1)
-    # row 0 of rank 0 attends only itself under exclusive striping of
-    # every OTHER block; with the inclusive diagonal block it always has
-    # >= 1 key, so lse is finite — but guard the normalizer anyway
+    # No normalizer guard needed: the diagonal block (step 0) is inclusive,
+    # so every query row attends >= 1 key and lse is finite; exclusive
+    # blocks with empty rows are handled by the kernel's empty-row
+    # convention (their partial lse is NEG_INF and merges as a no-op).
     return o.astype(q.dtype)
 
 

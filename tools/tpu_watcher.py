@@ -1,0 +1,198 @@
+"""Opportunistic TPU tunnel-watcher (VERDICT r3 item 1).
+
+Two rounds of headline numbers were hostage to *capture-time* probing: the
+exclusive axon tunnel was reachable at unpredictable moments, and by the
+time ``bench.py`` ran at round end it had wedged again.  This watcher
+inverts the race: it polls the tunnel cheaply all round (subprocess probe,
+timeout-wrapped — a wedged tunnel hangs inside backend init rather than
+erroring) and, the moment a probe answers, fires the TPU bench priority
+list, each item refreshing ``BENCH_TPU_LATEST.json`` via bench.py's own
+provenance machinery.
+
+Every probe attempt and every priority-item run is appended to
+``TPU_WATCH.jsonl`` in the repo root — the committed artifact is either the
+round's real-chip record or the proof that the tunnel never answered once.
+
+Usage (backgrounded for the whole session)::
+
+    python tools/tpu_watcher.py [--interval 600] [--probe-timeout 75] &
+
+Coordination files (repo root):
+
+* ``.tpu_watch_pause``  — create to make the watcher skip probing (e.g.
+  while a foreground CPU benchmark needs the single core to itself).
+* ``.tpu_watch_busy``   — written by the watcher while it is running the
+  priority list (the chip is exclusive; a concurrent foreground probe
+  would both fail and perturb the measurement).
+
+The priority list (VERDICT r3 item 1, in the judge's order) and per-item
+completion state live in the log: items that already succeeded are not
+re-run on later successful probes, so a flapping tunnel converges on the
+full set instead of re-measuring item 1 forever.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from neural_networks_parallel_training_with_mpi_tpu.utils import (  # noqa: E402
+    platform as plat,
+)
+
+LOG_PATH = os.path.join(REPO, "TPU_WATCH.jsonl")
+PAUSE_PATH = os.path.join(REPO, ".tpu_watch_pause")
+BUSY_PATH = os.path.join(REPO, ".tpu_watch_busy")
+
+# The priority list, in VERDICT r3's order.  Each item: (name, argv-tail,
+# timeout_s).  Timeouts are generous (first Mosaic compile of a 12-layer LM
+# is slow) but bounded — one wedged item must not eat the whole window.
+PRIORITY = [
+    ("big_lm", [sys.executable, "bench.py", "--config", "big_lm"], 2100),
+    ("all", [sys.executable, "bench.py", "--all"], 2400),
+    ("attention", [sys.executable, "bench.py", "--attention"], 2100),
+    ("decode", [sys.executable, "bench.py", "--decode"], 1500),
+    ("pallas_tpu_test",
+     [sys.executable, "-m", "pytest", "tests/test_pallas_tpu.py", "-q",
+      "-rs"], 900),
+]
+
+
+def log_event(rec: dict) -> None:
+    rec = {"t_unix": round(time.time(), 1),
+           "t_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           **rec}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"[tpu_watcher] {json.dumps(rec)}", flush=True)
+
+
+def load_done() -> set:
+    """Items that already succeeded (survives watcher restarts)."""
+    done = set()
+    try:
+        with open(LOG_PATH) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("event") == "item" and rec.get("ok"):
+                    done.add(rec["name"])
+    except OSError:
+        pass
+    return done
+
+
+def run_item(name: str, argv: list, timeout_s: float) -> bool:
+    """Run one priority item; returns True on success (rc 0 + for bench
+    items, a real-accelerator platform in the emitted JSON line)."""
+    env = dict(os.environ)
+    # the watcher just verified the tunnel answers: the child still probes
+    # (bench.py is hang-proof by design) but should not burn 11 minutes of
+    # backoff re-proving it
+    env.setdefault("BENCH_PROBE_TIMEOUT", "75")
+    env.setdefault("BENCH_PROBE_ATTEMPTS", "2")
+    env.setdefault("BENCH_PROBE_BACKOFF", "15")
+    env.pop("JAX_PLATFORMS", None)  # let the axon plugin register
+    t0 = time.time()
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s, env=env, cwd=REPO)
+        rc, timed_out = out.returncode, False
+        stdout, stderr = out.stdout, out.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, timed_out = None, True
+        stdout = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        stderr = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    elapsed = round(time.time() - t0, 1)
+    ok = rc == 0
+    last_json = None
+    if name not in ("pallas_tpu_test",):
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                last_json = json.loads(line)
+                break
+            except ValueError:
+                continue
+        # a bench item only counts as captured if it really ran on the chip
+        if ok and isinstance(last_json, dict):
+            plat_field = last_json.get("platform")
+            if plat_field is not None and plat_field == "cpu":
+                ok = False
+    log_event({
+        "event": "item", "name": name, "ok": ok, "rc": rc,
+        "timed_out": timed_out, "elapsed_s": elapsed,
+        "result": last_json,
+        "stderr_tail": (stderr or "").strip()[-500:],
+    })
+    return ok
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=600.0,
+                    help="seconds between probes (default 600)")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--once", action="store_true",
+                    help="single probe + (on success) priority list, then exit")
+    args = ap.parse_args()
+
+    log_event({"event": "start", "interval_s": args.interval,
+               "probe_timeout_s": args.probe_timeout,
+               "pending": [n for n, _, _ in PRIORITY
+                           if n not in load_done()]})
+    attempt = 0
+    while True:
+        attempt += 1
+        if os.path.exists(PAUSE_PATH):
+            log_event({"event": "probe", "attempt": attempt,
+                       "outcome": "paused"})
+        else:
+            t0 = time.time()
+            info = plat.probe(timeout_s=args.probe_timeout, attempts=1)
+            elapsed = round(time.time() - t0, 1)
+            if info and info.get("platform") != "cpu":
+                log_event({"event": "probe", "attempt": attempt,
+                           "outcome": "ok", "elapsed_s": elapsed, **info})
+                done = load_done()
+                pending = [(n, a, t) for n, a, t in PRIORITY if n not in done]
+                if not pending:
+                    log_event({"event": "complete",
+                               "note": "all priority items captured"})
+                    return 0
+                try:
+                    with open(BUSY_PATH, "w") as f:
+                        f.write(str(os.getpid()))
+                    for name, argv, timeout_s in pending:
+                        run_item(name, argv, timeout_s)
+                finally:
+                    try:
+                        os.remove(BUSY_PATH)
+                    except OSError:
+                        pass
+                if not [n for n, _, _ in PRIORITY if n not in load_done()]:
+                    log_event({"event": "complete",
+                               "note": "all priority items captured"})
+                    return 0
+            else:
+                log_event({"event": "probe", "attempt": attempt,
+                           "outcome": ("cpu_only" if info
+                                       else "timeout_or_error"),
+                           "elapsed_s": elapsed})
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
