@@ -176,11 +176,17 @@ def _make_config(name):
             }
 
         def make_model(cd):
+            # remat "dots" is LOAD-BEARING: without it XLA's buffer
+            # assignment wants ~17 GB of temps at B=8 (measured by
+            # `--preflight`, BENCH_PREFLIGHT.json) vs v5e's 16 GB HBM;
+            # dots saves matmul outputs and recomputes only elementwise
+            # ops, cutting temps to ~6.4 GB at negligible FLOP cost
             return Transformer(TransformerConfig(
                 vocab_size=c["vocab"], max_seq_len=c["seq"],
                 n_layers=c["n_layers"], d_model=c["d_model"],
                 n_heads=c["n_heads"], d_ff=c["d_ff"], compute_dtype=cd,
-                attention="flash", scan_layers=True))
+                attention="flash", scan_layers=True,
+                remat=True, remat_policy="dots"))
 
         # no torch baseline: a ~218M-param CPU step takes minutes — the
         # config exists to measure MFU on the chip, not to race torch
@@ -545,6 +551,153 @@ def run_scaling_sweep(out_path: str = "BENCH_SCALING.json",
                 "host_cpu_count": ncpu, "note": note,
                 "results": results}, f, indent=2)
         log(f"weak-scaling sweep -> {out_path}")
+
+
+def preflight_config(config_name: str = "big_lm",
+                     out_path: str | None = None,
+                     smoke_layers: int = 2, smoke_batch: int = 2,
+                     smoke_steps: int = 2,
+                     hbm_bytes: float = 16 * 1024**3) -> dict:
+    """No-chip de-risking of a TPU-oriented config (VERDICT r3 item 2).
+
+    ``big_lm`` exists to measure MFU on the real chip, and the tunnel to
+    that chip has been reachable for minutes per round — so every failure
+    mode that does NOT need the chip must be burned down in advance, on
+    CPU, leaving only Mosaic lowering chip-gated.  Four checks:
+
+    1. **State byte budget** (`jax.eval_shape`, allocates nothing): params
+       + optimizer state + one gradient pytree, in the TPU dtypes (bf16
+       compute / f32 params, exactly what ``bench_framework`` builds).
+    2. **Trace check**: ``jax.eval_shape`` of the full jitted train step at
+       the real batch shapes — shape errors surface here, not on the chip.
+    3. **XLA buffer assignment**: lower + compile the step for CPU and read
+       ``compiled.memory_analysis()`` — XLA's own peak temp (activation)
+       estimate for this program.  The CPU buffer assignment is not the TPU
+       one (different fusion/layout), but it is the same order and catches
+       a config that cannot fit 16 GB v5e HBM by construction.
+    4. **Same-shape-class smoke**: a scaled-down model (``smoke_layers``
+       layers, SAME d_model/d_ff/vocab/seq — the matmul shape classes the
+       MXU will see) trains ``smoke_steps`` real steps on CPU; the loss
+       must be finite and near ln(vocab) at init.
+
+    Runs CPU-pinned (never touches the tunnel); writes ``out_path`` and
+    returns the record.  The v5e HBM default (16 GiB) and the ~9/16 GiB
+    measured budget are documented in BASELINE.md.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    if out_path is None:
+        # only big_lm owns the canonical artifact ARTIFACTS.md documents;
+        # a cheap preflight of another config must not clobber it
+        out_path = ("BENCH_PREFLIGHT.json" if config_name == "big_lm"
+                    else f"BENCH_PREFLIGHT_{config_name}.json")
+    cfg = _make_config(config_name)
+    rec = {"metric": f"{config_name}_preflight", "config": config_name,
+           "hbm_capacity_bytes": int(hbm_bytes)}
+
+    def tree_bytes(shapes) -> int:
+        return sum(int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                   for l in jax.tree_util.tree_leaves(shapes))
+
+    # -- 1. state bytes in the TPU dtype configuration (nothing allocated)
+    model = cfg["make_model"](jnp.bfloat16)
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    state_shapes = jax.eval_shape(
+        lambda: TrainState.create(model, opt, prng.init_key(0)))
+    param_b = tree_bytes(state_shapes.params)
+    opt_b = tree_bytes(state_shapes.opt_state)
+    rec.update(param_bytes=param_b, opt_state_bytes=opt_b,
+               grad_bytes=param_b)
+
+    # -- 2 + 3. trace and compile the REAL train step (1-device CPU mesh —
+    # bench_framework on the single-chip bench builds exactly this)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    state = TrainState.create(model, opt, prng.init_key(0))
+    state = dp.replicate_state(state, mesh)
+    step = dp.make_train_step(model, opt, mesh, cfg["loss"], "global_mean")
+    rng = np.random.default_rng(0)
+    raw = cfg["make_batch"](rng, cfg["batch"])
+    batch = shd.shard_batch(mesh, raw)
+    jax.eval_shape(step, state, batch)
+    rec["eval_shape_ok"] = True
+    t0 = time.perf_counter()
+    compiled = jax.jit(step).lower(state, batch).compile()
+    rec["cpu_compile_s"] = round(time.perf_counter() - t0, 1)
+    temp_b = None
+    try:
+        ma = compiled.memory_analysis()
+        temp_b = int(getattr(ma, "temp_size_in_bytes", 0)) or None
+        rec["xla_cpu_memory_analysis"] = {
+            "temp_bytes": temp_b,
+            "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+            "generated_code_bytes": int(
+                getattr(ma, "generated_code_size_in_bytes", 0)),
+        }
+    except Exception as e:  # noqa: BLE001 — analysis is best-effort
+        rec["xla_cpu_memory_analysis"] = {"error": f"{type(e).__name__}: {e}"}
+    rec["lower_compile_ok"] = True
+    # steady-state residency: params + opt state + grads + XLA temp.  The
+    # CPU temp number stands in for the TPU one (same order; the real
+    # budget lands in BASELINE.md once the chip answers).
+    known = param_b + opt_b + param_b + (temp_b or 0)
+    rec["projected_hbm_bytes"] = known
+    rec["fits_hbm"] = bool(temp_b is not None and known < hbm_bytes * 0.9)
+
+    # -- 4. same-shape-class smoke (CPU f32, like bench_framework's CPU
+    # path): every matmul shape class the chip will see, fewer layers
+    smoke = dict(rec=None)
+    if config_name == "big_lm":
+        from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+            Transformer, TransformerConfig,
+        )
+
+        c = _BIG
+        small = Transformer(TransformerConfig(
+            vocab_size=c["vocab"], max_seq_len=c["seq"],
+            n_layers=smoke_layers, d_model=c["d_model"],
+            n_heads=c["n_heads"], d_ff=c["d_ff"],
+            compute_dtype=jnp.float32, attention="flash", scan_layers=True))
+        sstate = TrainState.create(small, opt, prng.init_key(0))
+        sstate = dp.replicate_state(sstate, mesh)
+        sstep = dp.make_train_step(small, opt, mesh, cfg["loss"],
+                                   "global_mean")
+        sraw = cfg["make_batch"](rng, smoke_batch)
+        sbatch = shd.shard_batch(mesh, sraw)
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(smoke_steps):
+            sstate, loss = sstep(sstate, sbatch)
+            losses.append(float(jax.device_get(loss)))
+        smoke = {
+            "layers": smoke_layers, "batch": smoke_batch,
+            "steps": smoke_steps, "losses": [round(l, 4) for l in losses],
+            "elapsed_s": round(time.perf_counter() - t0, 1),
+            "ln_vocab": round(float(np.log(c["vocab"])), 4),
+            "ok": bool(np.all(np.isfinite(losses))
+                       and abs(losses[0] - np.log(c["vocab"])) < 1.0),
+        }
+    rec["smoke"] = smoke
+    # fits_hbm is part of the verdict: an over-budget config passing its
+    # preflight would burn the scarce tunnel window on an on-chip OOM —
+    # the exact failure this gate exists to prevent
+    rec["ok"] = bool(rec["eval_shape_ok"] and rec["lower_compile_ok"]
+                     and rec["fits_hbm"] and (smoke.get("ok", True)))
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    log(f"preflight[{config_name}] -> {out_path}")
+    return rec
 
 
 def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
@@ -928,7 +1081,19 @@ def main() -> int:
                     help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
+    ap.add_argument("--preflight", action="store_true",
+                    help="no-chip de-risking of --config: state byte budget "
+                         "vs v5e HBM, eval_shape + CPU lower/compile of the "
+                         "real train step, same-shape-class CPU smoke; "
+                         "writes BENCH_PREFLIGHT.json (runs CPU-pinned, "
+                         "never touches the TPU tunnel)")
     args = ap.parse_args()
+
+    if args.preflight:
+        plat.pin("cpu")
+        rec = preflight_config(args.config)
+        print(json.dumps(rec))
+        return 0 if rec["ok"] else 1
 
     if args.scaling:
         run_scaling_sweep()
@@ -947,17 +1112,26 @@ def main() -> int:
         print(json.dumps({"decode_artifact": "BENCH_DECODE.json"}))
         return 0
 
-    if args.attention:  # after platform resolution: touches the backend
-        if choice == "cpu":
-            # the fallback parent has ONE device; ring needs a 'seq' axis
-            _run_flag_cpu_child("--attention-inproc", 4)
-        else:
-            bench_attention()
-    if args.decode:
-        if choice == "cpu":
-            _run_flag_cpu_child("--decode-inproc", 8)
-        else:
-            bench_decode()
+    if args.attention or args.decode:
+        # standalone artifact runs: do NOT fall through into the default
+        # config bench — on the exclusive tunnel that would spend extra
+        # minutes of a flapping window re-measuring `wide` (+ its torch
+        # baseline), and callers checking the last JSON line would read
+        # that trailing record instead of the artifact they asked for
+        if args.attention:  # after platform resolution: touches the backend
+            if choice == "cpu":
+                # the fallback parent has ONE device; ring needs a 'seq' axis
+                _run_flag_cpu_child("--attention-inproc", 4)
+            else:
+                bench_attention()
+            print(json.dumps({"attention_artifact": "BENCH_ATTENTION.json"}))
+        if args.decode:
+            if choice == "cpu":
+                _run_flag_cpu_child("--decode-inproc", 8)
+            else:
+                bench_decode()
+            print(json.dumps({"decode_artifact": "BENCH_DECODE.json"}))
+        return 0
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
     if args.all and choice == "cpu":
