@@ -22,10 +22,10 @@ pytestmark = pytest.mark.slow
 VOCAB, T = 64, 16
 
 
-def tiny_model(n_layers=4):
+def tiny_model(n_layers=4, attention="dense"):
     return Transformer(TransformerConfig(
         vocab_size=VOCAB, max_seq_len=T, n_layers=n_layers, d_model=32,
-        n_heads=4, d_ff=64, attention="dense"))
+        n_heads=4, d_ff=64, attention=attention))
 
 
 def lm_batch(rows, seed=0):
@@ -369,3 +369,49 @@ def test_pipeline_eval_pads_non_divisible_batch():
                                rtol=1e-5)
     np.testing.assert_allclose(float(got["accuracy"]),
                                float(want["accuracy"]), rtol=1e-5)
+
+def test_pipeline_tensor_flash_matches_single_device():
+    """PP x TP with flash attention (VERDICT r3 item 4): the Pallas flash
+    kernel runs over each tensor rank's LOCAL heads inside the Megatron
+    stage body — the composed step must still be a pure re-scheduling of
+    the single-device flash model (loss + updated blocks match)."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+
+    pipe, tp, v, n_mb = 2, 2, 2, 2
+    devs = jax.devices("cpu")[: pipe * tp * 2]
+    mesh = make_mesh(MeshConfig(data=2, pipe=pipe, tensor=tp), devices=devs)
+    model = tiny_model(pipe * v, attention="flash")
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows=2 * n_mb * 2)
+
+    state, loss = pp.run_one_step(model, opt, mesh, batch, prng.init_key(0),
+                                  n_microbatches=n_mb, interleave=v)
+
+    params = model.init(prng.init_key(0))
+    ref_loss, ref_params = reference_step(model, opt, params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+    got_stack = megatron.permute_qkv(
+        jax.device_get(state.params["blocks"]), model.cfg.d_model,
+        model.cfg.n_heads, tp, inverse=True)
+    got_blocks = pp.unstack_blocks(got_stack, stack_ndims=3)
+    ref_blocks = jax.device_get(ref_params["blocks"])
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)
+
+
+def test_pipeline_rejects_seq_sharded_attention():
+    """ring/striped/ulysses need a 'seq' mesh axis the pipe mesh does not
+    bind; the guard must fire for tp=1 too (previously only tp>1 was
+    checked and tp=1 failed at trace time with an unbound-axis error)."""
+    devs = jax.devices("cpu")[:2]
+    mesh = make_mesh(MeshConfig(data=1, pipe=2), devices=devs)
+    model = tiny_model(4, attention="ring")
+    with pytest.raises(NotImplementedError, match="seq-sharded"):
+        pp.make_pipeline_train_step(model, optim.sgd(0.1), mesh)

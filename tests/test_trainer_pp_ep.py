@@ -65,9 +65,9 @@ def test_trainer_expert_requires_moe_model():
 
 
 def test_trainer_rejects_unwired_mixed_styles():
-    cfg = _lm_cfg(data=2, pipe=2, expert=2)
-    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
-                                    moe_expert_axis="expert")
+    # pipe x seq stays unwired (pipe x expert is wired in round 4)
+    cfg = _lm_cfg(data=2, pipe=2, seq=2)
+    cfg.model = dataclasses.replace(cfg.model, attention="ring")
     with pytest.raises(NotImplementedError, match="pipe composes with"):
         Trainer(cfg)
     # seq x tensor, seq x expert, and expert x tensor are wired (round 2);
@@ -76,6 +76,107 @@ def test_trainer_rejects_unwired_mixed_styles():
     cfg2.model = dataclasses.replace(cfg2.model, attention="ring")
     with pytest.raises(NotImplementedError, match="wired combinations"):
         Trainer(cfg2)
+    # MoE x pipeline x tensor remains unwired — the specific guard names it
+    cfg3 = _lm_cfg(pipe=2, expert=2, tensor=2)  # data wildcards to 1
+    cfg3.model = dataclasses.replace(cfg3.model, moe_experts=4,
+                                     moe_expert_axis="expert")
+    with pytest.raises(NotImplementedError, match="MoE x pipeline x tensor"):
+        Trainer(cfg3)
+
+
+def test_trainer_pp_ep_end_to_end():
+    """DP x PP x EP through the Trainer (VERDICT r3 item 5): MoE blocks
+    inside pipeline stages — all_to_all expert dispatch per stage, aux
+    load-balance loss threaded through the tick carry."""
+    cfg = _lm_cfg(data=2, pipe=2, expert=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    moe_expert_axis="expert")
+    t = Trainer(cfg)
+    assert t.pp_ep and t.pipeline and t.expert
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_pp_ep_is_a_pure_rescheduling_of_dp_ep():
+    """The pipelined MoE step must be numerically the DP x EP step with
+    gradient accumulation: same shards (data x expert rows), same
+    contiguous microbatch split, same aux convention
+    (Σ_mb s_mb + aux_weight·aux_mb·cnt_mb, reported loss task-only) —
+    so loss AND updated params agree.  This is the aux-loss-carried
+    proof: both sides include aux_weight=0.01, so a pipeline that
+    dropped or mis-gated aux would diverge."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        expert as ep_lib,
+        pipeline as pp,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    V, T, n_mb = 64, 16, 2
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="dense", moe_experts=4,
+        moe_expert_axis="expert"))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, V, (16, T + 1))
+    batch = {"x": tok[:, :-1].astype(np.int32),
+             "y": tok[:, 1:].astype(np.int32),
+             "mask": np.ones((16,), np.float32)}
+
+    # --- pipelined: data=2 x pipe=2 x expert=2 ---
+    import jax as _jax
+
+    pmesh = make_mesh(MeshConfig(data=2, pipe=2, expert=2),
+                      devices=_jax.devices("cpu")[:8])
+    state_pp, loss_pp = pp.run_one_step(model, opt, pmesh, batch,
+                                        prng.init_key(0),
+                                        n_microbatches=n_mb)
+
+    # --- reference: data=2 x expert=2 with accum_steps = n_mb ---
+    emesh = make_mesh(MeshConfig(data=2, expert=2),
+                      devices=_jax.devices("cpu")[:4])
+    state_ep = ep_lib.shard_moe_state(
+        TrainState.create(model, opt, prng.init_key(0)), emesh, opt)
+    moe_step = ep_lib.make_moe_train_step(model, opt, emesh,
+                                          accum_steps=n_mb, donate=False)
+    placed = {k: jax.device_put(
+        jnp.asarray(v),
+        NamedSharding(emesh, P(("data", "fsdp", "expert"))))
+        for k, v in batch.items()}
+    state_ep, metrics = moe_step(state_ep, placed)
+
+    np.testing.assert_allclose(float(loss_pp), float(metrics["loss"]),
+                               rtol=1e-5, atol=1e-6)
+    got_blocks = pp.unstack_blocks(
+        jax.device_get(state_pp.params["blocks"]))
+    ref_blocks = jax.device_get(state_ep.params["blocks"])
+    assert len(got_blocks) == len(ref_blocks)
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)
+    for name in ("embed", "pos", "ln_f", "head"):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            jax.device_get(state_pp.params[name]),
+            jax.device_get(state_ep.params[name]))
 
 
 def test_cli_ep_flag_wires_moe():
