@@ -190,7 +190,7 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     ``optim.with_clipping`` instead (there the full mean gradient is local,
     so the wrapper's norm is already global).
     """
-    if grad_reduction not in ("global_mean", "per_shard_mean"):
+    if grad_reduction not in ("global_mean", "per_shard_mean", "local"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
     if update_sharding not in ("replicated", "zero1"):
         raise ValueError(f"unknown update_sharding {update_sharding!r}")
@@ -219,6 +219,16 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
             grads = jax.tree_util.tree_map(
                 lambda g: lax.psum(g, DATA_AXES) / total, grads)
             loss = lax.psum(s, DATA_AXES) / total
+        elif grad_reduction == "local":
+            # MEASUREMENT-ONLY ablation (bench.py --scaling): the exact
+            # same per-shard compute with ZERO cross-device collectives,
+            # so (global_mean step time) - (local step time) isolates the
+            # gradient allreduce cost at each mesh size.  Replicas apply
+            # their own shard-mean and silently diverge — never train
+            # with this; the Trainer does not expose it.
+            grads = jax.tree_util.tree_map(
+                lambda g: g / jnp.maximum(c, 1.0), grads)
+            loss = s / jnp.maximum(c, 1.0)
         else:  # per_shard_mean: the reference's :188-197 semantics
             local_mean = jax.tree_util.tree_map(
                 lambda g: g / jnp.maximum(c, 1.0), grads)

@@ -95,6 +95,14 @@ class Trainer:
                             or cfg.model.moe_experts <= 0):
             raise ValueError("expert axis > 1 requires a transformer with "
                              "moe_experts > 0 (--moe_experts)")
+        if cfg.grad_reduction not in ("global_mean", "per_shard_mean"):
+            # 'local' exists in data_parallel.make_train_step ONLY as
+            # bench.py's collective-cost ablation — replicas silently
+            # diverge; it must never reach a training job (the CLI choices
+            # already exclude it; this guards programmatic configs too)
+            raise ValueError(
+                f"grad_reduction={cfg.grad_reduction!r} is not a training "
+                "semantic (choices: global_mean, per_shard_mean)")
         if ((self.pipeline or self.expert or self.sp_tp)
                 and cfg.grad_reduction != "global_mean"):
             raise ValueError("pipeline/expert/seq-x-tensor steps always use "

@@ -1,0 +1,51 @@
+"""The canonical bench JSON line under accelerator fallback (VERDICT r3
+item 6): when the capture-time probe fails, the headline must be the
+cached real-chip row — explicitly stamped — with this run's CPU number
+demoted to a machine-readable mechanism check, so no driver-readable
+artifact carries an unmarked sub-1.0 vs_baseline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fallback_headline_is_cached_tpu_row():
+    env = dict(os.environ)
+    # force the probe to resolve fast and to cpu: the conftest already
+    # stripped the tunnel env, so a 10s single attempt answers "cpu_only"
+    # immediately and the fallback path engages
+    env["BENCH_PROBE_TIMEOUT"] = "10"
+    env["BENCH_PROBE_ATTEMPTS"] = "1"
+    env["BENCH_PROBE_BACKOFF"] = "1"
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--config", "toy", "--no-baseline"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-1500:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+
+    # BENCH_TPU_LATEST.json (committed) holds a toy row, so the headline
+    # must be the cached real-chip measurement, stamped as such
+    assert rec["measurement"] == "cached_tpu"
+    assert rec["platform_fallback"] is True
+    assert rec["platform"] not in (None, "cpu")
+    assert rec["captured_iso"] and rec["age_hours"] is not None
+    assert rec["probe"]["attempts"] >= 1
+
+    # this run's CPU number is inside, demoted and labeled
+    cpu = rec["cpu_fallback_run"]
+    assert cpu["role"] == "mechanism_check_on_fallback_host"
+    assert cpu["platform"] == "cpu"
+    assert cpu["value"] > 0
+
+    # the invariant the schema exists for: a sub-1.0 vs_baseline is never
+    # presented at top level without the fallback marker
+    if (rec.get("vs_baseline") or 1.0) < 1.0:
+        assert rec.get("platform_fallback") or rec.get("role")

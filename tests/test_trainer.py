@@ -145,3 +145,26 @@ def test_eval_accuracy_classification(mesh8):
     t.init_state()
     metrics = t.evaluate()
     assert 0.0 <= metrics["accuracy"] <= 1.0
+
+def test_trainer_rejects_ablation_grad_reduction():
+    """grad_reduction='local' is bench.py's collective-cost ablation
+    (replicas diverge); the Trainer must refuse it even though
+    data_parallel.make_train_step accepts it for the measurement path."""
+    import dataclasses
+
+    import pytest
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    cfg = TrainConfig(nepochs=1, batch_size=8,
+                      data=DataConfig(dataset="regression", n_samples=16),
+                      model=ModelConfig(arch="mlp"),
+                      mesh=MeshConfig(data=8))
+    cfg = dataclasses.replace(cfg, grad_reduction="local")
+    with pytest.raises(ValueError, match="not a training semantic"):
+        Trainer(cfg)
