@@ -1273,9 +1273,25 @@ def main() -> int:
                                 "error": f"{type(e).__name__}: {e}"})
                 continue
             # A process whose backend initialized cannot switch platforms;
-            # retry the config in a CPU-pinned subprocess instead.
+            # retry the config in a CPU-pinned subprocess instead.  ONLY
+            # when this run was aiming at the accelerator: an
+            # already-CPU run (e.g. a scaling-sweep child, possibly an
+            # ablation with overridden batch/devices) must fail loudly —
+            # a 1-device default-parameter retry would silently
+            # substitute a DIFFERENT measurement for the one requested.
+            if args.platform == "cpu":
+                if not args.all:
+                    raise
+                records.append({"metric": METRIC_NAMES[name], "value": None,
+                                "unit": "samples/sec",
+                                "error": f"{type(e).__name__}: {e}"})
+                continue
             rec = _run_child_cpu(name, n_devices=1,
-                                 baseline=not args.no_baseline)
+                                 baseline=not args.no_baseline,
+                                 batch=args.batch or None,
+                                 grad_reduction=(args.grad_reduction
+                                                 if args.grad_reduction
+                                                 != "global_mean" else None))
             if rec is None:
                 if not args.all:
                     raise

@@ -293,6 +293,33 @@ def make_moe_eval_step(model: Transformer, mesh: Mesh,
 
 TENSOR_AXIS = "tensor"
 
+# THE single consult point for which expert-FFN leaves carry a
+# tensor-sharded dim under EP x TP (each expert's hidden dim f): w_in
+# (E, d, f) column-parallel, b_in (E, f) with it, w_out (E, f, d)
+# row-parallel.  b_out (E, d) is expert-sharded only — it adds after the
+# row-parallel psum.  Consulted by moe_tp_param_specs, the EP x TP clip
+# axes, and parallel.pipeline's PP x EP x TP specs/clip, so the four
+# sites cannot desynchronize (same role megatron.is_tensor_sharded plays
+# for the attention/dense-FFN leaves).
+TENSOR_SHARDED_EXPERT_LEAVES = ("w_in", "b_in", "w_out")
+
+
+def moe_ffn_fn(cfg, expert_axis=None, tensor_axis=None):
+    """The shared MoE-FFN block injection for ``megatron.tp_block_apply``:
+    build the MoEFFN exactly once from the model config (the EP x TP
+    forward and the PP x EP x TP pipeline stage body both consume this,
+    so the two paths cannot drift) and return
+    ``ffn_fn(layer_params, h) -> (ff, aux)``."""
+    from ..models.moe import MoEFFN
+
+    ffn = MoEFFN(
+        cfg.d_model, cfg.d_ff, cfg.moe_experts,
+        capacity_factor=cfg.moe_capacity_factor, capacity=cfg.moe_capacity,
+        activation=cfg.activation, expert_axis=expert_axis,
+        tensor_axis=tensor_axis, router_top_k=cfg.moe_top_k,
+        param_dtype=cfg.param_dtype, compute_dtype=cfg.compute_dtype)
+    return lambda layer_params, h: ffn.apply(layer_params["moe"], h)
+
 
 def moe_tp_param_specs(params: Pytree) -> Pytree:
     """shard_map PartitionSpecs for the transformer-with-MoE param tree on a
@@ -313,11 +340,13 @@ def moe_tp_param_specs(params: Pytree) -> Pytree:
         names = megatron.path_names(path)
         if _is_expert_path(path):
             leaf_name = names[-1]
-            if leaf_name == "w_in":
-                return P(EXPERT_AXIS, None, TENSOR_AXIS)
-            if leaf_name == "b_in":
-                return P(EXPERT_AXIS, TENSOR_AXIS)
-            if leaf_name == "w_out":
+            if leaf_name in TENSOR_SHARDED_EXPERT_LEAVES:
+                # hidden dim f shards over 'tensor': col for w_in/b_in
+                # (last dim), row for w_out (first after E)
+                if leaf_name == "w_in":
+                    return P(EXPERT_AXIS, None, TENSOR_AXIS)
+                if leaf_name == "b_in":
+                    return P(EXPERT_AXIS, TENSOR_AXIS)
                 return P(EXPERT_AXIS, TENSOR_AXIS, None)
             if leaf_name == "b_out":
                 return P(EXPERT_AXIS)
@@ -402,19 +431,10 @@ def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
     MoEFFN (slots over 'expert' by all_to_all, hidden dim over 'tensor'),
     replicated LN + head.  Reuses Transformer.embed/head_logits so the
     composed path cannot drift from the dense model."""
-    from ..models.moe import MoEFFN
     from . import megatron
 
     c = model.cfg
-    ffn = MoEFFN(
-        c.d_model, c.d_ff, c.moe_experts,
-        capacity_factor=c.moe_capacity_factor, capacity=c.moe_capacity,
-        activation=c.activation, expert_axis=EXPERT_AXIS,
-        tensor_axis=TENSOR_AXIS, router_top_k=c.moe_top_k,
-        param_dtype=c.param_dtype, compute_dtype=c.compute_dtype)
-
-    def ffn_fn(layer_params, h):
-        return ffn.apply(layer_params["moe"], h)
+    ffn_fn = moe_ffn_fn(c, expert_axis=EXPERT_AXIS, tensor_axis=TENSOR_AXIS)
 
     b, t = ids.shape
     x = model.embed(params, ids, jnp.arange(t))
@@ -484,9 +504,9 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
     def clip_axes(path) -> Tuple[str, ...]:
         names = megatron.path_names(path)
         if _is_expert_path(path):
-            if names[-1] == "b_out":
-                return (EXPERT_AXIS,)
-            return (EXPERT_AXIS, TENSOR_AXIS)
+            if names[-1] in TENSOR_SHARDED_EXPERT_LEAVES:
+                return (EXPERT_AXIS, TENSOR_AXIS)
+            return (EXPERT_AXIS,)
         if megatron.is_tensor_sharded(names):
             return (TENSOR_AXIS,)
         return ()

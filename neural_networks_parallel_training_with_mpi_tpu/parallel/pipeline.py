@@ -198,7 +198,28 @@ def pipeline_param_specs(params: Pytree, tp: int = 1,
             # MoE expert leaves carry a leading expert dim right after the
             # stack dims — (S, per, E, ...) — sharded over 'expert' like
             # parallel.expert.moe_param_specs (gate stays pipe-sharded
-            # only, replicated over 'expert')
+            # only, replicated over 'expert').  With tp > 1 each expert's
+            # hidden dim f additionally shards over 'tensor' (GShard;
+            # same layout as parallel.expert.moe_tp_param_specs): w_in
+            # (S, per, E, d, f) column-parallel, b_in (S, per, E, f) with
+            # it, w_out (S, per, E, f, d) row-parallel, b_out expert-only
+            # (it adds after the row-parallel psum).
+            from .expert import TENSOR_SHARDED_EXPERT_LEAVES
+
+            names = megatron.path_names(path)
+            if tp > 1:
+                if names[-1] in TENSOR_SHARDED_EXPERT_LEAVES:
+                    if names[-1] == "w_in":
+                        return P(*lead, PIPE_AXIS, None, EXPERT_AXIS, None,
+                                 "tensor")
+                    if names[-1] == "b_in":
+                        return P(*lead, PIPE_AXIS, None, EXPERT_AXIS,
+                                 "tensor")
+                    return P(*lead, PIPE_AXIS, None, EXPERT_AXIS, "tensor",
+                             None)
+                if names[-1] == "b_out":
+                    return P(*lead, PIPE_AXIS, None, EXPERT_AXIS)
+                raise ValueError(f"unexpected expert leaf {names}")
             return P(*lead, PIPE_AXIS, None, EXPERT_AXIS)
         if tp <= 1:
             return blk
@@ -291,11 +312,23 @@ def _stage_fns(model: Transformer, tp: int):
         attn = (None if c.attention == "dense"
                 else (lambda q, k, v: sequence_sharded_attention(
                     c.attention, q, k, v, causal=True)))
+        ffn_fn = None
+        if c.moe_experts > 0:
+            # GShard expert+model parallelism inside the stage: experts
+            # over 'expert' (all_to_all slots), each expert's hidden dim
+            # over 'tensor' (psum combine) — the shared factory keeps this
+            # path and parallel.expert's EP x TP forward identical
+            from .expert import moe_ffn_fn
+
+            ffn_fn = moe_ffn_fn(c, expert_axis=c.moe_expert_axis,
+                                tensor_axis="tensor")
 
         def block_body(h, layer_params):
-            return (megatron.tp_block_apply(c, layer_params, h, tp,
-                                            attention_fn=attn),
-                    jnp.zeros((), jnp.float32))
+            out = megatron.tp_block_apply(c, layer_params, h, tp,
+                                          attention_fn=attn, ffn_fn=ffn_fn)
+            if ffn_fn is None:
+                return out, jnp.zeros((), jnp.float32)
+            return out  # (x, aux) from the MoE FFN
     else:
         def block_body(h, layer_params):
             # (h, aux): aux is the MoE load-balance scalar, 0 for dense FFN
@@ -346,16 +379,16 @@ def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
         from .expert import EXPERT_AXIS
 
         ep = int(mesh.shape.get(EXPERT_AXIS, 1))
-        if tp > 1:
+        if ep < 2:
             raise NotImplementedError(
-                "MoE x pipeline x tensor is not wired (tp_block_apply's "
-                "dense FFN only on the pipe path); use DP x PP x EP, or "
-                "parallel.expert's EP x TP step without the pipeline")
-        if ep > 1 and c.moe_expert_axis != EXPERT_AXIS:
+                "MoE x pipeline rides the expert axis (DP x PP x EP"
+                "[ x TP]): add expert > 1 to the mesh; dense-expert "
+                "pipelining without an 'expert' axis is not wired")
+        if c.moe_expert_axis != EXPERT_AXIS:
             raise ValueError(f"mesh expert={ep} but model.moe_expert_axis="
                              f"{c.moe_expert_axis!r}; set it to "
                              f"{EXPERT_AXIS!r}")
-        if c.moe_experts % max(ep, 1):
+        if c.moe_experts % ep:
             raise ValueError(f"{c.moe_experts} experts not divisible over "
                              f"expert axis of size {ep}")
     if c.attention not in ("dense", "flash"):
@@ -596,34 +629,36 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
             sq = {k: sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
                          for l in jax.tree_util.tree_leaves(v))
                   for k, v in grads.items() if k != "blocks"}
-            # blocks: pipe-sharded; with TP, Megatron col/row leaves are
-            # additionally tensor-sharded while ln/row-bias leaves are
-            # tensor-replicated (identical grads per rank — not summed);
-            # with EP, expert leaves are additionally expert-sharded
-            blk_t = jnp.zeros((), jnp.float32)
-            blk_e = jnp.zeros((), jnp.float32)
-            blk_r = jnp.zeros((), jnp.float32)
+            # blocks: every leaf is pipe-sharded; Megatron col/row leaves
+            # are additionally tensor-sharded, expert leaves expert-sharded
+            # (and their w_in/b_in/w_out tensor-sharded too under EP x TP),
+            # everything else replicated on those axes (identical grads per
+            # rank — not summed).  Bucket squared norms by their exact psum
+            # axes so each distinct axis set costs one psum.
             from . import megatron
 
+            from .expert import TENSOR_SHARDED_EXPERT_LEAVES
+
+            def blk_axes(path, names):
+                axes = [PIPE_AXIS]
+                if moe and _is_expert_path(path):
+                    axes.append(EXPERT_AXIS)
+                    if (tp > 1
+                            and names[-1] in TENSOR_SHARDED_EXPERT_LEAVES):
+                        axes.append("tensor")
+                elif tp > 1 and megatron.is_tensor_sharded(names):
+                    axes.append("tensor")
+                return tuple(axes)
+
+            buckets: Dict[Tuple[str, ...], jax.Array] = {}
             for path, g in jax.tree_util.tree_flatten_with_path(
                     grads["blocks"])[0]:
                 term = jnp.sum(jnp.square(g.astype(jnp.float32)))
-                names = megatron.path_names(path)
-                if tp > 1 and megatron.is_tensor_sharded(names):
-                    blk_t = blk_t + term
-                elif moe and ep > 1 and _is_expert_path(path):
-                    blk_e = blk_e + term
-                else:
-                    blk_r = blk_r + term
-            gsq = sum(sq.values()) + lax.psum(blk_r, PIPE_AXIS)
-            if tp > 1:
-                gsq = gsq + lax.psum(blk_t, (PIPE_AXIS, "tensor"))
-            else:
-                gsq = gsq + lax.psum(blk_t, PIPE_AXIS)
-            if moe and ep > 1:
-                gsq = gsq + lax.psum(blk_e, (PIPE_AXIS, EXPERT_AXIS))
-            else:
-                gsq = gsq + lax.psum(blk_e, PIPE_AXIS)
+                axes = blk_axes(path, megatron.path_names(path))
+                buckets[axes] = buckets.get(axes, 0.0) + term
+            gsq = sum(sq.values())
+            for axes, val in buckets.items():
+                gsq = gsq + lax.psum(val, axes)
             scale = jnp.minimum(
                 1.0, grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
             grads = jax.tree_util.tree_map(

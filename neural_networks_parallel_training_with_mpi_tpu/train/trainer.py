@@ -58,8 +58,10 @@ class Trainer:
                                or fsdp_on))
         self.sp_ep = (self.seq_parallel and self.expert
                       and not (self.pipeline or self.tensor or fsdp_on))
-        # DP x PP x EP: the pipeline step threads the MoE aux loss through
-        # the tick carry and runs the all_to_all dispatch inside each stage
+        # DP x PP x EP (x TP): the pipeline step threads the MoE aux loss
+        # through the tick carry and runs the all_to_all dispatch inside
+        # each stage (tensor > 1 additionally Megatron-shards attention
+        # heads and each expert's hidden dim — GShard in the pipeline)
         self.pp_ep = (self.pipeline and self.expert
                       and not (self.seq_parallel or fsdp_on))
         self.gspmd = (not self.pipeline and not self.sp_tp and not self.ep_tp
@@ -73,10 +75,6 @@ class Trainer:
                 f"pipe composes with data + tensor axes, or data + expert "
                 f"(MoE); got pipe x {unwired} — compose parallel.* step "
                 f"builders directly")
-        if self.pp_ep and self.tensor:
-            raise NotImplementedError(
-                "MoE x pipeline x tensor is not wired; use DP x PP x EP "
-                "(drop --tp) or the EP x TP step (drop --pp)")
         exclusive = [name for name, on in
                      (("seq", self.seq_parallel and not self.sp_tp
                        and not self.sp_ep),

@@ -76,12 +76,27 @@ def test_trainer_rejects_unwired_mixed_styles():
     cfg2.model = dataclasses.replace(cfg2.model, attention="ring")
     with pytest.raises(NotImplementedError, match="wired combinations"):
         Trainer(cfg2)
-    # MoE x pipeline x tensor remains unwired — the specific guard names it
-    cfg3 = _lm_cfg(pipe=2, expert=2, tensor=2)  # data wildcards to 1
-    cfg3.model = dataclasses.replace(cfg3.model, moe_experts=4,
-                                     moe_expert_axis="expert")
-    with pytest.raises(NotImplementedError, match="MoE x pipeline x tensor"):
-        Trainer(cfg3)
+    # MoE x pipeline without an expert axis stays unwired — clear guard
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        pipeline as pp,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    import jax as _jax
+
+    moe_model = Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=16, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, moe_experts=4))
+    mesh_noexp = make_mesh(MeshConfig(data=4, pipe=2),
+                           devices=_jax.devices("cpu")[:8])
+    with pytest.raises(NotImplementedError, match="expert axis"):
+        pp.make_pipeline_train_step(moe_model, optim.sgd(0.1), mesh_noexp)
 
 
 def test_trainer_pp_ep_end_to_end():
@@ -294,3 +309,86 @@ def test_trainer_seq_expert_end_to_end():
     assert np.isfinite(result["final_loss"])
     assert "val_loss" in result and np.isfinite(result["val_loss"])
     assert "val_accuracy" in result
+
+def test_pp_ep_tp_is_a_pure_rescheduling_of_ep_tp():
+    """PP x EP x TP (GShard experts inside pipeline stages): numerically
+    the EP x TP step with gradient accumulation — Megatron attention over
+    local heads, experts sharded over 'expert' AND each expert's hidden
+    dim over 'tensor', aux threaded through the tick carry.  Loss and
+    updated params agree with parallel.expert.make_moe_tp_train_step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        expert as ep_lib,
+        pipeline as pp,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    V, T, n_mb = 64, 16, 2
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="dense", moe_experts=4,
+        moe_expert_axis="expert"))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    rng = np.random.default_rng(1)
+    tok = rng.integers(0, V, (8, T + 1))
+    batch = {"x": tok[:, :-1].astype(np.int32),
+             "y": tok[:, 1:].astype(np.int32),
+             "mask": np.ones((8,), np.float32)}
+
+    pmesh = make_mesh(MeshConfig(pipe=2, expert=2, tensor=2),
+                      devices=jax.devices("cpu")[:8])
+    state_pp, loss_pp = pp.run_one_step(model, opt, pmesh, batch,
+                                        prng.init_key(0),
+                                        n_microbatches=n_mb)
+
+    emesh = make_mesh(MeshConfig(expert=2, tensor=2),
+                      devices=jax.devices("cpu")[:4])
+    state_ep = ep_lib.init_moe_tp_state(model, opt, prng.init_key(0), tp=2)
+    state_ep = ep_lib.shard_moe_tp_state(state_ep, emesh, opt)
+    moe_step = ep_lib.make_moe_tp_train_step(model, opt, emesh,
+                                             accum_steps=n_mb, donate=False)
+    placed = {k: jax.device_put(
+        jnp.asarray(v),
+        NamedSharding(emesh, P(("data", "fsdp", "expert"))))
+        for k, v in batch.items()}
+    state_ep, metrics = moe_step(state_ep, placed)
+
+    np.testing.assert_allclose(float(loss_pp), float(metrics["loss"]),
+                               rtol=1e-5, atol=1e-6)
+    got_blocks = pp.unstack_blocks(jax.device_get(state_pp.params["blocks"]))
+    ref_blocks = jax.device_get(state_ep.params["blocks"])
+    assert len(got_blocks) == len(ref_blocks)
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)
+    for name in ("embed", "pos", "ln_f", "head"):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            jax.device_get(state_pp.params[name]),
+            jax.device_get(state_ep.params[name]))
+
+
+def test_trainer_pp_ep_tp_end_to_end():
+    """DP x PP x EP x TP through the Trainer: four parallelism axes in one
+    job (pipe stages x all_to_all experts x Megatron tensor sharding)."""
+    cfg = _lm_cfg(pipe=2, expert=2, tensor=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    moe_expert_axis="expert")
+    t = Trainer(cfg)
+    assert t.pp_ep and t.pipeline and t.expert and t.tensor
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
