@@ -1274,12 +1274,13 @@ def main() -> int:
                 continue
             # A process whose backend initialized cannot switch platforms;
             # retry the config in a CPU-pinned subprocess instead.  ONLY
-            # when this run was aiming at the accelerator: an
-            # already-CPU run (e.g. a scaling-sweep child, possibly an
-            # ablation with overridden batch/devices) must fail loudly —
-            # a 1-device default-parameter retry would silently
-            # substitute a DIFFERENT measurement for the one requested.
-            if args.platform == "cpu":
+            # when this run actually bound the accelerator: a run that
+            # already resolved to CPU (explicit --platform cpu, a
+            # scaling-sweep/ablation child, or an auto probe that fell
+            # back) must fail loudly — a CPU child retry could only fail
+            # the same way, and a 1-device default-parameter retry would
+            # silently substitute a DIFFERENT measurement.
+            if choice == "cpu":
                 if not args.all:
                     raise
                 records.append({"metric": METRIC_NAMES[name], "value": None,

@@ -347,9 +347,16 @@ def _stage_fns(model: Transformer, tp: int):
         return out, jnp.sum(auxs)
 
     def embed(params, ids_mb):
+        from .sequence import global_positions
+
         t = ids_mb.shape[-1]
         x = jnp.take(params["embed"]["table"], ids_mb, axis=0)
-        x = x + jnp.take(params["pos"]["table"], jnp.arange(t), axis=0)
+        # global token positions of this shard's t local indices — offset
+        # by the seq shard under PP x SP (identical to arange(t) when the
+        # sequence is unsharded; striped layouts get their stripes)
+        x = x + jnp.take(params["pos"]["table"],
+                         global_positions(c.attention, c.seq_axis, t),
+                         axis=0)
         return x.astype(c.compute_dtype)
 
     ln_f = LayerNorm(c.d_model, param_dtype=c.param_dtype)
@@ -391,13 +398,34 @@ def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
         if c.moe_experts % ep:
             raise ValueError(f"{c.moe_experts} experts not divisible over "
                              f"expert axis of size {ep}")
-    if c.attention not in ("dense", "flash"):
+    sp = int(mesh.shape.get(c.seq_axis, 1))
+    from .sequence import SEQ_SHARDED_IMPLS
+
+    if c.attention in SEQ_SHARDED_IMPLS:
+        # PP x SP: each stage's attention rings over the 'seq' axis while
+        # activations rotate over 'pipe' (round 4)
+        if sp < 2:
+            raise NotImplementedError(
+                f"the pipeline path runs seq-sharded attention="
+                f"{c.attention!r} only with a '{c.seq_axis}' mesh axis > 1 "
+                f"(PP x SP); without it use dense or flash on the "
+                f"unsharded sequence")
+        if tp > 1 or c.moe_experts > 0:
+            raise NotImplementedError(
+                "PP x SP composes with the data axes only; PP x SP x TP "
+                "and PP x SP x EP are not wired — use the SP x TP / "
+                "SP x EP steps (parallel.spmd / parallel.expert) or drop "
+                "the seq axis")
+    elif sp > 1:
+        raise ValueError(
+            f"mesh '{c.seq_axis}'={sp} but attention={c.attention!r} is "
+            f"not seq-sharded; pick one of the ring/striped/ulysses impls "
+            f"or drop the seq axis")
+    elif c.attention not in ("dense", "flash"):
         raise NotImplementedError(
-            f"the pipeline path runs attention on the UNSHARDED sequence "
-            f"(dense or flash); the seq-sharded attention="
-            f"{c.attention!r} needs a 'seq' mesh axis the pipe mesh does "
-            f"not bind — use the SP x TP path (parallel.spmd) for "
-            f"sequence parallelism")
+            f"unknown/unwired attention={c.attention!r} on the pipeline "
+            f"path (dense, flash, or a seq-sharded impl with a "
+            f"'{c.seq_axis}' mesh axis)")
     if tp > 1:
         from . import megatron
 
@@ -523,7 +551,11 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
 
     ep = int(mesh.shape.get(EXPERT_AXIS, 1))
     batch_axes = _pipe_batch_axes(c, mesh)
-    reduce_axes = batch_axes + (PIPE_AXIS,)
+    # PP x SP: tokens additionally shard over 'seq' (T dim of x/y); every
+    # token-summed reduction spans it, the row-spec axes do not
+    use_seq = int(mesh.shape.get(c.seq_axis, 1)) > 1
+    token_axes = batch_axes + ((c.seq_axis,) if use_seq else ())
+    reduce_axes = token_axes + (PIPE_AXIS,)
     stage_apply, embed, head_logits = _stage_fns(model, tp)
 
     def head_loss(params, h, tgt, msk):
@@ -605,16 +637,17 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
             local_fwd, has_aux=True)(state.params, batch)
         total = lax.psum(cnt, reduce_axes)
         # blocks are pipe-SHARDED (each device owns its stage's grads; reduce
-        # over data only — plus 'expert' for the expert-REPLICATED block
-        # leaves when the mesh has an expert axis; the expert-sharded
-        # leaves reduce over the data axes only, mirroring
-        # expert.make_moe_train_step); embed/pos/ln_f/head are
+        # over data — plus 'seq' under PP x SP and 'expert' for the
+        # expert-REPLICATED block leaves when the mesh has an expert axis;
+        # the expert-sharded leaves reduce over the data axes only,
+        # mirroring expert.make_moe_train_step); embed/pos/ln_f/head are
         # pipe-REPLICATED (their grads are nonzero on one stage each; psum
         # over pipe re-replicates)
-        blk_axes = batch_axes  # data (+ expert) for expert-replicated leaves
+        seq_tail = (c.seq_axis,) if use_seq else ()
 
         def blocks_psum(path, g):
-            axes = DATA_AXES if _is_expert_path(path) else blk_axes
+            axes = ((DATA_AXES + seq_tail) if _is_expert_path(path)
+                    else token_axes)
             return lax.psum(g, axes) / total
 
         grads = {
@@ -674,7 +707,9 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
     if ospecs is None:
         raise ValueError("optimizer must provide state_specs for pipeline")
     state_specs = TrainState(step=P(), params=pspecs, opt_state=ospecs)
-    batch_specs = {k: P(batch_axes) for k in batch_keys}
+    batch_specs = {k: (P(batch_axes, c.seq_axis)
+                       if use_seq and k != "mask" else P(batch_axes))
+                   for k in batch_keys}
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_specs, batch_specs),
@@ -705,7 +740,10 @@ def make_pipeline_eval_step(model: Transformer, mesh: Mesh,
                          f"n_microbatches={n_mb} does not divide")
     base = losses_lib.get(loss_name)
     batch_axes = _pipe_batch_axes(c, mesh)
-    reduce_axes = batch_axes + (PIPE_AXIS,)
+    use_seq = int(mesh.shape.get(c.seq_axis, 1)) > 1
+    token_axes = batch_axes + ((c.seq_axis,) if use_seq else ())
+    reduce_axes = token_axes + (PIPE_AXIS,)
+    row_axes = batch_axes + (PIPE_AXIS,)  # example-level sums (accuracy)
     stage_apply, embed, head_logits = _stage_fns(model, tp)
 
     def shard_eval(params, batch):
@@ -762,13 +800,22 @@ def make_pipeline_eval_step(model: Transformer, mesh: Mesh,
         total = lax.psum(cn, reduce_axes)
         out = {"loss": lax.psum(ls, reduce_axes) / total, "count": total}
         if with_accuracy:
-            ex_total = lax.psum(hc, reduce_axes)
-            out["accuracy"] = lax.psum(hs, reduce_axes) / ex_total
+            # example-level: each row appears once per seq shard (its hit
+            # is the per-shard token-accuracy mean), so sum over the ROW
+            # axes and average the per-shard accuracies over 'seq' — the
+            # SP x EP eval's convention (parallel.expert)
+            ex_total = lax.psum(hc, row_axes)
+            acc = lax.psum(hs, row_axes) / ex_total
+            if use_seq:
+                acc = lax.pmean(acc, c.seq_axis)
+            out["accuracy"] = acc
             out["example_count"] = ex_total
         return out
 
     pspecs = _pipeline_specs(model, n_stages, tp, interleave)
-    batch_specs = {k: P(batch_axes) for k in batch_keys}
+    batch_specs = {k: (P(batch_axes, c.seq_axis)
+                       if use_seq and k != "mask" else P(batch_axes))
+                   for k in batch_keys}
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,
         in_specs=(pspecs, batch_specs),
@@ -790,9 +837,12 @@ def run_one_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
                                 tp=int(mesh.shape.get("tensor", 1)),
                                 interleave=interleave)
     state = shard_pipeline_state(state, mesh, optimizer, interleave)
+    rows = _pipe_batch_axes(model.cfg, mesh)
+    use_seq = int(mesh.shape.get(model.cfg.seq_axis, 1)) > 1
     placed = {k: jax.device_put(
-        jnp.asarray(v), NamedSharding(mesh, P(_pipe_batch_axes(model.cfg,
-                                                               mesh))))
+        jnp.asarray(v), NamedSharding(
+            mesh, P(rows, model.cfg.seq_axis)
+            if use_seq and k != "mask" else P(rows)))
         for k, v in batch.items()}
     step = make_pipeline_train_step(model, optimizer, mesh, loss_name,
                                     n_microbatches, donate=False,

@@ -64,17 +64,21 @@ class Trainer:
         # heads and each expert's hidden dim — GShard in the pipeline)
         self.pp_ep = (self.pipeline and self.expert
                       and not (self.seq_parallel or fsdp_on))
+        # DP x PP x SP: each stage's attention rings over 'seq' while
+        # activations rotate over 'pipe' — long-context pipelining
+        self.pp_sp = (self.pipeline and self.seq_parallel
+                      and not (self.expert or self.tensor or fsdp_on))
         self.gspmd = (not self.pipeline and not self.sp_tp and not self.ep_tp
                       and (self.tensor or fsdp_on))
         unwired = [name for name, on in
-                   (("seq", self.seq_parallel),
+                   (("seq", self.seq_parallel and not self.pp_sp),
                     ("fsdp", fsdp_on),
                     ("expert", self.expert and not self.pp_ep)) if on]
         if self.pipeline and unwired:
             raise NotImplementedError(
-                f"pipe composes with data + tensor axes, or data + expert "
-                f"(MoE); got pipe x {unwired} — compose parallel.* step "
-                f"builders directly")
+                f"pipe composes with data + tensor, data + expert (MoE), "
+                f"or data + seq (seq-sharded attention); got pipe x "
+                f"{unwired} — compose parallel.* step builders directly")
         exclusive = [name for name, on in
                      (("seq", self.seq_parallel and not self.sp_tp
                        and not self.sp_ep),

@@ -65,9 +65,8 @@ def test_trainer_expert_requires_moe_model():
 
 
 def test_trainer_rejects_unwired_mixed_styles():
-    # pipe x seq stays unwired (pipe x expert is wired in round 4)
-    cfg = _lm_cfg(data=2, pipe=2, seq=2)
-    cfg.model = dataclasses.replace(cfg.model, attention="ring")
+    # pipe x fsdp stays unwired (pipe x expert / pipe x seq wired round 4)
+    cfg = _lm_cfg(data=2, pipe=2, fsdp=2)
     with pytest.raises(NotImplementedError, match="pipe composes with"):
         Trainer(cfg)
     # seq x tensor, seq x expert, and expert x tensor are wired (round 2);
@@ -389,6 +388,30 @@ def test_trainer_pp_ep_tp_end_to_end():
                                     moe_expert_axis="expert")
     t = Trainer(cfg)
     assert t.pp_ep and t.pipeline and t.expert and t.tensor
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+def test_trainer_pp_sp_end_to_end():
+    """DP x PP x SP through the Trainer: ring attention over 'seq' inside
+    pipeline stages — long-context pipelining (round 4)."""
+    cfg = _lm_cfg(data=2, pipe=2, seq=2)
+    cfg.model = dataclasses.replace(cfg.model, attention="ring")
+    t = Trainer(cfg)
+    assert t.pp_sp and t.pipeline and t.seq_parallel
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_trainer_pp_sp_striped_flash_end_to_end():
+    """PP x SP with the striped (balanced-causal) ring flash kernel: the
+    loader's round-robin token permutation composes with the pipeline
+    schedule (positions come from sequence.global_positions)."""
+    cfg = _lm_cfg(data=2, pipe=2, seq=2)
+    cfg.model = dataclasses.replace(cfg.model, attention="striped_flash")
+    t = Trainer(cfg)
+    assert t.pp_sp and t.seq_permutation is not None
     result = t.fit()
     assert np.isfinite(result["final_loss"])
     assert "val_loss" in result and np.isfinite(result["val_loss"])
