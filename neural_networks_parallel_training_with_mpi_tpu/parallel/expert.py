@@ -125,6 +125,29 @@ def _seq_active(mesh: Mesh, seq_axis) -> bool:
     return seq_axis is not None and int(mesh.shape.get(seq_axis, 1)) > 1
 
 
+def _moe_token_axes(mesh: Mesh, seq_axis) -> Tuple[Tuple[str, ...],
+                                                   Tuple[str, ...]]:
+    """(token_axes, expert_leaf_axes) for one MoE layout: tokens ride
+    data x fsdp x expert (x seq when active); expert-SHARDED leaves reduce
+    over everything except 'expert' (they own their shard's grads).
+    'tensor' never appears in either — tensor-sharded leaves own their
+    shard locally and tensor-replicated leaves carry identical grads on
+    every tensor rank (the f/g conjugate ops guarantee it)."""
+    tail = (seq_axis,) if _seq_active(mesh, seq_axis) else ()
+    return TOKEN_AXES + tail, DATA_AXES + tail
+
+
+def _moe_grad_psum(grads: Pytree, total, token_axes, expert_axes) -> Pytree:
+    """THE single gradient-reduction rule for every MoE layout (plain EP,
+    EP x TP, their seq-composed forms): expert-sharded leaves psum over
+    ``expert_axes``, everything else over ``token_axes``, normalized by
+    the global token count."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, g: lax.psum(
+            g, expert_axes if _is_expert_path(path) else token_axes)
+        / total, grads)
+
+
 def _moe_batch_specs(batch_keys, token_axes, seq_axis) -> dict:
     """Batch specs for the MoE paths: rows over the token axes; with an
     active seq axis, x/y additionally shard dim 1 (mask stays per-row).
@@ -189,8 +212,7 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
     if use_seq and c.attention not in SEQ_SHARDED_IMPLS:
         raise ValueError(f"seq axis active but model attention="
                          f"{c.attention!r} is not seq-sharded")
-    token_axes = TOKEN_AXES + ((seq_axis,) if use_seq else ())
-    expert_axes = DATA_AXES + ((seq_axis,) if use_seq else ())
+    token_axes, expert_axes = _moe_token_axes(mesh, seq_axis)
     base = losses_lib.get(loss_name)
 
     def local_fwd(params, batch):
@@ -215,10 +237,7 @@ def make_moe_train_step(model: Transformer, optimizer: Optimizer, mesh: Mesh,
         s, cnt, aux, grads = _moe_accumulate(micro_grads, state.params,
                                              batch, accum_steps)
         total = lax.psum(cnt, token_axes)
-        grads = jax.tree_util.tree_map_with_path(
-            lambda path, g: lax.psum(
-                g, expert_axes if _is_expert_path(path) else token_axes)
-            / total, grads)
+        grads = _moe_grad_psum(grads, total, token_axes, expert_axes)
         metrics = {"loss": lax.psum(s, token_axes) / total,
                    "aux": lax.pmean(aux, token_axes)}
         if grad_clip > 0:
@@ -397,27 +416,42 @@ def shard_moe_tp_state(state: TrainState, mesh: Mesh,
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs)
 
 
-def _validate_moe_tp(model: Transformer, mesh: Mesh):
+def _validate_moe_tp(model: Transformer, mesh: Mesh, seq_axis=None):
     from . import megatron
+    from .sequence import SEQ_SHARDED_IMPLS
 
     c = model.cfg
     ep = int(mesh.shape.get(EXPERT_AXIS, 1))
     tp = int(mesh.shape.get(TENSOR_AXIS, 1))
-    if ep < 2 or tp < 2:
-        raise ValueError(f"EP x TP needs expert>1 and tensor>1; got "
-                         f"expert={ep}, tensor={tp} — use the plain "
-                         "expert/gspmd paths otherwise")
+    use_seq = _seq_active(mesh, seq_axis)
+    sp = int(mesh.shape[seq_axis]) if use_seq else 1
+    if tp < 2 or (ep < 2 and not use_seq):
+        raise ValueError(f"the MoE x TP step needs tensor>1 and "
+                         f"(expert>1 or an active seq axis); got expert="
+                         f"{ep}, tensor={tp}, seq={sp} — use the plain "
+                         "expert/gspmd/spmd paths otherwise")
     if c.moe_experts <= 0:
         raise ValueError("EP x TP requires a transformer with moe_experts "
                          "> 0 (--moe_experts)")
-    if c.moe_experts % ep:
+    if c.moe_experts % max(ep, 1):
         raise ValueError(f"{c.moe_experts} experts not divisible over "
                          f"expert axis of size {ep}")
     megatron.validate_tp(c, tp)
-    if c.attention != "dense":
+    if use_seq:
+        if c.attention not in SEQ_SHARDED_IMPLS:
+            raise ValueError(
+                f"seq axis {seq_axis!r}={sp} is active but attention="
+                f"{c.attention!r} is not seq-sharded "
+                f"({SEQ_SHARDED_IMPLS})")
+        if c.attention == "ulysses":
+            from .sequence import validate_ulysses_under_tp
+
+            validate_ulysses_under_tp(c.n_heads, tp, sp, seq_axis)
+    elif c.attention != "dense":
         raise ValueError("the EP x TP step runs Megatron attention over the "
                          f"full local sequence; attention={c.attention!r} "
-                         "is not wired here")
+                         "needs seq_axis (SP x EP x TP) or the sp/sp_ep "
+                         "paths")
     if c.scan_layers:
         raise ValueError("scan_layers is a plain-DP/SP layout; the EP x TP "
                          "step owns its own per-layer loop")
@@ -425,22 +459,42 @@ def _validate_moe_tp(model: Transformer, mesh: Mesh):
 
 
 def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
-                    tp: int):
-    """Local EP x TP forward inside shard_map: replicated embed, Megatron
-    blocks (heads over 'tensor') whose FFN is the expert+tensor-sharded
-    MoEFFN (slots over 'expert' by all_to_all, hidden dim over 'tensor'),
-    replicated LN + head.  Reuses Transformer.embed/head_logits so the
-    composed path cannot drift from the dense model."""
+                    tp: int, ep: int = 2, seq_axis=None):
+    """Local (SP x) EP x TP forward inside shard_map: replicated embed,
+    Megatron blocks (heads over 'tensor') whose FFN is the
+    expert+tensor-sharded MoEFFN (slots over 'expert' by all_to_all when
+    ``ep > 1``, hidden dim over 'tensor'), replicated LN + head.  Reuses
+    Transformer.embed/head_logits so the composed path cannot drift from
+    the dense model.
+
+    ``seq_axis`` composes sequence parallelism in: the sequence dim is
+    sharded over that axis, positions come from the shard's global offset
+    and attention runs the model's seq-sharded impl (ring/ulysses/
+    striped...) over the local heads — Megatron TP x context parallelism
+    x expert parallelism in one program.  With ``ep == 1`` (no expert
+    axis) the experts are held whole on every shard and only their hidden
+    dim is tensor-sharded — the SP x TP MoE layout."""
     from . import megatron
 
     c = model.cfg
-    ffn_fn = moe_ffn_fn(c, expert_axis=EXPERT_AXIS, tensor_axis=TENSOR_AXIS)
+    ffn_fn = moe_ffn_fn(c, expert_axis=EXPERT_AXIS if ep > 1 else None,
+                        tensor_axis=TENSOR_AXIS)
 
     b, t = ids.shape
-    x = model.embed(params, ids, jnp.arange(t))
+    if seq_axis is not None:
+        from .sequence import global_positions, sequence_sharded_attention
+
+        positions = global_positions(c.attention, seq_axis, t)
+        attn = lambda q, k, v: sequence_sharded_attention(
+            c.attention, q, k, v, axis=seq_axis, causal=True)
+    else:
+        positions = jnp.arange(t)
+        attn = None
+    x = model.embed(params, ids, positions)
 
     def block_fn(layer_params, h):
-        return megatron.tp_block_apply(c, layer_params, h, tp, ffn_fn=ffn_fn)
+        return megatron.tp_block_apply(c, layer_params, h, tp, ffn_fn=ffn_fn,
+                                       attention_fn=attn)
 
     if c.remat:
         from ..models.core import make_remat
@@ -453,21 +507,14 @@ def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
     return model.head_logits(params, x), aux_total
 
 
-def _moe_tp_reduce_axes(path) -> Tuple[str, ...]:
-    """Gradient psum axes per leaf.  Token (batch) rows ride data x expert;
-    'tensor' NEVER appears: tensor-sharded leaves own their shard's grads
-    locally and tensor-replicated leaves get identical grads on every
-    tensor rank (the f/g conjugate ops guarantee it — megatron/moe)."""
-    return DATA_AXES if _is_expert_path(path) else TOKEN_AXES
-
-
 def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
                            mesh: Mesh, loss_name: str = "cross_entropy",
                            aux_weight: float = 0.01,
                            donate: bool = True,
                            batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
                            grad_clip: float = 0.0,
-                           accum_steps: int = 1):
+                           accum_steps: int = 1,
+                           seq_axis=None):
     """(state, batch) -> (state, metrics) jitted over data x expert x tensor
     — GShard's expert + model parallelism, TPU-native: Megatron-sharded
     attention (heads over 'tensor'), expert FFNs sharded over BOTH 'expert'
@@ -477,6 +524,13 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
     is pinned by tests/test_moe.py::test_expert_tensor_parallel_matches_dense
     and the Trainer wiring by tests/test_trainer_pp_ep.py.
 
+    ``seq_axis`` composes sequence/context parallelism in: the model's
+    attention must be a seq-sharded impl (ring/ulysses/striped...), the
+    sequence dim of x/y shards over that axis, and every token reduction
+    additionally spans it.  With the mesh's expert axis at 1 this is the
+    SP x TP MoE layout (experts whole, hidden dim tensor-sharded, no
+    all_to_all); with expert>1 it is the full SP x EP x TP composition.
+
     ``grad_clip`` clips by the global norm with per-leaf shard accounting:
     expert+tensor-sharded leaves psum their squared norms over
     ('expert','tensor'), expert-only leaves over ('expert',), tensor-only
@@ -484,11 +538,14 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
     """
     from . import megatron
 
-    ep, tp = _validate_moe_tp(model, mesh)
+    ep, tp = _validate_moe_tp(model, mesh, seq_axis)
+    seq = seq_axis if _seq_active(mesh, seq_axis) else None
+    token_axes, expert_axes = _moe_token_axes(mesh, seq_axis)
     base = losses_lib.get(loss_name)
 
     def local_fwd(params, batch):
-        logits, aux = _moe_tp_forward(model, params, batch["x"], tp)
+        logits, aux = _moe_tp_forward(model, params, batch["x"], tp, ep,
+                                      seq)
         s, cnt = base(logits, batch["y"], batch.get("mask"))
         return s, (cnt, aux)
 
@@ -514,12 +571,10 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
     def shard_step(state: TrainState, batch: Batch):
         s, cnt, aux, grads = _moe_accumulate(micro_grads, state.params,
                                              batch, accum_steps)
-        total = lax.psum(cnt, TOKEN_AXES)
-        grads = jax.tree_util.tree_map_with_path(
-            lambda path, g: lax.psum(g, _moe_tp_reduce_axes(path)) / total,
-            grads)
-        metrics = {"loss": lax.psum(s, TOKEN_AXES) / total,
-                   "aux": lax.pmean(aux, TOKEN_AXES)}
+        total = lax.psum(cnt, token_axes)
+        grads = _moe_grad_psum(grads, total, token_axes, expert_axes)
+        metrics = {"loss": lax.psum(s, token_axes) / total,
+                   "aux": lax.pmean(aux, token_axes)}
         if grad_clip > 0:
             grads = _global_norm_clip(grads, grad_clip, clip_axes)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
@@ -528,7 +583,7 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     state_specs = moe_tp_state_specs(optimizer, dummy)
-    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    batch_specs = _moe_batch_specs(batch_keys, TOKEN_AXES, seq)
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_specs, batch_specs),
@@ -541,28 +596,39 @@ def make_moe_tp_train_step(model: Transformer, optimizer: Optimizer,
 def make_moe_tp_eval_step(model: Transformer, mesh: Mesh,
                           loss_name: str = "cross_entropy",
                           with_accuracy: bool = True,
-                          batch_keys: Tuple[str, ...] = ("x", "y", "mask")):
-    """Jitted global-mean eval on the EP x TP layout, params consumed in
-    place: (params, batch) -> metrics."""
-    _, tp = _validate_moe_tp(model, mesh)
+                          batch_keys: Tuple[str, ...] = ("x", "y", "mask"),
+                          seq_axis=None):
+    """Jitted global-mean eval on the (SP x) EP x TP layout, params
+    consumed in place: (params, batch) -> metrics.  With an active
+    ``seq_axis``, token reductions span it and example-level accuracy
+    averages the per-shard token accuracies over the seq axis (same
+    convention as the sp_tp/moe eval steps)."""
+    ep, tp = _validate_moe_tp(model, mesh, seq_axis)
+    use_seq = _seq_active(mesh, seq_axis)
+    seq = seq_axis if use_seq else None
+    token_axes = TOKEN_AXES + ((seq,) if seq else ())
     base = losses_lib.get(loss_name)
 
     def shard_eval(params, batch):
-        logits, _aux = _moe_tp_forward(model, params, batch["x"], tp)
+        logits, _aux = _moe_tp_forward(model, params, batch["x"], tp, ep,
+                                       seq)
         s, c = base(logits, batch["y"], batch.get("mask"))
-        total = lax.psum(c, TOKEN_AXES)
-        out = {"loss": lax.psum(s, TOKEN_AXES) / total, "count": total}
+        total = lax.psum(c, token_axes)
+        out = {"loss": lax.psum(s, token_axes) / total, "count": total}
         if with_accuracy:
             hs, hc = losses_lib.accuracy(logits, batch["y"],
                                          batch.get("mask"))
             ex_total = lax.psum(hc, TOKEN_AXES)
-            out["accuracy"] = lax.psum(hs, TOKEN_AXES) / ex_total
+            acc = lax.psum(hs, TOKEN_AXES) / ex_total
+            if seq:
+                acc = lax.pmean(acc, seq)
+            out["accuracy"] = acc
             out["example_count"] = ex_total
         return out
 
     dummy = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     pspecs = moe_tp_param_specs(dummy)
-    batch_specs = {k: P(TOKEN_AXES) for k in batch_keys}
+    batch_specs = _moe_batch_specs(batch_keys, TOKEN_AXES, seq)
     mapped = jax.shard_map(
         shard_eval, mesh=mesh,
         in_specs=(pspecs, batch_specs),

@@ -303,15 +303,15 @@ def _stage_fns(model: Transformer, tp: int):
         from . import megatron
         from .sequence import sequence_sharded_attention
 
-        # The sequence is UNSHARDED on the pipeline path, so flash composes
-        # directly: the Pallas kernel runs over this rank's LOCAL heads
-        # inside the Megatron block (VERDICT r3 item 4 — the long-context
-        # kernels were dense-only here).  Seq-sharded impls (ring/striped/
-        # ulysses) need a 'seq' mesh axis the pipe mesh does not bind and
-        # stay rejected by _validate_pipe.
+        # flash composes directly: the Pallas kernel runs over this rank's
+        # LOCAL heads inside the Megatron block (VERDICT r3 item 4 — the
+        # long-context kernels were dense-only here).  Seq-sharded impls
+        # (ring/striped/ulysses) ride the same closure with the sequence
+        # dim sharded over the mesh's seq axis (PP x SP x TP, round 4);
+        # _validate_pipe guarantees that axis is > 1 for them.
         attn = (None if c.attention == "dense"
                 else (lambda q, k, v: sequence_sharded_attention(
-                    c.attention, q, k, v, causal=True)))
+                    c.attention, q, k, v, axis=c.seq_axis, causal=True)))
         ffn_fn = None
         if c.moe_experts > 0:
             # GShard expert+model parallelism inside the stage: experts
@@ -403,19 +403,21 @@ def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
 
     if c.attention in SEQ_SHARDED_IMPLS:
         # PP x SP: each stage's attention rings over the 'seq' axis while
-        # activations rotate over 'pipe' (round 4)
+        # activations rotate over 'pipe' (round 4).  TP composes (the
+        # stage body runs the seq-sharded impl over its LOCAL Megatron
+        # heads) and so does EP (the MoE dispatch routes each seq shard's
+        # local tokens) — PP x SP x TP / PP x SP x EP x TP are the full
+        # four-axis compositions.
         if sp < 2:
             raise NotImplementedError(
                 f"the pipeline path runs seq-sharded attention="
                 f"{c.attention!r} only with a '{c.seq_axis}' mesh axis > 1 "
                 f"(PP x SP); without it use dense or flash on the "
                 f"unsharded sequence")
-        if tp > 1 or c.moe_experts > 0:
-            raise NotImplementedError(
-                "PP x SP composes with the data axes only; PP x SP x TP "
-                "and PP x SP x EP are not wired — use the SP x TP / "
-                "SP x EP steps (parallel.spmd / parallel.expert) or drop "
-                "the seq axis")
+        if c.attention == "ulysses" and tp > 1:
+            from .sequence import validate_ulysses_under_tp
+
+            validate_ulysses_under_tp(c.n_heads, tp, sp, c.seq_axis)
     elif sp > 1:
         raise ValueError(
             f"mesh '{c.seq_axis}'={sp} but attention={c.attention!r} is "

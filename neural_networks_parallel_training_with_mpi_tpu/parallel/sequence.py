@@ -373,6 +373,19 @@ SEQ_SHARDED_IMPLS = ("ring", "ring_flash", "striped", "striped_flash",
                      "ulysses")
 
 
+def validate_ulysses_under_tp(n_heads: int, tp: int, sp: int,
+                              seq_axis: str = "seq") -> None:
+    """Ulysses redistributes this rank's LOCAL heads over the seq axis —
+    under Megatron TP that is ``n_heads // tp`` heads over ``sp`` shards,
+    which must divide evenly.  THE single consult point for the rule
+    (spmd.make_sp_tp_train_step and expert._validate_moe_tp both route
+    here so the two composed layouts cannot drift)."""
+    if (n_heads // tp) % sp:
+        raise ValueError(
+            f"ulysses under TP redistributes the {n_heads // tp} "
+            f"local heads over {seq_axis}={sp}: not divisible")
+
+
 def global_positions(impl: str, axis: str, t: int) -> jax.Array:
     """Global token positions of this shard's ``t`` local indices under the
     impl's data layout — THE single source of truth consumed by every

@@ -323,11 +323,17 @@ def make_sp_tp_train_step(model, optimizer: Optimizer, mesh: Mesh,
                          "spmd/gspmd paths otherwise")
     megatron.validate_tp(model.cfg, tp)
     if model.cfg.moe_experts > 0:
-        raise NotImplementedError("SP x TP with an MoE FFN is not wired")
-    if attention_impl == "ulysses" and (model.cfg.n_heads // tp) % sp:
         raise ValueError(
-            f"ulysses under TP redistributes the {model.cfg.n_heads // tp} "
-            f"local heads over {seq_axis}={sp}: not divisible")
+            "SP x TP with an MoE FFN rides the expert module: "
+            "parallel.expert.make_moe_tp_train_step(seq_axis=...) — with "
+            "the mesh's expert axis at 1 the experts stay whole and only "
+            "their hidden dim is tensor-sharded; expert>1 gives the full "
+            "SP x EP x TP composition.  The Trainer routes MoE models "
+            "there automatically")
+    if attention_impl == "ulysses":
+        from .sequence import validate_ulysses_under_tp
+
+        validate_ulysses_under_tp(model.cfg.n_heads, tp, sp, seq_axis)
     reduce_axes = DATA_AXES + (seq_axis,)
 
     if vocab_parallel:

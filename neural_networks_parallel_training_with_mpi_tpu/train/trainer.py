@@ -47,27 +47,36 @@ class Trainer:
         #   seq x tensor        -> parallel.spmd sp_tp shard_map (Megatron
         #                          matmuls + ring/ulysses attention)
         #   expert x tensor     -> parallel.expert moe_tp shard_map (Megatron
-        #                          attention + tensor-sharded experts)
+        #                          attention + tensor-sharded experts);
+        #                          x seq runs seq-sharded attention too, and
+        #                          seq x tensor with an MoE FFN rides the
+        #                          same step with the expert axis at 1
         #   seq x expert        -> parallel.expert shard_map with seq_axis
         #                          (ring attention + all_to_all experts)
         fsdp_on = self.mesh.shape.get("fsdp", 1) > 1
+        moe_model = cfg.model.moe_experts > 0
         self.sp_tp = (self.seq_parallel and self.tensor
-                      and not (self.pipeline or self.expert or fsdp_on))
-        self.ep_tp = (self.expert and self.tensor
-                      and not (self.pipeline or self.seq_parallel
-                               or fsdp_on))
+                      and not (self.pipeline or self.expert or fsdp_on
+                               or moe_model))
+        # (SP x) EP x TP: Megatron attention + tensor-sharded experts,
+        # optionally with seq-sharded attention over 'seq'.  SP x TP with
+        # an MoE FFN rides this path too, with the expert axis at 1
+        # (experts held whole, hidden dim tensor-sharded — no all_to_all).
+        self.ep_tp = (self.tensor and not (self.pipeline or fsdp_on)
+                      and (self.expert
+                           or (self.seq_parallel and moe_model)))
         self.sp_ep = (self.seq_parallel and self.expert
                       and not (self.pipeline or self.tensor or fsdp_on))
-        # DP x PP x EP (x TP): the pipeline step threads the MoE aux loss
-        # through the tick carry and runs the all_to_all dispatch inside
-        # each stage (tensor > 1 additionally Megatron-shards attention
-        # heads and each expert's hidden dim — GShard in the pipeline)
-        self.pp_ep = (self.pipeline and self.expert
-                      and not (self.seq_parallel or fsdp_on))
-        # DP x PP x SP: each stage's attention rings over 'seq' while
-        # activations rotate over 'pipe' — long-context pipelining
-        self.pp_sp = (self.pipeline and self.seq_parallel
-                      and not (self.expert or self.tensor or fsdp_on))
+        # DP x PP x EP (x SP x TP): the pipeline step threads the MoE aux
+        # loss through the tick carry and runs the all_to_all dispatch
+        # inside each stage (tensor > 1 additionally Megatron-shards
+        # attention heads and each expert's hidden dim — GShard in the
+        # pipeline; seq > 1 seq-shards each stage's attention)
+        self.pp_ep = (self.pipeline and self.expert and not fsdp_on)
+        # DP x PP x SP (x TP/EP): each stage's attention rings over 'seq'
+        # while activations rotate over 'pipe' — long-context pipelining,
+        # composing with Megatron TP and expert parallelism (round 4)
+        self.pp_sp = (self.pipeline and self.seq_parallel and not fsdp_on)
         self.gspmd = (not self.pipeline and not self.sp_tp and not self.ep_tp
                       and (self.tensor or fsdp_on))
         unwired = [name for name, on in
@@ -76,21 +85,24 @@ class Trainer:
                     ("expert", self.expert and not self.pp_ep)) if on]
         if self.pipeline and unwired:
             raise NotImplementedError(
-                f"pipe composes with data + tensor, data + expert (MoE), "
-                f"or data + seq (seq-sharded attention); got pipe x "
-                f"{unwired} — compose parallel.* step builders directly")
+                f"pipe composes with the data, tensor, expert (MoE), and "
+                f"seq (seq-sharded attention) axes in any mix; got pipe x "
+                f"{unwired} — fsdp's parameter sharding is the GSPMD "
+                "path's job (compose parallel.* step builders directly)")
         exclusive = [name for name, on in
                      (("seq", self.seq_parallel and not self.sp_tp
-                       and not self.sp_ep),
+                       and not self.sp_ep and not self.ep_tp
+                       and not self.pp_sp),
                       ("tensor/fsdp", self.gspmd),
                       ("expert", self.expert and not self.ep_tp
-                       and not self.sp_ep)) if on]
+                       and not self.sp_ep and not self.pp_ep)) if on]
         if len(exclusive) > 1:
             raise NotImplementedError(
                 f"wired combinations: one of seq/tensor/fsdp/expert alone, "
-                f"pipe x tensor, seq x tensor, seq x expert, or expert x "
-                f"tensor (all x data); got {exclusive} — compose parallel.* "
-                "step builders directly for other mixes")
+                f"pipe x tensor, seq x tensor, seq x expert, expert x "
+                f"tensor, or seq x expert x tensor (all x data); got "
+                f"{exclusive} — compose parallel.* step builders directly "
+                "for other mixes")
         if self.pipeline and cfg.model.arch != "transformer":
             raise ValueError("pipe axis > 1 requires the transformer model")
         if self.expert and (cfg.model.arch != "transformer"
@@ -105,7 +117,7 @@ class Trainer:
             raise ValueError(
                 f"grad_reduction={cfg.grad_reduction!r} is not a training "
                 "semantic (choices: global_mean, per_shard_mean)")
-        if ((self.pipeline or self.expert or self.sp_tp)
+        if ((self.pipeline or self.expert or self.sp_tp or self.ep_tp)
                 and cfg.grad_reduction != "global_mean"):
             raise ValueError("pipeline/expert/seq-x-tensor steps always use "
                              "global_mean gradient semantics")
@@ -119,7 +131,7 @@ class Trainer:
                 "layouts keep them replicated")
         if (cfg.optimizer == "adafactor"
                 and (self.pipeline or self.sp_tp or self.expert
-                     or cfg.update_sharding == "zero1")):
+                     or self.ep_tp or cfg.update_sharding == "zero1")):
             raise ValueError(
                 "adafactor's stats are exact only where every leaf sees its "
                 "full matrix: DP/SP shard_map layouts and GSPMD global-view. "
@@ -141,7 +153,7 @@ class Trainer:
                 "sequence")
         self.zero1 = cfg.update_sharding == "zero1"
         if self.zero1 and (self.gspmd or self.pipeline or self.expert
-                           or self.sp_tp):
+                           or self.sp_tp or self.ep_tp):
             raise NotImplementedError(
                 "update_sharding='zero1' is wired into the shard_map DP "
                 "and DP x seq paths (fsdp/tensor axes already shard state "
@@ -182,8 +194,10 @@ class Trainer:
             self.data, val = train_val_split(self.data,
                                              cfg.data.val_fraction, cfg.seed)
             self.val_data = val or None
-        # the expert axis carries batch rows too (parallel.expert layout)
-        self.batch_axes = (("data", "fsdp", "expert") if self.expert
+        # the expert axis carries batch rows too (parallel.expert layout);
+        # the ep_tp path's step specs always include it (size-1 is free)
+        self.batch_axes = (("data", "fsdp", "expert")
+                           if (self.expert or self.ep_tp)
                            else ("data", "fsdp"))
         # striped attention: tokens reorder round-robin over the seq shards
         # (balanced causal blocks — parallel.sequence.striped_permutation);
@@ -228,7 +242,7 @@ class Trainer:
         train_loss = (f"{cfg.loss}@{cfg.label_smoothing}"
                       if cfg.label_smoothing else cfg.loss)
         step_clips = (self.pipeline or self.expert or self.zero1
-                      or self.sp_tp)
+                      or self.sp_tp or self.ep_tp)
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
@@ -255,9 +269,11 @@ class Trainer:
         elif self.ep_tp:
             from ..parallel import expert as ep_lib
 
+            moe_seq = "seq" if self.seq_parallel else None
             moe_step = ep_lib.make_moe_tp_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
-                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps)
+                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps,
+                seq_axis=moe_seq)
 
             def train_step(state, batch):
                 state, metrics = moe_step(state, batch)
@@ -266,7 +282,8 @@ class Trainer:
             self.train_step = train_step
             self.eval_step = ep_lib.make_moe_tp_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
-                with_accuracy=(cfg.loss == "cross_entropy"))
+                with_accuracy=(cfg.loss == "cross_entropy"),
+                seq_axis=moe_seq)
         elif self.expert:
             from ..parallel import expert as ep_lib
 

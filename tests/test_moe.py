@@ -355,3 +355,154 @@ def test_seq_expert_parallel_matches_dense(attention):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
         jax.device_get(state.params), jax.device_get(ref_params))
+
+
+@pytest.mark.parametrize("attention", ["ring", "ulysses", "striped_flash"])
+def test_seq_expert_tensor_parallel_matches_dense(attention):
+    """One SP x EP x TP train step == single-device dense-MoE step: the
+    full composition — seq-sharded attention over 'seq', Megatron head/
+    hidden sharding over 'tensor', expert all_to_all over 'expert' — in
+    one shard_map program.  Generous capacity so routing groups are
+    drop-free (order/grouping-invariant); aux_weight=0 as in the other
+    layout-parity pins."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+        striped_permutation,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows = 8
+    capacity = rows * T  # no drops on any shard grouping
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=1, seq=2, expert=2, tensor=2),
+                     devices=devs)
+    model_sp = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention=attention, moe_experts=E, moe_capacity=capacity,
+        moe_expert_axis="expert"))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows)
+    feed = batch
+    if attention.startswith("striped"):
+        perm = striped_permutation(T, 2)
+        feed = {k: (v[:, perm] if v.ndim >= 2 else v)
+                for k, v in batch.items()}
+
+    state = ep.init_moe_tp_state(model_sp, opt, prng.init_key(0), tp=2)
+    state = ep.shard_moe_tp_state(state, mesh, opt)
+    placed = {}
+    for k, v in feed.items():
+        spec = (P(ep.TOKEN_AXES, "seq") if k != "mask"
+                else P(ep.TOKEN_AXES))
+        placed[k] = jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec))
+    step = ep.make_moe_tp_train_step(model_sp, opt, mesh, aux_weight=0.0,
+                                     donate=False, seq_axis="seq")
+    state, metrics = step(state, placed)
+
+    model_dense = moe_model(expert_axis=None, capacity=capacity)
+    params = model_dense.init(prng.init_key(0))
+
+    def scalar(p):
+        logits = model_dense.apply(p, jnp.asarray(batch["x"]))
+        s, c = losses.softmax_cross_entropy(
+            logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+        return s / c, s / c
+
+    (loss_ref, _), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    ref_params, _ = opt.update(grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=2e-4, atol=1e-5)
+    got = dict(jax.device_get(state.params))
+    got["blocks"] = megatron.permute_qkv(got["blocks"], 32, 4, 2,
+                                         inverse=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        got, jax.device_get(ref_params))
+
+
+def test_sp_tp_moe_matches_dense():
+    """SP x TP with an MoE FFN and NO expert axis (expert=1): experts are
+    held whole on every shard, only their hidden dim is tensor-sharded
+    (MoEFFN tensor_axis without expert_axis — no all_to_all).  One step
+    == the single-device dense-MoE step."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows = 8
+    capacity = rows * T
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=2, seq=2, tensor=2), devices=devs)
+    model_sp = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="ring", moe_experts=E, moe_capacity=capacity))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows)
+
+    state = ep.init_moe_tp_state(model_sp, opt, prng.init_key(0), tp=2)
+    state = ep.shard_moe_tp_state(state, mesh, opt)
+    placed = {}
+    for k, v in batch.items():
+        spec = (P(ep.TOKEN_AXES, "seq") if k != "mask"
+                else P(ep.TOKEN_AXES))
+        placed[k] = jax.device_put(jnp.asarray(v), NamedSharding(mesh, spec))
+    step = ep.make_moe_tp_train_step(model_sp, opt, mesh, aux_weight=0.0,
+                                     donate=False, seq_axis="seq")
+    state, metrics = step(state, placed)
+
+    model_dense = moe_model(expert_axis=None, capacity=capacity)
+    params = model_dense.init(prng.init_key(0))
+
+    def scalar(p):
+        logits = model_dense.apply(p, jnp.asarray(batch["x"]))
+        s, c = losses.softmax_cross_entropy(
+            logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+        return s / c, s / c
+
+    (loss_ref, _), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    ref_params, _ = opt.update(grads, opt.init(params), params)
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(loss_ref),
+                               rtol=2e-4, atol=1e-5)
+    got = dict(jax.device_get(state.params))
+    got["blocks"] = megatron.permute_qkv(got["blocks"], 32, 4, 2,
+                                         inverse=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        got, jax.device_get(ref_params))
+
+
+def test_sp_tp_dense_path_redirects_moe():
+    """spmd.make_sp_tp_train_step names the wired MoE path instead of a
+    bare not-implemented."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import spmd
+
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=2, seq=2, tensor=2), devices=devs)
+    model = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="ring", moe_experts=E))
+    with pytest.raises(ValueError, match="expert module"):
+        spmd.make_sp_tp_train_step(
+            model, optim.sgd(lr=0.1), mesh,
+            example_batch={k: jnp.asarray(v)
+                           for k, v in lm_batch(8).items()})
+
+
+def test_moe_tp_validate_rejects_degenerate_and_dense_seq():
+    """The relaxed validator still refuses layouts the step cannot run:
+    tensor=1, and ep=1 WITHOUT an active seq axis."""
+    devs = jax.devices("cpu")[:8]
+    model = moe_model(expert_axis="expert")
+    mesh_no_tp = make_mesh(MeshConfig(data=4, expert=2), devices=devs)
+    with pytest.raises(ValueError, match="tensor>1"):
+        ep.make_moe_tp_train_step(model, optim.sgd(lr=0.1), mesh_no_tp)
+    mesh_no_ep = make_mesh(MeshConfig(data=4, tensor=2), devices=devs)
+    with pytest.raises(ValueError, match="expert>1 or an active seq"):
+        ep.make_moe_tp_train_step(model, optim.sgd(lr=0.1), mesh_no_ep)

@@ -466,3 +466,97 @@ def test_pipeline_seq_requires_seq_axis_match():
     with pytest.raises(ValueError, match="not seq-sharded"):
         pp.make_pipeline_train_step(tiny_model(4, attention="dense"),
                                     optim.sgd(0.1), mesh_sp)
+
+
+def test_pipeline_seq_tensor_matches_single_device():
+    """PP x SP x TP (round 4): ring attention over 'seq' inside
+    Megatron-sharded pipeline stages (heads over 'tensor') while
+    activations rotate over 'pipe' — three model axes in one program.
+    Ring attention is exact, so the composed step must match the
+    single-device dense model on the same weights."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+
+    pipe, sp, tp, n_mb = 2, 2, 2, 2
+    devs = jax.devices("cpu")[: pipe * sp * tp]
+    mesh = make_mesh(MeshConfig(data=1, pipe=pipe, seq=sp, tensor=tp),
+                     devices=devs)
+    model = tiny_model(4, attention="ring")
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows=2 * n_mb)
+
+    state, loss = pp.run_one_step(model, opt, mesh, batch, prng.init_key(0),
+                                  n_microbatches=n_mb)
+
+    dense = tiny_model(4, attention="dense")
+    params = dense.init(prng.init_key(0))
+    ref_loss, ref_params = reference_step(dense, opt, params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    got_stack = megatron.permute_qkv(
+        jax.device_get(state.params["blocks"]), model.cfg.d_model,
+        model.cfg.n_heads, tp, inverse=True)
+    got_blocks = pp.unstack_blocks(got_stack)
+    ref_blocks = jax.device_get(ref_params["blocks"])
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)
+    for name in ("embed", "pos", "ln_f", "head"):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            jax.device_get(state.params[name]),
+            jax.device_get(ref_params[name]))
+
+
+def test_pipeline_seq_expert_matches_dense():
+    """PP x SP x EP — GPipe ring x ring attention x all_to_all experts in
+    one shard_map program (8 devices = 2x2x2).  Generous capacity keeps
+    routing drop-free, so one step matches the single-device dense-MoE
+    model (aux_weight=0 — per-shard aux means differ from the global
+    mean by design, as in every MoE layout-parity pin)."""
+    pipe, sp, ep_, n_mb = 2, 2, 2, 2
+    rows = 4 * ep_
+    capacity = rows * T
+    devs = jax.devices("cpu")[:8]
+    mesh = make_mesh(MeshConfig(data=1, pipe=pipe, seq=sp, expert=ep_),
+                     devices=devs)
+    model = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=4, d_model=32,
+        n_heads=4, d_ff=64, attention="ring", moe_experts=4,
+        moe_capacity=capacity, moe_expert_axis="expert"))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    batch = lm_batch(rows=rows)
+
+    state = pp.init_pipeline_state(model, opt, prng.init_key(0), pipe)
+    state = pp.shard_pipeline_state(state, mesh, opt)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rows_spec = ("data", "fsdp", "expert")
+    placed = {k: jax.device_put(
+        jnp.asarray(v), NamedSharding(
+            mesh, P(rows_spec, "seq") if k != "mask" else P(rows_spec)))
+        for k, v in batch.items()}
+    step = pp.make_pipeline_train_step(model, opt, mesh,
+                                       n_microbatches=n_mb, donate=False,
+                                       aux_weight=0.0)
+    state, loss = step(state, placed)
+
+    dense = Transformer(TransformerConfig(
+        vocab_size=VOCAB, max_seq_len=T, n_layers=4, d_model=32,
+        n_heads=4, d_ff=64, attention="dense", moe_experts=4,
+        moe_capacity=capacity))
+    params = dense.init(prng.init_key(0))
+    ref_loss, ref_params = reference_step(dense, opt, params, batch)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+    got_blocks = pp.unstack_blocks(jax.device_get(state.params["blocks"]))
+    ref_blocks = jax.device_get(ref_params["blocks"])
+    for got, ref in zip(got_blocks, ref_blocks):
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            got, ref)

@@ -415,3 +415,57 @@ def test_trainer_pp_sp_striped_flash_end_to_end():
     result = t.fit()
     assert np.isfinite(result["final_loss"])
     assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_trainer_seq_expert_tensor_end_to_end():
+    """SP x EP x TP through the Trainer: seq-sharded attention + all_to_all
+    experts + Megatron tensor sharding in one layout (round 4)."""
+    cfg = _lm_cfg(data=1, seq=2, expert=2, tensor=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    moe_expert_axis="expert",
+                                    attention="ring")
+    t = Trainer(cfg)
+    assert t.ep_tp and t.seq_parallel and not t.sp_tp and not t.gspmd
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_trainer_sp_tp_moe_end_to_end():
+    """seq x tensor with an MoE FFN routes to the expert module's step
+    (expert axis 1: experts whole, hidden dim tensor-sharded)."""
+    cfg = _lm_cfg(data=2, seq=2, tensor=2)
+    cfg.model = dataclasses.replace(cfg.model, moe_experts=4,
+                                    attention="ring")
+    t = Trainer(cfg)
+    assert t.ep_tp and not t.sp_tp and not t.expert
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_trainer_pp_sp_tensor_end_to_end():
+    """PP x SP x TP through the Trainer (round 4): pipeline stages with
+    Megatron-sharded heads and ring attention over 'seq'."""
+    cfg = _lm_cfg(data=1, pipe=2, seq=2, tensor=2)
+    cfg.model = dataclasses.replace(cfg.model, n_layers=2,
+                                    attention="ring")
+    t = Trainer(cfg)
+    assert t.pipeline and t.pp_sp and t.seq_parallel and t.tensor
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])
+
+
+def test_trainer_pp_sp_expert_end_to_end():
+    """PP x SP x EP through the Trainer: long-context MoE pipelining."""
+    cfg = _lm_cfg(data=1, pipe=2, seq=2, expert=2)
+    cfg.model = dataclasses.replace(cfg.model, n_layers=2,
+                                    moe_experts=4,
+                                    moe_expert_axis="expert",
+                                    attention="ring")
+    t = Trainer(cfg)
+    assert t.pipeline and t.pp_sp and t.pp_ep and t.expert
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert "val_loss" in result and np.isfinite(result["val_loss"])

@@ -1,0 +1,124 @@
+"""On-chip big_lm MFU sweep (VERDICT r3 item 2 follow-through).
+
+The flagship config first executed on hardware this round at MFU 0.298
+(BENCH_TPU_LATEST.json); the 0.4 bar needs <= ~131 ms/step.  This tool
+sweeps the two HBM<->speed dials — batch size and remat policy — in ONE
+process (one tunnel claim, shared compile cache) and records every
+variant to ``BIGLM_SWEEP.json``.  OOM variants are caught and recorded,
+not fatal: v5e RESOURCE_EXHAUSTED raises cleanly through the tunnel.
+
+Usage:  python tools/big_lm_sweep.py            # ambient (TPU) backend
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402  (importable by design; main() is guarded)
+
+# (label, batch, remat?, policy, attention)
+VARIANTS = [
+    ("b8_dots", 8, True, "dots", "flash"),        # committed baseline
+    ("b16_dots", 16, True, "dots", "flash"),      # ~13.7G temps: near limit
+    ("b16_dots_no_batch", 16, True, "dots_no_batch", "flash"),
+    ("b16_full", 16, True, "full", "flash"),      # max recompute, min HBM
+    ("b32_full", 32, True, "full", "flash"),
+    ("b8_none", 8, False, "dots", "flash"),       # ~17G temps: expect OOM
+]
+
+
+def run_variant(label, batch, remat, policy, attention):
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    c = bench._BIG
+    devices = jax.devices()
+    on_tpu = devices[0].platform not in ("cpu",)
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        attention=attention, scan_layers=True, remat=remat,
+        remat_policy=policy))
+    mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)),
+                              devices=devices)
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    state = dp.replicate_state(TrainState.create(model, opt,
+                                                 prng.init_key(0)), mesh)
+    step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                              "global_mean")
+    rng = np.random.default_rng(0)
+    raw = {"x": rng.integers(0, c["vocab"], (batch, c["seq"])).astype(np.int32),
+           "y": rng.integers(0, c["vocab"], (batch, c["seq"])).astype(np.int32),
+           "mask": np.ones((batch,), np.float32)}
+    placed = shd.shard_batch(mesh, raw)
+    t0 = time.perf_counter()
+    _, state, _ = bench.timed_chain(step, state, placed, 2)
+    compile_s = time.perf_counter() - t0
+    n1, n2 = 10, 30
+    t1, state, _ = bench.timed_chain(step, state, placed, n1)
+    t2, state, loss = bench.timed_chain(step, state, placed, n2)
+    step_ms = max(t2 - t1, 1e-9) / (n2 - n1) * 1e3
+    fwd = model.fwd_flops(raw["x"].shape)
+    peak = bench.peak_flops(devices[0].device_kind) if on_tpu else None
+    mfu = (3.0 * fwd / (step_ms / 1e3) / (peak * len(devices))
+           if peak and fwd else None)
+    return {
+        "label": label, "batch": batch, "remat": remat, "policy": policy,
+        "attention": attention, "step_ms": round(step_ms, 2),
+        "samples_per_sec": round(batch / step_ms * 1e3, 1),
+        "mfu": None if mfu is None else round(mfu, 4),
+        "loss": float(loss), "compile_s": round(compile_s, 1),
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+    }
+
+
+def main() -> int:
+    results = []
+    for variant in VARIANTS:
+        label = variant[0]
+        try:
+            row = run_variant(*variant)
+        except Exception as e:  # OOM or lowering failure: record, continue
+            row = {"label": label, "error": f"{type(e).__name__}: {e}"[:400]}
+        print(f"[big_lm_sweep] {json.dumps(row)}", flush=True)
+        results.append(row)
+    best = max((r for r in results if r.get("mfu")),
+               key=lambda r: r["mfu"], default=None)
+    doc = {"results": results, "best": best,
+           "captured_unix": round(time.time(), 1),
+           "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}
+    with open(os.path.join(REPO, "BIGLM_SWEEP.json"), "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps({"sweep_artifact": "BIGLM_SWEEP.json",
+                      "best": best}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
