@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# 4D model-axis mesh: seq x expert x tensor (round 4) — ring attention
+# over 'seq', all_to_all expert dispatch over 'expert', Megatron head and
+# expert-hidden sharding over 'tensor', in ONE shard_map program.  The
+# same step builder with the expert axis at 1 gives SP x TP MoE (experts
+# whole per rank).  Parity pins:
+# tests/test_moe.py::test_seq_expert_tensor_parallel_matches_dense.
+# The full pipe x seq x expert x tensor composition (16 devices) is
+# exercised by
+# tests/test_pipeline.py::test_pipeline_four_axis_pp_sp_ep_tp_subprocess.
+set -euo pipefail
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --sp 2 --ep 2 --tp 2 --moe_experts 4 \
+    --seq_len 32 --attention ring --grad_clip 1.0

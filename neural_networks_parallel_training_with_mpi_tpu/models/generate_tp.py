@@ -61,12 +61,18 @@ def init_tp_kv_cache(model: Transformer, batch: int, max_len: int, tp: int):
 
 
 def _tp_block_chunk(cfg, lp, cache, x, pos, heads_local: int,
-                    axis: str = TENSOR_AXIS):
+                    axis: str = TENSOR_AXIS, moe_ffn=None):
     """One Megatron block on a chunk (B, S, D) at position ``pos`` with the
     KV cache holding this rank's heads.  Mirrors ``generate._block_chunk``
     (dense) with ``megatron.tp_block_apply``'s sharding: column-parallel
     qkv (local layout [q_r | k_r | v_r]), local-head attention, psum after
-    the row-parallel matmuls with the bias added once post-psum."""
+    the row-parallel matmuls with the bias added once post-psum.
+
+    ``moe_ffn`` (from ``parallel.expert.moe_ffn_fn`` with
+    ``expert_axis=None, tensor_axis='tensor'``) replaces the dense FFN
+    for MoE checkpoints: experts held whole per rank, each expert's
+    hidden dim tensor-sharded — the same layout the SP x TP MoE train
+    step uses, so trained expert shards decode in place."""
     cdt = cfg.compute_dtype
     ln = LayerNorm(cfg.d_model, param_dtype=cfg.param_dtype)
     h = ln.apply(lp["ln1"], x)
@@ -95,6 +101,9 @@ def _tp_block_chunk(cfg, lp, cache, x, pos, heads_local: int,
     attn = lax.psum(partial, axis) + lp["attn_out"]["b"].astype(cdt)
     x = x + attn.astype(x.dtype)
     h = ln.apply(lp["ln2"], x)
+    if moe_ffn is not None:
+        ff, _aux = moe_ffn(lp, h)  # load-balance aux is a training signal
+        return x + ff.astype(x.dtype), {"k": new_k, "v": new_v}
     hh = (h.astype(cdt) @ lp["ff_in"]["w"].astype(cdt)
           + lp["ff_in"]["b"].astype(cdt))
     hh = ACTIVATIONS[cfg.activation](hh)
@@ -200,12 +209,22 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
                                    top_k=top_k)
         return _full_sample(logits_2d, temperature, key, top_k, top_p)
 
+    moe_ffn = None
+    if c.moe_experts > 0:
+        # experts whole per rank, hidden dim tensor-sharded — the SP x TP
+        # MoE layout (parallel.expert.moe_ffn_fn is the single factory the
+        # train steps use too, so decode cannot drift from training)
+        from ..parallel.expert import moe_ffn_fn
+
+        moe_ffn = moe_ffn_fn(c, expert_axis=None, tensor_axis=TENSOR_AXIS)
+
     def forward_chunk(params, caches, ids, pos):
         positions = pos + jnp.arange(ids.shape[1])
         x = embed(params, ids, positions)
         new_caches = []
         for lp, cache in zip(params["blocks"], caches):
-            x, cache = _tp_block_chunk(c, lp, cache, x, pos, heads_local)
+            x, cache = _tp_block_chunk(c, lp, cache, x, pos, heads_local,
+                                       moe_ffn=moe_ffn)
             new_caches.append(cache)
         return x, new_caches
 
@@ -288,8 +307,10 @@ def generate_tp(model: Transformer, params, prompt, mesh,
     """Decode ``max_new_tokens`` after ``prompt`` (B, P) -> (B, P + N) with
     ``params`` in the NATIVE seq x tensor training layout (per-layer
     blocks, head-aligned qkv permutation, qkv/ff_in column- and
-    attn_out/ff_out row-sharded over 'tensor'; embed/head vocab-sharded
-    when ``vocab_parallel``).  No host gather, no dense param copy.
+    attn_out/ff_out row-sharded over 'tensor'; MoE expert stacks whole
+    per rank with their hidden dims tensor-sharded; embed/head
+    vocab-sharded when ``vocab_parallel``).  No host gather, no dense
+    param copy.
 
     Sampling knobs as in ``generate.generate``; with ``vocab_parallel``,
     greedy, temperature, and top_k are available (top_k restricts the
@@ -308,9 +329,6 @@ def generate_tp(model: Transformer, params, prompt, mesh,
         raise ValueError("temperature sampling needs a PRNG key")
     if max_new_tokens == 0:
         return jnp.asarray(prompt, jnp.int32)
-    if c.moe_experts > 0:
-        raise NotImplementedError("tensor-parallel decode covers dense-FFN "
-                                  "blocks; MoE decode rides the expert path")
     if c.scan_layers:
         # per-layer caches need per-layer params; unstack the scanned
         # leaves (slices of the same buffers — no copy under jit)

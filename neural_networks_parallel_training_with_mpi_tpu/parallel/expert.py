@@ -323,6 +323,23 @@ TENSOR_AXIS = "tensor"
 TENSOR_SHARDED_EXPERT_LEAVES = ("w_in", "b_in", "w_out")
 
 
+def expert_leaf_tensor_spec(leaf_name: str, ndim: int,
+                            tensor_axis: str = "tensor"):
+    """PartitionSpec of ONE expert-FFN leaf's tensor dims, with everything
+    left of the trailing layout dims (expert/scan/pipe stacks) unsharded
+    — the single place the hidden-dim f placement is written down.
+    Returns None for leaves with no tensor-sharded dim (b_out, gate).
+    Consumed by moe_tp_param_specs (expert axis added by the caller),
+    spmd.sp_tp_param_specs (experts whole; decode placement), and
+    parallel.pipeline's PP x EP x TP specs."""
+    if leaf_name not in TENSOR_SHARDED_EXPERT_LEAVES:
+        return None
+    if leaf_name == "w_out":  # (..., f, d): row-parallel on f
+        return P(*(None,) * (ndim - 2), tensor_axis, None)
+    # w_in (..., d, f) / b_in (..., f): column-parallel on f (last dim)
+    return P(*(None,) * (ndim - 1), tensor_axis)
+
+
 def moe_ffn_fn(cfg, expert_axis=None, tensor_axis=None):
     """The shared MoE-FFN block injection for ``megatron.tp_block_apply``:
     build the MoEFFN exactly once from the model config (the EP x TP
@@ -359,14 +376,11 @@ def moe_tp_param_specs(params: Pytree) -> Pytree:
         names = megatron.path_names(path)
         if _is_expert_path(path):
             leaf_name = names[-1]
-            if leaf_name in TENSOR_SHARDED_EXPERT_LEAVES:
-                # hidden dim f shards over 'tensor': col for w_in/b_in
-                # (last dim), row for w_out (first after E)
-                if leaf_name == "w_in":
-                    return P(EXPERT_AXIS, None, TENSOR_AXIS)
-                if leaf_name == "b_in":
-                    return P(EXPERT_AXIS, TENSOR_AXIS)
-                return P(EXPERT_AXIS, TENSOR_AXIS, None)
+            ndim = len(jnp.shape(leaf))
+            tspec = expert_leaf_tensor_spec(leaf_name, ndim, TENSOR_AXIS)
+            if tspec is not None:
+                # leading E dim additionally shards over 'expert'
+                return P(EXPERT_AXIS, *tuple(tspec)[1:])
             if leaf_name == "b_out":
                 return P(EXPERT_AXIS)
             raise ValueError(f"unexpected expert leaf {names}")
@@ -604,9 +618,8 @@ def make_moe_tp_eval_step(model: Transformer, mesh: Mesh,
     averages the per-shard token accuracies over the seq axis (same
     convention as the sp_tp/moe eval steps)."""
     ep, tp = _validate_moe_tp(model, mesh, seq_axis)
-    use_seq = _seq_active(mesh, seq_axis)
-    seq = seq_axis if use_seq else None
-    token_axes = TOKEN_AXES + ((seq,) if seq else ())
+    seq = seq_axis if _seq_active(mesh, seq_axis) else None
+    token_axes, _ = _moe_token_axes(mesh, seq_axis)
     base = losses_lib.get(loss_name)
 
     def shard_eval(params, batch):

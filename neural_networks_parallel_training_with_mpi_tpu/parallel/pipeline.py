@@ -204,23 +204,19 @@ def pipeline_param_specs(params: Pytree, tp: int = 1,
             # (S, per, E, d, f) column-parallel, b_in (S, per, E, f) with
             # it, w_out (S, per, E, f, d) row-parallel, b_out expert-only
             # (it adds after the row-parallel psum).
-            from .expert import TENSOR_SHARDED_EXPERT_LEAVES
+            from .expert import expert_leaf_tensor_spec
 
             names = megatron.path_names(path)
-            if tp > 1:
-                if names[-1] in TENSOR_SHARDED_EXPERT_LEAVES:
-                    if names[-1] == "w_in":
-                        return P(*lead, PIPE_AXIS, None, EXPERT_AXIS, None,
-                                 "tensor")
-                    if names[-1] == "b_in":
-                        return P(*lead, PIPE_AXIS, None, EXPERT_AXIS,
-                                 "tensor")
-                    return P(*lead, PIPE_AXIS, None, EXPERT_AXIS, "tensor",
-                             None)
-                if names[-1] == "b_out":
-                    return P(*lead, PIPE_AXIS, None, EXPERT_AXIS)
+            ndim = len(np.shape(leaf))
+            tspec = (expert_leaf_tensor_spec(names[-1], ndim)
+                     if tp > 1 else None)
+            if tp > 1 and tspec is None and names[-1] != "b_out":
                 raise ValueError(f"unexpected expert leaf {names}")
-            return P(*lead, PIPE_AXIS, None, EXPERT_AXIS)
+            spec = list(tuple(tspec) if tspec is not None
+                        else (None,) * ndim)
+            spec[nstack - 2] = PIPE_AXIS   # (v,) S, per, E, ...
+            spec[nstack] = EXPERT_AXIS
+            return P(*spec)
         if tp <= 1:
             return blk
         names = megatron.path_names(path)

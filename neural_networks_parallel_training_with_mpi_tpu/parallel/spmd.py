@@ -159,10 +159,19 @@ def sp_tp_param_specs(params: Pytree, vocab_parallel: bool = False) -> Pytree:
 
     def block_spec(path, leaf):
         names = megatron.path_names(path)
+        ndim = len(jnp.shape(leaf))
+        if "experts" in names:
+            # MoE expert stacks on the SP x TP layout: experts held WHOLE
+            # (no expert axis) with each expert's hidden dim f Megatron-
+            # sharded over 'tensor' — the per-leaf placement comes from
+            # the single consult point shared with the EP x TP layout.
+            from .expert import expert_leaf_tensor_spec
+
+            tspec = expert_leaf_tensor_spec(names[-1], ndim)
+            return tspec if tspec is not None else P()
         if not megatron.is_tensor_sharded(names):
             return P()
         col = "qkv" in names or "ff_in" in names
-        ndim = len(jnp.shape(leaf))
         # scan_layers stacks a leading (n_layers,) dim on every block leaf
         # (replicated); the Megatron col/row dims shift right by one
         if names[-1] == "w" and ndim in (2, 3):

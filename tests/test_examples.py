@@ -207,3 +207,13 @@ def test_cli_generate_reconciles_sp_tp_checkpoint(tmp_path):
                          jnp.asarray([[7, 8, 9], [7, 8, 9]], jnp.int32),
                          mesh, max_new_tokens=6)
     assert cli_ids == [int(t) for t in np.asarray(native)[0]]
+
+
+def test_example_14_four_axis_mesh_completes():
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "14_four_axis_mesh.sh")],
+        capture_output=True, text=True, timeout=600, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "done: final loss" in out.stderr + out.stdout

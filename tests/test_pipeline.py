@@ -560,3 +560,97 @@ def test_pipeline_seq_expert_matches_dense():
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
             got, ref)
+
+
+def test_pipeline_four_axis_pp_sp_ep_tp_subprocess():
+    """The FULL four-model-axis composition — pipe x seq x expert x tensor
+    in one shard_map program — needs 16 devices, so it runs in a
+    subprocess with its own virtual-device count (same pattern as the
+    multi-process tests).  One step must match the single-device
+    dense-MoE model (ring attention is exact; ample capacity keeps
+    routing drop-free)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    script = r"""
+import json
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.ops import losses, optim
+from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+    megatron, pipeline as pp,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+    make_mesh,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+V, T = 64, 8
+rows = 8
+capacity = rows * T
+mesh = make_mesh(MeshConfig(data=1, pipe=2, seq=2, expert=2, tensor=2),
+                 devices=jax.devices("cpu")[:16])
+model = Transformer(TransformerConfig(
+    vocab_size=V, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+    d_ff=64, attention="ring", moe_experts=4, moe_capacity=capacity,
+    moe_expert_axis="expert"))
+opt = optim.sgd(lr=0.1, momentum=0.9)
+rng = np.random.default_rng(0)
+tok = rng.integers(0, V, (rows, T + 1))
+batch = {"x": tok[:, :-1].astype(np.int32),
+         "y": tok[:, 1:].astype(np.int32),
+         "mask": np.ones((rows,), np.float32)}
+
+state, loss = pp.run_one_step(model, opt, mesh, batch, prng.init_key(0),
+                              n_microbatches=2)
+
+dense = Transformer(TransformerConfig(
+    vocab_size=V, max_seq_len=T, n_layers=2, d_model=32, n_heads=4,
+    d_ff=64, attention="dense", moe_experts=4, moe_capacity=capacity))
+params = dense.init(prng.init_key(0))
+
+def scalar(p):
+    logits = dense.apply(p, jnp.asarray(batch["x"]))
+    s, c = losses.softmax_cross_entropy(
+        logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+    return s / c
+
+ref_loss_val = scalar(params)
+grads = jax.grad(scalar)(params)
+ref_params, _ = opt.update(grads, opt.init(params), params)
+
+np.testing.assert_allclose(float(loss), float(ref_loss_val),
+                           rtol=1e-5, atol=1e-6)
+got_stack = megatron.permute_qkv(
+    jax.device_get(state.params["blocks"]), 32, 4, 2, inverse=True)
+got_blocks = pp.unstack_blocks(got_stack)
+ref_blocks = jax.device_get(ref_params["blocks"])
+# four stacked collective reductions (pipe + expert + seq psums, ring
+# online-softmax) reassociate more f32 sums than any pairwise layout;
+# tolerances match the MoE layout-parity pins (tests/test_moe.py)
+for got, ref in zip(got_blocks, ref_blocks):
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        got, ref)
+print(json.dumps({"ok": True, "loss": float(loss)}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=900,
+                         env=env, cwd=os.path.dirname(
+                             os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, (out.stderr or "")[-3000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["ok"] and np.isfinite(rec["loss"])

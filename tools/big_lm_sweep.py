@@ -98,6 +98,22 @@ def run_variant(label, batch, remat, policy, attention):
 
 
 def main() -> int:
+    # hang-proof: a wedged tunnel blocks inside backend init forever, so
+    # probe via subprocess (same machinery as bench.py / the watcher)
+    # before this process commits to claiming the backend
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        platform as plat,
+    )
+
+    info = plat.probe(timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                                     75)),
+                      attempts=int(os.environ.get("BENCH_PROBE_ATTEMPTS",
+                                                  2)))
+    if not info or info.get("platform") == "cpu":
+        print(json.dumps({"sweep_artifact": None,
+                          "skipped": "tunnel unreachable or cpu-only",
+                          "probe": info}))
+        return 2
     results = []
     for variant in VARIANTS:
         label = variant[0]

@@ -62,6 +62,13 @@ PRIORITY = [
     ("pallas_tpu_test",
      [sys.executable, "-m", "pytest", "tests/test_pallas_tpu.py", "-q",
       "-rs"], 900),
+    # round-4 additions (new names so a fresh window runs them even though
+    # the originals are already captured): the batch x remat MFU sweep of
+    # the flagship config, and the attention bench re-run that now carries
+    # the kernel-only microbench rows
+    ("biglm_sweep", [sys.executable, "tools/big_lm_sweep.py"], 2100),
+    ("attention_kernels", [sys.executable, "bench.py", "--attention"],
+     2100),
 ]
 
 
@@ -135,18 +142,24 @@ def run_item(name: str, argv: list, timeout_s: float) -> bool:
             plat_field = last_json.get("platform")
             if plat_field is not None and plat_field == "cpu":
                 ok = False
-        if ok and name in ("attention", "decode"):
-            # these runs print an artifact pointer, not a platform record;
-            # provenance lives inside the artifact they wrote
-            artifact = os.path.join(
-                REPO, "BENCH_ATTENTION.json" if name == "attention"
-                else "BENCH_DECODE.json")
-            try:
-                with open(artifact) as f:
-                    if json.load(f).get("platform") == "cpu":
-                        ok = False
-            except (OSError, ValueError):
+        if ok and name in ("attention", "attention_kernels", "decode"):
+            # these runs print an artifact POINTER; bench.py reports the
+            # true path it wrote (a cpu fallback diverts to *_CPU.json so
+            # the chip artifact is never clobbered) — a None or diverted
+            # pointer means the chip run did not happen, whatever the
+            # untouched primary artifact's provenance says
+            pointer = (last_json or {}).get(
+                "decode_artifact" if name == "decode"
+                else "attention_artifact")
+            if not pointer or pointer.endswith("_CPU.json"):
                 ok = False
+            else:
+                try:
+                    with open(os.path.join(REPO, pointer)) as f:
+                        if json.load(f).get("platform") == "cpu":
+                            ok = False
+                except (OSError, ValueError):
+                    ok = False
     log_event({
         "event": "item", "name": name, "ok": ok, "rc": rc,
         "timed_out": timed_out, "elapsed_s": elapsed,
