@@ -10,8 +10,10 @@ uses.
 
 Covers: world formation, barrier, broadcast_host_array, per-host data
 loading into a global mesh, a jitted DP train step over the 2-host mesh,
-replica-consistency assertion, and an orbax shard-parallel checkpoint
-save + restore round trip.
+replica-consistency assertion, an orbax shard-parallel checkpoint
+save + restore round trip, and cross-host SP (ring-attention ppermute),
+TP (partitioner all-reduces), and EP (MoE all_to_all) steps whose
+collectives span the process boundary.
 
 Usage: distributed_child.py <process_id> <num_processes> <port> <tmpdir>
 Prints one JSON line with per-phase results.
@@ -175,6 +177,29 @@ def main() -> int:
     report["tp_loss"] = round(float(jax.device_get(loss_tp)), 8)
     assert np.isfinite(report["tp_loss"]), report["tp_loss"]
     report["tp_ok"] = True
+
+    # ---- cross-host expert parallelism: the MoE all_to_all slot exchange
+    # crosses the process boundary (the 'expert' axis pairs device k of
+    # host 0 with device k of host 1, same interleaved order as seq/tp) --
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        expert as ep_lib,
+    )
+
+    mesh_ep = make_mesh(MeshConfig(data=2, expert=n), devices=inter)
+    model_ep = Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=16, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, attention="dense", moe_experts=2 * n,
+        moe_expert_axis="expert"))
+    tok3 = np.random.default_rng(3).integers(0, 64, (4 * n, 17))
+    ep_batch = {"x": tok3[:, :-1].astype(np.int32),
+                "y": tok3[:, 1:].astype(np.int32),
+                "mask": np.ones((4 * n,), np.float32)}
+    _, metrics_ep = ep_lib.run_one_step(model_ep, optim.adam(lr=1e-3),
+                                        mesh_ep, ep_batch,
+                                        prng.init_key(0))
+    report["ep_loss"] = round(float(jax.device_get(metrics_ep["loss"])), 8)
+    assert np.isfinite(report["ep_loss"]), report["ep_loss"]
+    report["ep_ok"] = True
 
     distributed.barrier("done")
     report["ok"] = True

@@ -96,6 +96,11 @@ def test_two_process_world(tmp_path):
     for r in reports:
         assert r["tp_ok"], r
     assert reports[0]["tp_loss"] == reports[1]["tp_loss"]
+    # ...and the cross-host MoE step (the all_to_all slot exchange spans
+    # the process boundary on the 'expert' axis)
+    for r in reports:
+        assert r["ep_ok"], r
+    assert reports[0]["ep_loss"] == reports[1]["ep_loss"]
 
 
 def test_peer_death_fails_fast():
