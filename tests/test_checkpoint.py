@@ -136,3 +136,44 @@ def test_interleaved_pipeline_resume_continues_exactly(tmp_path):
             np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
         jax.device_get(straight.state.params),
         jax.device_get(second.state.params))
+
+
+@pytest.mark.slow  # trains three Trainers end-to-end
+def test_sp_ep_tp_resume_continues_exactly(tmp_path):
+    """Checkpoint + resume on the round-4 SP x EP x TP layout (seq-sharded
+    attention + all_to_all experts + Megatron tensor sharding): straight
+    training == checkpointed + resumed, weight for weight — the moe_tp
+    state save/reshard path under the seq-composed flags."""
+    import dataclasses
+
+    def cfg(nepochs, ckpt_dir=None, resume=False):
+        c = TrainConfig(
+            lr=1e-3, nepochs=nepochs, full_batch=False, batch_size=16,
+            shuffle=True, seed=7, checkpoint_dir=ckpt_dir, resume=resume,
+            log_every=0, optimizer="adam", loss="cross_entropy",
+            mesh=MeshConfig(data=1, seq=2, expert=2, tensor=2),
+            data=DataConfig(dataset="lm", n_samples=32, seq_len=16,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=16))
+        c.model = dataclasses.replace(c.model, moe_experts=4,
+                                      moe_expert_axis="expert",
+                                      attention="ring")
+        return c
+
+    straight = Trainer(cfg(4))
+    assert straight.ep_tp and straight.seq_parallel
+    straight.fit()
+
+    d = str(tmp_path / "ck")
+    Trainer(cfg(2, d)).fit()
+    second = Trainer(cfg(4, d, resume=True))
+    second.init_state()
+    second.fit()
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+        jax.device_get(straight.state.params),
+        jax.device_get(second.state.params))
