@@ -176,17 +176,21 @@ def _make_config(name):
             }
 
         def make_model(cd):
-            # remat "dots" is LOAD-BEARING: without it XLA's buffer
-            # assignment wants ~17 GB of temps at B=8 (measured by
-            # `--preflight`, BENCH_PREFLIGHT.json) vs v5e's 16 GB HBM;
-            # dots saves matmul outputs and recomputes only elementwise
-            # ops, cutting temps to ~6.4 GB at negligible FLOP cost
+            # remat=False is the round-4 chip-validated choice: the CPU
+            # buffer-assignment proxy reads ~17 GB of temps at B=8 (over
+            # v5e's 16 GB HBM) but the REAL chip executed it twice at
+            # 163.4-163.8 ms/step = MFU 0.320 (BIGLM_SWEEP.json b8_none)
+            # vs 177.4 ms / 0.295 with remat "dots" — the proxy is
+            # pessimistic for no-remat programs (BASELINE.md).  The
+            # preflight records the proxy number and accepts the config
+            # via its chip_validated override; remat_policy stays "dots"
+            # so derived remat=True variants keep the measured policy.
             return Transformer(TransformerConfig(
                 vocab_size=c["vocab"], max_seq_len=c["seq"],
                 n_layers=c["n_layers"], d_model=c["d_model"],
                 n_heads=c["n_heads"], d_ff=c["d_ff"], compute_dtype=cd,
                 attention="flash", scan_layers=True,
-                remat=True, remat_policy="dots"))
+                remat=False, remat_policy="dots"))
 
         # no torch baseline: a ~218M-param CPU step takes minutes — the
         # config exists to measure MFU on the chip, not to race torch
@@ -702,6 +706,56 @@ def preflight_config(config_name: str = "big_lm",
     rec["projected_hbm_bytes"] = known
     rec["fits_hbm"] = bool(temp_b is not None and known < hbm_bytes * 0.9)
 
+    # -- 3b. sweep-candidate variants (tools/big_lm_sweep.py's MFU bets):
+    # same compile + memory_analysis at the sweep's (batch, ce_chunk,
+    # remat) points, DERIVED from the committed config (no hand-copied
+    # shape literals — the committed model is the single source), so the
+    # on-chip window never opens with an un-derisked candidate.  The CPU
+    # proxy is known-pessimistic for no-remat rows (round-4 chip runs
+    # executed b8 no-remat fine where the proxy read 17 GB), so fits_hbm
+    # here informs, and the sweep's own OOM-tolerance decides.
+    if config_name == "big_lm":
+        import dataclasses as _dc
+
+        from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+            Transformer as _T,
+        )
+
+        variants = []
+        for vb, vchunk, vremat in ((8, 256, True), (16, 256, True),
+                                   (8, 0, False), (8, 256, False)):
+            vrow = {"batch": vb, "ce_chunk": vchunk, "remat": vremat}
+            if (vb == cfg["batch"] and vchunk == model.cfg.ce_chunk
+                    and vremat == model.cfg.remat):
+                # byte-identical to the committed config compiled in
+                # step 3 — reuse its measurement instead of paying the
+                # most expensive CPU compile a second time
+                vrow.update(temp_bytes=temp_b,
+                            projected_hbm_bytes=known,
+                            fits_hbm=rec["fits_hbm"])
+                variants.append(vrow)
+                continue
+            vmodel = _T(_dc.replace(model.cfg, ce_chunk=vchunk,
+                                    remat=vremat))
+            vstate = dp.replicate_state(
+                TrainState.create(vmodel, opt, prng.init_key(0)), mesh)
+            vstep = dp.make_train_step(vmodel, opt, mesh, cfg["loss"],
+                                       "global_mean")
+            vraw = cfg["make_batch"](rng, vb)
+            vbatch = shd.shard_batch(mesh, vraw)
+            try:
+                vcomp = jax.jit(vstep).lower(vstate, vbatch).compile()
+                vtemp = int(getattr(vcomp.memory_analysis(),
+                                    "temp_size_in_bytes", 0)) or None
+                vknown = param_b + opt_b + param_b + (vtemp or 0)
+                vrow.update(temp_bytes=vtemp, projected_hbm_bytes=vknown,
+                            fits_hbm=bool(vtemp is not None
+                                          and vknown < hbm_bytes * 0.9))
+            except Exception as e:  # noqa: BLE001 — best-effort like 3.
+                vrow["error"] = f"{type(e).__name__}: {e}"[:300]
+            variants.append(vrow)
+        rec["ce_chunk_variants"] = variants
+
     # -- 4. same-shape-class smoke (CPU f32, like bench_framework's CPU
     # path): every matmul shape class the chip will see, fewer layers
     smoke = dict(rec=None)
@@ -736,11 +790,48 @@ def preflight_config(config_name: str = "big_lm",
                        and abs(losses[0] - np.log(c["vocab"])) < 1.0),
         }
     rec["smoke"] = smoke
-    # fits_hbm is part of the verdict: an over-budget config passing its
+    # fits_hbm gates the verdict: an over-budget config passing its
     # preflight would burn the scarce tunnel window on an on-chip OOM —
-    # the exact failure this gate exists to prevent
+    # the exact failure this gate exists to prevent.  EXCEPTION: an actual
+    # successful execution on the real chip is strictly stronger evidence
+    # than the CPU buffer-assignment proxy (which round 4 measured to be
+    # pessimistic for no-remat programs: 17 GB proxy vs a clean 163 ms
+    # chip step).  If BIGLM_SWEEP.json carries a successful TPU row
+    # matching the committed config, the proxy verdict is overridden and
+    # recorded as chip_validated.
+    rec["chip_validated"] = False
+    if config_name == "big_lm":
+        mc = model.cfg
+        sweep_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                  "BIGLM_SWEEP.json")
+        # rows measured before sweep rows carried a "config" stamp were
+        # all taken at these shapes — a row only waives the HBM gate if
+        # the shapes it was measured at are STILL the committed shapes
+        legacy_shapes = dict(vocab=32768, seq=1024, d_model=1024,
+                             n_layers=12, n_heads=16, d_ff=4096)
+        try:
+            with open(sweep_path) as f:
+                for row in json.load(f).get("results", []):
+                    if ("error" not in row
+                            and row.get("platform") == "tpu"
+                            and row.get("config", legacy_shapes) == _BIG
+                            and row.get("batch") == cfg["batch"]
+                            and row.get("remat") == mc.remat
+                            and (not mc.remat
+                                 or row.get("policy") == mc.remat_policy)
+                            and row.get("attention") == mc.attention
+                            and row.get("ce_chunk", 0) == mc.ce_chunk
+                            and row.get("scan_layers", True)
+                            == mc.scan_layers):
+                        rec["chip_validated"] = True
+                        rec["chip_row"] = {k: row.get(k) for k in
+                                           ("label", "step_ms", "mfu")}
+                        break
+        except (OSError, ValueError):
+            pass
     rec["ok"] = bool(rec["eval_shape_ok"] and rec["lower_compile_ok"]
-                     and rec["fits_hbm"] and (smoke.get("ok", True)))
+                     and (rec["fits_hbm"] or rec["chip_validated"])
+                     and (smoke.get("ok", True)))
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=2)
     log(f"preflight[{config_name}] -> {out_path}")

@@ -153,6 +153,11 @@ class ModelConfig:
     # tokens over capacity fall through the residual (models/moe.py)
     moe_capacity_factor: float = 1.25
     moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 routing
+    # transformer: fused chunked cross-entropy — evaluate LM head + CE
+    # ce_chunk tokens at a time under jax.checkpoint so the (B, T, vocab)
+    # f32 logits tensor is never materialized (0 = off).  Loss math is
+    # unchanged; peak HBM for large vocabularies drops ~T/ce_chunk-fold.
+    ce_chunk: int = 0
 
 
 @dataclass
@@ -364,6 +369,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "with the pallas kernel per block; striped[_flash] "
                         "= round-robin token stripes — balanced causal "
                         "blocks, ~2x causal ring throughput at scale)")
+    p.add_argument("--ce_chunk", type=int, default=0,
+                   help="transformer: fuse LM head + cross-entropy over "
+                        "sequence blocks of this many tokens (jax.checkpoint "
+                        "per block) so the (B, T, vocab) logits tensor is "
+                        "never materialized; 0 = off; must divide seq_len; "
+                        "data-parallel/ZeRO-1 layouts only (the trainer "
+                        "rejects it elsewhere — TP layouts shard the head "
+                        "via --vocab_parallel instead)")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")
@@ -463,6 +476,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                             n_layers=args.n_layers, d_model=args.d_model,
                             n_heads=args.n_heads, d_ff=args.d_ff,
                             vocab_size=args.vocab_size,
+                            ce_chunk=args.ce_chunk,
                             max_seq_len=max(args.seq_len, 512))
     if args.dataset in ("mnist", "cifar10", "digits"):
         cfg.loss = "cross_entropy"

@@ -36,6 +36,7 @@ def build_model(cfg: ModelConfig) -> Module:
             moe_expert_axis=cfg.moe_expert_axis,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_top_k=cfg.moe_top_k,
+            ce_chunk=cfg.ce_chunk,
             scan_layers=cfg.scan_layers)
         return Transformer(tc)
     raise ValueError(f"unknown arch {cfg.arch!r}")

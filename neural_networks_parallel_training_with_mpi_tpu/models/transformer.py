@@ -55,6 +55,19 @@ class TransformerConfig:
     moe_capacity: Optional[int] = None
     moe_expert_axis: Optional[str] = None
     moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 routing
+    # Fused chunked cross-entropy (>0 enables): the LM head + CE are
+    # evaluated over sequence blocks of this many tokens under
+    # jax.checkpoint, so the full (B, T, vocab) f32 logits tensor — the
+    # dominant HBM temp for large vocabularies, bigger than the entire
+    # rest of the activation stack for the flagship 32k-vocab config —
+    # is never materialized.  Peak head memory drops from O(B*T*V) to
+    # O(B*ce_chunk*V) in both passes (backward recomputes each chunk's
+    # logits).  Identical math to head_logits + ops.losses
+    # softmax_cross_entropy up to f32 summation order.  T must be a
+    # multiple of ce_chunk.  Training-loss path only (the decode path
+    # wants actual logits); picked up via fused_loss_sum by
+    # parallel.data_parallel.make_loss_fn.
+    ce_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -201,21 +214,16 @@ class Transformer(Module):
         per_layer += ffn
         return float(c.n_layers * per_layer + 2.0 * b * t * d * v)
 
-    def apply(self, params, ids: jax.Array, return_aux: bool = False,
-              **kwargs):
-        """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or
-        (logits, aux) with ``return_aux`` (aux = summed MoE load-balance
-        loss over blocks; 0.0 for dense FFNs).
-
-        Under sequence parallelism T_local = T / seq_axis_size and
-        ``pos_offset`` (the shard's global starting position) is derived from
-        the bound axis index; dense attention uses offset 0.
-        """
+    def backbone(self, params, ids: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+        """Embedding + all blocks -> ((B, T_local, d_model) pre-head
+        hidden states, MoE aux sum).  The shared trunk of :meth:`apply`
+        and the fused chunked-CE loss path (same drift argument as
+        :meth:`embed` / :meth:`head_logits`)."""
         c = self.cfg
-        b, t = ids.shape
         from ..parallel.sequence import global_positions
 
-        positions = global_positions(c.attention, c.seq_axis, t)
+        positions = global_positions(c.attention, c.seq_axis, ids.shape[1])
         x = self.embed(params, ids, positions)
         block_fn = self._block
         if c.remat:
@@ -235,5 +243,90 @@ class Transformer(Module):
             for layer_params in params["blocks"]:
                 x, aux = block_fn(layer_params, x)
                 aux_total = aux_total + aux
+        return x, aux_total
+
+    def apply(self, params, ids: jax.Array, return_aux: bool = False,
+              **kwargs):
+        """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or
+        (logits, aux) with ``return_aux`` (aux = summed MoE load-balance
+        loss over blocks; 0.0 for dense FFNs).
+
+        Under sequence parallelism T_local = T / seq_axis_size and
+        ``pos_offset`` (the shard's global starting position) is derived from
+        the bound axis index; dense attention uses offset 0.
+        """
+        x, aux_total = self.backbone(params, ids)
         logits = self.head_logits(params, x)
         return (logits, aux_total) if return_aux else logits
+
+    # ---- fused chunked cross-entropy (cfg.ce_chunk > 0) ----
+
+    def _chunked_ce_sum(self, params, x: jax.Array, labels: jax.Array,
+                        mask: Optional[jax.Array],
+                        label_smoothing: float
+                        ) -> Tuple[jax.Array, jax.Array]:
+        """(loss_sum, token_count) of head-projection + softmax CE computed
+        ``ce_chunk`` tokens at a time under ``jax.checkpoint``.  ``x`` is
+        the post-final-norm hidden state (B, T, d_model); the (B, T, V)
+        logits tensor never exists — each scan tick materializes only a
+        (B, ce_chunk, V) slice, and backward recomputes it.  Matches
+        head_logits + ops.losses.softmax_cross_entropy exactly up to f32
+        summation order (chunk sums are accumulated sequentially)."""
+        c = self.cfg
+        B, T, _ = x.shape
+        k = c.ce_chunk
+        if T % k != 0:
+            raise ValueError(
+                f"ce_chunk={k} must divide the local sequence length {T}")
+        n = T // k
+        head = Linear(c.d_model, c.vocab_size, use_bias=False,
+                      param_dtype=c.param_dtype,
+                      compute_dtype=c.compute_dtype)
+        mask_f = None if mask is None else mask.astype(jnp.float32)
+
+        def chunk_sum(head_params, xc, yc):
+            logits = head.apply(head_params, xc).astype(jnp.float32)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, yc[..., None],
+                                       axis=-1)[..., 0]
+            if label_smoothing > 0.0:
+                s = label_smoothing
+                nll = logz - (1.0 - s) * gold - s * logits.mean(axis=-1)
+            else:
+                nll = logz - gold  # (B, k)
+            per = nll if mask_f is None else nll * mask_f[:, None]
+            return per.sum()
+
+        chunk_sum = jax.checkpoint(chunk_sum)
+        xs = x.reshape(B, n, k, x.shape[-1]).swapaxes(0, 1)  # (n, B, k, d)
+        ys = labels.reshape(B, n, k).swapaxes(0, 1)          # (n, B, k)
+
+        def body(acc, inp):
+            xc, yc = inp
+            return acc + chunk_sum(params["head"], xc, yc), None
+
+        s, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys))
+        cnt = (jnp.asarray(float(B * T), jnp.float32) if mask_f is None
+               else mask_f.sum() * float(T))
+        return s, cnt
+
+    def fused_loss_sum(self, loss_name: str):
+        """(params, batch) -> (loss_sum, count) closure fusing the LM head
+        into a chunked cross-entropy, or None when not applicable (chunking
+        disabled, or a loss the fusion doesn't cover).  Hook consumed by
+        parallel.data_parallel.make_loss_fn; batch/mask semantics are
+        those of ops.losses.softmax_cross_entropy + reduce_token_nll."""
+        if self.cfg.ce_chunk <= 0:
+            return None
+        base, _, smooth = loss_name.partition("@")
+        if base != "cross_entropy":
+            return None
+        label_smoothing = float(smooth) if smooth else 0.0
+
+        def loss_fn(params, batch):
+            x, _aux = self.backbone(params, batch["x"])
+            x = self.final_norm(params, x)
+            return self._chunked_ce_sum(params, x, batch["y"],
+                                        batch.get("mask"), label_smoothing)
+
+        return loss_fn

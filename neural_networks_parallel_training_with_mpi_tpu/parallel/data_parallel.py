@@ -48,7 +48,20 @@ DATA_AXES: Tuple[str, ...] = ("data", "fsdp")
 
 def make_loss_fn(model, loss_name: str) -> Callable[[Pytree, Batch],
                                                     Tuple[jax.Array, jax.Array]]:
-    """(params, batch) -> (loss_sum, example_count), mask-aware."""
+    """(params, batch) -> (loss_sum, example_count), mask-aware.
+
+    Models may offer a fused loss path (``fused_loss_sum(loss_name)``
+    returning a closure, or None when inapplicable) that computes the same
+    (sum, count) without materializing the full prediction tensor — e.g.
+    the Transformer's chunked cross-entropy, which never builds the
+    (B, T, vocab) logits.  When present and applicable it is preferred;
+    the generic apply-then-loss path is the fallback and the semantic
+    definition both must match."""
+    fused_hook = getattr(model, "fused_loss_sum", None)
+    if fused_hook is not None:
+        fused = fused_hook(loss_name)
+        if fused is not None:
+            return fused
     base = losses_lib.get(loss_name)
 
     def loss_fn(params, batch):

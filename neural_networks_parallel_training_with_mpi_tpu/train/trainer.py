@@ -129,6 +129,25 @@ class Trainer:
                 "--vocab_parallel shards the embedding/head over 'tensor' "
                 "on the seq x tensor path (--sp > 1 and --tp > 1); other "
                 "layouts keep them replicated")
+        if cfg.model.ce_chunk > 0:
+            # only data_parallel.make_loss_fn consults the model's
+            # fused_loss_sum hook; anywhere it cannot fire the flag would
+            # be silently ignored and the full (B, T, vocab) logits
+            # materialized anyway — fail loudly instead (the TP paths get
+            # the same memory relief from --vocab_parallel's sharded head)
+            if (self.pipeline or self.tensor or self.seq_parallel
+                    or self.expert or fsdp_on):
+                raise ValueError(
+                    "--ce_chunk (fused chunked cross-entropy) is wired on "
+                    "the data-parallel/ZeRO-1 step path only; with tp/pp/"
+                    "sp/ep/fsdp axes use --vocab_parallel (seq x tensor) "
+                    "or drop --ce_chunk")
+            if (cfg.model.arch != "transformer"
+                    or cfg.loss.partition("@")[0] != "cross_entropy"):
+                raise ValueError(
+                    "--ce_chunk fuses the transformer LM head into "
+                    "cross-entropy; it does nothing for "
+                    f"arch={cfg.model.arch!r} loss={cfg.loss!r} — drop it")
         if (cfg.optimizer == "adafactor"
                 and (self.pipeline or self.sp_tp or self.expert
                      or self.ep_tp or cfg.update_sharding == "zero1")):

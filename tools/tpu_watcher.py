@@ -69,6 +69,15 @@ PRIORITY = [
     ("biglm_sweep", [sys.executable, "tools/big_lm_sweep.py"], 2100),
     ("attention_kernels", [sys.executable, "bench.py", "--attention"],
      2100),
+    # round-4 follow-ups after the 01:0x window: the round-3 sweep
+    # variants (unrolled layers + the HTTP-500 retries), and the
+    # canonical big_lm capture with the chip-validated no-remat default
+    ("biglm_sweep_r3", [sys.executable, "tools/big_lm_sweep.py"], 2100),
+    ("big_lm_none", [sys.executable, "bench.py", "--config", "big_lm"],
+     2100),
+    # where do big_lm's 163 ms go? ablation differencing (layers/fwd/
+    # update/ffn) -> BIGLM_ATTRIB.json guides the next MFU push
+    ("biglm_attrib", [sys.executable, "tools/big_lm_attrib.py"], 2100),
 ]
 
 
