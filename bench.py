@@ -722,7 +722,8 @@ def preflight_config(config_name: str = "big_lm",
         )
 
         variants = []
-        for vb, vchunk, vremat in ((8, 256, True), (16, 256, True),
+        for vb, vchunk, vremat in ((8, 0, True), (8, 256, True),
+                                   (16, 256, True),
                                    (8, 0, False), (8, 256, False)):
             vrow = {"batch": vb, "ce_chunk": vchunk, "remat": vremat}
             if (vb == cfg["batch"] and vchunk == model.cfg.ce_chunk
@@ -737,12 +738,15 @@ def preflight_config(config_name: str = "big_lm",
                 continue
             vmodel = _T(_dc.replace(model.cfg, ce_chunk=vchunk,
                                     remat=vremat))
-            vstate = dp.replicate_state(
-                TrainState.create(vmodel, opt, prng.init_key(0)), mesh)
+            # abstract lowering: memory_analysis only needs shapes, so
+            # skip materializing ~1.7 GB of real f32 state per variant
+            vstate = jax.eval_shape(
+                lambda m=vmodel: TrainState.create(m, opt, prng.init_key(0)))
             vstep = dp.make_train_step(vmodel, opt, mesh, cfg["loss"],
                                        "global_mean")
             vraw = cfg["make_batch"](rng, vb)
-            vbatch = shd.shard_batch(mesh, vraw)
+            vbatch = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                      for k, v in vraw.items()}
             try:
                 vcomp = jax.jit(vstep).lower(vstate, vbatch).compile()
                 vtemp = int(getattr(vcomp.memory_analysis(),
@@ -804,17 +808,15 @@ def preflight_config(config_name: str = "big_lm",
         mc = model.cfg
         sweep_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                   "BIGLM_SWEEP.json")
-        # rows measured before sweep rows carried a "config" stamp were
-        # all taken at these shapes — a row only waives the HBM gate if
-        # the shapes it was measured at are STILL the committed shapes
-        legacy_shapes = dict(vocab=32768, seq=1024, d_model=1024,
-                             n_layers=12, n_heads=16, d_ff=4096)
+        # a row only waives the HBM gate if the shapes it was measured at
+        # are STILL the committed shapes (unstamped rows = LEGACY_SWEEP_SHAPES)
         try:
             with open(sweep_path) as f:
                 for row in json.load(f).get("results", []):
                     if ("error" not in row
                             and row.get("platform") == "tpu"
-                            and row.get("config", legacy_shapes) == _BIG
+                            and row.get("config",
+                                        LEGACY_SWEEP_SHAPES) == _BIG
                             and row.get("batch") == cfg["batch"]
                             and row.get("remat") == mc.remat
                             and (not mc.remat
@@ -1358,6 +1360,39 @@ def save_tpu_latest(records: list) -> None:
     with open(TPU_LATEST_PATH, "w") as f:
         json.dump(doc, f, indent=2)
     log(f"TPU provenance record -> {TPU_LATEST_PATH}")
+
+
+# shapes the pre-"config"-stamp BIGLM_SWEEP.json rows were measured at
+# (round-4 windows); consulted wherever a stamped row is required so a
+# stale row cannot masquerade as the current config after _BIG changes
+LEGACY_SWEEP_SHAPES = dict(vocab=32768, seq=1024, d_model=1024,
+                           n_layers=12, n_heads=16, d_ff=4096)
+
+
+def merge_artifact_rows(path: str, new_rows: list, key: str = "label"
+                        ) -> list:
+    """Label-keyed merge of measurement rows across scarce tunnel windows
+    (shared by tools/big_lm_sweep.py and tools/big_lm_attrib.py): a new
+    successful row replaces the prior one; an ERROR row never clobbers a
+    prior success (those take a rare window to reproduce); prior rows for
+    labels not re-run this window are kept."""
+    prior = {}
+    try:
+        with open(path) as f:
+            for row in json.load(f).get("results", []):
+                if row.get(key):
+                    prior[row[key]] = row
+    except (OSError, ValueError):
+        pass
+    merged = []
+    for row in new_rows:
+        if "error" in row and "error" not in prior.get(row[key],
+                                                       {"error": 1}):
+            row = prior[row[key]]
+        merged.append(row)
+        prior.pop(row[key], None)
+    merged.extend(prior.values())
+    return merged
 
 
 def load_tpu_latest() -> dict | None:

@@ -373,10 +373,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="transformer: fuse LM head + cross-entropy over "
                         "sequence blocks of this many tokens (jax.checkpoint "
                         "per block) so the (B, T, vocab) logits tensor is "
-                        "never materialized; 0 = off; must divide seq_len; "
-                        "data-parallel/ZeRO-1 layouts only (the trainer "
-                        "rejects it elsewhere — TP layouts shard the head "
-                        "via --vocab_parallel instead)")
+                        "never materialized; 0 = off; must divide the "
+                        "local (per-seq-shard) sequence length; wired on "
+                        "the data-parallel/ZeRO-1, sequence-parallel, and "
+                        "pipeline layouts (the trainer rejects it "
+                        "elsewhere — non-pipeline TP layouts shard the "
+                        "head via --vocab_parallel instead)")
     p.add_argument("--dp", type=int, default=-1, help="data-parallel axis size (-1 = rest)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel axis size")
     p.add_argument("--pp", type=int, default=1, help="pipeline-parallel axis size")

@@ -282,20 +282,20 @@ class Transformer(Module):
         head = Linear(c.d_model, c.vocab_size, use_bias=False,
                       param_dtype=c.param_dtype,
                       compute_dtype=c.compute_dtype)
-        mask_f = None if mask is None else mask.astype(jnp.float32)
+
+        from ..ops import losses as losses_lib
 
         def chunk_sum(head_params, xc, yc):
+            # ops.losses.softmax_cross_entropy is the single definition of
+            # the nll/mask/count semantics (same anti-drift argument as
+            # embed/head_logits: the fused path must stay byte-equivalent
+            # in math to the materializing path it replaces); per chunk it
+            # returns (sum over B x k masked tokens, mask.sum() * k), and
+            # the scan total reproduces reduce_token_nll's (sum,
+            # mask.sum() * T) exactly
             logits = head.apply(head_params, xc).astype(jnp.float32)
-            logz = jax.nn.logsumexp(logits, axis=-1)
-            gold = jnp.take_along_axis(logits, yc[..., None],
-                                       axis=-1)[..., 0]
-            if label_smoothing > 0.0:
-                s = label_smoothing
-                nll = logz - (1.0 - s) * gold - s * logits.mean(axis=-1)
-            else:
-                nll = logz - gold  # (B, k)
-            per = nll if mask_f is None else nll * mask_f[:, None]
-            return per.sum()
+            return losses_lib.softmax_cross_entropy(
+                logits, yc, mask, label_smoothing=label_smoothing)
 
         chunk_sum = jax.checkpoint(chunk_sum)
         xs = x.reshape(B, n, k, x.shape[-1]).swapaxes(0, 1)  # (n, B, k, d)
@@ -303,11 +303,12 @@ class Transformer(Module):
 
         def body(acc, inp):
             xc, yc = inp
-            return acc + chunk_sum(params["head"], xc, yc), None
+            s, cnt = chunk_sum(params["head"], xc, yc)
+            return (acc[0] + s, acc[1] + cnt), None
 
-        s, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ys))
-        cnt = (jnp.asarray(float(B * T), jnp.float32) if mask_f is None
-               else mask_f.sum() * float(T))
+        (s, cnt), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+            (xs, ys))
         return s, cnt
 
     def fused_loss_sum(self, loss_name: str):

@@ -363,7 +363,21 @@ def _stage_fns(model: Transformer, tp: int):
         return head.apply(params["head"],
                           ln_f.apply(params["ln_f"], h)).astype(jnp.float32)
 
-    return stage_apply, embed, head_logits
+    # fused chunked cross-entropy for the last stage (cfg.ce_chunk > 0):
+    # the head is replicated on every pipeline layout (vocab sharding
+    # lives on the seq x tensor path), so the model's _chunked_ce_sum is
+    # a drop-in for base(head_logits(...)) — the (mb, T, vocab) logits of
+    # a microbatch never materialize.  None when chunking is off; the
+    # caller keeps the materializing closure for non-CE losses and eval
+    # (accuracy needs actual logits).
+    fused_head_loss = None
+    if c.ce_chunk > 0:
+        def fused_head_loss(params, h, tgt, msk, label_smoothing=0.0):
+            x = ln_f.apply(params["ln_f"], h)
+            return model._chunked_ce_sum(params, x, tgt, msk,
+                                         label_smoothing)
+
+    return stage_apply, embed, head_logits, fused_head_loss
 
 
 def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
@@ -554,10 +568,17 @@ def make_pipeline_train_step(model: Transformer, optimizer: Optimizer,
     use_seq = int(mesh.shape.get(c.seq_axis, 1)) > 1
     token_axes = batch_axes + ((c.seq_axis,) if use_seq else ())
     reduce_axes = token_axes + (PIPE_AXIS,)
-    stage_apply, embed, head_logits = _stage_fns(model, tp)
+    stage_apply, embed, head_logits, fused_head = _stage_fns(model, tp)
 
-    def head_loss(params, h, tgt, msk):
-        return base(head_logits(params, h), tgt, msk)
+    ce_base, _, ce_smooth = loss_name.partition("@")
+    if fused_head is not None and ce_base == "cross_entropy":
+        _smoothing = float(ce_smooth) if ce_smooth else 0.0
+
+        def head_loss(params, h, tgt, msk):
+            return fused_head(params, h, tgt, msk, _smoothing)
+    else:
+        def head_loss(params, h, tgt, msk):
+            return base(head_logits(params, h), tgt, msk)
 
     def local_fwd(params, batch):
         ids, tgts = batch["x"], batch["y"]
@@ -742,7 +763,7 @@ def make_pipeline_eval_step(model: Transformer, mesh: Mesh,
     token_axes = batch_axes + ((c.seq_axis,) if use_seq else ())
     reduce_axes = token_axes + (PIPE_AXIS,)
     row_axes = batch_axes + (PIPE_AXIS,)  # example-level sums (accuracy)
-    stage_apply, embed, head_logits = _stage_fns(model, tp)
+    stage_apply, embed, head_logits, _ = _stage_fns(model, tp)
 
     def shard_eval(params, batch):
         ids, tgts = batch["x"], batch["y"]

@@ -23,6 +23,7 @@ from ..train.state import TrainState
 from .data_parallel import (
     DATA_AXES,
     _accumulated_sum_and_grads,
+    make_loss_fn,
     zero1_shard_update,
     zero1_state_spec,
 )
@@ -81,14 +82,18 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
             "grad_clip is only applied inside the zero1 update; on the "
             "replicated path wrap the optimizer with optim.with_clipping "
             "instead of silently not clipping")
-    base = losses_lib.get(loss_name)
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     extra = (seq_axis,) if use_seq else ()
     reduce_axes = DATA_AXES + extra
 
-    def loss_sum(params, batch):
-        pred = model.apply(params, batch["x"])
-        return base(pred, batch["y"], batch.get("mask"))
+    # the shard-local (sum, count) is exactly data_parallel.make_loss_fn's
+    # contract — per-token CE over the LOCAL sequence shard with the
+    # per-example mask broadcast — so the seq-axis psum below completes
+    # the same global mean, and the model's fused loss path (chunked CE,
+    # TransformerConfig.ce_chunk) fires here too: under sequence
+    # parallelism the (B, T_local, vocab) logits shard it avoids is still
+    # the dominant temp for large vocabularies
+    loss_sum = make_loss_fn(model, loss_name)
 
     def shard_step(state: TrainState, batch: Batch):
         s, c, grads = _accumulated_sum_and_grads(

@@ -135,13 +135,20 @@ class Trainer:
             # be silently ignored and the full (B, T, vocab) logits
             # materialized anyway — fail loudly instead (the TP paths get
             # the same memory relief from --vocab_parallel's sharded head)
-            if (self.pipeline or self.tensor or self.seq_parallel
-                    or self.expert or fsdp_on):
+            if not self.pipeline and (self.tensor or self.expert
+                                      or fsdp_on):
+                # wired: pure DP/ZeRO-1, DP x SP, and every pipeline
+                # layout (the pipeline head is replicated, so its last
+                # stage fuses the same way).  Not wired: the non-pipeline
+                # tensor/expert/fsdp step builders — there the head is
+                # (or may be) sharded and --vocab_parallel is the
+                # equivalent relief.
                 raise ValueError(
                     "--ce_chunk (fused chunked cross-entropy) is wired on "
-                    "the data-parallel/ZeRO-1 step path only; with tp/pp/"
-                    "sp/ep/fsdp axes use --vocab_parallel (seq x tensor) "
-                    "or drop --ce_chunk")
+                    "the data-parallel/ZeRO-1, sequence-parallel, and "
+                    "pipeline step paths; with non-pipeline tp/ep/fsdp "
+                    "axes use --vocab_parallel (seq x tensor) or drop "
+                    "--ce_chunk")
             if (cfg.model.arch != "transformer"
                     or cfg.loss.partition("@")[0] != "cross_entropy"):
                 raise ValueError(

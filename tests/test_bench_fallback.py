@@ -49,3 +49,33 @@ def test_fallback_headline_is_cached_tpu_row():
     # presented at top level without the fallback marker
     if (rec.get("vs_baseline") or 1.0) < 1.0:
         assert rec.get("platform_fallback") or rec.get("role")
+
+
+def test_merge_artifact_rows(tmp_path):
+    """The cross-window row-merge protocol both chip tools share: new
+    success wins, an error row never clobbers a prior success, labels not
+    re-run are kept, a brand-new error row is recorded."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    path = tmp_path / "rows.json"
+    path.write_text(json.dumps({"results": [
+        {"label": "a", "mfu": 0.3},
+        {"label": "b", "mfu": 0.2},
+        {"label": "c", "error": "old boom"},
+    ]}))
+    merged = bench.merge_artifact_rows(str(path), [
+        {"label": "a", "error": "boom"},       # must NOT clobber prior a
+        {"label": "b", "mfu": 0.25},           # new success wins
+        {"label": "c", "error": "new boom"},   # error-over-error: new
+        {"label": "d", "error": "fresh"},      # new label, error recorded
+    ])
+    by = {r["label"]: r for r in merged}
+    assert by["a"] == {"label": "a", "mfu": 0.3}
+    assert by["b"] == {"label": "b", "mfu": 0.25}
+    assert by["c"] == {"label": "c", "error": "new boom"}
+    assert by["d"] == {"label": "d", "error": "fresh"}
+    # missing artifact: everything passes through
+    merged2 = bench.merge_artifact_rows(str(tmp_path / "nope.json"),
+                                        [{"label": "x", "mfu": 1.0}])
+    assert merged2 == [{"label": "x", "mfu": 1.0}]

@@ -128,3 +128,78 @@ def test_train_step_trajectory_parity():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
         params[0], params[1])
+
+
+def test_spmd_seq_parallel_trajectory_parity():
+    """Fused chunked CE under DP x SP (ring attention, seq-sharded batch):
+    one jitted step lands on the same weights as the unfused path — the
+    seq-axis psum completes the same global mean either way."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        mesh as mesh_lib, spmd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=2),
+                              devices=jax.devices()[:4])
+    batch = _batch(mask=[1, 1, 1, 1])
+    params_out, losses = [], []
+    for chunk in (0, 4):  # T_local = 8, chunk 4 divides it
+        model = _model(chunk, attention="ring")
+        opt = optim.sgd(lr=0.1, momentum=0.9)
+        state = TrainState.create(model, opt, jax.random.key(1))
+        step = spmd.make_spmd_train_step(
+            model, opt, mesh, "cross_entropy", seq_axis="seq",
+            donate=False,
+            example_batch=spmd.place_batch(mesh, batch, "seq"))
+        state, loss = step(state, spmd.place_batch(mesh, batch, "seq"))
+        losses.append(float(loss))
+        params_out.append(state.params)
+    assert abs(losses[0] - losses[1]) < 1e-5 * max(1.0, abs(losses[0]))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        params_out[0], params_out[1])
+
+
+@pytest.mark.slow
+def test_pipeline_trajectory_parity():
+    """Fused chunked CE at the pipeline's last stage: a DP x PP step with
+    ce_chunk lands on the same loss/weights as the unfused pipeline."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        pipeline as pp,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=2),
+                     devices=jax.devices("cpu")[:4])
+    rng = np.random.default_rng(3)
+    rows = 8
+    batch = {"x": rng.integers(0, V, (rows, T)).astype(np.int32),
+             "y": rng.integers(0, V, (rows, T)).astype(np.int32),
+             "mask": np.ones((rows,), np.float32)}
+    losses_out, params_out = [], []
+    for chunk in (0, 4):
+        model = _model(chunk)  # 2 layers = 1 per stage
+        opt = optim.sgd(lr=0.1, momentum=0.9)
+        state, loss = pp.run_one_step(model, opt, mesh, batch,
+                                      prng.init_key(0), n_microbatches=2)
+        losses_out.append(float(loss))
+        params_out.append(jax.device_get(state.params))
+    assert abs(losses_out[0] - losses_out[1]) < 1e-5
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+        params_out[0], params_out[1])

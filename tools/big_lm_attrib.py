@@ -130,6 +130,14 @@ def main() -> int:
     def var_dff_half():
         return {"step_ms": round(full_step(build(d_ff=2048)), 2)}
 
+    # timed_chain's only sync is device_get of the FINAL value, which is
+    # valid ONLY when every iteration depends on the previous one (its
+    # docstring: block_until_ready resolves early on the tunneled
+    # backend).  The fwd-only/grad-only chains below therefore thread the
+    # previous scalar INTO each program (prev * 1e-30 added to the loss —
+    # numerically invisible, but a real data dependence XLA cannot fold
+    # away, unlike `0.0 * prev` which fast-math may) so the final value
+    # transitively forces the whole chain.
     def var_fwd_only():
         model = build()
         state = dp.replicate_state(TrainState.create(model, opt,
@@ -137,15 +145,19 @@ def main() -> int:
         loss_fn = dp.make_loss_fn(model, "cross_entropy")
 
         @jax.jit
-        def fwd(params, b):
-            s, cnt = loss_fn(params, b)
-            return s / cnt
+        def fwd(prev, b):
+            s, cnt = loss_fn(state.params, b)
+            return s / cnt + prev * 1e-30
 
         def chainable(carry, b):  # timed_chain wants (state-like, batch)
-            return carry, fwd(state.params, b)
+            out = fwd(carry, b)
+            return out, out
 
-        bench.timed_chain(chainable, 0, placed, 2)
-        ms, _ = timed(chainable, 0, placed)
+        import jax.numpy as jnp
+
+        zero = jnp.zeros((), jnp.float32)
+        bench.timed_chain(chainable, zero, placed, 2)
+        ms, _ = timed(chainable, zero, placed)
         return {"fwd_ms": round(ms, 2)}
 
     def var_no_update():
@@ -155,23 +167,28 @@ def main() -> int:
         loss_fn = dp.make_loss_fn(model, "cross_entropy")
 
         @jax.jit
-        def grad_only(params, b):
+        def grad_only(prev, b):
             def scalar(p):
                 s, cnt = loss_fn(p, b)
                 return s / cnt
 
-            l, g = jax.value_and_grad(scalar)(params)
+            l, g = jax.value_and_grad(scalar)(state.params)
             # reduce the grads to a scalar so the timed chain depends on
             # the whole backward without materializing an update
-            return l + sum(jax.tree_util.tree_map(
-                lambda x: x.sum().astype(l.dtype),
-                jax.tree_util.tree_leaves(g)))
+            return (l + prev * 1e-30
+                    + sum(jax.tree_util.tree_map(
+                        lambda x: x.sum().astype(l.dtype),
+                        jax.tree_util.tree_leaves(g))))
 
         def chainable(carry, b):
-            return carry, grad_only(state.params, b)
+            out = grad_only(carry, b)
+            return out, out
 
-        bench.timed_chain(chainable, 0, placed, 2)
-        ms, _ = timed(chainable, 0, placed)
+        import jax.numpy as jnp
+
+        zero = jnp.zeros((), jnp.float32)
+        bench.timed_chain(chainable, zero, placed, 2)
+        ms, _ = timed(chainable, zero, placed)
         return {"fwd_bwd_ms": round(ms, 2)}
 
     record("full", var_full)
@@ -180,8 +197,13 @@ def main() -> int:
     record("no_update", var_no_update)
     record("dff_half", var_dff_half)
 
+    # merge with prior windows FIRST (bench.merge_artifact_rows: errors
+    # never clobber prior chip data), then derive from the merged view so
+    # a partially-failed re-run keeps the prior window's derived metrics
+    merged = bench.merge_artifact_rows(ARTIFACT, rows)
+
     # ---- derived attribution (only from rows that succeeded) ----
-    by = {r["label"]: r for r in rows}
+    by = {r["label"]: r for r in merged}
     derived = {}
     if "step_ms" in by.get("full", {}) and "step_ms" in by.get("layers6", {}):
         per_layer = (by["full"]["step_ms"] - by["layers6"]["step_ms"]) / 6.0
@@ -200,23 +222,6 @@ def main() -> int:
         derived["dff_half_delta_ms"] = round(
             by["full"]["step_ms"] - by["dff_half"]["step_ms"], 2)
 
-    # merge with prior windows (label-keyed; errors never clobber data)
-    prior = {}
-    try:
-        with open(ARTIFACT) as f:
-            for row in json.load(f).get("results", []):
-                if row.get("label"):
-                    prior[row["label"]] = row
-    except (OSError, ValueError):
-        pass
-    merged = []
-    for row in rows:
-        if "error" in row and "error" not in prior.get(row["label"],
-                                                       {"error": 1}):
-            row = prior[row["label"]]
-        merged.append(row)
-        prior.pop(row["label"], None)
-    merged.extend(prior.values())
     doc = {"results": merged, "derived": derived,
            "device_kind": info.get("device_kind"),
            "captured_unix": round(time.time(), 1),

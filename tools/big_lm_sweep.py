@@ -151,17 +151,7 @@ def main() -> int:
                           "skipped": "tunnel unreachable or cpu-only",
                           "probe": info}))
         return 2
-    # merge with previously-captured rows (label-keyed, new run wins):
-    # the tunnel flaps, so every window's rows are kept, never clobbered
-    prior = {}
-    try:
-        with open(os.path.join(REPO, "BIGLM_SWEEP.json")) as f:
-            for row in json.load(f).get("results", []):
-                if row.get("label"):
-                    prior[row["label"]] = row
-    except (OSError, ValueError):
-        pass
-    results = []
+    rows = []
     for variant in VARIANTS:
         label = variant[0]
         try:
@@ -169,14 +159,17 @@ def main() -> int:
         except Exception as e:  # OOM or lowering failure: record, continue
             row = {"label": label, "error": f"{type(e).__name__}: {e}"[:400]}
         print(f"[big_lm_sweep] {json.dumps(row)}", flush=True)
-        if "error" in row and "error" not in prior.get(label, {"error": 1}):
-            # a failed re-run must not clobber a prior window's successful
-            # chip measurement — those take a rare tunnel window to redo
-            row = prior[label]
-        results.append(row)
-        prior.pop(label, None)
-    results.extend(prior.values())
-    best = max((r for r in results if r.get("mfu")),
+        rows.append(row)
+    # merge with previously-captured rows (bench.merge_artifact_rows: new
+    # success wins, error rows never clobber prior chip measurements,
+    # not-re-run labels kept) — the tunnel flaps, every window counts
+    results = bench.merge_artifact_rows(
+        os.path.join(REPO, "BIGLM_SWEEP.json"), rows)
+    # the headline must describe the CURRENT shapes: stale rows from a
+    # since-edited bench._BIG stay in results (history) but cannot win
+    current = dict(bench._BIG)
+    best = max((r for r in results if r.get("mfu")
+                and r.get("config", bench.LEGACY_SWEEP_SHAPES) == current),
                key=lambda r: r["mfu"], default=None)
     doc = {"results": results, "best": best,
            "captured_unix": round(time.time(), 1),
