@@ -36,6 +36,15 @@ class TransformerConfig:
     compute_dtype: Any = jnp.float32   # set bfloat16 for TPU throughput
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
+    # Pallas flash-kernel tile sizes (flash / ring_flash / striped_flash
+    # only; dense and the non-flash ring ignore them).  128 x 128 is the
+    # v5e-safe default — block_k is the MXU contraction tile for the
+    # score matmul and block_q rows live in VMEM across the k-loop, so
+    # larger block_k amortizes loop overhead at the price of VMEM;
+    # bench's flagship sweep (tools/big_lm_sweep.py) tunes these on the
+    # real chip rather than guessing.
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     remat: bool = False                # jax.checkpoint each block (HBM <-> FLOPs)
     remat_policy: str = "full"         # full | dots | dots_no_batch (models.core.make_remat)
     # lax.scan over a stacked block pytree (leaves (n_layers, ...)) instead
@@ -144,7 +153,8 @@ class Transformer(Module):
         shape = (b, t, c.n_heads, c.head_dim)
         out = sequence_sharded_attention(
             c.attention, q.reshape(shape), k.reshape(shape), v.reshape(shape),
-            axis=c.seq_axis, causal=True)
+            axis=c.seq_axis, causal=True, block_q=c.flash_block_q,
+            block_k=c.flash_block_k)
         out = out.reshape(b, t, c.d_model)
         x = x + mods["attn_out"].apply(params["attn_out"], out)
         h = mods["ln2"].apply(params["ln2"], x)

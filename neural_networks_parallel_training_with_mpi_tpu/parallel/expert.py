@@ -500,7 +500,8 @@ def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
 
         positions = global_positions(c.attention, seq_axis, t)
         attn = lambda q, k, v: sequence_sharded_attention(
-            c.attention, q, k, v, axis=seq_axis, causal=True)
+            c.attention, q, k, v, axis=seq_axis, causal=True,
+            block_q=c.flash_block_q, block_k=c.flash_block_k)
     else:
         positions = jnp.arange(t)
         attn = None

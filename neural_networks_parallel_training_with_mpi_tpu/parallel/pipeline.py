@@ -307,7 +307,8 @@ def _stage_fns(model: Transformer, tp: int):
         # _validate_pipe guarantees that axis is > 1 for them.
         attn = (None if c.attention == "dense"
                 else (lambda q, k, v: sequence_sharded_attention(
-                    c.attention, q, k, v, axis=c.seq_axis, causal=True)))
+                    c.attention, q, k, v, axis=c.seq_axis, causal=True,
+                    block_q=c.flash_block_q, block_k=c.flash_block_k)))
         ffn_fn = None
         if c.moe_experts > 0:
             # GShard expert+model parallelism inside the stage: experts

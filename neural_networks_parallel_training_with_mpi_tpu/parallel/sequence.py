@@ -402,24 +402,30 @@ def global_positions(impl: str, axis: str, t: int) -> jax.Array:
 
 def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
                                causal: bool = True,
-                               scale: Optional[float] = None) -> jax.Array:
+                               scale: Optional[float] = None,
+                               block_q: int = 128,
+                               block_k: int = 128) -> jax.Array:
     if impl == "dense":
         return attention_reference(q, k, v, causal=causal, scale=scale)
     if impl == "flash":
         from ..ops.pallas_kernels import flash_attention
 
-        return flash_attention(q, k, v, causal)
+        return flash_attention(q, k, v, causal, block_q=block_q,
+                               block_k=block_k)
     if impl == "ring":
         return ring_attention(q, k, v, axis=axis, causal=causal, scale=scale)
     if impl == "ring_flash":
         return ring_flash_attention(q, k, v, axis=axis, causal=causal,
-                                    scale=scale)
+                                    scale=scale, block_q=block_q,
+                                    block_k=block_k)
     if impl == "striped":
         return ring_attention(q, k, v, axis=axis, causal=causal, scale=scale,
                               striped=True)
     if impl == "striped_flash":
         return striped_ring_flash_attention(q, k, v, axis=axis,
-                                            causal=causal, scale=scale)
+                                            causal=causal, scale=scale,
+                                            block_q=block_q,
+                                            block_k=block_k)
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis=axis, causal=causal, scale=scale)
     raise ValueError(f"unknown attention impl {impl!r}")

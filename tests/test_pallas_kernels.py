@@ -162,3 +162,51 @@ def test_flash_attention_with_lse_value_and_grads():
         for a, bb in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
                                        rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_rectangular_blocks():
+    """block_q != block_k tilings (the flagship sweep tunes block_k
+    independently — tools/big_lm_sweep.py) must be numerically identical
+    to the dense reference, fwd and bwd."""
+    q, k, v = _qkv(t=64)
+    expected = attention_reference(q, k, v, causal=True)
+    for bq, bk in ((16, 32), (32, 16), (16, 64)):
+        got = flash_attention(q, k, v, True, bq, bk, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"bq={bq} bk={bk}")
+
+    def loss(bq, bk):
+        return lambda q_, k_, v_: (
+            flash_attention(q_, k_, v_, True, bq, bk, True) ** 2).sum()
+
+    g_ref = jax.grad(loss(16, 16), argnums=(0, 1, 2))(q, k, v)
+    g_rect = jax.grad(loss(16, 32), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), g_rect, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4, err_msg=name)
+
+
+def test_flash_block_config_reaches_kernel():
+    """TransformerConfig.flash_block_q/flash_block_k thread through
+    sequence_sharded_attention to the kernel: a non-default legal tiling
+    gives the same forward as the default, and an illegal one (not
+    dividing T) raises — proof the values actually arrive."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    t = 32
+    mk = lambda **kw: Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=t, n_layers=1, d_model=32, n_heads=4,
+        d_ff=64, attention="flash", **kw))
+    params = mk().init(prng.init_key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, t)),
+                      jnp.int32)
+    default = mk().apply(params, ids)
+    tuned = mk(flash_block_q=16, flash_block_k=8).apply(params, ids)
+    np.testing.assert_allclose(np.asarray(tuned), np.asarray(default),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="not divisible"):
+        mk(flash_block_k=24).apply(params, ids)

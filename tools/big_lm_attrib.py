@@ -43,13 +43,16 @@ def build(n_layers=None, d_ff=None):
         Transformer, TransformerConfig,
     )
 
+    # mirrors the COMMITTED flagship (bench.py big_lm make_model): no
+    # remat, unrolled layers, fused ce_chunk=256 — the round-4 sweep
+    # winner the attribution must explain (BIGLM_SWEEP b8_none_unroll_*)
     c = bench._BIG
     return Transformer(TransformerConfig(
         vocab_size=c["vocab"], max_seq_len=c["seq"],
         n_layers=n_layers or c["n_layers"], d_model=c["d_model"],
         n_heads=c["n_heads"], d_ff=d_ff or c["d_ff"],
-        compute_dtype=jnp.bfloat16, attention="flash", scan_layers=True,
-        remat=False, remat_policy="dots"))
+        compute_dtype=jnp.bfloat16, attention="flash", scan_layers=False,
+        remat=False, remat_policy="dots", ce_chunk=256))
 
 
 def timed(fn, *args, n1=10, n2=30):
@@ -109,8 +112,15 @@ def main() -> int:
             row = {"label": label,
                    "error": f"{type(e).__name__}: {e}"[:400]}
         row["elapsed_s"] = round(time.perf_counter() - t0, 1)
+        if "error" not in row:
+            row["platform"] = info.get("platform")
+            row["device_kind"] = info.get("device_kind")
         print(f"[big_lm_attrib] {json.dumps(row)}", flush=True)
         rows.append(row)
+        # flush after EVERY variant: the first run of this tool lost all
+        # five measurements to a watchdog timeout because it wrote only at
+        # the end — each chip-minute is too scarce for that
+        flush(rows)
 
     def full_step(model):
         state = dp.replicate_state(TrainState.create(model, opt,
@@ -197,12 +207,20 @@ def main() -> int:
     record("no_update", var_no_update)
     record("dff_half", var_dff_half)
 
-    # merge with prior windows FIRST (bench.merge_artifact_rows: errors
-    # never clobber prior chip data), then derive from the merged view so
-    # a partially-failed re-run keeps the prior window's derived metrics
-    merged = bench.merge_artifact_rows(ARTIFACT, rows)
+    derived = flush(rows)
+    print(json.dumps({"attrib_artifact": "BIGLM_ATTRIB.json",
+                      "derived": derived}))
+    return 0
 
-    # ---- derived attribution (only from rows that succeeded) ----
+
+def flush(rows) -> dict:
+    """Merge ``rows`` with prior windows (bench.merge_artifact_rows:
+    errors never clobber prior chip data), re-derive the attribution from
+    the merged view, and write the artifact.  Called after every variant
+    so a watchdog timeout costs at most the in-flight measurement."""
+    import time as _t
+
+    merged = bench.merge_artifact_rows(ARTIFACT, rows)
     by = {r["label"]: r for r in merged}
     derived = {}
     if "step_ms" in by.get("full", {}) and "step_ms" in by.get("layers6", {}):
@@ -221,17 +239,12 @@ def main() -> int:
     if "step_ms" in by.get("full", {}) and "step_ms" in by.get("dff_half", {}):
         derived["dff_half_delta_ms"] = round(
             by["full"]["step_ms"] - by["dff_half"]["step_ms"], 2)
-
     doc = {"results": merged, "derived": derived,
-           "device_kind": info.get("device_kind"),
-           "captured_unix": round(time.time(), 1),
-           "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                         time.gmtime())}
+           "captured_unix": round(_t.time(), 1),
+           "captured_iso": _t.strftime("%Y-%m-%dT%H:%M:%SZ", _t.gmtime())}
     with open(ARTIFACT, "w") as f:
         json.dump(doc, f, indent=2)
-    print(json.dumps({"attrib_artifact": "BIGLM_ATTRIB.json",
-                      "derived": derived}))
-    return 0
+    return derived
 
 
 if __name__ == "__main__":

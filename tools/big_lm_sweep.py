@@ -56,17 +56,35 @@ import bench  # noqa: E402  (importable by design; main() is guarded)
 # (INTERNAL, not OOM — retried below).  Round-3 variants probe the next
 # suspect: lax.scan over layers serializes XLA's scheduler at every
 # layer boundary, so unrolled (scan_layers=False) may overlap better.
+# Round-4 variants attack the head geometry: n_heads only changes the
+# head RESHAPE of the same (d, 3d)/(d, d) projections — zero parameter
+# or FLOP delta — but head_dim 64 (h16) leaves half of every (8, 128)
+# vector lane empty in the flash kernel's q/k/v tiles and runs the MXU
+# score/value matmuls at K=64; head_dim 128 (h8) is exactly one lane
+# tile, head_dim 256 (h4) two.  The last tuple slot overrides bench._BIG
+# keys for the variant (recorded in the row's `config`, so the sweep's
+# `best` gate keeps shape-mismatched rows from waiving the committed
+# config's preflight until bench._BIG itself is flipped to the winner).
+# Round-4b stacks the head-geometry lever on the measured round-4a
+# winner (no remat, UNROLLED layers, fused ce_chunk=256 — MFU 0.3778 at
+# h16): every variant below keeps that base.  The dense retry gets a
+# fresh label because the two prior 500s were at scan=True shapes.
 VARIANTS = [
-    ("b8_none_unroll", 8, False, "dots", "flash", 0, False),
-    ("b8_none_unroll_ce256", 8, False, "dots", "flash", 256, False),
-    ("b8_none_dense", 8, False, "dots", "dense", 0, True),   # retry (500)
-    ("b16_none_ce256", 16, False, "dots", "flash", 256, True),  # retry (500)
-    ("b4_none", 4, False, "dots", "flash", 0, True),  # batch-curve low end
+    ("b8_unroll_ce256_h8", 8, False, "dots", "flash", 256, False,
+     {"n_heads": 8}),
+    ("b8_unroll_ce256_h4", 8, False, "dots", "flash", 256, False,
+     {"n_heads": 4}),
+    ("b8_unroll_ce256_h8_bk256", 8, False, "dots", "flash", 256, False,
+     {"n_heads": 8, "flash_block_k": 256}),
+    ("b8_unroll_ce256_bk512", 8, False, "dots", "flash", 256, False,
+     {"flash_block_k": 512}),
+    ("b8_unroll_ce256_h8_dense", 8, False, "dots", "dense", 256, False,
+     {"n_heads": 8}),
 ]
 
 
 def run_variant(label, batch, remat, policy, attention, ce_chunk=0,
-                scan_layers=True):
+                scan_layers=True, overrides=None):
     import jax
     import jax.numpy as jnp
 
@@ -85,7 +103,11 @@ def run_variant(label, batch, remat, policy, attention, ce_chunk=0,
     )
     from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
-    c = bench._BIG
+    c = {**bench._BIG, **(overrides or {})}
+    # override keys that are not bench._BIG shape knobs pass straight
+    # through as TransformerConfig kwargs (e.g. flash_block_q/block_k)
+    extra = {k: v for k, v in (overrides or {}).items()
+             if k not in bench._BIG}
     devices = jax.devices()
     on_tpu = devices[0].platform not in ("cpu",)
     model = Transformer(TransformerConfig(
@@ -93,7 +115,7 @@ def run_variant(label, batch, remat, policy, attention, ce_chunk=0,
         d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
         compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         attention=attention, scan_layers=scan_layers, remat=remat,
-        remat_policy=policy, ce_chunk=ce_chunk))
+        remat_policy=policy, ce_chunk=ce_chunk, **extra))
     mesh = mesh_lib.make_mesh(MeshConfig(data=len(devices)),
                               devices=devices)
     opt = optim.sgd(lr=1e-4, momentum=0.9)
@@ -123,8 +145,14 @@ def run_variant(label, batch, remat, policy, attention, ce_chunk=0,
         "scan_layers": scan_layers,
         # the model shapes this row was measured at — bench.preflight's
         # chip_validated gate refuses rows whose shapes no longer match
-        # the committed config (a stale row must not waive the HBM gate)
-        "config": dict(c),
+        # the committed config (a stale row must not waive the HBM gate).
+        # SHAPE keys only: non-shape overrides (kernel tile knobs) ride
+        # separately in tf_overrides, which the gate ALSO matches against
+        # the committed TransformerConfig — so a bk512 row can first win
+        # `best` at the committed shapes and then chip-validate the
+        # committed config once flash_block_k=512 is flipped in bench.py
+        "config": {k: c[k] for k in bench._BIG},
+        "tf_overrides": extra,
         "step_ms": round(step_ms, 2),
         "samples_per_sec": round(batch / step_ms * 1e3, 1),
         "mfu": None if mfu is None else round(mfu, 4),

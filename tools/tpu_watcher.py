@@ -75,8 +75,17 @@ PRIORITY = [
     ("biglm_sweep_r3", [sys.executable, "tools/big_lm_sweep.py"], 2100),
     ("big_lm_none", [sys.executable, "bench.py", "--config", "big_lm"],
      2100),
+    # round-4b (after the 03:1x window surfaced the unrolled winner):
+    # head-geometry sweep stacked on no-remat+unroll+ce256 (n_heads is a
+    # pure reshape — head_dim 64 half-fills the (8,128) lanes), then the
+    # canonical capture of the re-committed config (scan_layers=False,
+    # ce_chunk=256 — BIGLM_SWEEP b8_none_unroll_ce256, MFU 0.378)
+    ("biglm_sweep_r4", [sys.executable, "tools/big_lm_sweep.py"], 2400),
+    ("big_lm_unroll", [sys.executable, "bench.py", "--config", "big_lm"],
+     2100),
     # where do big_lm's 163 ms go? ablation differencing (layers/fwd/
     # update/ffn) -> BIGLM_ATTRIB.json guides the next MFU push
+    # (now flushes per-variant, so a mid-run tunnel wedge keeps rows)
     ("biglm_attrib", [sys.executable, "tools/big_lm_attrib.py"], 2100),
 ]
 
