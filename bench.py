@@ -190,9 +190,11 @@ def _make_config(name):
             # 12 blocks serialized XLA's scheduler at every layer
             # boundary, and with the layers unrolled the fused chunked CE
             # is a further win (166.4 -> 138.5) instead of neutral.
-            # Compile time rises (one traced block -> 12) but stays
-            # single-digit seconds on the chip; scan_layers=True keeps
-            # its coverage in tests/test_scan_layers.py and the SP path.
+            # Compile time rises (one traced block -> 12): 35-36 s
+            # measured on the chip (BIGLM_SWEEP b8_none_unroll* rows) vs
+            # 5-9 s scanned — size watchdog timeouts accordingly.
+            # scan_layers=True keeps its coverage in
+            # tests/test_scan_layers.py and the SP path.
             return Transformer(TransformerConfig(
                 vocab_size=c["vocab"], max_seq_len=c["seq"],
                 n_layers=c["n_layers"], d_model=c["d_model"],
@@ -1192,6 +1194,20 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
                "n_devices": n_dev}
     jitted = jax.jit(lambda pr: generate(model, params, pr, new_tokens))
     results["dense_tokens_per_sec"] = time_decode(jitted, 4)
+    # weights-only int8 PTQ (ops.quant): same decode program, kernels
+    # stored int8 + per-out-channel scales — the decode loop is HBM-bound
+    # streaming the weights once per token, so on-chip this row should
+    # approach 2x dense-bf16; on the CPU fallback it is a mechanism check
+    # (numerics parity is pinned by tests/test_quant.py)
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params, quantized_bytes,
+    )
+
+    qparams = quantize_params(params)
+    jitted_q = jax.jit(lambda pr: generate(model, qparams, pr, new_tokens))
+    results["dense_int8_tokens_per_sec"] = time_decode(jitted_q, 4)
+    results["int8_param_bytes"] = quantized_bytes(qparams)
+    results["full_param_bytes"] = quantized_bytes(params)
     if n_dev >= 2:
         from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
             replicated_sharding,

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Weights-only int8 serving (ops.quant): train a tiny byte-LM, checkpoint
+# it, then decode the SAME checkpoint twice — full precision and with
+# --quantize int8 (dense kernels stored int8 + one f32 scale per output
+# channel; the matmul stays bf16 on the MXU with the scale folded into
+# the output tile).  Autoregressive decode is bandwidth-bound streaming
+# the weights once per token, so int8 halves the HBM bytes per token on
+# chip; numerics parity is pinned by tests/test_quant.py.  The reference
+# has no inference path at all (its eval blocks are dead code,
+# dataParallelTraining_NN_MPI.py:213-236) — this is a TPU-serving
+# extension.
+set -euo pipefail
+CKPT="$(mktemp -d)"
+trap 'rm -rf "$CKPT"' EXIT
+
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --seq_len 32 --checkpoint_dir "$CKPT"
+
+echo "--- full-precision decode"
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-1}" \
+    --dataset lm --seq_len 32 --checkpoint_dir "$CKPT" \
+    --generate "10,20,30" --max_new_tokens 8
+
+echo "--- int8 weights-only decode (same checkpoint; --quantize_skip head
+---     keeps the logit projection exact)"
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-1}" \
+    --dataset lm --seq_len 32 --checkpoint_dir "$CKPT" \
+    --generate "10,20,30" --max_new_tokens 8 \
+    --quantize int8 --quantize_skip head

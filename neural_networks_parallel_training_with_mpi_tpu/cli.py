@@ -143,6 +143,15 @@ def _generate(args) -> int:
     else:
         log("note: no --checkpoint_dir; generating from a fresh init")
         params = model.init(prng.init_key(cfg.seed))
+    if getattr(args, "quantize", "none") == "int8":
+        from .ops.quant import quantize_params, quantized_bytes
+
+        skip = tuple(s for s in (args.quantize_skip or "").split(",") if s)
+        full_b = quantized_bytes(params)
+        params = quantize_params(params, skip=skip)
+        log(f"int8 weights-only PTQ: param bytes {full_b/2**20:.1f} -> "
+            f"{quantized_bytes(params)/2**20:.1f} MiB"
+            + (f" (kept {','.join(skip)} full-precision)" if skip else ""))
     prompt = jnp.asarray([ids], jnp.int32)
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,

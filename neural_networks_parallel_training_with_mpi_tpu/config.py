@@ -305,6 +305,13 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="0 = greedy; >0 = sampled")
     p.add_argument("--top_k", type=int, default=0)
     p.add_argument("--top_p", type=float, default=1.0)
+    p.add_argument("--quantize", choices=["none", "int8"], default="none",
+                   help="weights-only PTQ for decode (ops.quant): int8 "
+                        "kernels + per-output-channel f32 scales halve "
+                        "the HBM bytes streamed per generated token")
+    p.add_argument("--quantize_skip", type=str, default="",
+                   help="comma-separated param-tree names kept in full "
+                        "precision under --quantize (e.g. 'head')")
     p.add_argument("--grad_reduction", choices=["global_mean", "per_shard_mean"],
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)

@@ -1,0 +1,133 @@
+"""Weights-only int8 post-training quantization for inference.
+
+The reference has no inference path at all (its validation/test blocks are
+dead code, dataParallelTraining_NN_MPI.py:213-236); this module is a
+TPU-first extension to the serving side of the framework.  The motivation
+is bandwidth, not arithmetic: autoregressive decode at batch sizes below
+the MXU's arithmetic-intensity knee is bound by streaming the weight
+matrices from HBM once per token, so storing ``W`` as int8 (+ one f32
+scale per output channel) halves the bytes per token versus bf16 and
+~quarters them versus f32 — a direct tokens/sec lever on v5e's ~819 GB/s
+HBM.  The matmul itself stays bf16 on the MXU: ``x @ W_q`` with the int8
+weights cast in-register, then the per-output-channel scale folded into
+the product.  Per-OUTPUT-channel symmetric scales are chosen exactly
+because they commute through the contraction::
+
+    (x @ (W_q * s))[..., o] == (x @ W_q)[..., o] * s[o]
+
+so dequantization is one fused multiply on the (small) output tile, never
+a materialized f32 copy of the weights.
+
+Training is deliberately out of scope (straight-through estimators etc.
+belong to QAT, not PTQ): :func:`quantize_params` is applied to a trained
+(or restored) parameter pytree, and ``models.core.Linear.apply`` consumes
+the quantized form transparently — any leaf dict carrying ``w_scale``
+multiplies it back in after the matmul, so every decode path built on the
+shared modules (models.generate's KV-cache loop, generate_sharded's GSPMD
+program) picks it up with zero per-path wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+# parameter-dict keys that mark a quantizable dense kernel: Linear stores
+# {"w": (in, out)[, "b": (out,)]} (models/core.py); LayerNorm stores
+# {"scale", "bias"} and Embedding {"table"}, neither of which matches.
+_KERNEL_KEY = "w"
+_SCALE_KEY = "w_scale"
+
+# Subtrees that look like Linear params but are consumed RAW by their
+# module (no Linear.apply, so a w_scale would be silently dropped), or
+# whose numerics are too routing-critical to round: the MoE router gate
+# ({"w": (d, E)}, models/moe.py::_route does its own f32 matmul).  It is
+# O(d*E) — no bandwidth to win — so skipping costs nothing.
+_NEVER_QUANTIZE = ("gate",)
+
+
+def quantize_array(w: jax.Array, axis: int = -2
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-output-channel int8 quantization of a dense kernel.
+
+    ``axis`` is the contraction (input-feature) axis that the scale must
+    NOT span — the default -2 matches Linear's ``(in, out)`` layout and,
+    unchanged, the scan-stacked ``(n_layers, in, out)`` layout (the layer
+    axis keeps per-layer scales, which slice correctly inside the scan).
+
+    Returns ``(q, scale)`` with ``q`` int8 in [-127, 127] (symmetric:
+    -128 unused so negation is exact) and ``scale`` f32 shaped like ``w``
+    with ``axis`` removed; ``q * scale[..., None-at-axis]`` reconstructs
+    ``w`` to within ``scale/2`` per element.
+    """
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32)
+                           / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_array(q: jax.Array, scale: jax.Array,
+                     axis: int = -2) -> jax.Array:
+    """Inverse of :func:`quantize_array` (f32)."""
+    return q.astype(jnp.float32) * jnp.expand_dims(scale, axis)
+
+
+def _is_linear_params(node: Dict) -> bool:
+    w = node.get(_KERNEL_KEY)
+    # ndim 2 = plain Linear (in, out); ndim 3 = scan-stacked blocks
+    # (n_layers, in, out).  Already-quantized dicts are skipped so the
+    # transform is idempotent.
+    return (w is not None and getattr(w, "ndim", 0) in (2, 3)
+            and _SCALE_KEY not in node
+            and jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating))
+
+
+def quantize_params(params: Pytree,
+                    skip: Sequence[str] = ()) -> Pytree:
+    """Walk a model parameter pytree and quantize every dense kernel.
+
+    Every dict node shaped like Linear params (``{"w": ndim-2/3 float
+    array, ...}``) gains ``w_scale`` and an int8 ``w``; biases,
+    LayerNorms, and embedding tables are untouched (they are O(d) —
+    no bandwidth to win — and carry the numerics that int8 hurts most).
+
+    ``skip`` names path components to leave in full precision, e.g.
+    ``("head",)`` to keep the logit projection exact when perplexity
+    parity matters more than the head's (d_model x vocab) bytes.  The
+    MoE router gate is always skipped (``_NEVER_QUANTIZE``): its module
+    consumes ``w`` raw, so a quantized gate would silently drop its
+    scale and saturate the routing softmax.
+    """
+    skip = tuple(skip) + _NEVER_QUANTIZE
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            if path and path[-1] in skip:
+                return node
+            if _is_linear_params(node):
+                q, s = quantize_array(node[_KERNEL_KEY])
+                out = dict(node)
+                out[_KERNEL_KEY] = q
+                out[_SCALE_KEY] = s
+                return out
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v, path) for v in node]
+        if isinstance(node, tuple):
+            return tuple(walk(v, path) for v in node)
+        return node
+
+    return walk(params, ())
+
+
+def quantized_bytes(params: Pytree) -> int:
+    """Total parameter bytes as stored (int8 kernels count 1 byte/elt) —
+    the quantity decode bandwidth actually streams."""
+    return sum(int(l.size) * jnp.asarray(l).dtype.itemsize
+               for l in jax.tree_util.tree_leaves(params))

@@ -217,3 +217,20 @@ def test_example_14_four_axis_mesh_completes():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "done: final loss" in out.stderr + out.stdout
+
+
+def test_example_15_int8_quantized_serving_completes():
+    """Trains, checkpoints, and decodes the same checkpoint full-precision
+    and with --quantize int8 (weights-only PTQ, ops.quant)."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "15_int8_quantized_serving.sh")],
+        capture_output=True, text=True, timeout=600, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    text = out.stderr + out.stdout
+    assert "int8 weights-only PTQ: param bytes" in text
+    # both decodes print prompt + 8 continuation ids
+    id_lines = [l for l in out.stdout.splitlines()
+                if l.count(",") == 10 and l.replace(",", "").isdigit()]
+    assert len(id_lines) >= 2, out.stdout

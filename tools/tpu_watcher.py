@@ -87,6 +87,9 @@ PRIORITY = [
     # update/ffn) -> BIGLM_ATTRIB.json guides the next MFU push
     # (now flushes per-variant, so a mid-run tunnel wedge keeps rows)
     ("biglm_attrib", [sys.executable, "tools/big_lm_attrib.py"], 2100),
+    # int8 weights-only decode (ops.quant, round 4): the decode loop is
+    # HBM-bound, so the chip row should approach 2x dense bf16
+    ("decode_int8", [sys.executable, "bench.py", "--decode"], 1500),
 ]
 
 
