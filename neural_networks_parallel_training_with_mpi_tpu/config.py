@@ -128,6 +128,10 @@ class ModelConfig:
     n_layers: int = 2
     d_model: int = 128
     n_heads: int = 4
+    # 0 = classic multi-head; >0 = grouped-query attention (GQA): that
+    # many K/V heads shared across n_heads query heads — the KV cache
+    # (decode bandwidth/HBM) shrinks by n_heads/n_kv_heads
+    n_kv_heads: int = 0
     d_ff: int = 512
     vocab_size: int = 256
     max_seq_len: int = 512
@@ -361,6 +365,10 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--n_layers", type=int, default=2)
     p.add_argument("--d_model", type=int, default=128)
     p.add_argument("--n_heads", type=int, default=4)
+    p.add_argument("--n_kv_heads", type=int, default=0,
+                   help="grouped-query attention: K/V heads shared "
+                        "across the query heads (0 = multi-head); the "
+                        "KV cache shrinks by n_heads/n_kv_heads")
     p.add_argument("--d_ff", type=int, default=512)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--text_file", default="",
@@ -483,7 +491,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                             remat_policy=args.remat_policy,
                             scan_layers=args.scan_layers,
                             n_layers=args.n_layers, d_model=args.d_model,
-                            n_heads=args.n_heads, d_ff=args.d_ff,
+                            n_heads=args.n_heads,
+                            n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
                             vocab_size=args.vocab_size,
                             ce_chunk=args.ce_chunk,
                             max_seq_len=max(args.seq_len, 512))

@@ -34,13 +34,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from .core import ACTIVATIONS
-from .transformer import Transformer
+from .transformer import Transformer, split_qkv
 
 
 def init_kv_cache(model: Transformer, batch: int, max_len: int):
-    """Per-layer (k, v) buffers, (B, max_len, n_heads, head_dim)."""
+    """Per-layer (k, v) buffers, (B, max_len, kv_heads, head_dim).
+
+    Under GQA (cfg.n_kv_heads < n_heads) the cache stores the
+    UN-repeated K/V heads — kv_heads/n_heads of the MHA bytes, which is
+    the whole point: decode streams the cache every step, so grouped
+    heads cut the long-context serving bandwidth (and HBM residency) by
+    the group factor."""
     c = model.cfg
-    shape = (batch, max_len, c.n_heads, c.head_dim)
+    shape = (batch, max_len, c.kv_heads, c.head_dim)
     zeros = lambda: jnp.zeros(shape, c.compute_dtype)
     return [{"k": zeros(), "v": zeros()} for _ in range(c.n_layers)]
 
@@ -55,24 +61,36 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     h = mods["ln1"].apply(params["ln1"], x)
     qkv = mods["qkv"].apply(params["qkv"], h)
     b, s, _ = qkv.shape
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    shape = (b, s, c.n_heads, c.head_dim)
-    q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+    q, k, v = split_qkv(c, qkv)      # q: (b,s,H,hd); k/v: (b,s,KV,hd)
     new_k = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
                                      (0, pos, 0, 0))
     new_v = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
                                      (0, pos, 0, 0))
     scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        new_k.astype(jnp.float32)) * scale
     T = cache["k"].shape[1]
     # causal within the chunk: key position <= pos + query offset
     mask = (jnp.arange(T)[None, None, None, :]
             <= pos + jnp.arange(s)[None, None, :, None])
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                     new_v.astype(jnp.float32)).astype(x.dtype)
+    if c.kv_heads == c.n_heads:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            new_k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         new_v.astype(jnp.float32)).astype(x.dtype)
+    else:
+        # GQA: attend with the cache's grouped heads directly — the
+        # repeat stays virtual (an einsum batch dim), so each decode
+        # step streams only kv_heads/n_heads of the MHA cache bytes
+        g = c.n_heads // c.kv_heads
+        q5 = q.reshape(b, s, c.kv_heads, g, c.head_dim)
+        logits = jnp.einsum("bqcgd,bkcd->bcgqk", q5.astype(jnp.float32),
+                            new_k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
+                         new_v.astype(jnp.float32)).astype(x.dtype)
+        out = out.reshape(b, s, c.n_heads, c.head_dim)
     out = out.reshape(b, s, c.d_model)
     x = x + mods["attn_out"].apply(params["attn_out"], out)
     h = mods["ln2"].apply(params["ln2"], x)

@@ -176,9 +176,16 @@ class MoEFFN(Module):
             f, g = make_megatron_ops(self.tensor_axis)
             slots = f(slots)
         h = jnp.einsum("esd,edf->esf", slots.astype(cdt),
-                       ep["w_in"].astype(cdt)) + ep["b_in"][:, None, :].astype(cdt)
+                       ep["w_in"].astype(cdt))
+        if "w_in_scale" in ep:
+            # weights-only int8 experts (ops.quant): per-(expert, column)
+            # scale folded into the einsum output BEFORE bias/activation
+            h = h * ep["w_in_scale"][:, None, :].astype(cdt)
+        h = h + ep["b_in"][:, None, :].astype(cdt)
         h = ACTIVATIONS[self.activation](h)
         out = jnp.einsum("esf,efd->esd", h, ep["w_out"].astype(cdt))
+        if "w_out_scale" in ep:
+            out = out * ep["w_out_scale"][:, None, :].astype(cdt)
         if self.tensor_axis is not None:
             out = g(out)
         return out + ep["b_out"][:, None, :].astype(cdt)

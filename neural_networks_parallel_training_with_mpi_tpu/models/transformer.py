@@ -23,6 +23,29 @@ from ..parallel.sequence import sequence_sharded_attention
 from .core import Embedding, LayerNorm, Linear, Module, ACTIVATIONS
 
 
+def split_qkv(c: "TransformerConfig", qkv: jax.Array):
+    """Split a fused qkv projection (B, T, qkv_dim) into per-head
+    q (B, T, n_heads, hd) and k/v (B, T, kv_heads, hd) — the single
+    definition shared by the training block and the KV-cache decode path
+    so the GQA column layout [q | k | v] cannot drift between them."""
+    b, t, _ = qkv.shape
+    kvw = c.kv_heads * c.head_dim
+    q = qkv[..., :c.d_model].reshape(b, t, c.n_heads, c.head_dim)
+    k = qkv[..., c.d_model:c.d_model + kvw].reshape(b, t, c.kv_heads,
+                                                    c.head_dim)
+    v = qkv[..., c.d_model + kvw:].reshape(b, t, c.kv_heads, c.head_dim)
+    return q, k, v
+
+
+def repeat_kv(c: "TransformerConfig", kv: jax.Array) -> jax.Array:
+    """Broadcast grouped K/V heads (B, T, kv_heads, hd) to full query
+    heads (B, T, n_heads, hd); identity for classic multi-head."""
+    groups = c.n_heads // c.kv_heads
+    if groups == 1:
+        return kv
+    return jnp.repeat(kv, groups, axis=2)
+
+
 @dataclass(frozen=True)
 class TransformerConfig:
     vocab_size: int = 256
@@ -36,6 +59,17 @@ class TransformerConfig:
     compute_dtype: Any = jnp.float32   # set bfloat16 for TPU throughput
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
+    # Grouped-query attention (GQA, Ainslie et al. 2023): n_kv_heads < n_heads
+    # shares each K/V head across n_heads/n_kv_heads query heads.  None =
+    # classic multi-head (n_kv_heads == n_heads), keeping the default
+    # param treedef byte-identical to pre-GQA checkpoints.  The win is
+    # the KV cache: decode streams (and stores) n_kv_heads/n_heads of
+    # the MHA cache bytes — the long-context serving bottleneck — while
+    # training repeats K/V to full heads before the attention impls
+    # (same math, unchanged kernels).  Not wired into the Megatron-TP
+    # paths (the head-aligned qkv permutation assumes equal q/k/v
+    # thirds); those raise with a clear error.
+    n_kv_heads: Optional[int] = None
     # Pallas flash-kernel tile sizes (flash / ring_flash / striped_flash
     # only; dense and the non-flash ring ignore them).  128 x 128 is the
     # v5e-safe default — block_k is the MXU contraction tile for the
@@ -83,6 +117,20 @@ class TransformerConfig:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        """Effective K/V head count (== n_heads unless GQA)."""
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        assert self.n_heads % kv == 0, (
+            f"n_heads={self.n_heads} not divisible by n_kv_heads={kv}")
+        return kv
+
+    @property
+    def qkv_dim(self) -> int:
+        """Fused qkv projection width: d (q) + 2 * kv_heads * head_dim
+        (k, v) — reduces to 3 * d_model for classic multi-head."""
+        return self.d_model + 2 * self.kv_heads * self.head_dim
+
 
 @dataclass(frozen=True)
 class Transformer(Module):
@@ -93,7 +141,7 @@ class Transformer(Module):
         c = self.cfg
         mods = {
             "ln1": LayerNorm(c.d_model, param_dtype=c.param_dtype),
-            "qkv": Linear(c.d_model, 3 * c.d_model, param_dtype=c.param_dtype,
+            "qkv": Linear(c.d_model, c.qkv_dim, param_dtype=c.param_dtype,
                           compute_dtype=c.compute_dtype),
             "attn_out": Linear(c.d_model, c.d_model, param_dtype=c.param_dtype,
                                compute_dtype=c.compute_dtype),
@@ -148,14 +196,17 @@ class Transformer(Module):
         mods = self._block_modules()
         h = mods["ln1"].apply(params["ln1"], x)
         qkv = mods["qkv"].apply(params["qkv"], h)
-        b, t, _ = qkv.shape
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, t, c.n_heads, c.head_dim)
+        q, k, v = split_qkv(c, qkv)
+        # GQA training path: repeat K/V to full query heads so every
+        # attention impl (dense/flash/ring/...) sees plain MHA — same
+        # math as grouped attention; the bandwidth win is decode-side
+        # (models.generate caches the UN-repeated kv_heads)
+        k, v = repeat_kv(c, k), repeat_kv(c, v)
         out = sequence_sharded_attention(
-            c.attention, q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            c.attention, q, k, v,
             axis=c.seq_axis, causal=True, block_q=c.flash_block_q,
             block_k=c.flash_block_k)
-        out = out.reshape(b, t, c.d_model)
+        out = out.reshape(*out.shape[:2], c.d_model)
         x = x + mods["attn_out"].apply(params["attn_out"], out)
         h = mods["ln2"].apply(params["ln2"], x)
         if c.moe_experts > 0:
@@ -214,7 +265,7 @@ class Transformer(Module):
         c = self.cfg
         b, t = x_shape
         d, ff, v = c.d_model, c.d_ff, c.vocab_size
-        per_layer = 2.0 * b * t * d * (3 * d)   # qkv projection
+        per_layer = 2.0 * b * t * d * c.qkv_dim  # qkv projection (GQA-aware)
         per_layer += 2.0 * b * t * d * d        # attention out projection
         per_layer += 2.0 * (2.0 * b * t * t * d)  # scores + values
         ffn = 2.0 * (2.0 * b * t * d * ff)      # FFN in + out per expert

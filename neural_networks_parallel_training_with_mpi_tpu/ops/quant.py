@@ -88,6 +88,21 @@ def _is_linear_params(node: Dict) -> bool:
             and jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating))
 
 
+def _is_expert_params(node: Dict) -> bool:
+    # MoEFFN expert kernels {"w_in": (E, d, f), "w_out": (E, f, d), ...}
+    # (models/moe.py) — the bulk of an MoE model's parameter bytes, so
+    # skipping them would forfeit most of the decode bandwidth win.
+    # quantize_array's default contraction axis (-2) gives the needed
+    # per-(expert, out-column) scales; models/moe.py::_experts_ffn folds
+    # them back in after each einsum.
+    w_in, w_out = node.get("w_in"), node.get("w_out")
+    return (w_in is not None and w_out is not None
+            and getattr(w_in, "ndim", 0) == 3
+            and getattr(w_out, "ndim", 0) == 3
+            and "w_in_scale" not in node
+            and jnp.issubdtype(jnp.asarray(w_in).dtype, jnp.floating))
+
+
 def quantize_params(params: Pytree,
                     skip: Sequence[str] = ()) -> Pytree:
     """Walk a model parameter pytree and quantize every dense kernel.
@@ -115,6 +130,13 @@ def quantize_params(params: Pytree,
                 out = dict(node)
                 out[_KERNEL_KEY] = q
                 out[_SCALE_KEY] = s
+                return out
+            if _is_expert_params(node):
+                out = dict(node)
+                for key in ("w_in", "w_out"):
+                    q, s = quantize_array(node[key])
+                    out[key] = q
+                    out[key + "_scale"] = s
                 return out
             return {k: walk(v, path + (k,)) for k, v in node.items()}
         if isinstance(node, list):

@@ -105,6 +105,14 @@ def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
 
 
 def validate_tp(cfg, tp: int) -> None:
+    if getattr(cfg, "n_kv_heads", None) not in (None, cfg.n_heads):
+        raise NotImplementedError(
+            f"GQA (n_kv_heads={cfg.n_kv_heads} < n_heads={cfg.n_heads}) is "
+            "not wired into the Megatron tensor-parallel paths: the "
+            "head-aligned qkv column permutation and the per-rank local-"
+            "head split both assume equal q/k/v thirds.  Use GQA on the "
+            "DP / seq-parallel / pipeline(dense-stage) layouts, or "
+            "n_kv_heads=n_heads under TP")
     for name, dim in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
                       ("d_ff", cfg.d_ff)):
         if dim % tp:

@@ -1,0 +1,205 @@
+"""Grouped-query attention (TransformerConfig.n_kv_heads — Ainslie et
+al. 2023).  GQA is mathematically MHA with each K/V head tiled across a
+group of query heads, so the load-bearing test is EXACT equivalence: a
+GQA model must produce the same logits as the MHA twin whose fused-qkv
+K/V columns are tiled group-wise.  The serving win — the KV cache
+holding kv_heads instead of n_heads — is pinned on the decode path, and
+the deliberately-unwired Megatron-TP composition must refuse loudly
+(the head-aligned qkv permutation assumes equal q/k/v thirds)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+    generate, init_kv_cache,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig, repeat_kv, split_qkv,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+D, H, KV, HD, VOCAB, T = 32, 4, 2, 8, 64, 16
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=32, n_layers=2, d_model=D,
+                n_heads=H, d_ff=64)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _tile_qkv_params(gqa_params, c_gqa):
+    """Tile a GQA param tree's fused-qkv K/V columns group-wise into the
+    MHA layout (d, 3d) — the exact-equivalence construction."""
+    g = c_gqa.n_heads // c_gqa.kv_heads
+    kvw = c_gqa.kv_heads * c_gqa.head_dim
+
+    def tile_w(w):                      # (d_in, qkv_dim) -> (d_in, 3d)
+        d_in = w.shape[0]
+        qw = w[:, :c_gqa.d_model]
+        kw = w[:, c_gqa.d_model:c_gqa.d_model + kvw]
+        vw = w[:, c_gqa.d_model + kvw:]
+        t = lambda x: jnp.repeat(
+            x.reshape(d_in, c_gqa.kv_heads, c_gqa.head_dim), g,
+            axis=1).reshape(d_in, c_gqa.n_heads * c_gqa.head_dim)
+        return jnp.concatenate([qw, t(kw), t(vw)], axis=1)
+
+    def tile_b(b):                      # (qkv_dim,) -> (3d,)
+        qb = b[:c_gqa.d_model]
+        kb = b[c_gqa.d_model:c_gqa.d_model + kvw]
+        vb = b[c_gqa.d_model + kvw:]
+        t = lambda x: jnp.repeat(
+            x.reshape(c_gqa.kv_heads, c_gqa.head_dim), g,
+            axis=0).reshape(-1)
+        return jnp.concatenate([qb, t(kb), t(vb)])
+
+    out = jax.tree_util.tree_map(lambda x: x, gqa_params)  # deep copy
+    for blk in out["blocks"]:
+        blk["qkv"] = {"w": tile_w(blk["qkv"]["w"]),
+                      "b": tile_b(blk["qkv"]["b"])}
+    return out
+
+
+def test_param_shapes_and_default_unchanged():
+    gqa = Transformer(_cfg(n_kv_heads=KV)).init(prng.init_key(0))
+    assert gqa["blocks"][0]["qkv"]["w"].shape == (D, D + 2 * KV * HD)
+    mha = Transformer(_cfg()).init(prng.init_key(0))
+    # default (n_kv_heads=None) keeps the pre-GQA treedef byte-identical
+    assert mha["blocks"][0]["qkv"]["w"].shape == (D, 3 * D)
+    with pytest.raises(AssertionError, match="not divisible"):
+        Transformer(_cfg(n_kv_heads=3)).init(prng.init_key(0))
+
+
+def test_split_and_repeat_helpers():
+    c = _cfg(n_kv_heads=KV)
+    qkv = jnp.arange(2 * 4 * c.qkv_dim, dtype=jnp.float32).reshape(
+        2, 4, c.qkv_dim)
+    q, k, v = split_qkv(c, qkv)
+    assert q.shape == (2, 4, H, HD)
+    assert k.shape == v.shape == (2, 4, KV, HD)
+    rk = repeat_kv(c, k)
+    assert rk.shape == (2, 4, H, HD)
+    # group layout: query heads 2g, 2g+1 share kv head g
+    np.testing.assert_array_equal(np.asarray(rk[..., 0, :]),
+                                  np.asarray(rk[..., 1, :]))
+    np.testing.assert_array_equal(np.asarray(rk[..., 0, :]),
+                                  np.asarray(k[..., 0, :]))
+
+
+@pytest.mark.parametrize("attention", ["dense", "flash"])
+def test_gqa_equals_tiled_mha(attention):
+    """The exact-equivalence identity: GQA(params) == MHA(tiled params).
+    Tiling K/V weight columns group-wise commutes with the matmul, so
+    both models compute identical per-head k/v — logits match to f32
+    roundoff."""
+    c_gqa = _cfg(n_kv_heads=KV, attention=attention)
+    model_gqa = Transformer(c_gqa)
+    params = model_gqa.init(prng.init_key(0))
+    model_mha = Transformer(_cfg(attention=attention))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, VOCAB, (2, T)),
+                      jnp.int32)
+    got = model_gqa.apply(params, ids)
+    want = model_mha.apply(_tile_qkv_params(params, c_gqa), ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_kv_cache_shrinks_and_decode_matches_tiled_mha():
+    """init_kv_cache allocates kv_heads (the serving win: half the cache
+    bytes at KV = H/2), and the grouped-einsum decode loop emits exactly
+    the tokens the tiled-MHA twin does (greedy)."""
+    c_gqa = _cfg(n_kv_heads=KV)
+    model_gqa = Transformer(c_gqa)
+    params = model_gqa.init(prng.init_key(0))
+    cache = init_kv_cache(model_gqa, batch=1, max_len=8)
+    assert cache[0]["k"].shape == (1, 8, KV, HD)
+    mha_cache = init_kv_cache(Transformer(_cfg()), batch=1, max_len=8)
+    assert mha_cache[0]["k"].shape == (1, 8, H, HD)
+
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    got = generate(model_gqa, params, prompt, 8)
+    want = generate(Transformer(_cfg()), _tile_qkv_params(params, c_gqa),
+                    prompt, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_gqa_trains_under_dp():
+    """One jitted DP train step on the GQA model: loss finite, grads
+    update every param (the fused qkv's uneven split must backprop)."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    model = Transformer(_cfg(n_kv_heads=KV))
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2),
+                              devices=jax.devices()[:2])
+    opt = optim.sgd(lr=1e-2, momentum=0.0)
+    state = dp.replicate_state(TrainState.create(model, opt,
+                                                 prng.init_key(0)), mesh)
+    step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                              "global_mean")
+    rng = np.random.default_rng(0)
+    batch = shd.shard_batch(mesh, {
+        "x": rng.integers(0, VOCAB, (4, T)).astype(np.int32),
+        "y": rng.integers(0, VOCAB, (4, T)).astype(np.int32),
+        "mask": np.ones((4,), np.float32)})
+    before = jax.device_get(state.params["blocks"][0]["qkv"]["w"])
+    state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+    after = jax.device_get(state.params["blocks"][0]["qkv"]["w"])
+    assert np.abs(after - before).max() > 0  # qkv actually updated
+
+
+def test_gqa_refused_under_megatron_tp():
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+
+    with pytest.raises(NotImplementedError, match="GQA"):
+        megatron.validate_tp(_cfg(n_kv_heads=KV), tp=2)
+    megatron.validate_tp(_cfg(), tp=2)                     # MHA fine
+    megatron.validate_tp(_cfg(n_kv_heads=H), tp=2)         # kv==H fine
+
+
+def test_gqa_composes_with_int8_quant():
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    model = Transformer(_cfg(n_kv_heads=KV))
+    params = model.init(prng.init_key(0))
+    q = quantize_params(params)
+    assert q["blocks"][0]["qkv"]["w"].dtype == jnp.int8
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, VOCAB, (2, T)),
+                      jnp.int32)
+    full = model.apply(params, ids)
+    quant = model.apply(q, ids)
+    assert np.asarray(jnp.abs(quant - full)).max() < 0.15
+    out = generate(model, q, jnp.asarray([[1, 2, 3]], jnp.int32), 4)
+    assert out.shape == (1, 7)
+
+
+def test_cli_n_kv_heads_flag():
+    """--n_kv_heads reaches TransformerConfig via ModelConfig/registry."""
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        build_argparser, config_from_args,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.models.registry import (
+        build_model,
+    )
+
+    args = build_argparser().parse_args(
+        ["--dataset", "lm", "--n_heads", "4", "--n_kv_heads", "2"])
+    model = build_model(config_from_args(args).model)
+    assert model.cfg.kv_heads == 2
+    args0 = build_argparser().parse_args(["--dataset", "lm"])
+    assert build_model(config_from_args(args0).model).cfg.kv_heads == 4

@@ -144,19 +144,28 @@ def test_cli_generate_quantized(tmp_path, capsys):
     assert len(toks) == 3 + 4
 
 
-def test_moe_gate_never_quantized():
+def test_moe_quantization():
     """The MoE router gate's matmul consumes w RAW (models/moe.py::_route
     — no Linear.apply, a w_scale would be silently dropped), so the walk
-    must leave it full-precision; expert FFN kernels (w_in/w_out) don't
-    match the Linear shape and stay full-precision too.  Quantized-model
-    logits must stay within the dense-model parity bound."""
+    must leave it full-precision.  The expert FFN kernels — the bulk of
+    an MoE model's parameter bytes — DO quantize, with per-(expert,
+    column) scales folded back in by _experts_ffn; routing decisions stay
+    exact, so quantized-model logits must stay within the dense-model
+    parity bound and the transform stays idempotent."""
     model = _tiny_lm(moe_experts=4, moe_top_k=1)
     params = model.init(prng.init_key(0))
     q = quantize_params(params)
     blk = q["blocks"][0]
-    assert blk["moe"]["gate"]["w"].dtype == jnp.float32
-    assert blk["moe"]["experts"]["w_in"].dtype == jnp.float32
+    assert blk["moe"]["gate"]["w"].dtype == jnp.float32  # routing exact
+    assert blk["moe"]["experts"]["w_in"].dtype == jnp.int8
+    assert blk["moe"]["experts"]["w_out"].dtype == jnp.int8
+    assert blk["moe"]["experts"]["w_in_scale"].shape == (4, 64)  # (E, f)
+    assert blk["moe"]["experts"]["w_out_scale"].shape == (4, 32)  # (E, d)
+    assert blk["moe"]["experts"]["b_in"].dtype == jnp.float32
     assert blk["qkv"]["w"].dtype == jnp.int8  # attention still quantizes
+    assert quantize_params(q)["blocks"][0]["moe"]["experts"][
+        "w_in"].dtype == jnp.int8  # idempotent
+    assert quantized_bytes(q) < quantized_bytes(params)
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)),
                       jnp.int32)
     full = model.apply(params, ids)
