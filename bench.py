@@ -1208,6 +1208,34 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
     results["dense_int8_tokens_per_sec"] = time_decode(jitted_q, 4)
     results["int8_param_bytes"] = quantized_bytes(qparams)
     results["full_param_bytes"] = quantized_bytes(params)
+    # grouped-query attention (n_kv_heads = heads/4): the KV cache — what
+    # decode re-streams EVERY step, growing with context — shrinks 4x.
+    # A different model (smaller kv projection), so this is a config
+    # comparison at equal d_model/layers, not a same-weights ablation;
+    # the int8 row stacks both serving levers.
+    gq = max(1, c["n_heads"] // 4)
+    model_gqa = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], n_kv_heads=gq,
+        d_ff=c["d_ff"], compute_dtype=cd))
+    params_gqa = model_gqa.init(prng.init_key(0))
+    results["gqa_kv_heads"] = gq
+    results["gqa_tokens_per_sec"] = time_decode(
+        jax.jit(lambda pr: generate(model_gqa, params_gqa, pr,
+                                    new_tokens)), 4)
+    qparams_gqa = quantize_params(params_gqa)
+    results["gqa_int8_tokens_per_sec"] = time_decode(
+        jax.jit(lambda pr: generate(model_gqa, qparams_gqa, pr,
+                                    new_tokens)), 4)
+    # int8 KV cache (generate(kv_quant=True)): the third serving lever —
+    # the cache is what decode RE-streams every step, growing with
+    # context; all three stack in the last row
+    results["dense_kv8_tokens_per_sec"] = time_decode(
+        jax.jit(lambda pr: generate(model, params, pr, new_tokens,
+                                    kv_quant=True)), 4)
+    results["gqa_int8_kv8_tokens_per_sec"] = time_decode(
+        jax.jit(lambda pr: generate(model_gqa, qparams_gqa, pr,
+                                    new_tokens, kv_quant=True)), 4)
     if n_dev >= 2:
         from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
             replicated_sharding,

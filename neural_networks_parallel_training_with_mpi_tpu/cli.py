@@ -156,7 +156,8 @@ def _generate(args) -> int:
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p,
-                   key=jax.random.PRNGKey(cfg.seed))
+                   key=jax.random.PRNGKey(cfg.seed),
+                   kv_quant=getattr(args, "kv_quant", "none") == "int8")
     toks = [int(t) for t in jax.device_get(out)[0]]
     print(",".join(str(t) for t in toks))
     return 0

@@ -313,6 +313,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="weights-only PTQ for decode (ops.quant): int8 "
                         "kernels + per-output-channel f32 scales halve "
                         "the HBM bytes streamed per generated token")
+    p.add_argument("--kv_quant", choices=["none", "int8"], default="none",
+                   help="int8 KV cache for decode: per-(batch, position, "
+                        "head) scales; ~4x fewer cache bytes re-streamed "
+                        "per step vs the f32 cache (long-context lever, "
+                        "stacks with --quantize and --n_kv_heads)")
     p.add_argument("--quantize_skip", type=str, default="",
                    help="comma-separated param-tree names kept in full "
                         "precision under --quantize (e.g. 'head')")

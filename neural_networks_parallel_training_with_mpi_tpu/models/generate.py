@@ -37,18 +37,42 @@ from .core import ACTIVATIONS
 from .transformer import Transformer, split_qkv
 
 
-def init_kv_cache(model: Transformer, batch: int, max_len: int):
+def init_kv_cache(model: Transformer, batch: int, max_len: int,
+                  quant: bool = False):
     """Per-layer (k, v) buffers, (B, max_len, kv_heads, head_dim).
 
     Under GQA (cfg.n_kv_heads < n_heads) the cache stores the
     UN-repeated K/V heads — kv_heads/n_heads of the MHA bytes, which is
     the whole point: decode streams the cache every step, so grouped
     heads cut the long-context serving bandwidth (and HBM residency) by
-    the group factor."""
+    the group factor.
+
+    ``quant=True`` stores K/V as int8 with one f32 scale per (batch,
+    position, head) — the third serving-bandwidth lever (stacks with
+    GQA and int8 weights).  Both scales commute through the attention
+    contractions: the K scale multiplies each key position's logit
+    column, and the V scale folds into the softmax weights before the
+    value einsum, so dequantization never materializes an f32 cache."""
     c = model.cfg
     shape = (batch, max_len, c.kv_heads, c.head_dim)
+    if quant:
+        zeros = lambda: jnp.zeros(shape, jnp.int8)
+        ones = lambda: jnp.ones(shape[:-1], jnp.float32)
+        return [{"k": zeros(), "v": zeros(),
+                 "k_scale": ones(), "v_scale": ones()}
+                for _ in range(c.n_layers)]
     zeros = lambda: jnp.zeros(shape, c.compute_dtype)
     return [{"k": zeros(), "v": zeros()} for _ in range(c.n_layers)]
+
+
+def _quantize_kv(x: jax.Array):
+    """(..., head_dim) -> int8 codes + f32 scale over the trailing dim
+    (symmetric, +/-127; zero rows get scale 1 so 0/1 round-trips)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    codes = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                     -127, 127).astype(jnp.int8)
+    return codes, s.astype(jnp.float32)
 
 
 def _block_chunk(model: Transformer, params, cache, x, pos):
@@ -62,6 +86,12 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     qkv = mods["qkv"].apply(params["qkv"], h)
     b, s, _ = qkv.shape
     q, k, v = split_qkv(c, qkv)      # q: (b,s,H,hd); k/v: (b,s,KV,hd)
+    quant = "k_scale" in cache       # int8 KV cache (init_kv_cache)
+    if quant:
+        k, ks = _quantize_kv(k)
+        v, vs = _quantize_kv(v)
+        new_ks = lax.dynamic_update_slice(cache["k_scale"], ks, (0, pos, 0))
+        new_vs = lax.dynamic_update_slice(cache["v_scale"], vs, (0, pos, 0))
     new_k = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
                                      (0, pos, 0, 0))
     new_v = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
@@ -74,8 +104,16 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     if c.kv_heads == c.n_heads:
         logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                             new_k.astype(jnp.float32)) * scale
+        if quant:
+            # K scale: one multiplier per key position/head on the logit
+            # column — dequantization without an f32 copy of the cache
+            logits = logits * new_ks.transpose(0, 2, 1)[:, :, None, :]
         logits = jnp.where(mask, logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
+        if quant:
+            # V scale folds into the softmax weights (out is linear in
+            # each value row, so p_k * s_k reweights exactly)
+            probs = probs * new_vs.transpose(0, 2, 1)[:, :, None, :]
         out = jnp.einsum("bhqk,bkhd->bqhd", probs,
                          new_v.astype(jnp.float32)).astype(x.dtype)
     else:
@@ -86,8 +124,12 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
         q5 = q.reshape(b, s, c.kv_heads, g, c.head_dim)
         logits = jnp.einsum("bqcgd,bkcd->bcgqk", q5.astype(jnp.float32),
                             new_k.astype(jnp.float32)) * scale
+        if quant:
+            logits = logits * new_ks.transpose(0, 2, 1)[:, :, None, None, :]
         logits = jnp.where(mask[:, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
+        if quant:
+            probs = probs * new_vs.transpose(0, 2, 1)[:, :, None, None, :]
         out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
                          new_v.astype(jnp.float32)).astype(x.dtype)
         out = out.reshape(b, s, c.n_heads, c.head_dim)
@@ -100,7 +142,10 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
         h = mods["ff_in"].apply(params["ff_in"], h)
         h = ACTIVATIONS[c.activation](h)
         ff = mods["ff_out"].apply(params["ff_out"], h)
-    return x + ff.astype(x.dtype), {"k": new_k, "v": new_v}
+    new_cache = {"k": new_k, "v": new_v}
+    if quant:
+        new_cache.update(k_scale=new_ks, v_scale=new_vs)
+    return x + ff.astype(x.dtype), new_cache
 
 
 def _forward_chunk(model: Transformer, params, caches, ids, pos):
@@ -154,7 +199,7 @@ def generate(model: Transformer, params, prompt: jax.Array,
              top_k: int = 0, top_p: float = 1.0,
              key: Optional[jax.Array] = None,
              prompt_lens: Optional[jax.Array] = None,
-             pad_id: int = 0) -> jax.Array:
+             pad_id: int = 0, kv_quant: bool = False) -> jax.Array:
     """Decode ``max_new_tokens`` after ``prompt`` (B, P) -> (B, P + N).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
@@ -166,9 +211,15 @@ def generate(model: Transformer, params, prompt: jax.Array,
     (sequential path — generated tokens, not pads, populate the cache for
     short rows).
 
+    ``kv_quant=True`` stores the KV cache as int8 with per-(batch,
+    position, head) f32 scales (see ``init_kv_cache``) — ~half the cache
+    bytes re-streamed per step vs the bf16-compute cache (~4x vs f32),
+    the long-context serving lever that stacks with GQA and int8
+    weights.  Also accepted by :func:`generate_sharded`.
+
     Wrap in ``jax.jit`` (static: model, max_new_tokens, temperature,
-    top_k, top_p) for repeated use; shapes are static so recompiles only
-    on new (B, P, N).
+    top_k, top_p, kv_quant) for repeated use; shapes are static so
+    recompiles only on new (B, P, N).
     """
     c = model.cfg
     b, p = prompt.shape
@@ -193,7 +244,7 @@ def generate(model: Transformer, params, prompt: jax.Array,
             jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
             for i in range(c.n_layers)
         ]
-    caches = init_kv_cache(model, b, total)
+    caches = init_kv_cache(model, b, total, quant=kv_quant)
     tokens = jnp.concatenate(
         [prompt.astype(jnp.int32),
          jnp.full((b, max_new_tokens), pad_id, jnp.int32)], axis=1)
@@ -230,7 +281,8 @@ def generate(model: Transformer, params, prompt: jax.Array,
 @functools.lru_cache(maxsize=32)
 def _sharded_decode_program(model: Transformer, mesh, max_new_tokens: int,
                             temperature: float, top_k: int, top_p: float,
-                            pad_id: int, batch_axes):
+                            pad_id: int, batch_axes,
+                            kv_quant: bool = False):
     """One jitted decode program per (model, mesh, decode knobs) — cached
     so a serving loop pays compilation once, not per call.  The PRNG key
     and prompt lengths are TRACED arguments (new keys don't recompile)."""
@@ -241,7 +293,8 @@ def _sharded_decode_program(model: Transformer, mesh, max_new_tokens: int,
     def run(params, prompt, lens, key):
         return generate(model, params, prompt, max_new_tokens,
                         temperature=temperature, top_k=top_k, top_p=top_p,
-                        key=key, prompt_lens=lens, pad_id=pad_id)
+                        key=key, prompt_lens=lens, pad_id=pad_id,
+                        kv_quant=kv_quant)
 
     return jax.jit(run, out_shardings=rows), rows
 
@@ -252,7 +305,8 @@ def generate_sharded(model: Transformer, params, prompt, mesh,
                      key: Optional[jax.Array] = None,
                      prompt_lens: Optional[jax.Array] = None,
                      pad_id: int = 0,
-                     batch_axes=("data", "fsdp")) -> jax.Array:
+                     batch_axes=("data", "fsdp"),
+                     kv_quant: bool = False) -> jax.Array:
     """Batch-parallel decode over the mesh's data axes: params replicated,
     prompt rows sharded, one CACHED jitted program — GSPMD partitions the
     KV caches and the sampling with the batch, so serving throughput
@@ -279,7 +333,7 @@ def generate_sharded(model: Transformer, params, prompt, mesh,
                          f"{axes} axes product {n}")
     run, rows = _sharded_decode_program(model, mesh, max_new_tokens,
                                         temperature, top_k, top_p, pad_id,
-                                        axes)
+                                        axes, kv_quant)
     params = jax.device_put(params, replicated_sharding(mesh))
     prompt = jax.device_put(jnp.asarray(prompt, jnp.int32), rows)
     if prompt_lens is not None:
