@@ -24,10 +24,11 @@ python -m neural_networks_parallel_training_with_mpi_tpu \
     --dataset lm --seq_len 32 --checkpoint_dir "$CKPT" \
     --generate "10,20,30" --max_new_tokens 8
 
-echo "--- int8 weights-only decode (same checkpoint; --quantize_skip head
----     keeps the logit projection exact)"
+echo "--- int8 weights + int8 KV cache (same checkpoint; --quantize_skip
+---     head keeps the logit projection exact, --kv_quant int8 stores the
+---     KV cache as int8 with per-position scales)"
 python -m neural_networks_parallel_training_with_mpi_tpu \
     --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-1}" \
     --dataset lm --seq_len 32 --checkpoint_dir "$CKPT" \
     --generate "10,20,30" --max_new_tokens 8 \
-    --quantize int8 --quantize_skip head
+    --quantize int8 --quantize_skip head --kv_quant int8
