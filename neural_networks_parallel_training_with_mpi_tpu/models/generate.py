@@ -79,28 +79,38 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     """One block on a chunk ``x`` (B, S, D) starting at position ``pos``:
     writes the chunk's K/V into the cache and attends causally over
     positions 0..pos+S-1.  S = prompt length at prefill, 1 per decode step.
-    Mirrors Transformer._block for the incremental case."""
+    Mirrors Transformer._block for the incremental case.
+
+    ``pos`` may be a scalar (every row at the same depth — the
+    single-stream generate() path) or a ``(B,)`` vector (each row at its
+    OWN depth — continuous batching, models.serve): the cache write is a
+    vmapped per-row dynamic_update_slice and the causal mask compares
+    against each row's own position, so both cases share one
+    implementation and the int8-KV branch."""
     c = model.cfg
     mods = model._block_modules()
     h = mods["ln1"].apply(params["ln1"], x)
     qkv = mods["qkv"].apply(params["qkv"], h)
     b, s, _ = qkv.shape
     q, k, v = split_qkv(c, qkv)      # q: (b,s,H,hd); k/v: (b,s,KV,hd)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    write = jax.vmap(lambda buf, row, p: lax.dynamic_update_slice(
+        buf, row, (p,) + (0,) * (buf.ndim - 1)))
     quant = "k_scale" in cache       # int8 KV cache (init_kv_cache)
     if quant:
         k, ks = _quantize_kv(k)
         v, vs = _quantize_kv(v)
-        new_ks = lax.dynamic_update_slice(cache["k_scale"], ks, (0, pos, 0))
-        new_vs = lax.dynamic_update_slice(cache["v_scale"], vs, (0, pos, 0))
-    new_k = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
-                                     (0, pos, 0, 0))
-    new_v = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
-                                     (0, pos, 0, 0))
+        new_ks = write(cache["k_scale"], ks, pos_b)
+        new_vs = write(cache["v_scale"], vs, pos_b)
+    new_k = write(cache["k"], k.astype(cache["k"].dtype), pos_b)
+    new_v = write(cache["v"], v.astype(cache["v"].dtype), pos_b)
     scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
     T = cache["k"].shape[1]
-    # causal within the chunk: key position <= pos + query offset
-    mask = (jnp.arange(T)[None, None, None, :]
-            <= pos + jnp.arange(s)[None, None, :, None])
+    # causal within the chunk: key position <= row position + query
+    # offset — (b, s, T), degenerating to the classic chunk mask when
+    # pos is scalar
+    mask = (jnp.arange(T)[None, None, :]
+            <= pos_b[:, None, None] + jnp.arange(s)[None, :, None])
     if c.kv_heads == c.n_heads:
         logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                             new_k.astype(jnp.float32)) * scale
@@ -108,7 +118,7 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
             # K scale: one multiplier per key position/head on the logit
             # column — dequantization without an f32 copy of the cache
             logits = logits * new_ks.transpose(0, 2, 1)[:, :, None, :]
-        logits = jnp.where(mask, logits, -1e30)
+        logits = jnp.where(mask[:, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         if quant:
             # V scale folds into the softmax weights (out is linear in
@@ -126,7 +136,7 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
                             new_k.astype(jnp.float32)) * scale
         if quant:
             logits = logits * new_ks.transpose(0, 2, 1)[:, :, None, None, :]
-        logits = jnp.where(mask[:, None], logits, -1e30)
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         if quant:
             probs = probs * new_vs.transpose(0, 2, 1)[:, :, None, None, :]
@@ -146,6 +156,21 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     if quant:
         new_cache.update(k_scale=new_ks, v_scale=new_vs)
     return x + ff.astype(x.dtype), new_cache
+
+
+def _forward_token_batched(model: Transformer, params, caches, ids,
+                           pos_vec: jax.Array):
+    """Logits for one token per row at PER-ROW positions (continuous
+    batching, models.serve): ids (B, 1), pos_vec (B,) -> ((B, 1, vocab)
+    f32, updated caches).  Rides :func:`_block_chunk`'s vector-``pos``
+    mode, so the int8-KV branch and any future attention fix are shared
+    with the single-stream path by construction."""
+    x = model.embed(params, ids, pos_vec[:, None])
+    new_caches = []
+    for layer_params, cache in zip(params["blocks"], caches):
+        x, cache = _block_chunk(model, layer_params, cache, x, pos_vec)
+        new_caches.append(cache)
+    return model.head_logits(params, x), new_caches
 
 
 def _forward_chunk(model: Transformer, params, caches, ids, pos):

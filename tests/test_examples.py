@@ -234,3 +234,16 @@ def test_example_15_int8_quantized_serving_completes():
     id_lines = [l for l in out.stdout.splitlines()
                 if l.count(",") == 10 and l.replace(",", "").isdigit()]
     assert len(id_lines) >= 2, out.stdout
+
+
+def test_example_16_continuous_batching_completes():
+    """Staggered requests through the slot server; each must match its
+    single-stream decode exactly (asserted inside the script)."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "16_continuous_batching.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "continuous-batched tokens == single-stream generate()" \
+        in out.stdout

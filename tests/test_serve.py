@@ -1,0 +1,191 @@
+"""Continuous-batching decode server (models.serve.DecodeServer).
+
+The load-bearing property: a request decoded through the slot server —
+batched with strangers, admitted mid-flight, finishing at its own time —
+must emit exactly the tokens the single-stream generate() path emits for
+the same prompt (greedy).  Plus the scheduling contract: slot reuse,
+pool-full admission, staggered lifetimes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+    generate,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.serve import (
+    DecodeServer,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+VOCAB = 64
+
+
+def _model(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=64, n_layers=2, d_model=32,
+                n_heads=4, d_ff=64)
+    base.update(kw)
+    return Transformer(TransformerConfig(**base))
+
+
+def _reference(model, params, prompt, n):
+    out = generate(model, params, jnp.asarray([prompt], jnp.int32), n)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def test_single_request_matches_generate():
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=4)
+    rid = srv.submit([1, 2, 3], max_new_tokens=10)
+    assert rid is not None and srv.live() == 1
+    while not srv.done(rid):
+        srv.step()
+    assert srv.result(rid) == _reference(model, params, [1, 2, 3], 10)
+    assert srv.live() == 0
+
+
+def test_staggered_admission_exact_tokens():
+    """Three requests joining at different times, different prompts and
+    lengths, batched in flight — each must match its single-stream
+    decode exactly (per-row attention reduces over the same values in
+    the same order regardless of who shares the batch)."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=4)
+    reqs = {}
+    reqs[srv.submit([1, 2, 3], max_new_tokens=12)] = ([1, 2, 3], 12)
+    srv.step(); srv.step()
+    reqs[srv.submit([7, 8], max_new_tokens=6)] = ([7, 8], 6)
+    srv.step()
+    reqs[srv.submit([5, 9, 11, 13], max_new_tokens=9)] = ([5, 9, 11, 13], 9)
+    for _ in range(40):
+        if all(srv.done(r) for r in reqs):
+            break
+        srv.step()
+    for rid, (prompt, n) in reqs.items():
+        assert srv.result(rid) == _reference(model, params, prompt, n), rid
+
+
+def test_slot_reuse_and_pool_full():
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2)
+    a = srv.submit([1], max_new_tokens=4)
+    b = srv.submit([2], max_new_tokens=20)
+    assert srv.submit([3], max_new_tokens=4) is None      # pool full
+    while not srv.done(a):
+        srv.step()
+    # a finished -> its slot is reclaimable while b is still in flight
+    c = srv.submit([3], max_new_tokens=4)
+    assert c is not None
+    for _ in range(40):
+        if srv.done(b) and srv.done(c):
+            break
+        srv.step()
+    assert srv.result(a) == _reference(model, params, [1], 4)
+    assert srv.result(b) == _reference(model, params, [2], 20)
+    assert srv.result(c) == _reference(model, params, [3], 4)
+
+
+def test_single_token_request():
+    """max_new_tokens=1 completes at submit (prefill samples it)."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2)
+    rid = srv.submit([4, 5, 6], max_new_tokens=1)
+    assert srv.done(rid)
+    assert srv.result(rid) == _reference(model, params, [4, 5, 6], 1)
+
+
+def test_gqa_server():
+    """The per-row-position decode step's grouped-head branch."""
+    model = _model(n_kv_heads=2)
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2)
+    rid = srv.submit([1, 2, 3], max_new_tokens=8)
+    while not srv.done(rid):
+        srv.step()
+    assert srv.result(rid) == _reference(model, params, [1, 2, 3], 8)
+
+
+def test_int8_weights_server():
+    """Continuous batching on a quantized model (weights-only PTQ rides
+    Linear.apply, so the server needs zero wiring)."""
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    model = _model()
+    q = quantize_params(model.init(prng.init_key(0)))
+    srv = DecodeServer(model, q, slots=2)
+    rid = srv.submit([1, 2, 3], max_new_tokens=8)
+    while not srv.done(rid):
+        srv.step()
+    assert srv.result(rid) == _reference(model, q, [1, 2, 3], 8)
+
+
+def test_scan_layers_server():
+    model = _model(scan_layers=True)
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2)
+    rid = srv.submit([9, 8, 7], max_new_tokens=6)
+    while not srv.done(rid):
+        srv.step()
+    assert srv.result(rid) == _reference(model, params, [9, 8, 7], 6)
+
+
+def test_int8_kv_cache_server():
+    """kv_quant rides _block_chunk's shared int8 branch in the batched
+    per-row-position step (the unification that replaced the duplicated
+    token-batched block): greedy tokens must track the kv_quant
+    single-stream decode exactly (identical quantization points: prefill
+    chunk + one token per step)."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2, kv_quant=True)
+    assert srv.caches[0]["k"].dtype == jnp.int8
+    rid = srv.submit([1, 2, 3], max_new_tokens=8)
+    while not srv.done(rid):
+        srv.step()
+    want = generate(model, params, jnp.asarray([[1, 2, 3]], jnp.int32), 8,
+                    kv_quant=True)
+    assert srv.result(rid) == [int(t) for t in np.asarray(want)[0]]
+
+
+def test_done_raises_on_stale_or_unknown_rid():
+    import pytest
+
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=2)
+    with pytest.raises(KeyError):
+        srv.done(42)                       # never issued
+    rid = srv.submit([1, 2], max_new_tokens=3)
+    while not srv.done(rid):
+        srv.step()
+    srv.result(rid)
+    with pytest.raises(KeyError):          # consumed: loud, not a spin
+        srv.done(rid)
+
+
+def test_prefill_bucketing_exact_tokens():
+    """Prompts of many lengths share log2(max_len) compiled prefill
+    programs (padded to power-of-two buckets); pad positions' K/V are
+    never attended, so tokens still match single-stream generate()."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = DecodeServer(model, params, slots=4)
+    reqs = {}
+    for prompt in ([1], [1, 2, 3, 4, 5], [3] * 9, [7] * 17):
+        reqs[srv.submit(list(prompt), max_new_tokens=5)] = list(prompt)
+    for _ in range(20):
+        if all(rid in srv._results for rid in reqs):
+            break
+        srv.step()
+    for rid, prompt in reqs.items():
+        assert srv.result(rid) == _reference(model, params, prompt, 5), \
+            prompt
