@@ -1236,6 +1236,40 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
     results["gqa_int8_kv8_tokens_per_sec"] = time_decode(
         jax.jit(lambda pr: generate(model_gqa, qparams_gqa, pr,
                                     new_tokens, kv_quant=True)), 4)
+    # continuous batching (models.serve): ragged requests sharing one
+    # batched step.  Run the same workload twice — the first pass pays
+    # every compile (log2-bucketed prefills + the step), the second is
+    # the steady-state number a serving loop sees.
+    from neural_networks_parallel_training_with_mpi_tpu.models.serve import (
+        DecodeServer,
+    )
+
+    def serve_pass():
+        srv = DecodeServer(model, params, slots=4, max_len=c["seq"])
+        lens = [3, 7, 12, 5, 9, 4, 14, 6]
+        pending = [(list(rng.integers(0, c["vocab"], (p,))), new_tokens)
+                   for p in lens]
+        done_tok = 0
+        t0 = time.perf_counter()
+        rids = []
+        while pending or rids:
+            while pending:
+                rid = srv.submit(*pending[0])
+                if rid is None:
+                    break
+                rids.append((rid, pending.pop(0)[1]))
+            srv.step()
+            for rid, n in list(rids):
+                if srv.done(rid):
+                    srv.result(rid)
+                    done_tok += n
+                    rids.remove((rid, n))
+        return round(done_tok / (time.perf_counter() - t0), 1)
+
+    serve_pass()  # compile pass (prefill buckets + batched step)
+    results["serve_requests"] = 8
+    results["serve_slots"] = 4
+    results["serve_tokens_per_sec"] = serve_pass()
     if n_dev >= 2:
         from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
             replicated_sharding,

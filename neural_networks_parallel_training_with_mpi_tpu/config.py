@@ -136,6 +136,12 @@ class ModelConfig:
     vocab_size: int = 256
     max_seq_len: int = 512
     attention: str = "dense"  # dense | flash (pallas) | ring | ulysses
+    # "learned" position table (default) or "rope" rotary q/k (no
+    # position parameters; relative-distance attention)
+    pos_encoding: str = "learned"
+    # transformer FFN activation; "swiglu" = gated FFN with a third
+    # (d, ff) projection (pick ~2/3 d_ff for iso-params)
+    ffn_activation: str = "gelu"
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
@@ -374,6 +380,15 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="grouped-query attention: K/V heads shared "
                         "across the query heads (0 = multi-head); the "
                         "KV cache shrinks by n_heads/n_kv_heads")
+    p.add_argument("--pos_encoding", choices=["learned", "rope"],
+                   default="learned",
+                   help="rope = rotary q/k position encoding (no "
+                        "position-embedding parameters)")
+    p.add_argument("--ffn_activation",
+                   choices=["gelu", "relu", "silu", "tanh", "swiglu"],
+                   default="gelu",
+                   help="transformer FFN activation; swiglu = gated FFN "
+                        "(third (d, ff) projection)")
     p.add_argument("--d_ff", type=int, default=512)
     p.add_argument("--seq_len", type=int, default=128)
     p.add_argument("--text_file", default="",
@@ -497,7 +512,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                             scan_layers=args.scan_layers,
                             n_layers=args.n_layers, d_model=args.d_model,
                             n_heads=args.n_heads,
-                            n_kv_heads=args.n_kv_heads, d_ff=args.d_ff,
+                            n_kv_heads=args.n_kv_heads,
+                            pos_encoding=args.pos_encoding,
+                            ffn_activation=args.ffn_activation,
+                            d_ff=args.d_ff,
                             vocab_size=args.vocab_size,
                             ce_chunk=args.ce_chunk,
                             max_seq_len=max(args.seq_len, 512))

@@ -94,6 +94,15 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     b, s, _ = qkv.shape
     q, k, v = split_qkv(c, qkv)      # q: (b,s,H,hd); k/v: (b,s,KV,hd)
     pos_b = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (b,))
+    if c.pos_encoding == "rope":
+        # rotate q and THIS chunk's k at their absolute positions; the
+        # cache then holds already-rotated keys (standard RoPE decode),
+        # so earlier positions are never revisited
+        from ..ops.rope import rope_rotate
+
+        chunk_pos = pos_b[:, None] + jnp.arange(s)[None, :]   # (b, s)
+        q = rope_rotate(q, chunk_pos, c.rope_theta)
+        k = rope_rotate(k, chunk_pos, c.rope_theta)
     write = jax.vmap(lambda buf, row, p: lax.dynamic_update_slice(
         buf, row, (p,) + (0,) * (buf.ndim - 1)))
     quant = "k_scale" in cache       # int8 KV cache (init_kv_cache)
@@ -149,9 +158,7 @@ def _block_chunk(model: Transformer, params, cache, x, pos):
     if c.moe_experts > 0:
         ff, _ = mods["moe"].apply(params["moe"], h)
     else:
-        h = mods["ff_in"].apply(params["ff_in"], h)
-        h = ACTIVATIONS[c.activation](h)
-        ff = mods["ff_out"].apply(params["ff_out"], h)
+        ff = model._ffn(mods, params, h)
     new_cache = {"k": new_k, "v": new_v}
     if quant:
         new_cache.update(k_scale=new_ks, v_scale=new_vs)

@@ -173,6 +173,13 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
     c = model.cfg
     tp = int(mesh.shape[TENSOR_AXIS])
     megatron.validate_tp(c, tp)
+    if getattr(c, "pos_encoding", "learned") == "rope":
+        raise NotImplementedError(
+            "RoPE is not wired into the tensor-parallel decode path "
+            "(generate_tp runs its own head-sharded cache attention); "
+            "decode RoPE checkpoints with models.generate / "
+            "generate_sharded, or train with pos_encoding='learned' "
+            "for TP serving")
     heads_local = c.n_heads // tp
     if vocab_parallel and c.vocab_size % tp:
         raise ValueError(f"vocab_size={c.vocab_size} not divisible by "

@@ -30,6 +30,8 @@ def build_model(cfg: ModelConfig) -> Module:
             vocab_size=cfg.vocab_size, max_seq_len=cfg.max_seq_len,
             n_layers=cfg.n_layers, d_model=cfg.d_model, n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads or None,
+            pos_encoding=cfg.pos_encoding,
+            activation=cfg.ffn_activation,
             d_ff=cfg.d_ff, attention=cfg.attention, param_dtype=pdt,
             compute_dtype=cdt, remat=cfg.remat,
             remat_policy=cfg.remat_policy,

@@ -154,6 +154,10 @@ class DecodeServer:
         if not free:
             return None
         p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt: a request needs at least one "
+                             "token (bucketed prefill would otherwise "
+                             "sample from pad-position logits)")
         if p + max_new_tokens > self.max_len:
             raise ValueError(f"prompt {p} + {max_new_tokens} exceeds "
                              f"server max_len {self.max_len}")

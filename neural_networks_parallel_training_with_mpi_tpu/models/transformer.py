@@ -59,6 +59,17 @@ class TransformerConfig:
     compute_dtype: Any = jnp.float32   # set bfloat16 for TPU throughput
     attention: str = "dense"           # dense | ring | ulysses
     seq_axis: str = "seq"
+    # Position encoding: "learned" adds a trained position-embedding table
+    # (the default, matching the original treedef); "rope" rotates q/k by
+    # their global positions instead (ops.rope — no position parameters at
+    # all, relative-distance attention, fused elementwise on TPU).  The
+    # rotation happens inside sequence_sharded_attention, so every
+    # attention impl (dense/flash/ring/striped/ulysses) and every
+    # seq-parallel layout inherits it; the KV-cache decode paths rotate
+    # the new position and cache rotated keys.  Not wired into the
+    # explicit Megatron-TP shard_map paths (validate_tp guards).
+    pos_encoding: str = "learned"      # learned | rope
+    rope_theta: float = 10000.0
     # Grouped-query attention (GQA, Ainslie et al. 2023): n_kv_heads < n_heads
     # shares each K/V head across n_heads/n_kv_heads query heads.  None =
     # classic multi-head (n_kv_heads == n_heads), keeping the default
@@ -148,6 +159,11 @@ class Transformer(Module):
             "ln2": LayerNorm(c.d_model, param_dtype=c.param_dtype),
         }
         if c.moe_experts > 0:
+            if c.activation == "swiglu":
+                raise NotImplementedError(
+                    "SwiGLU experts are not wired (MoEFFN's expert einsum "
+                    "is the classic 2-matmul FFN); use a dense-FFN "
+                    "activation with moe_experts > 0")
             from .moe import MoEFFN
 
             mods["moe"] = MoEFFN(
@@ -161,10 +177,32 @@ class Transformer(Module):
             mods["ff_in"] = Linear(c.d_model, c.d_ff,
                                    param_dtype=c.param_dtype,
                                    compute_dtype=c.compute_dtype)
+            if c.activation == "swiglu":
+                # gated FFN (Shazeer 2020): silu(x W_gate) * (x W_in),
+                # then W_out — the modern-LM FFN.  A third (d, ff)
+                # projection; pick d_ff ~2/3 of the ungated width for
+                # iso-parameter comparisons.
+                mods["ff_gate"] = Linear(c.d_model, c.d_ff,
+                                         param_dtype=c.param_dtype,
+                                         compute_dtype=c.compute_dtype)
             mods["ff_out"] = Linear(c.d_ff, c.d_model,
                                     param_dtype=c.param_dtype,
                                     compute_dtype=c.compute_dtype)
         return mods
+
+    def _ffn(self, mods, params, h: jax.Array) -> jax.Array:
+        """Dense-FFN tail shared by the training block and the KV-cache
+        decode chunk (anti-drift): classic act(W_in h) W_out, or SwiGLU
+        when activation == 'swiglu'."""
+        c = self.cfg
+        if c.activation == "swiglu":
+            gate = jax.nn.silu(mods["ff_gate"].apply(params["ff_gate"], h))
+            return mods["ff_out"].apply(
+                params["ff_out"],
+                gate * mods["ff_in"].apply(params["ff_in"], h))
+        h = mods["ff_in"].apply(params["ff_in"], h)
+        h = ACTIVATIONS[c.activation](h)
+        return mods["ff_out"].apply(params["ff_out"], h)
 
     def init(self, key: jax.Array):
         c = self.cfg
@@ -181,13 +219,15 @@ class Transformer(Module):
         if c.scan_layers:  # stacked layout: leaves (n_layers, ...)
             blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
                                             *blocks)
-        return {
+        out = {
             "embed": embed.init(keys[-3]),
-            "pos": pos.init(keys[-2]),
             "blocks": blocks,
             "ln_f": LayerNorm(c.d_model, param_dtype=c.param_dtype).init(keys[-1]),
             "head": head.init(keys[-1]),
         }
+        if c.pos_encoding != "rope":   # RoPE has no position parameters
+            out["pos"] = pos.init(keys[-2])
+        return out
 
     def _block(self, params, x: jax.Array):
         """One pre-LN block: (params, x) -> (x, aux); aux is the MoE
@@ -205,16 +245,16 @@ class Transformer(Module):
         out = sequence_sharded_attention(
             c.attention, q, k, v,
             axis=c.seq_axis, causal=True, block_q=c.flash_block_q,
-            block_k=c.flash_block_k)
+            block_k=c.flash_block_k,
+            rope_theta=(c.rope_theta if c.pos_encoding == "rope"
+                        else None))
         out = out.reshape(*out.shape[:2], c.d_model)
         x = x + mods["attn_out"].apply(params["attn_out"], out)
         h = mods["ln2"].apply(params["ln2"], x)
         if c.moe_experts > 0:
             ff, aux = mods["moe"].apply(params["moe"], h)
         else:
-            h = mods["ff_in"].apply(params["ff_in"], h)
-            h = ACTIVATIONS[c.activation](h)
-            ff = mods["ff_out"].apply(params["ff_out"], h)
+            ff = self._ffn(mods, params, h)
             aux = jnp.zeros((), jnp.float32)
         return x + ff.astype(x.dtype), aux
 
@@ -226,6 +266,10 @@ class Transformer(Module):
         table-sharded but THIS part must stay identical to the dense
         model."""
         c = self.cfg
+        if c.pos_encoding == "rope":
+            # position enters through the q/k rotation inside attention
+            # (sequence_sharded_attention / the decode chunk), not here
+            return x_tokens.astype(c.compute_dtype)
         x = x_tokens + Embedding(c.max_seq_len, c.d_model,
                                  c.param_dtype).apply(params["pos"],
                                                       positions)
@@ -268,7 +312,9 @@ class Transformer(Module):
         per_layer = 2.0 * b * t * d * c.qkv_dim  # qkv projection (GQA-aware)
         per_layer += 2.0 * b * t * d * d        # attention out projection
         per_layer += 2.0 * (2.0 * b * t * t * d)  # scores + values
-        ffn = 2.0 * (2.0 * b * t * d * ff)      # FFN in + out per expert
+        # FFN in + out per expert; SwiGLU adds the (d, ff) gate matmul
+        ffn = 2.0 * ((3.0 if c.activation == "swiglu" else 2.0)
+                     * b * t * d * ff)
         if c.moe_experts > 0:
             ffn *= c.moe_top_k
             per_layer += 2.0 * b * t * d * c.moe_experts  # router

@@ -1,0 +1,65 @@
+"""Rotary position embeddings (RoPE — Su et al. 2021, RoFormer).
+
+Instead of ADDING a learned position vector to the token embedding (the
+reference-era convention this framework's default keeps), RoPE rotates
+each query/key head pair-wise by an angle proportional to its absolute
+position; the q·k contraction then depends only on the RELATIVE distance
+m − n, which is what attention actually wants.  TPU-friendly by
+construction: the rotation is a fused elementwise multiply-add on the
+(…, head_dim) tile — no gather, no position table streamed from HBM, no
+extra parameters (and so nothing for the optimizer/checkpoint to carry).
+
+Applied OUTSIDE the attention kernels, on q/k right after the head
+split: every impl (dense, Pallas flash, ring, striped, Ulysses) then
+works unchanged, because a token's rotation depends only on its own
+global position — under sequence parallelism each shard rotates its
+local tokens by their global positions before any collective, and the
+already-rotated K travels the ring.  Decode rotates the single new
+position and caches the rotated key (standard practice), so cached keys
+never need re-rotation.
+
+Half-split convention: the head dim is split as [x1 | x2] and rotated as
+(x1·cos − x2·sin, x1·sin + x2·cos) — self-consistent within this
+framework (checkpoints trained here decode here; no external-weight
+layout to match).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_THETA = 10000.0
+
+
+def rope_angles(positions: jax.Array, head_dim: int,
+                theta: float = DEFAULT_THETA):
+    """(cos, sin) tables for ``positions`` (any shape P) and an even
+    ``head_dim`` -> each (*P, head_dim // 2) in f32."""
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def rope_rotate(x: jax.Array, positions: jax.Array,
+                theta: float = DEFAULT_THETA) -> jax.Array:
+    """Rotate q or k (..., T, H, D) by per-token ``positions``.
+
+    ``positions`` is (T,) (one sequence of global positions — the
+    training path, where sequence-parallel shards pass their own global
+    slice) or (B, T) (per-row positions — the decode paths, where every
+    batch row sits at its own depth).  Output dtype matches ``x``."""
+    cos, sin = rope_angles(positions, x.shape[-1], theta)
+    # broadcast over batch (T,) case and insert the heads axis
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]            # (1, T, half)
+    cos, sin = cos[:, :, None, :], sin[:, :, None, :]  # (B|1, T, 1, half)
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+        axis=-1).astype(x.dtype)

@@ -501,7 +501,9 @@ def _moe_tp_forward(model: Transformer, params: Pytree, ids: jax.Array,
         positions = global_positions(c.attention, seq_axis, t)
         attn = lambda q, k, v: sequence_sharded_attention(
             c.attention, q, k, v, axis=seq_axis, causal=True,
-            block_q=c.flash_block_q, block_k=c.flash_block_k)
+            block_q=c.flash_block_q, block_k=c.flash_block_k,
+            rope_theta=(c.rope_theta if c.pos_encoding == "rope"
+                        else None))
     else:
         positions = jnp.arange(t)
         attn = None

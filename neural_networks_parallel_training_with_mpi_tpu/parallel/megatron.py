@@ -105,6 +105,19 @@ def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
 
 
 def validate_tp(cfg, tp: int) -> None:
+    if (getattr(cfg, "pos_encoding", "learned") == "rope"
+            and cfg.attention == "dense"):
+        raise NotImplementedError(
+            "RoPE with attention='dense' on the Megatron-TP paths is not "
+            "wired: dense attention runs INSIDE tp_block_apply (no "
+            "sequence_sharded_attention hook to rotate q/k).  Use "
+            "attention='flash' or a seq-sharded impl under TP, or "
+            "pos_encoding='learned'")
+    if cfg.activation == "swiglu":
+        raise NotImplementedError(
+            "SwiGLU is not wired into tp_block_apply's column/row-"
+            "parallel FFN pair (it assumes the classic 2-matmul FFN); "
+            "use the GSPMD TP path or a dense-FFN activation")
     if getattr(cfg, "n_kv_heads", None) not in (None, cfg.n_heads):
         raise NotImplementedError(
             f"GQA (n_kv_heads={cfg.n_kv_heads} < n_heads={cfg.n_heads}) is "

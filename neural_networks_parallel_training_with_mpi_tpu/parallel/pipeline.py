@@ -308,7 +308,9 @@ def _stage_fns(model: Transformer, tp: int):
         attn = (None if c.attention == "dense"
                 else (lambda q, k, v: sequence_sharded_attention(
                     c.attention, q, k, v, axis=c.seq_axis, causal=True,
-                    block_q=c.flash_block_q, block_k=c.flash_block_k)))
+                    block_q=c.flash_block_q, block_k=c.flash_block_k,
+                    rope_theta=(c.rope_theta if c.pos_encoding == "rope"
+                                else None))))
         ffn_fn = None
         if c.moe_experts > 0:
             # GShard expert+model parallelism inside the stage: experts
@@ -348,6 +350,11 @@ def _stage_fns(model: Transformer, tp: int):
 
         t = ids_mb.shape[-1]
         x = jnp.take(params["embed"]["table"], ids_mb, axis=0)
+        if c.pos_encoding == "rope":
+            # RoPE models carry no "pos" table; position enters via the
+            # q/k rotation inside the stage's attention (the rope_theta
+            # threaded through sequence_sharded_attention / model._block)
+            return x.astype(c.compute_dtype)
         # global token positions of this shard's t local indices — offset
         # by the seq shard under PP x SP (identical to arange(t) when the
         # sequence is unsharded; striped layouts get their stripes)

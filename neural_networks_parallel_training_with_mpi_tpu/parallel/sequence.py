@@ -404,7 +404,21 @@ def sequence_sharded_attention(impl: str, q, k, v, *, axis: str = "seq",
                                causal: bool = True,
                                scale: Optional[float] = None,
                                block_q: int = 128,
-                               block_k: int = 128) -> jax.Array:
+                               block_k: int = 128,
+                               rope_theta: Optional[float] = None
+                               ) -> jax.Array:
+    if rope_theta is not None:
+        # RoPE rotates q/k by their GLOBAL positions before any impl or
+        # collective — global_positions already answers "what are this
+        # shard's global token positions" for every layout (contiguous
+        # ring shards, the striped permutation, unsharded dense/flash),
+        # so the rotated K that travels the ring is correct by the same
+        # argument the positional embedding relies on.
+        from ..ops.rope import rope_rotate
+
+        positions = global_positions(impl, axis, q.shape[1])
+        q = rope_rotate(q, positions, rope_theta)
+        k = rope_rotate(k, positions, rope_theta)
     if impl == "dense":
         return attention_reference(q, k, v, causal=causal, scale=scale)
     if impl == "flash":

@@ -264,7 +264,8 @@ def _sp_tp_forward(model, params, ids, tp: int, seq_axis: str,
                          f"{SEQ_SHARDED_IMPLS}, got {attention_impl!r}")
     attn = lambda q, k, v: sequence_sharded_attention(
         attention_impl, q, k, v, axis=seq_axis, causal=True,
-        block_q=c.flash_block_q, block_k=c.flash_block_k)
+        block_q=c.flash_block_q, block_k=c.flash_block_k,
+        rope_theta=(c.rope_theta if c.pos_encoding == "rope" else None))
     b, t = ids.shape
     positions = global_positions(attention_impl, seq_axis, t)
     if vocab_parallel:

@@ -58,7 +58,7 @@ def transformer_rules(mesh: Mesh) -> PathRule:
 
     def rule(path: Tuple[str, ...], leaf) -> P:
         shape = np.shape(leaf)
-        col = ("qkv" in path or "ff_in" in path)
+        col = ("qkv" in path or "ff_in" in path or "ff_gate" in path)
         row = ("attn_out" in path or "ff_out" in path)
         is_w = path[-1] == "w"
         if is_w and len(shape) == 2:

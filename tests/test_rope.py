@@ -1,0 +1,295 @@
+"""Rotary position embeddings (ops.rope + TransformerConfig.pos_encoding).
+
+The defining property: after rotating q by R(m) and k by R(n), the score
+q·k depends only on m − n.  Everything else follows from where the
+rotation is applied — inside sequence_sharded_attention (so every
+attention impl and SP layout inherits it with each shard rotating by its
+GLOBAL positions) and in the decode chunk (rotated keys are cached)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+    generate,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.ops.rope import (
+    rope_rotate,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+    make_mesh,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+T, VOCAB = 32, 64
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=T, n_layers=2, d_model=32,
+                n_heads=4, d_ff=64, pos_encoding="rope")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_relative_position_invariance():
+    """(R(m) q) . (R(n) k) == (R(m+s) q) . (R(n+s) k) for any shift s —
+    the property that makes RoPE a position encoding at all."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 8, 2, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 8, 2, 16)), jnp.float32)
+
+    def scores(shift):
+        pos = jnp.arange(8) + shift
+        qr = rope_rotate(q, pos)
+        kr = rope_rotate(k, pos)
+        return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+    np.testing.assert_allclose(np.asarray(scores(0)),
+                               np.asarray(scores(11)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rotation_preserves_norm_and_identity_at_zero():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((2, 6, 3, 8)), jnp.float32)
+    r = rope_rotate(x, jnp.arange(6))
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(r), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5, atol=1e-5)
+    # position 0 rotates by angle 0 everywhere
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6, atol=1e-6)
+    with pytest.raises(ValueError, match="even head_dim"):
+        rope_rotate(x[..., :7], jnp.arange(6))
+
+
+def test_rope_model_has_no_pos_params_and_trains():
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    model = Transformer(_cfg())
+    params = model.init(prng.init_key(0))
+    assert "pos" not in params
+    mesh = make_mesh(MeshConfig(data=2), devices=jax.devices()[:2])
+    opt = optim.sgd(lr=1e-2, momentum=0.0)
+    state = dp.replicate_state(TrainState.create(model, opt,
+                                                 prng.init_key(0)), mesh)
+    step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                              "global_mean")
+    rng = np.random.default_rng(0)
+    batch = shd.shard_batch(mesh, {
+        "x": rng.integers(0, VOCAB, (4, T)).astype(np.int32),
+        "y": rng.integers(0, VOCAB, (4, T)).astype(np.int32),
+        "mask": np.ones((4,), np.float32)})
+    state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+
+
+def test_rope_is_position_sensitive():
+    """Same tokens at different offsets must produce different logits —
+    i.e. the rotation really is the position signal (a bug that silently
+    dropped it would still pass parity tests)."""
+    model = Transformer(_cfg(n_layers=1))
+    params = model.init(prng.init_key(0))
+    ids = jnp.asarray([[5, 9, 5, 9, 5, 9, 5, 9]], jnp.int32)
+    logits = model.apply(params, ids)
+    # token 5 at positions 0, 2, 4: attends over different prefixes AND
+    # different rotations; if positions were ignored its logits would
+    # repeat once the prefix content repeats
+    assert not np.allclose(np.asarray(logits[0, 2]),
+                           np.asarray(logits[0, 4]), atol=1e-5)
+
+
+@pytest.mark.parametrize("attention", ["dense", "flash"])
+def test_rope_flash_matches_dense(attention):
+    """The rotation happens before the impl dispatch, so flash == dense
+    on a RoPE model to kernel tolerance."""
+    params = Transformer(_cfg()).init(prng.init_key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, VOCAB, (2, T)),
+                      jnp.int32)
+    want = Transformer(_cfg(attention="dense")).apply(params, ids)
+    got = Transformer(_cfg(attention=attention)).apply(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rope_ring_seq_parallel_matches_dense():
+    """Each seq shard rotates by its GLOBAL positions (via
+    global_positions inside sequence_sharded_attention), so the ring
+    model under a seq=4 mesh must equal the dense single-device model."""
+    mesh = make_mesh(MeshConfig(data=1, seq=4),
+                     devices=jax.devices("cpu")[:4])
+    params = Transformer(_cfg()).init(prng.init_key(0))
+    ids = np.random.default_rng(0).integers(0, VOCAB, (2, T)).astype(
+        np.int32)
+    expected = Transformer(_cfg(attention="dense")).apply(
+        params, jnp.asarray(ids))
+    ring_model = Transformer(_cfg(attention="ring"))
+    got = jax.jit(jax.shard_map(
+        lambda p, i: ring_model.apply(p, i),
+        mesh=mesh, in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq"), check_vma=False))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rope_decode_matches_training_forward():
+    """The KV-cache path caches ROTATED keys; its prefill logits must
+    match the training forward position-for-position, and the
+    prefill+scan decode must equal the fully-sequential ragged path
+    (same rotations at the same absolute positions)."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+        _forward_chunk, init_kv_cache,
+    )
+
+    model = Transformer(_cfg())
+    params = model.init(prng.init_key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, VOCAB, (2, 8)),
+                      jnp.int32)
+    train_logits = model.apply(params, ids)
+    cache_logits, _ = _forward_chunk(model, params,
+                                     init_kv_cache(model, 2, 8), ids, 0)
+    np.testing.assert_allclose(np.asarray(cache_logits),
+                               np.asarray(train_logits),
+                               rtol=2e-4, atol=2e-4)
+
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    fast = generate(model, params, prompt, 10)
+    seq = generate(model, params, prompt, 10,
+                   prompt_lens=jnp.asarray([3], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(seq))
+
+
+def test_rope_composes_with_gqa_kv8_and_server():
+    """The full modern-LM stack: RoPE x GQA x int8 weights x int8 KV
+    through the continuous-batching server, token-equal to the
+    single-stream decode of the same quantized model."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.serve import (
+        DecodeServer,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    model = Transformer(_cfg(n_kv_heads=2))
+    q = quantize_params(model.init(prng.init_key(0)))
+    srv = DecodeServer(model, q, slots=2, kv_quant=True)
+    rid = srv.submit([1, 2, 3], max_new_tokens=8)
+    while not srv.done(rid):
+        srv.step()
+    want = generate(model, q, jnp.asarray([[1, 2, 3]], jnp.int32), 8,
+                    kv_quant=True)
+    assert srv.result(rid) == [int(t) for t in np.asarray(want)[0]]
+
+
+def test_rope_tp_guards():
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+    )
+
+    with pytest.raises(NotImplementedError, match="RoPE"):
+        megatron.validate_tp(_cfg(attention="dense"), tp=2)
+    megatron.validate_tp(_cfg(attention="flash"), tp=2)  # wired via hook
+    megatron.validate_tp(
+        TransformerConfig(d_model=32, n_heads=4, d_ff=64), tp=2)
+
+
+def test_cli_pos_encoding_flag():
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        build_argparser, config_from_args,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.models.registry import (
+        build_model,
+    )
+
+    args = build_argparser().parse_args(
+        ["--dataset", "lm", "--pos_encoding", "rope"])
+    model = build_model(config_from_args(args).model)
+    assert model.cfg.pos_encoding == "rope"
+    assert "pos" not in model.init(prng.init_key(0))
+
+
+@pytest.mark.slow
+def test_rope_pipeline_matches_single_device():
+    """RoPE x pipeline: stage-0's embed must skip the (absent) position
+    table — RoPE models carry none — and each stage's attention rotates
+    q/k itself; the pipelined step must match the unpipelined reference
+    step exactly (loss + updated params)."""
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.ops import (
+        losses, optim,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        pipeline as pp,
+    )
+
+    mesh = make_mesh(MeshConfig(data=1, pipe=2),
+                     devices=jax.devices("cpu")[:2])
+    model = Transformer(_cfg(n_layers=4))
+    opt = optim.sgd(lr=0.1, momentum=0.9)
+    rng = np.random.default_rng(0)
+    tok = rng.integers(0, VOCAB, (4, T + 1))
+    batch = {"x": tok[:, :-1].astype(np.int32),
+             "y": tok[:, 1:].astype(np.int32),
+             "mask": np.ones((4,), np.float32)}
+
+    state, loss = pp.run_one_step(model, opt, mesh, batch,
+                                  prng.init_key(0), n_microbatches=2)
+
+    params = model.init(prng.init_key(0))
+    assert "pos" not in params
+
+    def scalar(p):
+        logits = model.apply(p, jnp.asarray(batch["x"]))
+        s, cnt = losses.softmax_cross_entropy(
+            logits, jnp.asarray(batch["y"]), jnp.asarray(batch["mask"]))
+        return s / cnt
+
+    ref_loss, grads = jax.value_and_grad(scalar)(params)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_swiglu_gate_is_tensor_sharded_on_gspmd():
+    """transformer_rules must treat ff_gate like ff_in (column-parallel)
+    on the GSPMD TP path — the path validate_tp's SwiGLU guard points
+    users at."""
+    from jax.sharding import PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        tensor_parallel as tp_rules,
+    )
+
+    mesh = make_mesh(MeshConfig(data=1, tensor=2),
+                     devices=jax.devices("cpu")[:2])
+    model = Transformer(_cfg(pos_encoding="learned", activation="swiglu",
+                             d_ff=64))
+    params = model.init(prng.init_key(0))
+    specs = tp_rules.param_specs(model, params, mesh)
+    blk = specs["blocks"][0]
+    assert blk["ff_gate"]["w"] == blk["ff_in"]["w"] == P(None, "tensor")
+    assert blk["ff_out"]["w"] == P("tensor", None)
+
+
+def test_serve_rejects_empty_prompt():
+    from neural_networks_parallel_training_with_mpi_tpu.models.serve import (
+        DecodeServer,
+    )
+
+    model = Transformer(_cfg())
+    srv = DecodeServer(model, model.init(prng.init_key(0)), slots=2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        srv.submit([], max_new_tokens=4)
