@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The modern-LM stack in one CLI run: RoPE rotary positions (no position
+# parameters), SwiGLU gated FFN, and grouped-query attention (half the
+# KV heads), trained on the virtual mesh, checkpointed, then decoded
+# with every serving lever stacked — int8 weights + int8 KV cache.
+# The reference's model is a 13-parameter MLP (dataParallelTraining_NN_MPI.py:41-45);
+# this is the "don't stop at parity" model family.
+set -euo pipefail
+CKPT="$(mktemp -d)"
+trap 'rm -rf "$CKPT"' EXIT
+
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+    --dataset lm --no-full-batch --batch_size 32 --nepochs 1 \
+    --optimizer adam --lr 1e-3 --seq_len 32 \
+    --pos_encoding rope --ffn_activation swiglu \
+    --n_heads 4 --n_kv_heads 2 \
+    --checkpoint_dir "$CKPT"
+
+echo "--- decode the RoPE x SwiGLU x GQA checkpoint, int8 weights + int8 KV"
+python -m neural_networks_parallel_training_with_mpi_tpu \
+    --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-1}" \
+    --dataset lm --seq_len 32 \
+    --pos_encoding rope --ffn_activation swiglu \
+    --n_heads 4 --n_kv_heads 2 \
+    --checkpoint_dir "$CKPT" \
+    --generate "10,20,30" --max_new_tokens 8 \
+    --quantize int8 --quantize_skip head --kv_quant int8

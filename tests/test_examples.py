@@ -247,3 +247,20 @@ def test_example_16_continuous_batching_completes():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "continuous-batched tokens == single-stream generate()" \
         in out.stdout
+
+
+def test_example_17_modern_lm_stack_completes():
+    """RoPE x SwiGLU x GQA trained via the CLI, then decoded from the
+    checkpoint with int8 weights + int8 KV cache stacked."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "17_modern_lm_stack.sh")],
+        capture_output=True, text=True, timeout=600, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    text = out.stderr + out.stdout
+    assert "done: final loss" in text
+    assert "int8 weights-only PTQ" in text
+    last = out.stdout.strip().splitlines()[-1]
+    ids = [int(t) for t in last.split(",")]
+    assert ids[:3] == [10, 20, 30] and len(ids) == 11
