@@ -180,6 +180,14 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
             "decode RoPE checkpoints with models.generate / "
             "generate_sharded, or train with pos_encoding='learned' "
             "for TP serving")
+    if c.kv_heads != c.n_heads:
+        raise NotImplementedError(
+            "GQA is not wired into the tensor-parallel decode path "
+            "(its head-sharded KV cache and chunk attention assume "
+            "equal q/k/v thirds); GQA TRAINS under Megatron TP "
+            "(tp_block_apply), and GQA checkpoints decode via "
+            "models.generate / generate_sharded after layout "
+            "reconciliation")
     heads_local = c.n_heads // tp
     if vocab_parallel and c.vocab_size % tp:
         raise ValueError(f"vocab_size={c.vocab_size} not divisible by "
@@ -405,7 +413,8 @@ def pipeline_params_for_decode(params, model: Transformer,
                                            saved_tp=int(qkv_tp))
         if int(decode_tp) > 1:
             out["blocks"] = megatron.permute_qkv(
-                out["blocks"], c.d_model, c.n_heads, int(decode_tp))
+                out["blocks"], c.d_model, c.n_heads, int(decode_tp),
+                kv_heads=c.kv_heads)
     else:
         # degrees match (or caller vouches): keep the head-aligned
         # permutation — generate_tp consumes the NATIVE tp layout; only

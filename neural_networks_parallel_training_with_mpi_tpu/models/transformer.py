@@ -77,9 +77,12 @@ class TransformerConfig:
     # the KV cache: decode streams (and stores) n_kv_heads/n_heads of
     # the MHA cache bytes — the long-context serving bottleneck — while
     # training repeats K/V to full heads before the attention impls
-    # (same math, unchanged kernels).  Not wired into the Megatron-TP
-    # paths (the head-aligned qkv permutation assumes equal q/k/v
-    # thirds); those raise with a clear error.
+    # (same math, unchanged kernels).  Under Megatron TP the K/V heads
+    # shard over the tensor axis too (needs n_kv_heads % tp == 0; the
+    # contiguous head-aligned permutation keeps each rank's query-head
+    # groups on exactly its own K/V heads — qkv_tp_permutation).  The
+    # generate_tp decode path refuses GQA (its head-sharded cache
+    # assumes equal thirds); GQA checkpoints decode via the dense paths.
     n_kv_heads: Optional[int] = None
     # Pallas flash-kernel tile sizes (flash / ring_flash / striped_flash
     # only; dense and the non-flash ring ignore them).  128 x 128 is the

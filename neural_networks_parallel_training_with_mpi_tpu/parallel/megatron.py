@@ -12,14 +12,19 @@ program with every collective explicit:
   psum backward (placed at a column-parallel layer's input — the partial
   input-gradients from each tensor rank must be summed), ``g`` is psum
   forward / identity backward (placed at a row-parallel layer's output).
-* **qkv column permutation**: the fused qkv weight is ``(d, 3d)`` laid out
-  ``[q | k | v]``; a contiguous tensor-axis slice of that would hand a rank
-  fragments of q and k from unrelated heads.  ``qkv_tp_permutation``
-  reorders columns to ``[q_r | k_r | v_r]`` per rank r (whole heads), so
-  the *sharded* layout is head-aligned while checkpoints stay
-  interchangeable with the dense model via the inverse permutation.
+* **qkv column permutation**: the fused qkv weight is ``(d, qkv_dim)``
+  laid out ``[q | k | v]`` (``qkv_dim = 3d`` classic multi-head, or
+  ``d + 2·kv_heads·head_dim`` under GQA); a contiguous tensor-axis slice
+  of that would hand a rank fragments of q and k from unrelated heads.
+  ``qkv_tp_permutation`` reorders columns to ``[q_r | k_r | v_r]`` per
+  rank r (whole heads; under GQA rank r's ``n_heads/tp`` query heads and
+  its ``kv_heads/tp`` K/V heads, contiguously, so every query-head group
+  lands on its own rank's K/V heads), keeping the *sharded* layout
+  head-aligned while checkpoints stay interchangeable with the dense
+  model via the inverse permutation.
 * **tp_block_apply**: one pre-LN block with column-parallel qkv/ff_in,
-  local attention over ``n_heads / tp`` heads, and row-parallel
+  local attention over ``n_heads / tp`` heads (GQA: ``kv_heads / tp``
+  K/V heads repeated rank-locally to the query heads), and row-parallel
   attn_out/ff_out — numerically the dense ``Transformer._block``
   (models/transformer.py) up to split-matmul reassociation.
 """
@@ -71,27 +76,42 @@ def make_megatron_ops(axis: str = TENSOR_AXIS):
     return f, g
 
 
-def qkv_tp_permutation(d_model: int, n_heads: int, tp: int) -> np.ndarray:
+def qkv_tp_permutation(d_model: int, n_heads: int, tp: int,
+                       kv_heads: int = 0) -> np.ndarray:
     """Column order mapping the fused ``[q | k | v]`` qkv weight to a layout
-    whose tensor-axis slice r is ``[q_heads_r | k_heads_r | v_heads_r]``."""
+    whose tensor-axis slice r is ``[q_heads_r | k_heads_r | v_heads_r]``.
+
+    Under GQA (``kv_heads < n_heads``) the k/v projections are
+    ``kv_heads * head_dim`` wide: rank r takes ``n_heads/tp`` query heads
+    and ``kv_heads/tp`` K/V heads, CONTIGUOUSLY — since the per-rank
+    query-head count is a multiple of the group size G = n_heads/kv_heads,
+    rank r's query heads group onto exactly rank r's K/V heads, so local
+    attention needs no cross-rank head traffic.  ``kv_heads=0`` (or
+    ``n_heads``) reduces to the classic equal-thirds layout."""
+    kv = kv_heads or n_heads
     if n_heads % tp:
         raise ValueError(f"n_heads={n_heads} not divisible by tp={tp}")
+    if kv % tp:
+        raise ValueError(f"n_kv_heads={kv} not divisible by tp={tp}")
     head_dim = d_model // n_heads
-    per = (n_heads // tp) * head_dim  # columns per rank per projection
+    per_q = (n_heads // tp) * head_dim
+    per_kv = (kv // tp) * head_dim
+    kvw = kv * head_dim
     cols = []
     for r in range(tp):
-        for proj in range(3):  # q, k, v
-            base = proj * d_model + r * per
-            cols.extend(range(base, base + per))
+        for base, per in ((0, per_q), (d_model, per_kv),
+                          (d_model + kvw, per_kv)):   # q, k, v
+            b0 = base + r * per
+            cols.extend(range(b0, b0 + per))
     return np.asarray(cols, dtype=np.int64)
 
 
 def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
-                inverse: bool = False) -> Pytree:
+                inverse: bool = False, kv_heads: int = 0) -> Pytree:
     """Apply (or invert) the qkv column permutation on a blocks pytree —
     works on both per-layer lists and pipeline-stacked leaves, since the
     permuted dim is always the last."""
-    perm = qkv_tp_permutation(d_model, n_heads, tp)
+    perm = qkv_tp_permutation(d_model, n_heads, tp, kv_heads)
     if inverse:
         perm = np.argsort(perm)
 
@@ -118,14 +138,15 @@ def validate_tp(cfg, tp: int) -> None:
             "SwiGLU is not wired into tp_block_apply's column/row-"
             "parallel FFN pair (it assumes the classic 2-matmul FFN); "
             "use the GSPMD TP path or a dense-FFN activation")
-    if getattr(cfg, "n_kv_heads", None) not in (None, cfg.n_heads):
-        raise NotImplementedError(
-            f"GQA (n_kv_heads={cfg.n_kv_heads} < n_heads={cfg.n_heads}) is "
-            "not wired into the Megatron tensor-parallel paths: the "
-            "head-aligned qkv column permutation and the per-rank local-"
-            "head split both assume equal q/k/v thirds.  Use GQA on the "
-            "DP / seq-parallel / pipeline(dense-stage) layouts, or "
-            "n_kv_heads=n_heads under TP")
+    kv = getattr(cfg, "kv_heads", cfg.n_heads)
+    if kv % tp:
+        # same divisibility contract (and exception type) as the
+        # d_model/n_heads/d_ff checks below and qkv_tp_permutation
+        raise ValueError(
+            f"GQA under Megatron TP shards the K/V heads over the tensor "
+            f"axis, which needs n_kv_heads % tp == 0; got n_kv_heads={kv} "
+            f"with tp={tp}.  Use a kv-head count divisible by tp, the "
+            f"GSPMD TP path, or n_kv_heads=n_heads")
     for name, dim in (("d_model", cfg.d_model), ("n_heads", cfg.n_heads),
                       ("d_ff", cfg.d_ff)):
         if dim % tp:
@@ -171,9 +192,22 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     qkv = (h.astype(cdt) @ layer_params["qkv"]["w"].astype(cdt)
            + layer_params["qkv"]["b"].astype(cdt))
     b, t, _ = qkv.shape
-    q, k, v = jnp.split(qkv, 3, axis=-1)  # local layout is [q_r | k_r | v_r]
-    shape = (b, t, heads_local, cfg.head_dim)
-    out = attention_fn(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+    # local layout is [q_r | k_r | v_r] (qkv_tp_permutation); under GQA
+    # the k/v spans are kv_local = kv_heads/tp heads wide and rank r's
+    # query heads group onto exactly rank r's K/V heads (contiguous
+    # assignment), so the repeat to local query heads stays rank-local
+    kv_heads = getattr(cfg, "kv_heads", cfg.n_heads)
+    kv_local = kv_heads // tp
+    q_w = heads_local * cfg.head_dim
+    kv_w = kv_local * cfg.head_dim
+    q = qkv[..., :q_w].reshape(b, t, heads_local, cfg.head_dim)
+    k = qkv[..., q_w:q_w + kv_w].reshape(b, t, kv_local, cfg.head_dim)
+    v = qkv[..., q_w + kv_w:].reshape(b, t, kv_local, cfg.head_dim)
+    if kv_local != heads_local:
+        groups = heads_local // kv_local
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+    out = attention_fn(q, k, v)
     out = out.reshape(b, t, heads_local * cfg.head_dim)
     partial = out @ layer_params["attn_out"]["w"].astype(cdt)
     attn = g(partial) + layer_params["attn_out"]["b"].astype(cdt)

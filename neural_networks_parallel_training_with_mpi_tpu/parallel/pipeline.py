@@ -136,7 +136,8 @@ def dense_layer_blocks(blocks: Pytree, model_cfg=None,
 
         blocks = megatron.permute_qkv(blocks, model_cfg.d_model,
                                       model_cfg.n_heads, saved_tp,
-                                      inverse=True)
+                                      inverse=True,
+                                      kv_heads=model_cfg.kv_heads)
     stack = infer_stack_ndims(blocks)
     if stack >= 2:
         return unstack_blocks(blocks, stack_ndims=stack)
@@ -158,7 +159,8 @@ def init_pipeline_params(model: Transformer, key: jax.Array,
         from . import megatron
 
         c = model.cfg
-        blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp)
+        blocks = megatron.permute_qkv(blocks, c.d_model, c.n_heads, tp,
+                                      kv_heads=c.kv_heads)
     params["blocks"] = blocks
     return params
 

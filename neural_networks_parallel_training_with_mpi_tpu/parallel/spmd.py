@@ -210,7 +210,8 @@ def init_sp_tp_state(model, optimizer: Optimizer, key, tp: int) -> TrainState:
         c = model.cfg
         params = dict(params)
         params["blocks"] = megatron.permute_qkv(params["blocks"], c.d_model,
-                                                c.n_heads, tp)
+                                                c.n_heads, tp,
+                                                kv_heads=c.kv_heads)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=optimizer.init(params))
 

@@ -526,9 +526,11 @@ class Trainer:
             b = tree["blocks"]
             if saved_tp > 1:
                 b = megatron.permute_qkv(b, c.d_model, c.n_heads,
-                                         saved_tp, inverse=True)
+                                         saved_tp, inverse=True,
+                                         kv_heads=c.kv_heads)
             if tp > 1:
-                b = megatron.permute_qkv(b, c.d_model, c.n_heads, tp)
+                b = megatron.permute_qkv(b, c.d_model, c.n_heads, tp,
+                                         kv_heads=c.kv_heads)
             tree["blocks"] = b
             return tree
 

@@ -3,9 +3,12 @@ al. 2023).  GQA is mathematically MHA with each K/V head tiled across a
 group of query heads, so the load-bearing test is EXACT equivalence: a
 GQA model must produce the same logits as the MHA twin whose fused-qkv
 K/V columns are tiled group-wise.  The serving win — the KV cache
-holding kv_heads instead of n_heads — is pinned on the decode path, and
-the deliberately-unwired Megatron-TP composition must refuse loudly
-(the head-aligned qkv permutation assumes equal q/k/v thirds)."""
+holding kv_heads instead of n_heads — is pinned on the decode path.
+Under Megatron TP (round 4) the K/V heads shard over the tensor axis
+(n_kv_heads % tp == 0 required, ValueError otherwise): the contiguous
+head-aligned permutation keeps each rank's query-head groups on its own
+K/V heads, pinned here by trajectory parity through the real seq x
+tensor path; only the generate_tp decode path still refuses GQA."""
 
 import jax
 import jax.numpy as jnp
@@ -159,15 +162,81 @@ def test_gqa_trains_under_dp():
     assert np.abs(after - before).max() > 0  # qkv actually updated
 
 
-def test_gqa_refused_under_megatron_tp():
+def test_gqa_tp_validation():
+    """GQA shards K/V heads over the tensor axis (round 4): legal when
+    n_kv_heads % tp == 0, loud otherwise; the TP decode path refuses."""
     from neural_networks_parallel_training_with_mpi_tpu.parallel import (
         megatron,
     )
 
-    with pytest.raises(NotImplementedError, match="GQA"):
-        megatron.validate_tp(_cfg(n_kv_heads=KV), tp=2)
+    megatron.validate_tp(_cfg(n_kv_heads=KV), tp=2)        # 2 % 2 == 0
     megatron.validate_tp(_cfg(), tp=2)                     # MHA fine
-    megatron.validate_tp(_cfg(n_kv_heads=H), tp=2)         # kv==H fine
+    with pytest.raises(ValueError, match="n_kv_heads % tp"):
+        megatron.validate_tp(_cfg(n_kv_heads=1), tp=2)
+
+
+def test_gqa_qkv_tp_permutation_roundtrip():
+    """The GQA-aware column permutation: rank slices hold whole heads
+    with per-rank widths [q: H/tp, k: KV/tp, v: KV/tp] * head_dim, it
+    inverts exactly, and kv_heads=n_heads reduces to the classic
+    equal-thirds layout."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.megatron import (
+        qkv_tp_permutation,
+    )
+
+    tp = 2
+    perm = qkv_tp_permutation(D, H, tp, kv_heads=KV)
+    qkv_dim = D + 2 * KV * HD
+    assert sorted(perm.tolist()) == list(range(qkv_dim))
+    per_rank = qkv_dim // tp
+    # rank 0's slice: q heads 0..H/tp-1, then k/v heads 0..KV/tp-1
+    r0 = perm[:per_rank].tolist()
+    assert r0[:D // tp] == list(range(0, D // tp))                    # q
+    assert r0[D // tp:D // tp + HD] == list(range(D, D + HD))         # k
+    assert r0[D // tp + HD:] == list(range(D + KV * HD,
+                                           D + KV * HD + HD))        # v
+    np.testing.assert_array_equal(
+        qkv_tp_permutation(D, H, tp, kv_heads=H),
+        qkv_tp_permutation(D, H, tp))                      # MHA reduces
+
+
+@pytest.mark.slow
+def test_gqa_sp_tp_trainer_matches_dp():
+    """GQA through the REAL Megatron seq x tensor path (Trainer routes
+    DP x SP x TP to init_sp_tp_state + make_sp_tp_train_step: the
+    GQA-aware qkv permutation, tp_block_apply's per-rank [q|k|v] split
+    with kv_local heads, and the rank-local group repeat) — the full
+    training trajectory must match plain DP on the identical GQA model.
+    A wrong slice boundary in the TP split would diverge at step 1."""
+    import dataclasses
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    def cfg(**mesh_kw):
+        return TrainConfig(
+            nepochs=2, batch_size=32, full_batch=False, shuffle=False,
+            loss="cross_entropy", optimizer="adam", lr=1e-3,
+            data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                            vocab_size=VOCAB),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=D,
+                              n_heads=H, n_kv_heads=KV, d_ff=64,
+                              vocab_size=VOCAB, max_seq_len=16),
+            mesh=MeshConfig(**mesh_kw))
+
+    r_dp = Trainer(cfg(data=8)).fit()
+    c3 = cfg(data=2, seq=2, tensor=2)
+    c3.model = dataclasses.replace(c3.model, attention="ring")
+    t3 = Trainer(c3)
+    assert t3.sp_tp and not t3.gspmd
+    r_3d = t3.fit()
+    assert np.isfinite(r_3d["final_loss"])
+    assert r_3d["final_loss"] == pytest.approx(r_dp["final_loss"],
+                                               rel=2e-4)
 
 
 def test_gqa_composes_with_int8_quant():
