@@ -66,8 +66,10 @@ class TransformerConfig:
     # rotation happens inside sequence_sharded_attention, so every
     # attention impl (dense/flash/ring/striped/ulysses) and every
     # seq-parallel layout inherits it; the KV-cache decode paths rotate
-    # the new position and cache rotated keys.  Not wired into the
-    # explicit Megatron-TP shard_map paths (validate_tp guards).
+    # the new position and cache rotated keys; Megatron-TP dense
+    # attention rotates inside tp_block_apply on its local heads.  Only
+    # the generate_tp decode path refuses RoPE (decode via the dense
+    # paths).
     pos_encoding: str = "learned"      # learned | rope
     rope_theta: float = 10000.0
     # Grouped-query attention (GQA, Ainslie et al. 2023): n_kv_heads < n_heads

@@ -125,14 +125,6 @@ def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
 
 
 def validate_tp(cfg, tp: int) -> None:
-    if (getattr(cfg, "pos_encoding", "learned") == "rope"
-            and cfg.attention == "dense"):
-        raise NotImplementedError(
-            "RoPE with attention='dense' on the Megatron-TP paths is not "
-            "wired: dense attention runs INSIDE tp_block_apply (no "
-            "sequence_sharded_attention hook to rotate q/k).  Use "
-            "attention='flash' or a seq-sharded impl under TP, or "
-            "pos_encoding='learned'")
     if cfg.activation == "swiglu":
         raise NotImplementedError(
             "SwiGLU is not wired into tp_block_apply's column/row-"
@@ -183,8 +175,23 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     heads_local = cfg.n_heads // tp
     ln = LayerNorm(cfg.d_model, param_dtype=cfg.param_dtype)
     if attention_fn is None:
-        attention_fn = lambda q, k, v: attention_reference(q, k, v,
-                                                           causal=True)
+        if getattr(cfg, "pos_encoding", "learned") == "rope":
+            # dense attention runs the full (unsharded) local sequence,
+            # so positions are arange(t); rotation is per-head-
+            # independent, hence correct on this rank's local heads.
+            # Seq-sharded impls arrive as attention_fn closures that
+            # rotate INSIDE sequence_sharded_attention (global
+            # positions) — rotating here too would double-rotate.
+            from ..ops.rope import rope_rotate
+
+            def attention_fn(q, k, v):
+                pos = jnp.arange(q.shape[1])
+                return attention_reference(
+                    rope_rotate(q, pos, cfg.rope_theta),
+                    rope_rotate(k, pos, cfg.rope_theta), v, causal=True)
+        else:
+            attention_fn = lambda q, k, v: attention_reference(
+                q, k, v, causal=True)
 
     # --- attention: column-parallel qkv, local heads, row-parallel out ---
     h = ln.apply(layer_params["ln1"], x)

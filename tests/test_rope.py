@@ -194,16 +194,53 @@ def test_rope_composes_with_gqa_kv8_and_server():
     assert srv.result(rid) == [int(t) for t in np.asarray(want)[0]]
 
 
-def test_rope_tp_guards():
+def test_rope_tp_validates():
+    """RoPE passes TP validation on every attention impl (round 4: the
+    dense branch rotates inside tp_block_apply; seq-sharded impls rotate
+    inside their sequence_sharded_attention closures)."""
     from neural_networks_parallel_training_with_mpi_tpu.parallel import (
         megatron,
     )
 
-    with pytest.raises(NotImplementedError, match="RoPE"):
-        megatron.validate_tp(_cfg(attention="dense"), tp=2)
-    megatron.validate_tp(_cfg(attention="flash"), tp=2)  # wired via hook
+    megatron.validate_tp(_cfg(attention="dense"), tp=2)
+    megatron.validate_tp(_cfg(attention="flash"), tp=2)
     megatron.validate_tp(
         TransformerConfig(d_model=32, n_heads=4, d_ff=64), tp=2)
+
+
+@pytest.mark.slow
+def test_rope_pp_tp_trainer_matches_dp():
+    """RoPE through the REAL pipe x tensor path (dense attention inside
+    tp_block_apply rotates q/k by arange(t) on its local heads): the
+    full training trajectory must match plain DP on the same RoPE
+    model — a double- or missing rotation diverges at step 1."""
+    import dataclasses
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    def cfg(**mesh_kw):
+        return TrainConfig(
+            nepochs=2, batch_size=32, full_batch=False, shuffle=False,
+            loss="cross_entropy", optimizer="adam", lr=1e-3,
+            data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                            vocab_size=VOCAB),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=VOCAB,
+                              max_seq_len=16, pos_encoding="rope"),
+            mesh=MeshConfig(**mesh_kw))
+
+    r_dp = Trainer(cfg(data=8)).fit()
+    t_pt = Trainer(cfg(data=2, pipe=2, tensor=2))
+    assert t_pt.pipeline
+    r_pt = t_pt.fit()
+    assert np.isfinite(r_pt["final_loss"])
+    assert r_pt["final_loss"] == pytest.approx(r_dp["final_loss"],
+                                               rel=2e-4)
 
 
 def test_cli_pos_encoding_flag():
