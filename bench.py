@@ -847,41 +847,16 @@ def preflight_config(config_name: str = "big_lm",
     # recorded as chip_validated.
     rec["chip_validated"] = False
     if config_name == "big_lm":
-        mc = model.cfg
-        sweep_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                  "BIGLM_SWEEP.json")
-        # a row only waives the HBM gate if the shapes it was measured at
-        # are STILL the committed shapes (unstamped rows = LEGACY_SWEEP_SHAPES)
-        try:
-            with open(sweep_path) as f:
-                for row in json.load(f).get("results", []):
-                    if ("error" not in row
-                            and row.get("platform") == "tpu"
-                            and row.get("config",
-                                        LEGACY_SWEEP_SHAPES) == _BIG
-                            and row.get("batch") == cfg["batch"]
-                            and row.get("remat") == mc.remat
-                            and (not mc.remat
-                                 or row.get("policy") == mc.remat_policy)
-                            and row.get("attention") == mc.attention
-                            and row.get("ce_chunk", 0) == mc.ce_chunk
-                            and row.get("scan_layers", True)
-                            == mc.scan_layers
-                            # kernel-tile overrides (tools/big_lm_sweep
-                            # stamps non-shape overrides separately from
-                            # the shape config): a row measured at a
-                            # non-default tiling only validates a
-                            # committed config with the SAME tiling
-                            and row.get("tf_overrides", {}).get(
-                                "flash_block_q", 128) == mc.flash_block_q
-                            and row.get("tf_overrides", {}).get(
-                                "flash_block_k", 128) == mc.flash_block_k):
-                        rec["chip_validated"] = True
-                        rec["chip_row"] = {k: row.get(k) for k in
-                                           ("label", "step_ms", "mfu")}
-                        break
-        except (OSError, ValueError):
-            pass
+        # a row only waives the HBM gate if every knob it was measured
+        # at is STILL the committed configuration (shapes, batch, remat,
+        # attention, ce_chunk, scan_layers, kernel tiles —
+        # committed_big_lm_sweep_row; unstamped rows fall back to
+        # LEGACY_SWEEP_SHAPES and cannot match a changed config)
+        row = committed_big_lm_sweep_row(model.cfg, cfg["batch"])
+        if row is not None:
+            rec["chip_validated"] = True
+            rec["chip_row"] = {k: row.get(k) for k in
+                               ("label", "step_ms", "mfu")}
     rec["ok"] = bool(rec["eval_shape_ok"] and rec["lower_compile_ok"]
                      and (rec["fits_hbm"] or rec["chip_validated"])
                      and (smoke.get("ok", True)))
@@ -1522,6 +1497,37 @@ def merge_artifact_rows(path: str, new_rows: list, key: str = "label"
     return merged
 
 
+def committed_big_lm_sweep_row(mc, batch: int) -> dict | None:
+    """The BIGLM_SWEEP.json TPU row measured at EXACTLY the committed
+    big_lm configuration (shapes + batch + remat/attention/ce_chunk/
+    scan_layers + kernel-tile overrides), or None.  Shared by the
+    preflight's chip_validated gate and the CPU-fallback headline: a row
+    only speaks for the committed config if every knob matches."""
+    sweep_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "BIGLM_SWEEP.json")
+    try:
+        with open(sweep_path) as f:
+            rows = json.load(f).get("results", [])
+    except (OSError, ValueError):
+        return None
+    for row in rows:
+        if ("error" not in row
+                and row.get("platform") == "tpu"
+                and row.get("config", LEGACY_SWEEP_SHAPES) == _BIG
+                and row.get("batch") == batch
+                and row.get("remat") == mc.remat
+                and (not mc.remat or row.get("policy") == mc.remat_policy)
+                and row.get("attention") == mc.attention
+                and row.get("ce_chunk", 0) == mc.ce_chunk
+                and row.get("scan_layers", True) == mc.scan_layers
+                and row.get("tf_overrides", {}).get(
+                    "flash_block_q", 128) == mc.flash_block_q
+                and row.get("tf_overrides", {}).get(
+                    "flash_block_k", 128) == mc.flash_block_k):
+            return row
+    return None
+
+
 def load_tpu_latest() -> dict | None:
     try:
         with open(TPU_LATEST_PATH) as f:
@@ -1758,14 +1764,57 @@ def main() -> int:
         if cached:
             row = next((r for r in cached.get("records", [])
                         if r.get("metric") == primary["metric"]), None)
+        if args.config == "big_lm":
+            # BENCH_TPU_LATEST's big_lm row may predate a config flip
+            # (it does not record scan_layers/ce_chunk); a BIGLM_SWEEP
+            # chip row matched against EVERY committed knob is the
+            # stronger cached evidence — synthesize the headline from it
+            # with explicit source provenance.
+            import jax.numpy as _jnp
+
+            srow = committed_big_lm_sweep_row(
+                _make_config("big_lm")["make_model"](_jnp.bfloat16).cfg,
+                _make_config("big_lm")["batch"])
+            if srow is not None:
+                try:
+                    with open(os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "BIGLM_SWEEP.json")) as f:
+                        sweep_doc = json.load(f)
+                    sweep_iso = sweep_doc.get("captured_iso")
+                    sweep_age = round(
+                        (time.time() - sweep_doc["captured_unix"]) / 3600,
+                        2)
+                except (OSError, ValueError, KeyError):
+                    sweep_iso, sweep_age = None, None
+                row = {
+                    "captured_iso": sweep_iso, "age_hours": sweep_age,
+                    "metric": primary["metric"],
+                    "value": srow.get("samples_per_sec"),
+                    "unit": "samples/sec", "vs_baseline": None,
+                    "platform": "tpu",
+                    "device_kind": srow.get("device_kind"),
+                    "n_devices": 1, "mfu": srow.get("mfu"),
+                    "step_ms": srow.get("step_ms"),
+                    "batch": srow.get("batch"),
+                    "source": "BIGLM_SWEEP.json",
+                    "source_label": srow.get("label"),
+                    "source_note": (
+                        "sweep row measured on-chip at exactly the "
+                        "committed config (every knob matched by "
+                        "committed_big_lm_sweep_row); preferred over "
+                        "BENCH_TPU_LATEST's row, which does not record "
+                        "config flags and may predate a config flip"),
+                }
         if row:
             demoted = dict(primary)
             demoted["role"] = "mechanism_check_on_fallback_host"
             primary = dict(row)
             primary["measurement"] = "cached_tpu"
             primary["platform_fallback"] = True
-            primary["captured_iso"] = cached.get("captured_iso")
-            primary["age_hours"] = cached.get("age_hours")
+            if "captured_iso" not in primary:
+                primary["captured_iso"] = (cached or {}).get("captured_iso")
+                primary["age_hours"] = (cached or {}).get("age_hours")
             primary["note"] = (
                 "capture-time probe failed (history in 'probe'); headline "
                 "is the latest successful real-chip measurement from this "

@@ -79,3 +79,43 @@ def test_merge_artifact_rows(tmp_path):
     merged2 = bench.merge_artifact_rows(str(tmp_path / "nope.json"),
                                         [{"label": "x", "mfu": 1.0}])
     assert merged2 == [{"label": "x", "mfu": 1.0}]
+
+
+def test_committed_big_lm_sweep_row_matching():
+    """The shared matcher behind the preflight's chip_validated gate AND
+    the CPU-fallback headline: a BIGLM_SWEEP row speaks for the committed
+    big_lm config only when EVERY knob matches (shapes, batch, remat,
+    attention, ce_chunk, scan_layers, kernel tiles)."""
+    sys.path.insert(0, REPO)
+    import jax.numpy as jnp
+
+    import bench
+
+    cfg = bench._make_config("big_lm")
+    mc = cfg["make_model"](jnp.bfloat16).cfg
+
+    # the real artifact must contain a row for the committed config —
+    # this is the invariant that keeps `bench.py --config big_lm` honest
+    # on a wedged tunnel (the headline quotes a chip measurement of
+    # exactly the committed knobs, stamped with its sweep label)
+    row = bench.committed_big_lm_sweep_row(mc, cfg["batch"])
+    assert row is not None, (
+        "no BIGLM_SWEEP.json chip row matches the committed big_lm "
+        "config — re-run tools/big_lm_sweep.py on the chip or revert "
+        "the config flip")
+    assert row.get("platform") == "tpu" and row.get("mfu")
+    assert row.get("scan_layers") == mc.scan_layers
+    assert row.get("ce_chunk", 0) == mc.ce_chunk
+
+    # every knob is load-bearing: flip one -> no match.  (scan_layers is
+    # NOT in this list on purpose: flipping it back to True matches the
+    # genuine scanned-config chip rows from the earlier sweep windows —
+    # exactly the legacy-default semantics the matcher implements.)
+    import dataclasses
+    for flip in (dict(ce_chunk=mc.ce_chunk + 128),
+                 dict(remat=not mc.remat),
+                 dict(attention="dense"),
+                 dict(flash_block_k=512)):
+        assert bench.committed_big_lm_sweep_row(
+            dataclasses.replace(mc, **flip), cfg["batch"]) is None, flip
+    assert bench.committed_big_lm_sweep_row(mc, cfg["batch"] + 1) is None
