@@ -1497,19 +1497,24 @@ def merge_artifact_rows(path: str, new_rows: list, key: str = "label"
     return merged
 
 
-def committed_big_lm_sweep_row(mc, batch: int) -> dict | None:
+def committed_big_lm_sweep_row(mc, batch: int,
+                               return_doc: bool = False):
     """The BIGLM_SWEEP.json TPU row measured at EXACTLY the committed
     big_lm configuration (shapes + batch + remat/attention/ce_chunk/
     scan_layers + kernel-tile overrides), or None.  Shared by the
     preflight's chip_validated gate and the CPU-fallback headline: a row
-    only speaks for the committed config if every knob matches."""
+    only speaks for the committed config if every knob matches.
+    ``return_doc=True`` returns ``(row, parsed_doc)`` so the caller can
+    read capture timestamps without re-parsing the artifact."""
     sweep_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BIGLM_SWEEP.json")
     try:
         with open(sweep_path) as f:
-            rows = json.load(f).get("results", [])
+            doc = json.load(f)
+        rows = doc.get("results", [])
     except (OSError, ValueError):
-        return None
+        return (None, None) if return_doc else None
+    match = None
     for row in rows:
         if ("error" not in row
                 and row.get("platform") == "tpu"
@@ -1524,8 +1529,9 @@ def committed_big_lm_sweep_row(mc, batch: int) -> dict | None:
                     "flash_block_q", 128) == mc.flash_block_q
                 and row.get("tf_overrides", {}).get(
                     "flash_block_k", 128) == mc.flash_block_k):
-            return row
-    return None
+            match = row
+            break
+    return (match, doc) if return_doc else match
 
 
 def load_tpu_latest() -> dict | None:
@@ -1772,20 +1778,17 @@ def main() -> int:
             # with explicit source provenance.
             import jax.numpy as _jnp
 
-            srow = committed_big_lm_sweep_row(
-                _make_config("big_lm")["make_model"](_jnp.bfloat16).cfg,
-                _make_config("big_lm")["batch"])
+            big_cfg = _make_config("big_lm")
+            srow, sweep_doc = committed_big_lm_sweep_row(
+                big_cfg["make_model"](_jnp.bfloat16).cfg,
+                big_cfg["batch"], return_doc=True)
             if srow is not None:
                 try:
-                    with open(os.path.join(
-                            os.path.dirname(os.path.abspath(__file__)),
-                            "BIGLM_SWEEP.json")) as f:
-                        sweep_doc = json.load(f)
                     sweep_iso = sweep_doc.get("captured_iso")
                     sweep_age = round(
                         (time.time() - sweep_doc["captured_unix"]) / 3600,
                         2)
-                except (OSError, ValueError, KeyError):
+                except (KeyError, TypeError):
                     sweep_iso, sweep_age = None, None
                 row = {
                     "captured_iso": sweep_iso, "age_hours": sweep_age,
@@ -1817,11 +1820,11 @@ def main() -> int:
                 primary["age_hours"] = (cached or {}).get("age_hours")
             primary["note"] = (
                 "capture-time probe failed (history in 'probe'); headline "
-                "is the latest successful real-chip measurement from this "
-                "repo (BENCH_TPU_LATEST.json, refreshed on every TPU "
-                "capture); 'cpu_fallback_run' is THIS run's mechanism "
-                "check on the single-core fallback host, not a framework "
-                "performance claim")
+                "is a prior successful real-chip measurement from this "
+                f"repo ({primary.get('source', 'BENCH_TPU_LATEST.json')}); "
+                "'cpu_fallback_run' is THIS run's mechanism check on the "
+                "single-core fallback host, not a framework performance "
+                "claim")
             primary["cpu_fallback_run"] = demoted
             primary["probe"] = probe_rec
         else:

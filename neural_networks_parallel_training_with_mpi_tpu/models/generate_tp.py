@@ -11,9 +11,13 @@ decodes *without* ever assembling dense replicated params —
 * **Megatron blocks, incremental.**  Each tensor rank holds its head-aligned
   qkv / ff_in column shards and attn_out / ff_out row shards (the training
   layout, ``parallel.megatron``); the per-chunk forward runs attention over
-  ``n_heads / tp`` LOCAL heads against a KV cache sharded over 'tensor' on
-  the heads dim, with one psum per row-parallel matmul (no backward here,
-  so plain ``lax.psum`` replaces the f/g custom-vjp pair).
+  ``n_heads / tp`` LOCAL query heads against a KV cache holding
+  ``kv_heads / tp`` heads (== n_heads/tp for classic multi-head; under GQA
+  the grouped heads — rank-local by the contiguous permutation — stack the
+  cache shrink on top of the head sharding, with RoPE rotating the local
+  heads at the chunk's absolute positions), one psum per row-parallel
+  matmul (no backward here, so plain ``lax.psum`` replaces the f/g
+  custom-vjp pair).
 * **Vocab-parallel logits + sampling.**  With ``vocab_parallel=True`` the
   head matmul produces only the LOCAL ``(B, V/tp)`` logits shard
   (``megatron.vocab_parallel_logits``); greedy decoding argmaxes across the
@@ -53,9 +57,12 @@ TENSOR_AXIS = "tensor"
 
 
 def init_tp_kv_cache(model: Transformer, batch: int, max_len: int, tp: int):
-    """Per-layer (k, v) buffers with LOCAL heads: (B, max_len, H/tp, Dh)."""
+    """Per-layer (k, v) buffers with LOCAL heads: (B, max_len, KV/tp, Dh)
+    — under GQA the cache holds this rank's kv_heads/tp grouped heads
+    (the same per-rank assignment as training, megatron.qkv_tp_permutation),
+    stacking the GQA cache shrink on top of the head sharding."""
     c = model.cfg
-    shape = (batch, max_len, c.n_heads // tp, c.head_dim)
+    shape = (batch, max_len, c.kv_heads // tp, c.head_dim)
     zeros = lambda: jnp.zeros(shape, c.compute_dtype)
     return [{"k": zeros(), "v": zeros()} for _ in range(c.n_layers)]
 
@@ -79,23 +86,52 @@ def _tp_block_chunk(cfg, lp, cache, x, pos, heads_local: int,
     qkv = (h.astype(cdt) @ lp["qkv"]["w"].astype(cdt)
            + lp["qkv"]["b"].astype(cdt))
     b, s, _ = qkv.shape
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    shape = (b, s, heads_local, cfg.head_dim)
-    q, k, v = q.reshape(shape), k.reshape(shape), v.reshape(shape)
+    # local layout is [q_r | k_r | v_r] (megatron.qkv_tp_permutation);
+    # under GQA the k/v spans hold this rank's kv_heads/tp heads, whose
+    # query-head groups are exactly this rank's (contiguous assignment)
+    tp = cfg.n_heads // heads_local
+    kv_local = cfg.kv_heads // tp
+    q_w = heads_local * cfg.head_dim
+    kv_w = kv_local * cfg.head_dim
+    q = qkv[..., :q_w].reshape(b, s, heads_local, cfg.head_dim)
+    k = qkv[..., q_w:q_w + kv_w].reshape(b, s, kv_local, cfg.head_dim)
+    v = qkv[..., q_w + kv_w:].reshape(b, s, kv_local, cfg.head_dim)
+    if cfg.pos_encoding == "rope":
+        # rotation is per-head-independent, so this rank's local heads
+        # rotate correctly; cached keys are stored rotated (standard)
+        from ..ops.rope import rope_rotate
+
+        chunk_pos = pos + jnp.arange(s)
+        q = rope_rotate(q, chunk_pos, cfg.rope_theta)
+        k = rope_rotate(k, chunk_pos, cfg.rope_theta)
     new_k = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
                                      (0, pos, 0, 0))
     new_v = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
                                      (0, pos, 0, 0))
     scale = 1.0 / jnp.sqrt(jnp.asarray(cfg.head_dim, jnp.float32))
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        new_k.astype(jnp.float32)) * scale
     T = cache["k"].shape[1]
     mask = (jnp.arange(T)[None, None, None, :]
             <= pos + jnp.arange(s)[None, None, :, None])
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                     new_v.astype(jnp.float32)).astype(x.dtype)
+    if kv_local == heads_local:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            new_k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                         new_v.astype(jnp.float32)).astype(x.dtype)
+    else:
+        # GQA: grouped-head attention on the local cache — the repeat
+        # stays virtual (an einsum batch dim), mirroring the dense
+        # decode's grouped branch (models.generate._block_chunk)
+        g = heads_local // kv_local
+        q5 = q.reshape(b, s, kv_local, g, cfg.head_dim)
+        logits = jnp.einsum("bqcgd,bkcd->bcgqk", q5.astype(jnp.float32),
+                            new_k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask[:, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
+                         new_v.astype(jnp.float32)).astype(x.dtype)
+        out = out.reshape(b, s, heads_local, cfg.head_dim)
     out = out.reshape(b, s, heads_local * cfg.head_dim)
     partial = out.astype(cdt) @ lp["attn_out"]["w"].astype(cdt)
     attn = lax.psum(partial, axis) + lp["attn_out"]["b"].astype(cdt)
@@ -173,21 +209,6 @@ def _tp_decode_program(model: Transformer, mesh, max_new_tokens: int,
     c = model.cfg
     tp = int(mesh.shape[TENSOR_AXIS])
     megatron.validate_tp(c, tp)
-    if getattr(c, "pos_encoding", "learned") == "rope":
-        raise NotImplementedError(
-            "RoPE is not wired into the tensor-parallel decode path "
-            "(generate_tp runs its own head-sharded cache attention); "
-            "decode RoPE checkpoints with models.generate / "
-            "generate_sharded, or train with pos_encoding='learned' "
-            "for TP serving")
-    if c.kv_heads != c.n_heads:
-        raise NotImplementedError(
-            "GQA is not wired into the tensor-parallel decode path "
-            "(its head-sharded KV cache and chunk attention assume "
-            "equal q/k/v thirds); GQA TRAINS under Megatron TP "
-            "(tp_block_apply), and GQA checkpoints decode via "
-            "models.generate / generate_sharded after layout "
-            "reconciliation")
     heads_local = c.n_heads // tp
     if vocab_parallel and c.vocab_size % tp:
         raise ValueError(f"vocab_size={c.vocab_size} not divisible by "

@@ -65,11 +65,10 @@ class TransformerConfig:
     # all, relative-distance attention, fused elementwise on TPU).  The
     # rotation happens inside sequence_sharded_attention, so every
     # attention impl (dense/flash/ring/striped/ulysses) and every
-    # seq-parallel layout inherits it; the KV-cache decode paths rotate
-    # the new position and cache rotated keys; Megatron-TP dense
-    # attention rotates inside tp_block_apply on its local heads.  Only
-    # the generate_tp decode path refuses RoPE (decode via the dense
-    # paths).
+    # seq-parallel layout inherits it; the KV-cache decode paths
+    # (dense AND the native-TP generate_tp) rotate the new position and
+    # cache rotated keys; Megatron-TP dense attention rotates inside
+    # tp_block_apply on its local heads.
     pos_encoding: str = "learned"      # learned | rope
     rope_theta: float = 10000.0
     # Grouped-query attention (GQA, Ainslie et al. 2023): n_kv_heads < n_heads
@@ -82,9 +81,9 @@ class TransformerConfig:
     # (same math, unchanged kernels).  Under Megatron TP the K/V heads
     # shard over the tensor axis too (needs n_kv_heads % tp == 0; the
     # contiguous head-aligned permutation keeps each rank's query-head
-    # groups on exactly its own K/V heads — qkv_tp_permutation).  The
-    # generate_tp decode path refuses GQA (its head-sharded cache
-    # assumes equal thirds); GQA checkpoints decode via the dense paths.
+    # groups on exactly its own K/V heads — qkv_tp_permutation), and the
+    # native-TP decode (generate_tp) serves the kv_heads/tp-sharded
+    # cache with grouped local attention.
     n_kv_heads: Optional[int] = None
     # Pallas flash-kernel tile sizes (flash / ring_flash / striped_flash
     # only; dense and the non-flash ring ignore them).  128 x 128 is the

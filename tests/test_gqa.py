@@ -8,7 +8,7 @@ Under Megatron TP (round 4) the K/V heads shard over the tensor axis
 (n_kv_heads % tp == 0 required, ValueError otherwise): the contiguous
 head-aligned permutation keeps each rank's query-head groups on its own
 K/V heads, pinned here by trajectory parity through the real seq x
-tensor path; only the generate_tp decode path still refuses GQA."""
+tensor path and by token-exact native-TP decode (test_generate_tp)."""
 
 import jax
 import jax.numpy as jnp
@@ -164,7 +164,7 @@ def test_gqa_trains_under_dp():
 
 def test_gqa_tp_validation():
     """GQA shards K/V heads over the tensor axis (round 4): legal when
-    n_kv_heads % tp == 0, loud otherwise; the TP decode path refuses."""
+    n_kv_heads % tp == 0, loud otherwise."""
     from neural_networks_parallel_training_with_mpi_tpu.parallel import (
         megatron,
     )
