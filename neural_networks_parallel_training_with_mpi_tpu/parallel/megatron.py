@@ -125,11 +125,6 @@ def permute_qkv(blocks: Pytree, d_model: int, n_heads: int, tp: int,
 
 
 def validate_tp(cfg, tp: int) -> None:
-    if cfg.activation == "swiglu":
-        raise NotImplementedError(
-            "SwiGLU is not wired into tp_block_apply's column/row-"
-            "parallel FFN pair (it assumes the classic 2-matmul FFN); "
-            "use the GSPMD TP path or a dense-FFN activation")
     kv = getattr(cfg, "kv_heads", cfg.n_heads)
     if kv % tp:
         # same divisibility contract (and exception type) as the
@@ -228,7 +223,17 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
     h = f(h)
     hh = (h.astype(cdt) @ layer_params["ff_in"]["w"].astype(cdt)
           + layer_params["ff_in"]["b"].astype(cdt))
-    hh = ACTIVATIONS[cfg.activation](hh)
+    if cfg.activation == "swiglu":
+        # SwiGLU (round 4): the gate is column-parallel with the SAME
+        # column partition as ff_in, so the elementwise gated product of
+        # the two local shards IS the local shard of the global product —
+        # no extra collective before the row-parallel ff_out
+        gate = jax.nn.silu(
+            h.astype(cdt) @ layer_params["ff_gate"]["w"].astype(cdt)
+            + layer_params["ff_gate"]["b"].astype(cdt))
+        hh = gate * hh
+    else:
+        hh = ACTIVATIONS[cfg.activation](hh)
     ff = (g(hh @ layer_params["ff_out"]["w"].astype(cdt))
           + layer_params["ff_out"]["b"].astype(cdt))
     return x + ff.astype(x.dtype)
@@ -345,4 +350,5 @@ def tensor_sharded_block_paths() -> Tuple[Tuple[str, str], ...]:
     ff_out.b — is tensor-replicated with identical grads on every rank,
     which the f operator's backward psum guarantees)."""
     return (("qkv", "w"), ("qkv", "b"), ("ff_in", "w"), ("ff_in", "b"),
+            ("ff_gate", "w"), ("ff_gate", "b"),   # SwiGLU: col like ff_in
             ("attn_out", "w"), ("ff_out", "w"))

@@ -176,7 +176,8 @@ def sp_tp_param_specs(params: Pytree, vocab_parallel: bool = False) -> Pytree:
             return tspec if tspec is not None else P()
         if not megatron.is_tensor_sharded(names):
             return P()
-        col = "qkv" in names or "ff_in" in names
+        col = ("qkv" in names or "ff_in" in names
+               or "ff_gate" in names)   # SwiGLU gate: column like ff_in
         # scan_layers stacks a leading (n_layers,) dim on every block leaf
         # (replicated); the Megatron col/row dims shift right by one
         if names[-1] == "w" and ndim in (2, 3):

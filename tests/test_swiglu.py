@@ -129,10 +129,46 @@ def test_guards():
         megatron,
     )
 
-    with pytest.raises(NotImplementedError, match="SwiGLU"):
-        megatron.validate_tp(_cfg(), tp=2)
+    megatron.validate_tp(_cfg(), tp=2)  # SwiGLU wired under TP (round 4)
     with pytest.raises(NotImplementedError, match="SwiGLU experts"):
         Transformer(_cfg(moe_experts=4)).init(prng.init_key(0))
+
+
+@pytest.mark.slow
+def test_swiglu_sp_tp_trainer_matches_dp():
+    """SwiGLU through the REAL Megatron seq x tensor path: the gate is
+    column-parallel with ff_in's exact column partition, so the local
+    gated product is the local shard of the global one — pinned by full
+    training-trajectory parity against plain DP on the same model."""
+    import dataclasses
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    def cfg(**mesh_kw):
+        return TrainConfig(
+            nepochs=2, batch_size=32, full_batch=False, shuffle=False,
+            loss="cross_entropy", optimizer="adam", lr=1e-3,
+            data=DataConfig(dataset="lm", n_samples=64, seq_len=16,
+                            vocab_size=VOCAB),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=48, ffn_activation="swiglu",
+                              vocab_size=VOCAB, max_seq_len=16),
+            mesh=MeshConfig(**mesh_kw))
+
+    r_dp = Trainer(cfg(data=8)).fit()
+    c3 = cfg(data=2, seq=2, tensor=2)
+    c3.model = dataclasses.replace(c3.model, attention="ring")
+    t3 = Trainer(c3)
+    assert t3.sp_tp
+    r_3d = t3.fit()
+    assert np.isfinite(r_3d["final_loss"])
+    assert r_3d["final_loss"] == pytest.approx(r_dp["final_loss"],
+                                               rel=2e-4)
 
 
 def test_cli_ffn_activation_flag():
