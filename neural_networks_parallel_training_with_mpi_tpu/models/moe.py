@@ -73,17 +73,25 @@ class MoEFFN(Module):
     router_top_k: int = 1
 
     def init(self, key: jax.Array) -> Pytree:
-        kg, k1, k2, k3, k4 = jax.random.split(key, 5)
+        kg, k1, k2, k3, k4, k5, k6 = jax.random.split(key, 7)
         e, d, f = self.n_experts, self.d_model, self.d_ff
         bd, bf = 1.0 / math.sqrt(d), 1.0 / math.sqrt(f)
+        experts = {
+            "w_in": _uniform(k1, (e, d, f), bd, self.param_dtype),
+            "b_in": _uniform(k2, (e, f), bd, self.param_dtype),
+            "w_out": _uniform(k3, (e, f, d), bf, self.param_dtype),
+            "b_out": _uniform(k4, (e, d), bf, self.param_dtype),
+        }
+        if self.activation == "swiglu":
+            # gated experts (round 4): silu(x W_gate) * (x W_in) per
+            # expert — same column layout as w_in/b_in, so the tensor-
+            # sharding spec and the EP dispatch treat it identically
+            experts["w_gate"] = _uniform(k5, (e, d, f), bd,
+                                         self.param_dtype)
+            experts["b_gate"] = _uniform(k6, (e, f), bd, self.param_dtype)
         return {
             "gate": {"w": _uniform(kg, (d, e), bd, self.param_dtype)},
-            "experts": {
-                "w_in": _uniform(k1, (e, d, f), bd, self.param_dtype),
-                "b_in": _uniform(k2, (e, f), bd, self.param_dtype),
-                "w_out": _uniform(k3, (e, f, d), bf, self.param_dtype),
-                "b_out": _uniform(k4, (e, d), bf, self.param_dtype),
-            },
+            "experts": experts,
         }
 
     # ---- routing -------------------------------------------------------
@@ -182,7 +190,18 @@ class MoEFFN(Module):
             # scale folded into the einsum output BEFORE bias/activation
             h = h * ep["w_in_scale"][:, None, :].astype(cdt)
         h = h + ep["b_in"][:, None, :].astype(cdt)
-        h = ACTIVATIONS[self.activation](h)
+        if self.activation == "swiglu":
+            # gated experts: the gate shares w_in's column layout, so
+            # under tensor sharding the local gated product is the local
+            # shard of the global one (same argument as the dense TP FFN)
+            gate = jnp.einsum("esd,edf->esf", slots.astype(cdt),
+                              ep["w_gate"].astype(cdt))
+            if "w_gate_scale" in ep:
+                gate = gate * ep["w_gate_scale"][:, None, :].astype(cdt)
+            gate = gate + ep["b_gate"][:, None, :].astype(cdt)
+            h = jax.nn.silu(gate) * h
+        else:
+            h = ACTIVATIONS[self.activation](h)
         out = jnp.einsum("esf,efd->esd", h, ep["w_out"].astype(cdt))
         if "w_out_scale" in ep:
             out = out * ep["w_out_scale"][:, None, :].astype(cdt)

@@ -163,11 +163,6 @@ class Transformer(Module):
             "ln2": LayerNorm(c.d_model, param_dtype=c.param_dtype),
         }
         if c.moe_experts > 0:
-            if c.activation == "swiglu":
-                raise NotImplementedError(
-                    "SwiGLU experts are not wired (MoEFFN's expert einsum "
-                    "is the classic 2-matmul FFN); use a dense-FFN "
-                    "activation with moe_experts > 0")
             from .moe import MoEFFN
 
             mods["moe"] = MoEFFN(

@@ -133,7 +133,9 @@ def quantize_params(params: Pytree,
                 return out
             if _is_expert_params(node):
                 out = dict(node)
-                for key in ("w_in", "w_out"):
+                for key in ("w_in", "w_out", "w_gate"):
+                    if key not in node:   # w_gate: SwiGLU experts only
+                        continue
                     q, s = quantize_array(node[key])
                     out[key] = q
                     out[key + "_scale"] = s

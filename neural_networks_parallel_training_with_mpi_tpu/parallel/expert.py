@@ -320,7 +320,8 @@ TENSOR_AXIS = "tensor"
 # axes, and parallel.pipeline's PP x EP x TP specs/clip, so the four
 # sites cannot desynchronize (same role megatron.is_tensor_sharded plays
 # for the attention/dense-FFN leaves).
-TENSOR_SHARDED_EXPERT_LEAVES = ("w_in", "b_in", "w_out")
+TENSOR_SHARDED_EXPERT_LEAVES = ("w_in", "b_in", "w_gate", "b_gate",
+                                "w_out")  # w_gate/b_gate: SwiGLU experts
 
 
 def expert_leaf_tensor_spec(leaf_name: str, ndim: int,
