@@ -231,7 +231,8 @@ def generate(model: Transformer, params, prompt: jax.Array,
              top_k: int = 0, top_p: float = 1.0,
              key: Optional[jax.Array] = None,
              prompt_lens: Optional[jax.Array] = None,
-             pad_id: int = 0, kv_quant: bool = False) -> jax.Array:
+             pad_id: int = 0, kv_quant: bool = False,
+             prefill_chunk: int = 0) -> jax.Array:
     """Decode ``max_new_tokens`` after ``prompt`` (B, P) -> (B, P + N).
 
     ``temperature=0`` is greedy argmax; otherwise softmax sampling at the
@@ -248,6 +249,12 @@ def generate(model: Transformer, params, prompt: jax.Array,
     bytes re-streamed per step vs the bf16-compute cache (~4x vs f32),
     the long-context serving lever that stacks with GQA and int8
     weights.  Also accepted by :func:`generate_sharded`.
+
+    ``prefill_chunk > 0`` prefills the prompt in chunks of that many
+    positions instead of one (B, P) pass: peak prefill attention memory
+    drops from O(P·T) scores to O(chunk·T) — the long-PROMPT lever;
+    identical tokens (chunk boundaries only change which query rows
+    share a pass).  Ignored on the ragged path (already sequential).
 
     Wrap in ``jax.jit`` (static: model, max_new_tokens, temperature,
     top_k, top_p, kv_quant) for repeated use; shapes are static so
@@ -297,11 +304,27 @@ def generate(model: Transformer, params, prompt: jax.Array,
 
     if ragged:  # fully sequential: per-row start positions
         start = 0
-    else:  # prefill: all P prompt positions in one parallel chunk
-        logits, caches = _forward_chunk(model, params, caches,
-                                        tokens[:, :p], 0)
-        first, key = _sample(logits[:, p - 1], temperature, key, top_k,
-                             top_p)
+    else:  # prefill: prompt positions in parallel chunks
+        if 0 < prefill_chunk < p:
+            # chunked prefill (long-context serving): attention scores
+            # for a chunk are (B, H, C, T) instead of (B, H, P, T), so
+            # peak prefill memory is bounded by the chunk size while the
+            # cache still fills left to right (each chunk attends over
+            # everything already written, mirroring _block_chunk's
+            # causal mask at its start offset).  Chunk boundaries don't
+            # change the math — only which query rows share a pass.
+            logits = None
+            for off in range(0, p, prefill_chunk):
+                c_len = min(prefill_chunk, p - off)
+                logits, caches = _forward_chunk(
+                    model, params, caches, tokens[:, off:off + c_len],
+                    off)
+            last_logits = logits[:, -1]   # final chunk ends at p - 1
+        else:
+            logits, caches = _forward_chunk(model, params, caches,
+                                            tokens[:, :p], 0)
+            last_logits = logits[:, p - 1]
+        first, key = _sample(last_logits, temperature, key, top_k, top_p)
         tokens = lax.dynamic_update_slice(tokens, first[:, None], (0, p))
         start = p
     if start < total - 1:

@@ -167,3 +167,36 @@ def test_generate_sharded_matches_single_device(lm):
 
     with _pytest.raises(ValueError, match="not divisible"):
         generate_sharded(model, params, prompt[:3], mesh, 2)
+
+
+def test_chunked_prefill_token_exact():
+    """prefill_chunk bounds prefill attention memory (O(chunk * T)
+    scores instead of O(P * T)); tokens must be identical to the
+    one-pass prefill for even and uneven chunk boundaries, and compose
+    with kv_quant and GQA."""
+    import numpy as np
+
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    for kw in ({}, {"n_kv_heads": 2}):
+        model = Transformer(TransformerConfig(
+            vocab_size=64, max_seq_len=64, n_layers=2, d_model=32,
+            n_heads=4, d_ff=64, **kw))
+        params = model.init(prng.init_key(0))
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(rng.integers(0, 64, (2, 13)), jnp.int32)
+        want = generate(model, params, prompt, 10)
+        for chunk in (4, 5, 13, 64):   # uneven, even-ish, ==P, >P
+            got = generate(model, params, prompt, 10,
+                           prefill_chunk=chunk)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want), err_msg=str(
+                                              (kw, chunk)))
+        kv8_want = generate(model, params, prompt, 10, kv_quant=True)
+        kv8_got = generate(model, params, prompt, 10, kv_quant=True,
+                           prefill_chunk=4)
+        np.testing.assert_array_equal(np.asarray(kv8_got),
+                                      np.asarray(kv8_want))
