@@ -113,12 +113,17 @@ def digits_quality() -> dict:
     }
 
 
-def docs_lm_quality() -> dict:
+def docs_lm_quality(modern: bool = False) -> dict:
     """Byte-level LM on REAL text — this repo's own documentation corpus
     (~100KB of English/markdown, zero egress).  The bar is self-calibrating:
     held-out perplexity must beat the corpus's UNIGRAM perplexity (byte
     frequency entropy), i.e. the model must have learned CONTEXT, not just
-    character frequencies."""
+    character frequencies.
+
+    ``modern=True`` trains the round-4 model family instead — RoPE
+    rotary positions, SwiGLU gated FFN, GQA (2 of 4 KV heads) — to the
+    SAME bar: the stack must LEARN on real data, not merely pass parity
+    tests."""
     import math
     import tempfile
     from pathlib import Path
@@ -149,8 +154,11 @@ def docs_lm_quality() -> dict:
             data=DataConfig(dataset="text", text_file=path, seq_len=128,
                             val_fraction=0.1),
             model=ModelConfig(arch="transformer", n_layers=2, d_model=64,
-                              n_heads=4, d_ff=256, vocab_size=256,
-                              max_seq_len=128),
+                              n_heads=4, d_ff=192 if modern else 256,
+                              vocab_size=256, max_seq_len=128,
+                              **(dict(pos_encoding="rope",
+                                      ffn_activation="swiglu",
+                                      n_kv_heads=2) if modern else {})),
             mesh=MeshConfig(data=8),
         )
         res = Trainer(cfg).fit()
@@ -160,7 +168,8 @@ def docs_lm_quality() -> dict:
         _os.unlink(path)
     ppl = float(res.get("val_ppl", float("inf")))
     return {
-        "config": "docs_text_lm_perplexity",
+        "config": ("docs_text_lm_perplexity_modern_stack" if modern
+                   else "docs_text_lm_perplexity"),
         "val_ppl": round(ppl, 2),
         "unigram_ppl_bar": round(unigram_ppl, 2),
         "corpus_bytes": len(corpus),
@@ -169,7 +178,8 @@ def docs_lm_quality() -> dict:
 
 
 def main() -> int:
-    records = [toy_parity(), digits_quality(), docs_lm_quality()]
+    records = [toy_parity(), digits_quality(), docs_lm_quality(),
+               docs_lm_quality(modern=True)]
     with open("QUALITY.json", "w") as f:
         json.dump(records, f, indent=2)
     for r in records:
