@@ -157,7 +157,8 @@ def _generate(args) -> int:
                    temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p,
                    key=jax.random.PRNGKey(cfg.seed),
-                   kv_quant=getattr(args, "kv_quant", "none") == "int8")
+                   kv_quant=getattr(args, "kv_quant", "none") == "int8",
+                   prefill_chunk=getattr(args, "prefill_chunk", 0))
     toks = [int(t) for t in jax.device_get(out)[0]]
     print(",".join(str(t) for t in toks))
     return 0

@@ -324,6 +324,11 @@ def build_argparser() -> argparse.ArgumentParser:
                         "head) scales; ~4x fewer cache bytes re-streamed "
                         "per step vs the f32 cache (long-context lever, "
                         "stacks with --quantize and --n_kv_heads)")
+    p.add_argument("--prefill_chunk", type=int, default=0,
+                   help="prefill the prompt in chunks of this many "
+                        "positions (0 = one pass): bounds peak prefill "
+                        "attention memory for long prompts; tokens are "
+                        "identical")
     p.add_argument("--quantize_skip", type=str, default="",
                    help="comma-separated param-tree names kept in full "
                         "precision under --quantize (e.g. 'head')")
