@@ -189,3 +189,21 @@ def test_prefill_bucketing_exact_tokens():
     for rid, prompt in reqs.items():
         assert srv.result(rid) == _reference(model, params, prompt, 5), \
             prompt
+
+
+def test_moe_server():
+    """MoE models flow through the slot server unchanged (_block_chunk's
+    expert branch runs inside the batched per-row step); tokens equal the
+    single-stream decode, with gated (SwiGLU) experts and int8 expert
+    kernels stacked."""
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    model = _model(moe_experts=4, activation="swiglu", d_ff=48)
+    params = quantize_params(model.init(prng.init_key(0)))
+    srv = DecodeServer(model, params, slots=2)
+    rid = srv.submit([1, 2, 3], max_new_tokens=8)
+    while not srv.done(rid):
+        srv.step()
+    assert srv.result(rid) == _reference(model, params, [1, 2, 3], 8)
