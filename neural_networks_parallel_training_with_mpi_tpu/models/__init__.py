@@ -14,10 +14,12 @@ from .registry import build_model
 from .generate import generate, generate_sharded
 from .generate_tp import generate_tp, pipeline_params_for_decode
 from .serve import DecodeServer
+from .speculative import speculative_generate
 
 __all__ = [
     "Module", "Linear", "Sequential", "Activation", "Conv2D", "LayerNorm",
     "Embedding", "MLP", "reference_mlp", "ConvNet", "Transformer",
     "TransformerConfig", "build_model", "generate", "generate_sharded",
     "generate_tp", "pipeline_params_for_decode", "DecodeServer",
+    "speculative_generate",
 ]

@@ -1,0 +1,156 @@
+"""Speculative decoding (greedy): a small DRAFT model proposes k tokens,
+the TARGET verifies all k in ONE chunked forward, and the longest agreeing
+prefix is accepted plus the target's own correction token.
+
+Why it belongs in a TPU serving stack: autoregressive decode runs one
+bandwidth-bound (B, 1) step per token on the big model, while a chunked
+verify runs k+1 positions through the SAME weights for nearly the same
+HBM traffic as one step (weights stream once either way; the MXU eats
+the extra rows).  With an accept rate a, the target pays roughly
+ceil(N / (accepted-per-round)) chunk passes instead of N steps — the
+classic latency lever when a cheap draft tracks the target well.
+
+Greedy speculation is EXACT: every emitted token is argmax of the
+target's logits at its position (accepted proposals by the verify
+comparison, corrections directly), so the output is identical to
+``generate(target, ...)`` token for token — pinned by
+tests/test_speculative.py, not just asserted here.  (Temperature
+speculation needs the rejection-sampling correction of Leviathan et al.
+2023 to keep the target distribution; not implemented — greedy is the
+serving mode with an exactness contract.)
+
+Cache bookkeeping rides the same invariant as the server's bucketed
+prefill: positions past the accepted point hold stale K/V from rejected
+proposals, but decode masks keys ``<= pos`` and every position is
+REWRITTEN by the pass that next visits it before it becomes visible, so
+no rewind is ever needed — "rollback" is free.
+
+Both models run their standard chunked forward
+(``models.generate._forward_chunk``), so GQA, RoPE, SwiGLU, int8
+weights, and the int8 KV cache all compose with speculation untouched.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generate import _forward_chunk, init_kv_cache
+from .transformer import Transformer
+
+
+@functools.lru_cache(maxsize=64)
+def _chunk_program(model: Transformer, max_len: int, chunk: int,
+                   kv_quant: bool):
+    """One jitted (params, caches, ids (B, chunk), pos) -> (logits,
+    caches) per (model, shapes): position is TRACED, so draft steps and
+    verify chunks at every position share one compiled program each."""
+
+    def run(params, caches, ids, pos):
+        return _forward_chunk(model, params, caches, ids, pos)
+
+    return jax.jit(run)
+
+
+def speculative_generate(target: Transformer, target_params,
+                         draft: Transformer, draft_params,
+                         prompt: jax.Array, max_new_tokens: int,
+                         k: int = 4, kv_quant: bool = False
+                         ) -> Tuple[jax.Array, dict]:
+    """Greedy speculative decode -> ``(tokens (B, P + N), stats)``.
+
+    ``stats`` reports ``target_passes`` (chunked verifies the target ran,
+    vs ``max_new_tokens`` single steps without speculation),
+    ``draft_steps``, and ``accept_rate`` (accepted_total /
+    proposed_total — tail rounds propose fewer than k, so the
+    denominator is what was actually proposed).  The draft must share the target's vocabulary; batch
+    rows are verified in lockstep (a row's round accepts the minimum of
+    its own agreement — B=1 recovers the per-stream optimum, and larger
+    B trades some accept rate for batching, the standard tradeoff).
+    """
+    if target.cfg.vocab_size != draft.cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft.cfg.vocab_size} != target vocab "
+            f"{target.cfg.vocab_size}")
+    b, p = prompt.shape
+    if max_new_tokens <= 0:   # mirror generate(): nothing to decode
+        return jnp.asarray(prompt, jnp.int32), {
+            "target_passes": 0, "draft_steps": 0, "rounds": 0,
+            "accepted_total": 0, "accept_rate": 0.0}
+    total = p + max_new_tokens
+    for name, m in (("target", target), ("draft", draft)):
+        if total > m.cfg.max_seq_len:
+            raise ValueError(f"prompt {p} + {max_new_tokens} exceeds "
+                             f"{name} max_seq_len {m.cfg.max_seq_len}")
+    k = max(1, min(int(k), max_new_tokens))
+
+    d_step = _chunk_program(draft, total, 1, kv_quant)
+    t_caches = init_kv_cache(target, b, total, quant=kv_quant)
+    d_caches = init_kv_cache(draft, b, total, quant=kv_quant)
+
+    tokens = np.zeros((b, total), np.int32)
+    tokens[:, :p] = np.asarray(prompt, np.int32)
+
+    # prefill both models; the target's last-position argmax is token p
+    t_prefill = _chunk_program(target, total, p, kv_quant)
+    d_prefill = _chunk_program(draft, total, p, kv_quant)
+    logits, t_caches = t_prefill(target_params, t_caches,
+                                 jnp.asarray(tokens[:, :p]), 0)
+    tokens[:, p] = np.asarray(jnp.argmax(logits[:, -1], -1))
+    _, d_caches = d_prefill(draft_params, d_caches,
+                            jnp.asarray(tokens[:, :p]), 0)
+
+    pos = p            # index of the newest COMMITTED token
+    stats = {"target_passes": 1, "draft_steps": 0, "rounds": 0,
+             "accepted_total": 0, "proposed_total": 0}
+    while pos < total - 1:
+        r = min(k, total - 1 - pos)
+        # --- draft proposes r tokens autoregressively ------------------
+        proposals = np.zeros((b, r), np.int32)
+        cur = tokens[:, pos]
+        for i in range(r):
+            dl, d_caches = d_step(draft_params, d_caches,
+                                  jnp.asarray(cur[:, None]), pos + i)
+            cur = np.asarray(jnp.argmax(dl[:, -1], -1), np.int32)
+            proposals[:, i] = cur
+            stats["draft_steps"] += 1
+        # --- target verifies the r proposals in one chunk --------------
+        # chunk = committed token at pos followed by the r proposals;
+        # logits[i] are the target's prediction for position pos+1+i.
+        # NO padding to a fixed width: a padded chunk near the sequence
+        # end would write K/V past `total`, and dynamic_update_slice
+        # CLAMPS the start index — silently corrupting earlier
+        # positions.  The lru-cached program compiles once per distinct
+        # r (k in steady state plus at most k-1 tail shapes).
+        chunk = np.concatenate([tokens[:, pos:pos + 1], proposals], 1)
+        vl, t_caches = _chunk_program(target, total, r + 1, kv_quant)(
+            target_params, t_caches, jnp.asarray(chunk), pos)
+        want = np.asarray(jnp.argmax(vl[:, :r + 1], -1), np.int32)
+        # accepted prefix: proposals[i] == target argmax at that slot,
+        # batch rows in lockstep (min across rows)
+        agree = proposals == want[:, :r]
+        n_acc = int(min((np.argmin(row) if not row.all() else r)
+                        for row in agree))
+        # commit accepted proposals + the target's own next token (the
+        # correction slot may not EXIST when the tail round's proposals
+        # were all accepted and land exactly on the last position)
+        if n_acc:
+            tokens[:, pos + 1:pos + 1 + n_acc] = proposals[:, :n_acc]
+        if pos + 1 + n_acc < total:
+            tokens[:, pos + 1 + n_acc] = want[:, n_acc]
+            pos += n_acc + 1
+        else:
+            pos += n_acc
+        stats["target_passes"] += 1
+        stats["rounds"] += 1
+        stats["accepted_total"] += n_acc
+        stats["proposed_total"] += r
+        # stale draft/target cache entries past `pos` are rewritten
+        # before the mask can expose them (module docstring) — no rewind
+    stats["accept_rate"] = (stats["accepted_total"]
+                            / max(1, stats["proposed_total"]))
+    return jnp.asarray(tokens), stats

@@ -1,0 +1,136 @@
+"""Greedy speculative decoding (models.speculative): the load-bearing
+property is EXACTNESS — every emitted token is the target's greedy
+argmax, so speculative output must equal generate(target, ...) token for
+token, for any draft (even an adversarially WRONG one), any k, batch
+sizes > 1, and composed with the modern stack + quantization.  The
+efficiency side (fewer target passes than tokens when the draft agrees)
+is asserted on the self-draft case where agreement is perfect."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+    generate,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (
+    speculative_generate,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+VOCAB = 64
+
+
+def _model(layers=2, d=32, seed=0, **kw):
+    cfg = TransformerConfig(vocab_size=VOCAB, max_seq_len=64,
+                            n_layers=layers, d_model=d, n_heads=4,
+                            d_ff=2 * d, **kw)
+    m = Transformer(cfg)
+    return m, m.init(prng.init_key(seed))
+
+
+@pytest.mark.parametrize("k", [1, 3, 4, 7])
+def test_exactness_any_k(k):
+    """Independent draft (different init + depth): output == target-only
+    greedy decode regardless of how often the draft is right."""
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, seed=7)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want = generate(target, tp, prompt, 17)
+    got, stats = speculative_generate(target, tp, draft, dp, prompt, 17,
+                                      k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["target_passes"] >= 1 and stats["rounds"] >= 1
+
+
+def test_exactness_with_adversarial_draft():
+    """A draft that is ALWAYS wrong (random weights, zero overlap by
+    construction of a different seed + width) degenerates to one
+    correction per round — still exact, just slow."""
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, d=16, seed=99)
+    prompt = jnp.asarray([[5, 6]], jnp.int32)
+    want = generate(target, tp, prompt, 12)
+    got, stats = speculative_generate(target, tp, draft, dp, prompt, 12,
+                                      k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_self_draft_accepts_everything():
+    """Draft == target: every proposal verifies, so the target runs
+    ~N/(k+1) chunk passes instead of N steps and accept_rate == 1."""
+    target, tp = _model(layers=2, seed=0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    n = 16
+    want = generate(target, tp, prompt, n)
+    got, stats = speculative_generate(target, tp, target, tp, prompt, n,
+                                      k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["accept_rate"] == 1.0
+    # 1 prefill + ceil((n-1)/(k+1)) verify rounds, vs n single steps
+    assert stats["target_passes"] <= 1 + -(-(n - 1) // 5)
+
+
+def test_batched_rows_lockstep():
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, seed=7)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (3, 4)), jnp.int32)
+    want = generate(target, tp, prompt, 9)
+    got, _ = speculative_generate(target, tp, draft, dp, prompt, 9, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_modern_stack_and_quant_compose():
+    """RoPE x GQA x SwiGLU target with int8 weights and int8 KV cache:
+    speculation rides the standard chunked forward, so every lever
+    composes; exactness vs the equally-levered single-stream decode."""
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    target, tp = _model(layers=2, seed=0, pos_encoding="rope",
+                        activation="swiglu", n_kv_heads=2)
+    tp = quantize_params(tp)
+    draft, dp = _model(layers=1, seed=7, pos_encoding="rope",
+                       activation="swiglu", n_kv_heads=2)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want = generate(target, tp, prompt, 12, kv_quant=True)
+    got, _ = speculative_generate(target, tp, draft, dp, prompt, 12,
+                                  k=4, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_vocab_mismatch_rejected():
+    target, tp = _model()
+    cfg = TransformerConfig(vocab_size=VOCAB * 2, max_seq_len=64,
+                            n_layers=1, d_model=32, n_heads=4, d_ff=64)
+    draft = Transformer(cfg)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative_generate(target, tp, draft,
+                             draft.init(prng.init_key(1)),
+                             jnp.asarray([[1]], jnp.int32), 4)
+
+
+def test_tail_round_full_accept_and_zero_tokens():
+    """Regression: a tail round whose r < k proposals are ALL accepted
+    lands exactly on the last position — there is no correction slot,
+    and the commit must not write past the tokens buffer.  Self-draft
+    with (p=3, n=7, k=4) hits it deterministically (round 1 commits 5,
+    round 2 proposes r=1, accepts it).  Plus: perfect drafts report
+    accept_rate 1.0 even WITH tail rounds, and max_new_tokens=0 returns
+    the prompt instead of indexing out of bounds."""
+    target, tp = _model(layers=2, seed=0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want = generate(target, tp, prompt, 7)
+    got, stats = speculative_generate(target, tp, target, tp, prompt, 7,
+                                      k=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["accept_rate"] == 1.0   # denominator = proposed, not k
+
+    got0, stats0 = speculative_generate(target, tp, target, tp, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(got0), np.asarray(prompt))
+    assert stats0["rounds"] == 0
