@@ -1245,6 +1245,46 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
     results["serve_requests"] = 8
     results["serve_slots"] = 4
     results["serve_tokens_per_sec"] = serve_pass()
+    # greedy speculative decoding: a 1-layer draft of the same family
+    # proposes k=4, the full model verifies in one chunk — tokens are
+    # EXACT (tests/test_speculative.py), so the only question is the
+    # accept rate and the wall-clock vs plain decode
+    from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (
+        speculative_generate,
+    )
+
+    draft = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=1,
+        d_model=c["d_model"] // 2, n_heads=c["n_heads"],
+        d_ff=c["d_ff"] // 2, compute_dtype=cd))
+    draft_params = draft.init(prng.init_key(1))
+    spec_prompt = jnp.asarray(rng.integers(0, c["vocab"], (4, p_len)),
+                              jnp.int32)
+    speculative_generate(model, params, draft, draft_params, spec_prompt,
+                         new_tokens, k=4)     # compile pass
+    t0 = time.perf_counter()
+    _, spec_stats = speculative_generate(model, params, draft,
+                                         draft_params, spec_prompt,
+                                         new_tokens, k=4)
+    dt = time.perf_counter() - t0
+    results["speculative_tokens_per_sec"] = round(
+        4 * new_tokens / dt, 1)
+    results["speculative_accept_rate"] = round(
+        spec_stats["accept_rate"], 3)
+    results["speculative_target_passes"] = spec_stats["target_passes"]
+    # the bench models are UNTRAINED, so the real-draft accept rate is
+    # meaningless (unrelated random argmaxes -> ~0, the worst case);
+    # the self-draft row shows the mechanism's ceiling: accept rate 1,
+    # 1 + ceil((N-1)/(k+1)) target passes instead of N
+    speculative_generate(model, params, model, params, spec_prompt,
+                         new_tokens, k=4)     # compile pass
+    t0 = time.perf_counter()
+    _, self_stats = speculative_generate(model, params, model, params,
+                                         spec_prompt, new_tokens, k=4)
+    results["speculative_selfdraft_tokens_per_sec"] = round(
+        4 * new_tokens / (time.perf_counter() - t0), 1)
+    results["speculative_selfdraft_target_passes"] = (
+        self_stats["target_passes"])
     if n_dev >= 2:
         from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
             replicated_sharding,

@@ -14,10 +14,17 @@ Greedy speculation is EXACT: every emitted token is argmax of the
 target's logits at its position (accepted proposals by the verify
 comparison, corrections directly), so the output is identical to
 ``generate(target, ...)`` token for token — pinned by
-tests/test_speculative.py, not just asserted here.  (Temperature
-speculation needs the rejection-sampling correction of Leviathan et al.
-2023 to keep the target distribution; not implemented — greedy is the
-serving mode with an exactness contract.)
+tests/test_speculative.py, not just asserted here.  One honest caveat:
+the verify pass computes those logits in an (r+1)-wide chunk while
+``generate`` uses (B, 1) steps — different XLA programs, so floats may
+reassociate and a NEAR-TIE argmax can in principle flip.  Trained
+models have logit margins that make this unobservable (the tests pin
+bitwise equality), but UNTRAINED models' near-flat logits do flip ties
+— visible as a sub-1 self-draft accept rate in the bench's mechanism
+row, which is a tie-stability artifact, not a speculation bug.
+(Temperature speculation needs the rejection-sampling correction of
+Leviathan et al. 2023 to keep the target distribution; not implemented
+— greedy is the serving mode with an exactness contract.)
 
 Cache bookkeeping rides the same invariant as the server's bucketed
 prefill: positions past the accepted point hold stale K/V from rejected
