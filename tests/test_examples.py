@@ -264,3 +264,16 @@ def test_example_17_modern_lm_stack_completes():
     last = out.stdout.strip().splitlines()[-1]
     ids = [int(t) for t in last.split(",")]
     assert ids[:3] == [10, 20, 30] and len(ids) == 11
+
+
+def test_example_18_speculative_decoding_completes():
+    """Trains a byte-LM, then self-draft speculative decode: tokens must
+    equal plain greedy (asserted inside) with fewer target passes."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "18_speculative_decoding.sh")],
+        capture_output=True, text=True, timeout=600, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "tokens identical" in out.stdout
+    assert "accept rate" in out.stdout
