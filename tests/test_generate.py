@@ -169,6 +169,7 @@ def test_generate_sharded_matches_single_device(lm):
         generate_sharded(model, params, prompt[:3], mesh, 2)
 
 
+@pytest.mark.slow
 def test_chunked_prefill_token_exact():
     """prefill_chunk bounds prefill attention memory (O(chunk * T)
     scores instead of O(P * T)); tokens must be identical to the

@@ -131,6 +131,7 @@ def test_kv_cache_decode_with_quantized_params():
     assert agree >= 0.5, (np.asarray(q), np.asarray(full))
 
 
+@pytest.mark.slow
 def test_cli_generate_quantized(tmp_path, capsys):
     """--quantize int8 end to end through the CLI (fresh-init decode)."""
     from neural_networks_parallel_training_with_mpi_tpu.cli import main

@@ -172,6 +172,7 @@ def test_rope_decode_matches_training_forward():
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(seq))
 
 
+@pytest.mark.slow
 def test_rope_composes_with_gqa_kv8_and_server():
     """The full modern-LM stack: RoPE x GQA x int8 weights x int8 KV
     through the continuous-batching server, token-equal to the

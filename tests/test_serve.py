@@ -9,6 +9,7 @@ pool-full admission, staggered lifetimes."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
     generate,
@@ -172,6 +173,7 @@ def test_done_raises_on_stale_or_unknown_rid():
         srv.done(rid)
 
 
+@pytest.mark.slow
 def test_prefill_bucketing_exact_tokens():
     """Prompts of many lengths share log2(max_len) compiled prefill
     programs (padded to power-of-two buckets); pad positions' K/V are
@@ -191,6 +193,7 @@ def test_prefill_bucketing_exact_tokens():
             prompt
 
 
+@pytest.mark.slow
 def test_moe_server():
     """MoE models flow through the slot server unchanged (_block_chunk's
     expert branch runs inside the batched per-row step); tokens equal the

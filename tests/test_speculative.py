@@ -74,6 +74,7 @@ def test_self_draft_accepts_everything():
     assert stats["target_passes"] <= 1 + -(-(n - 1) // 5)
 
 
+@pytest.mark.slow
 def test_batched_rows_lockstep():
     target, tp = _model(layers=2, seed=0)
     draft, dp = _model(layers=1, seed=7)
@@ -84,6 +85,7 @@ def test_batched_rows_lockstep():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_modern_stack_and_quant_compose():
     """RoPE x GQA x SwiGLU target with int8 weights and int8 KV cache:
     speculation rides the standard chunked forward, so every lever
