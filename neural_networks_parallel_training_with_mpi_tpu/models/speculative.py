@@ -22,9 +22,14 @@ models have logit margins that make this unobservable (the tests pin
 bitwise equality), but UNTRAINED models' near-flat logits do flip ties
 — visible as a sub-1 self-draft accept rate in the bench's mechanism
 row, which is a tie-stability artifact, not a speculation bug.
-(Temperature speculation needs the rejection-sampling correction of
-Leviathan et al. 2023 to keep the target distribution; not implemented
-— greedy is the serving mode with an exactness contract.)
+Temperature speculation (``temperature > 0`` + a PRNG key) uses the
+rejection-sampling correction of Leviathan et al. 2023
+(:func:`accept_proposals`): each draft sample is accepted with
+probability ``min(1, p/q)`` and the first rejection resamples from the
+residual ``norm(max(0, p − q))``, so committed tokens are distributed
+EXACTLY as target samples — pinned statistically on the pure numpy
+core.  Greedy (``temperature == 0``) keeps the argmax-equality
+contract above.
 
 Cache bookkeeping rides the same invariant as the server's bucketed
 prefill: positions past the accepted point hold stale K/V from rejected
@@ -50,6 +55,48 @@ from .generate import _forward_chunk, init_kv_cache
 from .transformer import Transformer
 
 
+def _softmax(logits: np.ndarray, temperature: float) -> np.ndarray:
+    z = logits.astype(np.float64) / temperature
+    z -= z.max(-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(-1, keepdims=True)
+
+
+def accept_proposals(p_logits: np.ndarray, q_logits: np.ndarray,
+                     proposals: np.ndarray, temperature: float,
+                     rng: np.random.Generator) -> Tuple[int, int]:
+    """The Leviathan et al. 2023 rejection-sampling core for ONE batch
+    row: proposals ``x_i ~ q_i`` are accepted with probability
+    ``min(1, p_i(x_i) / q_i(x_i))``; at the first rejection the bonus
+    token is drawn from the residual ``norm(max(0, p_i − q_i))``; if all
+    r proposals survive, the bonus comes from the target's own
+    ``p_{r}``.  Returns ``(n_accepted, bonus_token)``.
+
+    The committed sequence ``x_0..x_{n-1}, bonus`` is distributed
+    EXACTLY as n+1 ancestral samples from the target at this
+    temperature — the marginal-exactness property pinned statistically
+    by tests/test_speculative.py::test_acceptance_core_preserves_target
+    (a pure-numpy function so the test can afford 10^5 trials).
+
+    Shapes: ``p_logits (r+1, V)`` (target logits at the r proposal slots
+    plus the bonus slot), ``q_logits (r, V)`` (draft logits the
+    proposals were sampled from), ``proposals (r,)``.
+    """
+    r = proposals.shape[0]
+    p = _softmax(p_logits, temperature)          # (r+1, V)
+    q = _softmax(q_logits, temperature)          # (r, V)
+    for i in range(r):
+        x = int(proposals[i])
+        if rng.random() < min(1.0, p[i, x] / max(q[i, x], 1e-38)):
+            continue
+        residual = np.maximum(p[i] - q[i], 0.0)
+        total = residual.sum()
+        if total <= 0:                            # p == q: accept x
+            return i, x
+        return i, int(rng.choice(p.shape[-1], p=residual / total))
+    return r, int(rng.choice(p.shape[-1], p=p[r]))
+
+
 @functools.lru_cache(maxsize=64)
 def _chunk_program(model: Transformer, max_len: int, chunk: int,
                    kv_quant: bool):
@@ -66,9 +113,18 @@ def _chunk_program(model: Transformer, max_len: int, chunk: int,
 def speculative_generate(target: Transformer, target_params,
                          draft: Transformer, draft_params,
                          prompt: jax.Array, max_new_tokens: int,
-                         k: int = 4, kv_quant: bool = False
+                         k: int = 4, kv_quant: bool = False,
+                         temperature: float = 0.0,
+                         key: Optional[jax.Array] = None
                          ) -> Tuple[jax.Array, dict]:
-    """Greedy speculative decode -> ``(tokens (B, P + N), stats)``.
+    """Speculative decode -> ``(tokens (B, P + N), stats)``.
+
+    ``temperature == 0`` (default) is greedy: output equals
+    ``generate(target, ...)`` token for token.  ``temperature > 0``
+    REQUIRES ``key`` and samples with the rejection-sampling correction
+    (:func:`accept_proposals`), so committed tokens are distributed as
+    target samples at that temperature; the decode is deterministic
+    given ``(key, inputs)``.
 
     ``stats`` reports ``target_passes`` (chunked verifies the target ran,
     vs ``max_new_tokens`` single steps without speculation),
@@ -83,6 +139,15 @@ def speculative_generate(target: Transformer, target_params,
         raise ValueError(
             f"draft vocab {draft.cfg.vocab_size} != target vocab "
             f"{target.cfg.vocab_size}")
+    use_temp = temperature > 0
+    if use_temp and key is None:
+        raise ValueError("temperature speculation needs a PRNG key")
+    # numpy rng streams derived from the jax key: one per (round, row),
+    # shared by the draft's sampling and the acceptance draws — the
+    # whole decode is deterministic given (key, inputs)
+    key_ints = ([int(x) for x in
+                 np.asarray(jax.random.key_data(key)).ravel()]
+                if use_temp else [])
     b, p = prompt.shape
     if max_new_tokens <= 0:   # mirror generate(): nothing to decode
         return jnp.asarray(prompt, jnp.int32), {
@@ -107,7 +172,14 @@ def speculative_generate(target: Transformer, target_params,
     d_prefill = _chunk_program(draft, total, p, kv_quant)
     logits, t_caches = t_prefill(target_params, t_caches,
                                  jnp.asarray(tokens[:, :p]), 0)
-    tokens[:, p] = np.asarray(jnp.argmax(logits[:, -1], -1))
+    if use_temp:
+        last = np.asarray(logits[:, -1])
+        rng0 = np.random.default_rng(key_ints + [0xFEED])
+        tokens[:, p] = [int(rng0.choice(last.shape[-1],
+                                        p=_softmax(last[row], temperature)))
+                       for row in range(b)]
+    else:
+        tokens[:, p] = np.asarray(jnp.argmax(logits[:, -1], -1))
     _, d_caches = d_prefill(draft_params, d_caches,
                             jnp.asarray(tokens[:, :p]), 0)
 
@@ -116,13 +188,27 @@ def speculative_generate(target: Transformer, target_params,
              "accepted_total": 0, "proposed_total": 0}
     while pos < total - 1:
         r = min(k, total - 1 - pos)
+        rngs = ([np.random.default_rng(key_ints + [stats["rounds"], row])
+                 for row in range(b)] if use_temp else None)
         # --- draft proposes r tokens autoregressively ------------------
         proposals = np.zeros((b, r), np.int32)
+        q_store = (np.zeros((b, r, target.cfg.vocab_size), np.float32)
+                   if use_temp else None)
         cur = tokens[:, pos]
         for i in range(r):
             dl, d_caches = d_step(draft_params, d_caches,
                                   jnp.asarray(cur[:, None]), pos + i)
-            cur = np.asarray(jnp.argmax(dl[:, -1], -1), np.int32)
+            if use_temp:
+                dl_np = np.asarray(dl[:, -1])
+                q_store[:, i] = dl_np
+                cur = np.asarray(
+                    [rngs[row].choice(dl_np.shape[-1],
+                                      p=_softmax(dl_np[row], temperature))
+                     for row in range(b)], np.int32)
+            else:
+                # greedy transfers only the (B,) argmax ints — never the
+                # full logits row — on the latency-critical loop
+                cur = np.asarray(jnp.argmax(dl[:, -1], -1), np.int32)
             proposals[:, i] = cur
             stats["draft_steps"] += 1
         # --- target verifies the r proposals in one chunk --------------
@@ -136,19 +222,40 @@ def speculative_generate(target: Transformer, target_params,
         chunk = np.concatenate([tokens[:, pos:pos + 1], proposals], 1)
         vl, t_caches = _chunk_program(target, total, r + 1, kv_quant)(
             target_params, t_caches, jnp.asarray(chunk), pos)
-        want = np.asarray(jnp.argmax(vl[:, :r + 1], -1), np.int32)
-        # accepted prefix: proposals[i] == target argmax at that slot,
-        # batch rows in lockstep (min across rows)
-        agree = proposals == want[:, :r]
-        n_acc = int(min((np.argmin(row) if not row.all() else r)
-                        for row in agree))
-        # commit accepted proposals + the target's own next token (the
-        # correction slot may not EXIST when the tail round's proposals
-        # were all accepted and land exactly on the last position)
+        if use_temp:
+            # per-row rejection sampling (accept_proposals), then batch
+            # rows commit in LOCKSTEP at the minimum accepted count: a
+            # row that accepted past the cut commits its accepted
+            # proposal at the cut slot (a valid target draw), a row cut
+            # at its own rejection commits its residual/bonus sample —
+            # either way the committed tokens stay target-distributed
+            vl_np = np.asarray(vl)
+            accepts, bonuses = [], []
+            for row in range(b):
+                a_row, bonus = accept_proposals(
+                    vl_np[row, :r + 1], q_store[row], proposals[row],
+                    temperature, rngs[row])
+                accepts.append(a_row)
+                bonuses.append(bonus)
+            n_acc = int(min(accepts))
+            nxt = np.asarray(
+                [proposals[row, n_acc] if accepts[row] > n_acc
+                 else bonuses[row] for row in range(b)], np.int32)
+        else:
+            want = np.asarray(jnp.argmax(vl[:, :r + 1], -1), np.int32)
+            # accepted prefix: proposals[i] == target argmax at that
+            # slot, batch rows in lockstep (min across rows)
+            agree = proposals == want[:, :r]
+            n_acc = int(min((np.argmin(row) if not row.all() else r)
+                            for row in agree))
+            nxt = want[:, n_acc]
+        # commit accepted proposals + the next token (the bonus slot may
+        # not EXIST when the tail round's proposals were all accepted
+        # and land exactly on the last position)
         if n_acc:
             tokens[:, pos + 1:pos + 1 + n_acc] = proposals[:, :n_acc]
         if pos + 1 + n_acc < total:
-            tokens[:, pos + 1 + n_acc] = want[:, n_acc]
+            tokens[:, pos + 1 + n_acc] = nxt
             pos += n_acc + 1
         else:
             pos += n_acc

@@ -136,3 +136,61 @@ def test_tail_round_full_accept_and_zero_tokens():
     got0, stats0 = speculative_generate(target, tp, target, tp, prompt, 0)
     np.testing.assert_array_equal(np.asarray(got0), np.asarray(prompt))
     assert stats0["rounds"] == 0
+
+
+def test_acceptance_core_preserves_target():
+    """The Leviathan rejection-sampling core, statistically: over many
+    trials with FIXED synthetic (p, q) logits, the marginal of the first
+    committed token (accepted proposal x_0 or the residual bonus) must
+    match softmax(p_0 / T) — the property that makes temperature
+    speculation exact.  Pure numpy, so 200k trials are cheap."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (
+        _softmax, accept_proposals,
+    )
+
+    rng = np.random.default_rng(0)
+    V, T_ = 8, 0.7
+    p_logits = rng.standard_normal((2, V)).astype(np.float32)  # r=1 (+bonus)
+    q_logits = rng.standard_normal((1, V)).astype(np.float32)
+    p0 = _softmax(p_logits, T_)[0]
+    q0 = _softmax(q_logits, T_)[0]
+
+    n = 200_000
+    trial_rng = np.random.default_rng(1)
+    counts = np.zeros(V)
+    for _ in range(n):
+        x = int(trial_rng.choice(V, p=q0))          # draft proposal
+        n_acc, bonus = accept_proposals(
+            p_logits, q_logits, np.asarray([x]), T_, trial_rng)
+        first = x if n_acc >= 1 else bonus
+        counts[first] += 1
+    freq = counts / n
+    # ~3.5 sigma at the largest bin: |freq - p| < 3.5 * sqrt(p(1-p)/n)
+    bound = 3.5 * np.sqrt(p0 * (1 - p0) / n) + 1e-9
+    assert (np.abs(freq - p0) < bound).all(), (freq, p0, bound)
+
+
+def test_temperature_speculation_runs_and_is_deterministic():
+    """End to end: sampled speculation emits valid tokens, is
+    deterministic given the key, varies across keys, and requires one."""
+    import jax
+
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, seed=7)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    k1, k2 = jax.random.PRNGKey(0), jax.random.PRNGKey(1)
+    a, stats = speculative_generate(target, tp, draft, dp, prompt, 12,
+                                    k=3, temperature=0.9, key=k1)
+    b_, _ = speculative_generate(target, tp, draft, dp, prompt, 12,
+                                 k=3, temperature=0.9, key=k1)
+    c, _ = speculative_generate(target, tp, draft, dp, prompt, 12,
+                                k=3, temperature=0.9, key=k2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+    arr = np.asarray(a)
+    assert arr.shape == (1, 15) and (arr >= 0).all() \
+        and (arr < VOCAB).all()
+    assert stats["rounds"] >= 1
+    with pytest.raises(ValueError, match="PRNG key"):
+        speculative_generate(target, tp, draft, dp, prompt, 4,
+                             temperature=0.5)
