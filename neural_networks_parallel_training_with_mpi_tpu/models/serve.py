@@ -64,7 +64,8 @@ Pytree = Any
 
 @functools.lru_cache(maxsize=8)
 def _programs(model: Transformer, max_len: int, temperature: float,
-              top_k: int, top_p: float, kv_quant: bool = False):
+              top_k: int, top_p: float, kv_quant: bool = False,
+              prefill_chunk: int = 0):
     """The three jitted programs of a server instance, cached per (model,
     shape, sampling) so constructing several servers (or re-constructing
     in tests) compiles once."""
@@ -78,6 +79,19 @@ def _programs(model: Transformer, max_len: int, temperature: float,
         # decode masks keys <= pos and overwrites position p, p+1, ...
         # with generated tokens before each becomes visible.
         caches = init_kv_cache(model, 1, max_len, quant=kv_quant)
+        pb = prompt.shape[1]
+        if 0 < prefill_chunk < pb:
+            # chunked prefill (generate()'s long-prompt lever): peak
+            # attention memory O(chunk * T) instead of O(bucket * T);
+            # all widths are static, so this is still ONE compiled
+            # program per bucket
+            outs = []
+            for off in range(0, pb, prefill_chunk):
+                w = min(prefill_chunk, pb - off)
+                lg, caches = _forward_chunk(model, params, caches,
+                                            prompt[:, off:off + w], off)
+                outs.append(lg)
+            return jnp.concatenate(outs, axis=1), caches
         logits, caches = _forward_chunk(model, params, caches, prompt, 0)
         return logits, caches
 
@@ -114,7 +128,7 @@ class DecodeServer:
     def __init__(self, model: Transformer, params: Pytree, slots: int = 4,
                  max_len: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, prefill_chunk: int = 0):
         c = model.cfg
         self.model, self.params = model, params
         self.slots = int(slots)
@@ -124,7 +138,8 @@ class DecodeServer:
                              f"max_seq_len {c.max_seq_len}")
         self._sampling = (float(temperature), int(top_k), float(top_p))
         self._prefill, self._insert, self._step = _programs(
-            model, self.max_len, *self._sampling, bool(kv_quant))
+            model, self.max_len, *self._sampling, bool(kv_quant),
+            int(prefill_chunk))
         self.caches = init_kv_cache(model, self.slots, self.max_len,
                                     quant=kv_quant)
         self.tokens = jnp.zeros((self.slots, self.max_len), jnp.int32)

@@ -210,3 +210,22 @@ def test_moe_server():
     while not srv.done(rid):
         srv.step()
     assert srv.result(rid) == _reference(model, params, [1, 2, 3], 8)
+
+
+def test_server_chunked_prefill_exact():
+    """prefill_chunk bounds the server's prefill attention memory
+    (O(chunk * T) instead of O(bucket * T)); admission tokens must be
+    identical to the unchunked server for prompts across bucket sizes,
+    including boundaries that split unevenly."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    plain = DecodeServer(model, params, slots=4)
+    chunked = DecodeServer(model, params, slots=4, prefill_chunk=3)
+    for prompt in ([1, 2], [1, 2, 3, 4, 5, 6, 7], [9] * 12):
+        a = plain.submit(list(prompt), max_new_tokens=5)
+        b = chunked.submit(list(prompt), max_new_tokens=5)
+        while not plain.done(a):
+            plain.step()
+        while not chunked.done(b):
+            chunked.step()
+        assert plain.result(a) == chunked.result(b), prompt
