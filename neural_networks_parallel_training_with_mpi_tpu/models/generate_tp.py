@@ -49,7 +49,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..parallel import megatron
-from .core import ACTIVATIONS, LayerNorm
+from .core import LayerNorm
 from .generate import _filter_logits
 from .transformer import Transformer
 
@@ -140,9 +140,7 @@ def _tp_block_chunk(cfg, lp, cache, x, pos, heads_local: int,
     if moe_ffn is not None:
         ff, _aux = moe_ffn(lp, h)  # load-balance aux is a training signal
         return x + ff.astype(x.dtype), {"k": new_k, "v": new_v}
-    hh = (h.astype(cdt) @ lp["ff_in"]["w"].astype(cdt)
-          + lp["ff_in"]["b"].astype(cdt))
-    hh = ACTIVATIONS[cfg.activation](hh)
+    hh = megatron.tp_ffn_hidden(cfg, lp, h)
     ff = (lax.psum(hh @ lp["ff_out"]["w"].astype(cdt), axis)
           + lp["ff_out"]["b"].astype(cdt))
     return x + ff.astype(x.dtype), {"k": new_k, "v": new_v}

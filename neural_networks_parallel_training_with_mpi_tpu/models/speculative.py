@@ -152,7 +152,7 @@ def speculative_generate(target: Transformer, target_params,
     if max_new_tokens <= 0:   # mirror generate(): nothing to decode
         return jnp.asarray(prompt, jnp.int32), {
             "target_passes": 0, "draft_steps": 0, "rounds": 0,
-            "accepted_total": 0, "accept_rate": 0.0}
+            "accepted_total": 0, "proposed_total": 0, "accept_rate": 0.0}
     total = p + max_new_tokens
     for name, m in (("target", target), ("draft", draft)):
         if total > m.cfg.max_seq_len:

@@ -221,22 +221,31 @@ def tp_block_apply(cfg, layer_params: Pytree, x: jax.Array, tp: int,
         ff, aux = ffn_fn(layer_params, h)
         return x + ff.astype(x.dtype), aux
     h = f(h)
-    hh = (h.astype(cdt) @ layer_params["ff_in"]["w"].astype(cdt)
-          + layer_params["ff_in"]["b"].astype(cdt))
-    if cfg.activation == "swiglu":
-        # SwiGLU (round 4): the gate is column-parallel with the SAME
-        # column partition as ff_in, so the elementwise gated product of
-        # the two local shards IS the local shard of the global product —
-        # no extra collective before the row-parallel ff_out
-        gate = jax.nn.silu(
-            h.astype(cdt) @ layer_params["ff_gate"]["w"].astype(cdt)
-            + layer_params["ff_gate"]["b"].astype(cdt))
-        hh = gate * hh
-    else:
-        hh = ACTIVATIONS[cfg.activation](hh)
+    hh = tp_ffn_hidden(cfg, layer_params, h)
     ff = (g(hh @ layer_params["ff_out"]["w"].astype(cdt))
           + layer_params["ff_out"]["b"].astype(cdt))
     return x + ff.astype(x.dtype)
+
+
+def tp_ffn_hidden(cfg, layer_params, h: jax.Array) -> jax.Array:
+    """Column-parallel FFN hidden (the shard before the row-parallel
+    ff_out): ``act(h W_in + b)``, or for SwiGLU ``silu(h W_gate + b_g) *
+    (h W_in + b)``.  The gate is column-parallel with the SAME column
+    partition as ff_in, so the elementwise gated product of the two
+    local shards IS the local shard of the global product — no extra
+    collective before ff_out.  One definition shared by the training
+    block (``tp_block_apply``) and the KV-cache decode chunk
+    (``models.generate_tp``), the same anti-drift rule as
+    ``Transformer._ffn``."""
+    cdt = cfg.compute_dtype
+    hh = (h.astype(cdt) @ layer_params["ff_in"]["w"].astype(cdt)
+          + layer_params["ff_in"]["b"].astype(cdt))
+    if cfg.activation == "swiglu":
+        gate = jax.nn.silu(
+            h.astype(cdt) @ layer_params["ff_gate"]["w"].astype(cdt)
+            + layer_params["ff_gate"]["b"].astype(cdt))
+        return gate * hh
+    return ACTIVATIONS[cfg.activation](hh)
 
 
 # ---------------------------------------------------------------------------

@@ -269,6 +269,7 @@ def test_pipeline_checkpoint_decode_tp_mismatch_repermutes(lm):
     np.testing.assert_array_equal(np.asarray(dense), np.asarray(tp))
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_moe_greedy_parity_vs_dense(tp_mesh):
     """MoE checkpoints decode tensor-parallel (round 4): experts whole per
     rank, hidden dims tensor-sharded (the SP x TP MoE training layout).
@@ -353,3 +354,29 @@ def test_rope_decode_parity_vs_dense(tp_mesh):
     tp_vp = generate_tp(model, tp_params, prompt, mesh, max_new_tokens=8,
                         vocab_parallel=True)
     np.testing.assert_array_equal(np.asarray(dense), np.asarray(tp_vp))
+
+
+def test_swiglu_decode_parity_vs_dense(tp_mesh):
+    """SwiGLU in the native TP layout (ADVICE r4): ff_gate is
+    column-parallel with the SAME partition as ff_in, so the gated
+    product of local shards is the local shard of the global product —
+    the decode chunk must gate before the row-parallel ff_out psum.
+    Stacked with RoPE + GQA (the modern-stack serving config)."""
+    cfg = TransformerConfig(vocab_size=V, max_seq_len=32, n_layers=2,
+                            d_model=32, n_heads=4, n_kv_heads=2,
+                            d_ff=64, activation="swiglu",
+                            pos_encoding="rope")
+    model = Transformer(cfg)
+    params = model.init(prng.init_key(5))
+    assert "ff_gate" in params["blocks"][0]
+    tp_params = dict(params)
+    tp_params["blocks"] = megatron.permute_qkv(
+        params["blocks"], cfg.d_model, cfg.n_heads, 2,
+        kv_heads=cfg.kv_heads)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, tensor=2),
+                              devices=np.asarray(jax.devices()[:4]))
+    rng = np.random.default_rng(6)
+    prompt = jnp.asarray(rng.integers(0, V, (4, 4)), jnp.int32)
+    dense = generate(model, params, prompt, max_new_tokens=8)
+    tp = generate_tp(model, tp_params, prompt, mesh, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(tp))

@@ -136,6 +136,9 @@ def test_tail_round_full_accept_and_zero_tokens():
     got0, stats0 = speculative_generate(target, tp, target, tp, prompt, 0)
     np.testing.assert_array_equal(np.asarray(got0), np.asarray(prompt))
     assert stats0["rounds"] == 0
+    # schema parity with the normal path (ADVICE r4): callers read
+    # proposed_total unconditionally
+    assert set(stats0) >= set(stats)
 
 
 def test_acceptance_core_preserves_target():
