@@ -135,7 +135,10 @@ class ModelConfig:
     d_ff: int = 512
     vocab_size: int = 256
     max_seq_len: int = 512
-    attention: str = "dense"  # dense | flash (pallas) | ring | ulysses
+    # auto (default) = per-backend shape dispatch: dense below the
+    # measured crossover, flash above (parallel.sequence.AUTO_FLASH_MIN_SEQ,
+    # seeded from BENCH_ATTENTION.json); explicit impls pin the choice
+    attention: str = "auto"  # auto | dense | flash (pallas) | ring | ulysses
     # "learned" position table (default) or "rope" rotary q/k (no
     # position parameters; relative-distance attention)
     pos_encoding: str = "learned"
@@ -192,6 +195,13 @@ class TrainConfig:
     # microbatch gradient accumulation inside the jitted step (DP path);
     # 1 = off.  One accumulated update = one optimizer step.
     accum_steps: int = 1
+    # k optimizer steps per host dispatch (lax.scan over a device-staged
+    # stack of k batches, VERDICT r4 item 6): amortizes the per-step host
+    # dispatch that dominates small models (MNIST MLP measured 0.011 MFU —
+    # dispatch-bound, BENCH_FULL.json).  Trajectory-identical to k=1 (the
+    # scan replays the same batches in the same order); 1 = off.
+    # Single-host, non-SP layouts (see ShardedLoader.epoch_groups).
+    steps_per_dispatch: int = 1
     # virtual stage-slices per pipeline device (interleaved schedule,
     # parallel.pipeline): bubble fraction (pp-1)/(v*M + pp-1) instead of
     # (pp-1)/(M + pp-1) at constant microbatch count; costs v ppermute
@@ -297,6 +307,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="global-norm gradient clipping (0 = off)")
     p.add_argument("--accum_steps", type=int, default=1,
                    help="microbatch gradient-accumulation factor (DP path)")
+    p.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="k optimizer steps per host dispatch (lax.scan "
+                        "over a device-staged batch stack) — amortizes "
+                        "per-step dispatch overhead on small models; "
+                        "trajectory-identical to k=1")
     p.add_argument("--pp_interleave", type=int, default=1,
                    help="virtual stage-slices per pipeline device "
                         "(interleaved schedule: bubble / v at constant "
@@ -401,10 +416,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "training (zero-egress real text)")
     p.add_argument("--vocab_size", type=int, default=256)
     p.add_argument("--attention",
-                   choices=["dense", "flash", "ring", "ring_flash",
+                   choices=["auto", "dense", "dense_blockwise", "flash",
+                            "ring", "ring_flash",
                             "striped", "striped_flash", "ulysses"],
                    default=None,
-                   help="attention impl (default: dense; ring when --sp > 1; "
+                   help="attention impl (default: auto = dense below the "
+                        "measured per-backend crossover, flash above; "
+                        "ring when --sp > 1; "
                         "flash = blocked pallas kernel; ring_flash = ring "
                         "with the pallas kernel per block; striped[_flash] "
                         "= round-robin token stripes — balanced causal "
@@ -484,6 +502,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         min_lr=args.min_lr,
         grad_clip=args.grad_clip,
         accum_steps=args.accum_steps,
+        steps_per_dispatch=args.steps_per_dispatch,
         pp_interleave=args.pp_interleave,
         loss=args.loss, label_smoothing=args.label_smoothing,
         grad_reduction=args.grad_reduction,

@@ -57,7 +57,9 @@ class TransformerConfig:
     activation: str = "gelu"
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.float32   # set bfloat16 for TPU throughput
-    attention: str = "dense"           # dense | ring | ulysses
+    # "auto" dispatches dense-vs-flash by (backend, T) at the measured
+    # crossover (parallel.sequence.resolve_attention_impl)
+    attention: str = "auto"            # auto | dense | flash | ring | ...
     seq_axis: str = "seq"
     # Position encoding: "learned" adds a trained position-embedding table
     # (the default, matching the original treedef); "rope" rotates q/k by

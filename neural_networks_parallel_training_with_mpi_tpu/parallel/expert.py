@@ -463,7 +463,9 @@ def _validate_moe_tp(model: Transformer, mesh: Mesh, seq_axis=None):
             from .sequence import validate_ulysses_under_tp
 
             validate_ulysses_under_tp(c.n_heads, tp, sp, seq_axis)
-    elif c.attention != "dense":
+    elif c.attention not in ("dense", "auto"):
+        # "auto" resolves to dense here: this step's only wired unsharded
+        # attention is the Megatron dense path (attention_fn=None)
         raise ValueError("the EP x TP step runs Megatron attention over the "
                          f"full local sequence; attention={c.attention!r} "
                          "needs seq_axis (SP x EP x TP) or the sp/sp_ep "

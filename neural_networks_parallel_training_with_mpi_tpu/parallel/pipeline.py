@@ -307,6 +307,10 @@ def _stage_fns(model: Transformer, tp: int):
         # (ring/striped/ulysses) ride the same closure with the sequence
         # dim sharded over the mesh's seq axis (PP x SP x TP, round 4);
         # _validate_pipe guarantees that axis is > 1 for them.
+        # "auto" rides the closure: it resolves (per backend + local T)
+        # inside sequence_sharded_attention, to attention_reference below
+        # the crossover — the same math as megatron's attention_fn=None
+        # dense default
         attn = (None if c.attention == "dense"
                 else (lambda q, k, v: sequence_sharded_attention(
                     c.attention, q, k, v, axis=c.seq_axis, causal=True,
@@ -443,7 +447,7 @@ def _validate_pipe(model: Transformer, mesh: Mesh, interleave: int = 1):
             f"mesh '{c.seq_axis}'={sp} but attention={c.attention!r} is "
             f"not seq-sharded; pick one of the ring/striped/ulysses impls "
             f"or drop the seq axis")
-    elif c.attention not in ("dense", "flash"):
+    elif c.attention not in ("dense", "dense_blockwise", "flash", "auto"):
         raise NotImplementedError(
             f"unknown/unwired attention={c.attention!r} on the pipeline "
             f"path (dense, flash, or a seq-sharded impl with a "

@@ -1,0 +1,99 @@
+"""--attention auto (VERDICT r4 item 3): shape-based dense-vs-flash
+dispatch at the measured crossover, so users stop paying the ~10% dense
+deficit at short T that a hard-coded ``flash`` costs (BENCH_ATTENTION.json:
+full-step flash 0.89x @ T=512, kernel-only 0.91x @ 1k / 0.98x @ 2k)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neural_networks_parallel_training_with_mpi_tpu.config import (
+    TrainConfig, build_argparser, config_from_args,
+)
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+    AUTO_FLASH_MIN_SEQ, resolve_attention_impl,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+
+def test_dispatch_table_pinned():
+    """The per-backend crossover is the measured one — a change to the
+    table is a deliberate re-measurement, not an accident."""
+    assert AUTO_FLASH_MIN_SEQ == {"tpu": 2048}
+    # tpu: dense strictly below 2048, flash at/above
+    for t in (128, 512, 1024, 2047):
+        assert resolve_attention_impl("auto", t, "tpu") == "dense"
+    for t in (2048, 4096, 8192):
+        assert resolve_attention_impl("auto", t, "tpu") == "flash"
+    # cpu (and any unmeasured backend): never auto-select the pallas
+    # kernel — it runs in interpret mode there
+    for t in (128, 2048, 65536):
+        assert resolve_attention_impl("auto", t, "cpu") == "dense"
+    # explicit impls pass through untouched
+    for impl in ("dense", "flash", "ring", "ring_flash", "striped",
+                 "striped_flash", "ulysses"):
+        assert resolve_attention_impl(impl, 8192, "tpu") == impl
+
+
+def test_auto_is_the_default():
+    """TransformerConfig, ModelConfig, and the CLI all default to auto."""
+    assert TransformerConfig(vocab_size=8).attention == "auto"
+    assert TrainConfig().model.attention == "auto"
+    args = build_argparser().parse_args(["--dataset", "text"])
+    assert config_from_args(args).model.attention == "auto"
+
+
+def test_dense_blockwise_exact_vs_dense():
+    """attention_dense_blockwise (VERDICT r4 item 5): same math as dense
+    with a (B,H,C,T) scores temp — outputs AND grads must match the
+    reference to float32 tolerance at chunking, non-chunking (T % chunk
+    != 0 falls back to one block), causal and bidirectional shapes."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+        attention_dense_blockwise, attention_reference,
+    )
+
+    rng = np.random.default_rng(0)
+    for (b, t, h, d), chunk, causal in [
+        ((2, 512, 4, 16), 128, True),
+        ((2, 512, 4, 16), 128, False),
+        ((1, 96, 2, 8), 64, True),     # 96 % 64 != 0 -> whole-seq block
+        ((2, 256, 2, 32), 256, True),  # chunk == T
+    ]:
+        q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)),
+                               jnp.float32) for _ in range(3))
+
+        def loss(fn, q, k, v, _c=causal):
+            return jnp.sum(fn(q, k, v, causal=_c).astype(jnp.float32) ** 2)
+
+        ref = attention_reference(q, k, v, causal=causal)
+        blk = attention_dense_blockwise(q, k, v, causal=causal,
+                                        q_chunk=chunk)
+        np.testing.assert_allclose(np.asarray(blk), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        g_ref = jax.grad(lambda *a: loss(attention_reference, *a))(q, k, v)
+        g_blk = jax.grad(
+            lambda *a: loss(attention_dense_blockwise, *a))(q, k, v)
+        np.testing.assert_allclose(np.asarray(g_blk), np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_auto_equals_dense_below_crossover():
+    """On this backend (cpu) auto resolves to dense at every T, so the
+    forward is bitwise identical — the resolution changes dispatch, never
+    math."""
+    cfg_auto = TransformerConfig(vocab_size=64, max_seq_len=32, n_layers=2,
+                                 d_model=32, n_heads=4, d_ff=64,
+                                 attention="auto")
+    cfg_dense = TransformerConfig(vocab_size=64, max_seq_len=32, n_layers=2,
+                                  d_model=32, n_heads=4, d_ff=64,
+                                  attention="dense")
+    model_a, model_d = Transformer(cfg_auto), Transformer(cfg_dense)
+    params = model_a.init(prng.init_key(0))
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (2, 16)), jnp.int32)
+    out_a = jax.jit(model_a.apply)(params, ids)
+    out_d = jax.jit(model_d.apply)(params, ids)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_d))
