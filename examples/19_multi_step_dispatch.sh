@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Multi-step dispatch (--steps_per_dispatch, round 5): k optimizer steps
+# run inside ONE jitted lax.scan over a device-staged stack of k batches,
+# so small (dispatch-bound) models stop paying a host round trip per step
+# — the TPU-first answer to the reference's per-step gather-average-send
+# loop (dataParallelTraining_NN_MPI.py:149-211).  The scan replays the
+# identical batches in the identical order, so the loss trajectory is the
+# k=1 trajectory; this script runs the same job both ways and diffs the
+# final loss.
+set -euo pipefail
+
+run() {
+    python -m neural_networks_parallel_training_with_mpi_tpu \
+        --platform "${PLATFORM:-cpu}" --num_devices "${NUM_DEVICES:-8}" \
+        --nepochs 4 --no-full-batch --batch_size 4 \
+        --steps_per_dispatch "$1" 2>&1 | tail -3
+}
+
+echo "== per-step dispatch (k=1) =="
+L1=$(run 1 | grep -o 'loss [0-9.]*' | tail -1)
+echo "$L1"
+echo "== 8 steps per dispatch (k=8) =="
+L8=$(run 8 | grep -o 'loss [0-9.]*' | tail -1)
+echo "$L8"
+
+[ "$L1" = "$L8" ] || { echo "trajectory mismatch: '$L1' vs '$L8'"; exit 1; }
+echo "OK: k=8 dispatch trajectory identical to k=1 ($L1)"

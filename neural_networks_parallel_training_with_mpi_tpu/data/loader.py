@@ -194,7 +194,47 @@ class ShardedLoader:
                 break
             yield {k: v[idx] for k, v in self.data.items()}
 
-    def _place(self, batch: Arrays) -> Dict[str, jax.Array]:
+    def epoch_groups(self, epoch: int, k: int, start_step: int = 0
+                     ) -> Iterator[tuple]:
+        """Yield ``(stacked_batch, n_steps, rows)`` groups of up to ``k``
+        consecutive batches, stacked on a leading scan axis and shipped in
+        ONE host->device transfer (parallel.sharding.shard_batch_stack) —
+        the data side of multi-step dispatch (--steps_per_dispatch).  The
+        batches and their order are IDENTICAL to :meth:`epoch`'s (same
+        shuffle, same padding), so a k-step ``lax.scan`` over the stack
+        replays exactly the steps the per-step loop would run; the final
+        group of an epoch may be shorter.  ``rows`` is the group's real
+        (unpadded) row count for samples/sec accounting."""
+        if self.multi_host:
+            raise NotImplementedError(
+                "steps_per_dispatch > 1 is single-host for now: the "
+                "stacked group would need a make_global_batch variant "
+                "assembling per-process rows under the scan axis")
+        if self.seq_axis:
+            raise NotImplementedError(
+                "steps_per_dispatch > 1 with sequence parallelism needs a "
+                "stacked spmd.place_batch (seq-sharded dim 2); run the "
+                "per-step loop on SP layouts")
+        host = (self._native.epoch(epoch, start_batch=start_step)
+                if self._native is not None
+                else self._host_batches(epoch, start_step))
+        if self.prefetch > 0 and self._native is None:
+            host = _thread_prefetch(host, self.prefetch)
+        group, rows, step = [], 0, start_step
+        for batch in host:
+            group.append(self._pad(batch))
+            rows += self.batch_rows(step)
+            step += 1
+            if len(group) == k:
+                yield (shd.shard_batch_stack(self.mesh, group,
+                                             self.batch_axes),
+                       len(group), rows)
+                group, rows = [], 0
+        if group:
+            yield (shd.shard_batch_stack(self.mesh, group, self.batch_axes),
+                   len(group), rows)
+
+    def _pad(self, batch: Arrays) -> Arrays:
         padded = {}
         pad_mask = None
         for k, v in batch.items():
@@ -206,6 +246,10 @@ class ShardedLoader:
             padded["mask"] = padded["mask"].astype(np.float32) * pad_mask
         else:
             padded["mask"] = pad_mask
+        return padded
+
+    def _place(self, batch: Arrays) -> Dict[str, jax.Array]:
+        padded = self._pad(batch)
         if not self.multi_host:
             if self.seq_axis:
                 from ..parallel import spmd

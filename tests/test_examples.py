@@ -277,3 +277,15 @@ def test_example_18_speculative_decoding_completes():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "tokens identical" in out.stdout
     assert "accept rate" in out.stdout
+
+
+def test_example_19_multi_step_dispatch_completes():
+    """Same job at --steps_per_dispatch 1 and 8: the script itself diffs
+    the final loss lines and fails on any trajectory divergence."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "19_multi_step_dispatch.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "trajectory identical" in out.stdout
