@@ -14,7 +14,7 @@ from .registry import build_model
 from .generate import generate, generate_sharded
 from .generate_tp import generate_tp, pipeline_params_for_decode
 from .serve import DecodeServer
-from .speculative import speculative_generate
+from .speculative import speculative_generate, speculative_generate_device
 
 __all__ = [
     "Module", "Linear", "Sequential", "Activation", "Conv2D", "LayerNorm",
@@ -22,4 +22,5 @@ __all__ = [
     "TransformerConfig", "build_model", "generate", "generate_sharded",
     "generate_tp", "pipeline_params_for_decode", "DecodeServer",
     "speculative_generate",
+    "speculative_generate_device",
 ]

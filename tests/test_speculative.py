@@ -197,3 +197,67 @@ def test_temperature_speculation_runs_and_is_deterministic():
     with pytest.raises(ValueError, match="PRNG key"):
         speculative_generate(target, tp, draft, dp, prompt, 4,
                              temperature=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Device-side single-program greedy speculation (round 5)
+# ---------------------------------------------------------------------------
+
+from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (  # noqa: E402
+    speculative_generate_device,
+)
+
+
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
+@pytest.mark.parametrize("k", [1, 3, 4, 7])
+def test_device_exactness_any_k(k):
+    """The fully-jitted program (lax.while_loop rounds + scan draft +
+    on-device acceptance) must equal plain greedy decode token for
+    token, like the host loop — including the predicated tail phase."""
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, seed=7)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    want = generate(target, tp, prompt, 17)
+    got, stats = speculative_generate_device(target, tp, draft, dp,
+                                             prompt, 17, k=k)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert stats["target_passes"] >= 1
+    assert stats["proposed_total"] == k * stats["rounds"]
+
+
+def test_device_exactness_batch_and_tail():
+    """B > 1 rows commit in lockstep (min acceptance across rows); an
+    n+p combination that forces the tail scan to finish the decode."""
+    target, tp = _model(layers=2, seed=0)
+    draft, dp = _model(layers=1, seed=7)
+    rng = np.random.default_rng(3)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (3, 4)), jnp.int32)
+    for n, k in [(6, 4), (5, 5), (12, 3)]:
+        want = generate(target, tp, prompt, n)
+        got, _ = speculative_generate_device(target, tp, draft, dp,
+                                             prompt, n, k=k)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_device_matches_host_loop_commits():
+    """Same acceptance rule as the host loop: identical tokens AND the
+    same accepted_total on a trained-ish (self-draft) pair where
+    acceptance is nontrivial."""
+    target, tp = _model(layers=2, seed=0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    got_h, st_h = speculative_generate(target, tp, target, tp, prompt,
+                                       12, k=4)
+    got_d, st_d = speculative_generate_device(target, tp, target, tp,
+                                              prompt, 12, k=4)
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(got_d))
+    # self-draft: every full-round proposal accepted on both paths
+    assert st_d["accept_rate"] == 1.0 or st_d["rounds"] == 0
+
+
+def test_device_zero_tokens_schema():
+    target, tp = _model(layers=1, seed=0)
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    got, stats = speculative_generate_device(target, tp, target, tp,
+                                             prompt, 0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(prompt))
+    assert "proposed_total" in stats and stats["rounds"] == 0
