@@ -363,13 +363,54 @@ def bench_framework(config_name: str, batch_override: int | None = None,
     log(f"[{config_name}] {steps} steps in {dt:.3f}s -> {sps:,.0f} samples/sec"
         f" ({step_ms:.2f} ms/step"
         + (f", MFU {mfu:.1%}" if mfu is not None else "") + ")")
-    return dict(
+    rec = dict(
         config=config_name, samples_per_sec=sps, step_ms=step_ms,
         mfu=None if mfu is None else round(mfu, 4),
         platform=devices[0].platform, device_kind=kind,
         n_devices=len(devices), batch=batch_size,
         train_flops_per_step=train_flops, param_bytes=param_bytes,
     )
+    # multi-step dispatch (--steps_per_dispatch, VERDICT r4 item 6): the
+    # dispatch-bound configs (MNIST 0.011 / CIFAR 0.038 MFU) spend their
+    # step in the host->device round trip this per-step loop above pays by
+    # construction.  Measure the lever: k distinct batches staged in ONE
+    # transfer (shard_batch_stack), k steps in ONE lax.scan dispatch —
+    # including the transfer in the timed region, because that is the real
+    # per-dispatch cost the trainer's epoch_groups path pays.
+    if (config_name in ("toy", "wide", "mnist", "cifar")
+            and not os.environ.get("BENCH_SKIP_DISPATCH8")):
+        from jax import lax
+
+        k_disp = 8
+
+        def multi(state, stacked):
+            return lax.scan(lambda s, b: step(s, b), state, stacked)
+
+        multi = jax.jit(multi)
+        host_batches = [cfg["make_batch"](rng, batch_size)
+                        for _ in range(k_disp)]
+        stacked = shd.shard_batch_stack(mesh, host_batches)
+        state, losses = multi(state, stacked)     # compile
+        float(jax.device_get(losses[-1]))
+        n_disp = max(2, (n2 // k_disp))
+        best = None
+        for _rep in range(1 if on_tpu else _CPU_TIMING_REPS):
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                stacked = shd.shard_batch_stack(mesh, host_batches)
+                state, losses = multi(state, stacked)
+            float(jax.device_get(losses[-1]))
+            d = time.perf_counter() - t0
+            best = d if best is None else min(best, d)
+        ms_k = best / (n_disp * k_disp) * 1e3
+        rec["step_ms_dispatch8"] = round(ms_k, 3)
+        rec["dispatch8_speedup"] = round(step_ms / ms_k, 3)
+        if mfu is not None:
+            rec["mfu_dispatch8"] = round(
+                train_flops / (ms_k / 1e3) / (peak * len(devices)), 4)
+        log(f"[{config_name}] steps_per_dispatch=8: {ms_k:.3f} ms/step "
+            f"({rec['dispatch8_speedup']}x vs per-step dispatch)")
+    return rec
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +537,10 @@ def _run_child_cpu(config: str, n_devices: int = 1,
     accelerator-failure fallback (a process whose backend already
     initialized cannot switch platforms)."""
     env = _cpu_child_env(n_devices)
+    # scaling-sweep children only ever read step_ms; the dispatch8
+    # side-measurement would add a k=8 scan compile + timing reps to each
+    # of the ~30 median-of-k attribution children for discarded output
+    env["BENCH_SKIP_DISPATCH8"] = "1"
     cmd = [sys.executable, __file__, "--config", config, "--platform", "cpu"]
     if batch:
         cmd += ["--batch", str(batch)]
@@ -543,29 +588,57 @@ def run_scaling_sweep(out_path: str = "BENCH_SCALING.json",
         # ring all-reduce moves 2(n-1)/n * bytes per device per step
         rec["allreduce_bytes_per_device"] = (
             None if pb is None else int(2 * (n - 1) / n * pb))
-        # collective-cost attribution (VERDICT r3 item 7): the identical
-        # per-shard compute with every gradient psum removed ('local'
-        # ablation, parallel.data_parallel) — the step-time difference IS
-        # the allreduce + rendezvous cost at this mesh size
+        # collective-cost attribution (VERDICT r3 item 7 / r4 item 7):
+        # the identical per-shard compute with every gradient psum
+        # removed ('local' ablation, parallel.data_parallel).  A single
+        # full/ablate pair drowned at n=8 (the diff was smaller than this
+        # single-core host's run-to-run noise), so the diff is now a
+        # MEDIAN-OF-K INTERLEAVED DIFFERENCE: k alternating (full,
+        # ablate) child runs cancel slow load drift, the medians
+        # difference, and the repeat spread (max-min of each column) is
+        # the stated noise floor — when the diff still loses to it, the
+        # row carries the statistical BOUND instead of null.
         if n > 1:
-            ab = _run_child_cpu("wide", n_devices=n,
-                                batch=per_device_batch * n,
-                                grad_reduction="local")
-            if ab is not None:
-                rec["compute_ms"] = ab["step_ms"]
-                if ab["step_ms"] >= rec["step_ms"]:
-                    # the ablation timing beat is smaller than this
-                    # single-core host's run-to-run noise: report that,
-                    # not a fake measured zero
-                    rec["collective_ms"] = None
-                    rec["collective_pct_of_step"] = None
-                    rec["collective_attribution"] = "below_noise_floor"
-                else:
-                    rec["collective_ms"] = round(
-                        rec["step_ms"] - ab["step_ms"], 3)
+            k_reps = 5
+            fulls, ablates = [rec["step_ms"]], []
+            for _rep in range(k_reps):
+                ab = _run_child_cpu("wide", n_devices=n,
+                                    batch=per_device_batch * n,
+                                    grad_reduction="local")
+                if ab is not None:
+                    ablates.append(ab["step_ms"])
+                if len(fulls) < k_reps:
+                    fl = _run_child_cpu("wide", n_devices=n,
+                                        batch=per_device_batch * n)
+                    if fl is not None:
+                        fulls.append(fl["step_ms"])
+            if ablates:
+                med_full = float(np.median(fulls))
+                med_ab = float(np.median(ablates))
+                spread = round(max(np.ptp(fulls), np.ptp(ablates)), 3)
+                rec["compute_ms"] = round(med_ab, 3)
+                rec["step_ms_median_of_k"] = round(med_full, 3)
+                rec["repeat_spread_ms"] = spread
+                rec["attribution_reps"] = {"full": len(fulls),
+                                           "ablate": len(ablates)}
+                diff = round(med_full - med_ab, 3)
+                if diff > 0 and diff > spread / 2:
+                    rec["collective_ms"] = diff
                     rec["collective_pct_of_step"] = round(
-                        100.0 * rec["collective_ms"] / rec["step_ms"], 1)
-                    rec["collective_attribution"] = "measured"
+                        100.0 * diff / med_full, 1)
+                    rec["collective_attribution"] = "measured_median_of_k"
+                else:
+                    # the true cost is indistinguishable from noise even
+                    # after k interleaved repeats: publish the bound the
+                    # data supports, not null
+                    bound = round(max(diff, 0.0) + spread / 2, 3)
+                    rec["collective_ms"] = None
+                    rec["collective_ms_upper_bound"] = bound
+                    rec["collective_pct_of_step"] = None
+                    rec["collective_pct_upper_bound"] = round(
+                        100.0 * bound / med_full, 1)
+                    rec["collective_attribution"] = \
+                        "bounded_by_noise_median_of_k"
         else:
             rec["compute_ms"] = rec["step_ms"]
             rec["collective_ms"] = 0.0
@@ -596,6 +669,8 @@ def run_scaling_sweep(out_path: str = "BENCH_SCALING.json",
             "collective overhead added by the framework; compute_ms is the "
             "same step with every gradient psum removed "
             "(--grad-reduction local), so collective_ms = step - compute "
+            "(median of k interleaved full/ablate repeats; rows the noise "
+            "floor still beats carry collective_ms_upper_bound instead) "
             "attributes the allreduce/rendezvous share and "
             "compute_only_overhead_pct the rest (XLA:CPU per-program "
             "dispatch, which multiplies with n on one shared core and "
@@ -895,6 +970,9 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
         sharding as shd,
         spmd,
     )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.sequence import (
+        resolve_attention_impl,
+    )
     from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
     from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
@@ -937,7 +1015,11 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
         row = {"seq": seq, "batch": b, "mode": "dense_vs_flash"}
         if not on_tpu:
             row["interpret_mode"] = True  # flash = Pallas emulation on CPU
-        impls = ("dense", "flash") if seq <= 4096 else ("flash",)
+        # "auto" is the framework default (VERDICT r4 item 3): the row
+        # proves the dispatch table picks the winner at every swept T —
+        # auto_ms should track min(dense_ms, flash_ms) within noise
+        impls = (("dense", "flash", "auto") if seq <= 4096
+                 else ("flash", "auto"))
         if seq > 4096:
             row["dense_skipped"] = "quadratic scores tensor at 8k"
         for att in impls:
@@ -954,6 +1036,12 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
             row[f"{att}_ms"] = time_step(step, state, batch, n1, n2)
         if row.get("dense_ms") and row.get("flash_ms"):
             row["flash_speedup"] = round(row["dense_ms"] / row["flash_ms"], 3)
+        if row.get("auto_ms"):
+            row["auto_resolved"] = resolve_attention_impl(
+                "auto", seq, "tpu" if on_tpu else "cpu")
+            best = min(v for k_, v in row.items()
+                       if k_ in ("dense_ms", "flash_ms"))
+            row["auto_vs_best"] = round(row["auto_ms"] / best, 3)
         log(f"[attention] {row}")
         results.append(row)
 

@@ -80,6 +80,15 @@ VARIANTS = [
      {"flash_block_k": 512}),
     ("b8_unroll_ce256_h8_dense", 8, False, "dots", "dense", 256, False,
      {"n_heads": 8}),
+    # round 5 (VERDICT r4 item 5): blockwise dense — identical math to
+    # dense with a (B,H,256,T) scores temp per scan tick, so the remote
+    # compile helper never sees the (B,H,T,T) tensor its suspected-
+    # systematic HTTP 500 keys on.  Answers "is flash the right choice
+    # at big_lm shape" even if full dense keeps 500ing.
+    ("b8_unroll_ce256_h8_dense_blockwise", 8, False, "dots",
+     "dense_blockwise", 256, False, {"n_heads": 8}),
+    ("b8_unroll_ce256_dense_blockwise", 8, False, "dots",
+     "dense_blockwise", 256, False, {}),
 ]
 
 

@@ -90,6 +90,25 @@ PRIORITY = [
     # int8 weights-only decode (ops.quant, round 4): the decode loop is
     # HBM-bound, so the chip row should approach 2x dense bf16
     ("decode_int8", [sys.executable, "bench.py", "--decode"], 1500),
+    # ---- round 5 (VERDICT r4 items 1-6) ----
+    # head-geometry + blockwise-dense big_lm variants (h8/h4 reshape fills
+    # the (8,128) lane tiles; dense_blockwise dodges the (B,H,T,T) temp
+    # the remote compile helper 500s on)
+    ("biglm_sweep_r5", [sys.executable, "tools/big_lm_sweep.py"], 2400),
+    # block_q x block_k sweep at the 1k-2k kernel-only deficit shapes
+    ("flash_block_sweep", [sys.executable, "tools/flash_block_sweep.py"],
+     2100),
+    # trained draft/target speculative decode: accept rate + tokens/sec
+    ("spec_decode_trained", [sys.executable, "tools/spec_decode_eval.py"],
+     2400),
+    # attention bench re-run: now carries the auto-dispatch column
+    # (auto_ms must track min(dense, flash) at every swept T)
+    ("attention_auto", [sys.executable, "bench.py", "--attention"], 2100),
+    # full config sweep re-run: mnist/wide/cifar rows now carry
+    # step_ms_dispatch8 (the multi-step dispatch lever on the
+    # dispatch-bound configs) and serving rows the int8/GQA/kv8 levers
+    ("bench_all_r5", [sys.executable, "bench.py", "--all"], 2400),
+    ("decode_r5", [sys.executable, "bench.py", "--decode"], 1500),
 ]
 
 
