@@ -37,6 +37,51 @@ except Exception:
 
 import pytest  # noqa: E402
 
+# Core-lane wall-clock budget (VERDICT r4 item 8: the lane doubled from ~5
+# to ~10 min in one round with no brake).  Every `-m "not slow"` session
+# appends its duration to .lane_times.jsonl and FAILS the run if it blew
+# the budget — growth now breaks CI loudly instead of compounding
+# silently.  Heavyweight additions belong in the full lane (@slow).
+CORE_LANE_BUDGET_S = 600.0
+_session_t0 = None
+
+
+def pytest_sessionstart(session):
+    global _session_t0
+    import time as _time
+
+    _session_t0 = _time.time()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json as _json
+    import time as _time
+
+    if _session_t0 is None:
+        return
+    marker = session.config.getoption("-m", default="") or ""
+    if "not slow" not in marker:
+        return  # full lane / targeted runs are unbudgeted
+    elapsed = _time.time() - _session_t0
+    n = session.testscollected
+    rec = {"t_iso": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
+           "seconds": round(elapsed, 1), "tests": n,
+           "budget_s": CORE_LANE_BUDGET_S,
+           "over_budget": elapsed > CORE_LANE_BUDGET_S}
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "..",
+                               ".lane_times.jsonl"), "a") as f:
+            f.write(_json.dumps(rec) + "\n")
+    except OSError:
+        pass
+    if elapsed > CORE_LANE_BUDGET_S and n > 100:
+        # n > 100 guards against budget-failing a filtered subset run
+        # that happens to pass -m "not slow"
+        session.exitstatus = 1
+        print(f"\nCORE LANE OVER BUDGET: {elapsed:.0f}s > "
+              f"{CORE_LANE_BUDGET_S:.0f}s — move the heaviest new tests "
+              f"to the full lane (@pytest.mark.slow)", flush=True)
+
 
 @pytest.fixture(scope="session")
 def devices():

@@ -76,6 +76,7 @@ def test_wait_pending_surfaces_write_errors(tmp_path, mesh8, monkeypatch):
         ckpt.wait_pending()
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_seq_parallel_accumulation_matches_unsplit(mesh8):
     """DP x SP with accum_steps=2 equals accum_steps=1 up to f32
     summation-order noise (partial sums per microbatch reassociate the

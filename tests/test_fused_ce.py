@@ -130,6 +130,7 @@ def test_train_step_trajectory_parity():
         params[0], params[1])
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_spmd_seq_parallel_trajectory_parity():
     """Fused chunked CE under DP x SP (ring attention, seq-sharded batch):
     one jitted step lands on the same weights as the unfused path — the

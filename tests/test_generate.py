@@ -24,6 +24,7 @@ def lm():
     return model, params
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_greedy_matches_parallel_forward(lm):
     """Each greedy token equals the argmax of the full (non-cached) forward
     at that position — the KV-cache path reproduces training math."""

@@ -174,6 +174,7 @@ def test_moe_quantization():
     assert np.asarray(jnp.abs(quant - full)).max() < 0.15
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_int8_kv_cache_decode():
     """generate(kv_quant=True): int8 cache + per-(b, pos, head) scales.
     Both scales commute exactly through the attention contractions (K

@@ -91,6 +91,7 @@ def test_digits_real_dataset():
     assert abs(float(d1["x"].mean())) < 1e-4
 
 
+@pytest.mark.slow  # lane budget (round 5): heaviest in module; core coverage kept by the sibling tests
 def test_lm_validation_reports_perplexity():
     cfg = TrainConfig(
         nepochs=1, batch_size=32, full_batch=False, optimizer="adam",
