@@ -134,108 +134,163 @@ def main() -> int:
     )
     from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+
     target, t_params, draft, d_params, quality, held = _train_pair()
     print(f"[spec_eval] trained pair: {quality}", flush=True)
 
-    # held-out prompts: BATCH distinct 32-byte windows of unseen text
+    # Truncated-target draft (VERDICT r4 item 2's other suggestion):
+    # the target's OWN embed + first block + final LN + head, no extra
+    # training — its distribution correlates with the target's far more
+    # than an independently-trained tiny model's, which is what accept
+    # rate actually measures.
+    trunc_cfg = TransformerConfig(
+        vocab_size=target.cfg.vocab_size,
+        max_seq_len=target.cfg.max_seq_len, n_layers=1,
+        d_model=target.cfg.d_model, n_heads=target.cfg.n_heads,
+        d_ff=target.cfg.d_ff)
+    trunc = Transformer(trunc_cfg)
+    trunc_params = dict(t_params)
+    trunc_params["blocks"] = [t_params["blocks"][0]]
+    drafts = {
+        "trained_L1_d64": (draft, d_params),
+        "truncated_L1_of_target": (trunc, trunc_params),
+    }
+
+    # held-out prompts: N_PROMPTS distinct windows of unseen text.
+    # B=1 rows are the standard per-stream speculative setting; accept
+    # rate is averaged over all windows (a single window is prompt
+    # lottery — run-to-run corpus drift moved it 0.23 -> 0.03), timing
+    # uses window 0.
     held_arr = np.frombuffer(held, np.uint8)
-    step = max(1, (len(held_arr) - PROMPT_LEN) // BATCH)
-    prompt = jnp.asarray(
-        np.stack([held_arr[i * step:i * step + PROMPT_LEN]
-                  for i in range(BATCH)]).astype(np.int32))
+    n_prompts = 4
+    stride = max(1, (len(held_arr) - PROMPT_LEN) // n_prompts)
+    windows = [jnp.asarray(held_arr[i * stride:i * stride + PROMPT_LEN]
+                           .astype(np.int32))[None, :]
+               for i in range(n_prompts)]
 
     reps = 3
+
+    def time_fn(fn, *args, **kw):
+        jax.block_until_ready(fn(*args, **kw)[0])     # warmup/compile
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args, **kw)
+            jax.block_until_ready(out[0] if isinstance(out, tuple) else out)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return best
+
     plain = jax.jit(lambda pr: generate(target, t_params, pr, NEW_TOKENS))
-    ref_out = jax.block_until_ready(plain(prompt))     # warmup + reference
-    plain_best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        jax.block_until_ready(plain(prompt))
-        dt = time.perf_counter() - t0
-        plain_best = dt if plain_best is None else min(plain_best, dt)
-    plain_tps = BATCH * NEW_TOKENS / plain_best
+    refs = [jax.block_until_ready(plain(w)) for w in windows]
+    plain_best = time_fn(lambda pr: (plain(pr),), windows[0])
+    plain_tps = NEW_TOKENS / plain_best
 
     rows = []
-    for k in GREEDY_KS:
-        for mode, fn in (("greedy_host", speculative_generate),
-                         ("greedy_device", speculative_generate_device)):
-            out, stats = fn(target, t_params, draft, d_params,
-                            prompt, NEW_TOKENS, k=k)
-            # the exactness contract, on the TRAINED pair
-            np.testing.assert_array_equal(np.asarray(out),
-                                          np.asarray(ref_out))
-            best = None
-            for _ in range(reps):
-                t0 = time.perf_counter()
-                out, stats = fn(target, t_params, draft, d_params,
-                                prompt, NEW_TOKENS, k=k)
-                jax.block_until_ready(out)
-                dt = time.perf_counter() - t0
-                best = dt if best is None else min(best, dt)
-            tps = BATCH * NEW_TOKENS / best
+    for dname, (dm, dp) in drafts.items():
+        for k in GREEDY_KS:
+            # accept stats: mean over every held-out window (host loop;
+            # the device path pins equal commits so its rate matches
+            # up to tail bookkeeping)
+            accs, passes = [], []
+            for w, ref in zip(windows, refs):
+                out, st = speculative_generate(target, t_params, dm, dp,
+                                               w, NEW_TOKENS, k=k)
+                np.testing.assert_array_equal(np.asarray(out),
+                                              np.asarray(ref))
+                accs.append(st["accepted_total"]
+                            / max(st["proposed_total"], 1))
+                passes.append(st["target_passes"] / NEW_TOKENS)
+            t_host = time_fn(speculative_generate, target, t_params,
+                             dm, dp, windows[0], NEW_TOKENS, k=k)
+            t_dev = time_fn(speculative_generate_device, target, t_params,
+                            dm, dp, windows[0], NEW_TOKENS, k=k)
             rows.append({
-                "mode": mode, "k": k,
-                "accept_rate": round(stats["accepted_total"]
-                                     / max(stats["proposed_total"], 1), 4),
-                "target_passes": stats["target_passes"],
-                "passes_per_token": round(
-                    stats["target_passes"] / NEW_TOKENS, 4),
-                "draft_steps": stats["draft_steps"],
-                "tokens_per_sec": round(tps, 1),
-                "ratio_vs_plain": round(tps / plain_tps, 3),
+                "mode": "greedy", "draft": dname, "k": k, "batch": 1,
+                "accept_rate_mean": round(float(np.mean(accs)), 4),
+                "accept_rate_per_window": [round(a, 4) for a in accs],
+                "passes_per_token_mean": round(float(np.mean(passes)), 4),
+                "host_tokens_per_sec": round(NEW_TOKENS / t_host, 1),
+                "device_tokens_per_sec": round(NEW_TOKENS / t_dev, 1),
+                "host_ratio_vs_plain": round(plain_best / t_host, 3),
+                "device_ratio_vs_plain": round(plain_best / t_dev, 3),
                 "greedy_exact": True,
             })
-            print(f"[spec_eval] {mode} k={k}: "
-                  f"accept={rows[-1]['accept_rate']} "
-                  f"passes/tok={rows[-1]['passes_per_token']} "
-                  f"ratio={rows[-1]['ratio_vs_plain']}", flush=True)
+            print(f"[spec_eval] {dname} k={k}: "
+                  f"accept={rows[-1]['accept_rate_mean']} "
+                  f"passes/tok={rows[-1]['passes_per_token_mean']} "
+                  f"host_ratio={rows[-1]['host_ratio_vs_plain']} "
+                  f"device_ratio={rows[-1]['device_ratio_vs_plain']}",
+                  flush=True)
+
+    # batched lockstep row: B rows commit at the min acceptance across
+    # the batch — the documented batching-vs-accept tradeoff, one row
+    batch_prompt = jnp.concatenate(windows[:BATCH], axis=0)
+    plain_b = jax.jit(lambda pr: generate(target, t_params, pr,
+                                          NEW_TOKENS))
+    ref_b = jax.block_until_ready(plain_b(batch_prompt))
+    tb_plain = time_fn(lambda pr: (plain_b(pr),), batch_prompt)
+    dm, dp = drafts["truncated_L1_of_target"]
+    out, st = speculative_generate(target, t_params, dm, dp, batch_prompt,
+                                   NEW_TOKENS, k=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref_b))
+    tb_dev = time_fn(speculative_generate_device, target, t_params, dm,
+                     dp, batch_prompt, NEW_TOKENS, k=2)
+    rows.append({
+        "mode": "greedy_lockstep", "draft": "truncated_L1_of_target",
+        "k": 2, "batch": BATCH,
+        "accept_rate": round(st["accepted_total"]
+                             / max(st["proposed_total"], 1), 4),
+        "passes_per_token": round(st["target_passes"] / NEW_TOKENS, 4),
+        "device_ratio_vs_plain": round(tb_plain / tb_dev, 3),
+        "note": "B rows commit at the min acceptance across the batch",
+    })
+    print(f"[spec_eval] lockstep B={BATCH} k=2: {rows[-1]}", flush=True)
 
     k, temp = TEMP_ROW
     key = prng.init_key(7)
-    out, stats = speculative_generate(target, t_params, draft, d_params,
-                                      prompt, NEW_TOKENS, k=k,
-                                      temperature=temp, key=key)
-    best = None
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _, stats = speculative_generate(target, t_params, draft, d_params,
-                                        prompt, NEW_TOKENS, k=k,
-                                        temperature=temp, key=key)
-        dt = time.perf_counter() - t0
-        best = dt if best is None else min(best, dt)
-    tps = BATCH * NEW_TOKENS / best
+    out, st = speculative_generate(target, t_params, draft, d_params,
+                                   windows[0], NEW_TOKENS, k=k,
+                                   temperature=temp, key=key)
+    t_temp = time_fn(speculative_generate, target, t_params, draft,
+                     d_params, windows[0], NEW_TOKENS, k=k,
+                     temperature=temp, key=key)
     rows.append({
-        "mode": "temperature", "k": k, "temperature": temp,
-        "accept_rate": round(stats["accepted_total"]
-                             / max(stats["proposed_total"], 1), 4),
-        "target_passes": stats["target_passes"],
-        "passes_per_token": round(stats["target_passes"] / NEW_TOKENS, 4),
-        "draft_steps": stats["draft_steps"],
-        "tokens_per_sec": round(tps, 1),
-        "ratio_vs_plain": round(tps / plain_tps, 3),
+        "mode": "temperature", "draft": "trained_L1_d64", "k": k,
+        "batch": 1, "temperature": temp,
+        "accept_rate": round(st["accepted_total"]
+                             / max(st["proposed_total"], 1), 4),
+        "passes_per_token": round(st["target_passes"] / NEW_TOKENS, 4),
+        "host_ratio_vs_plain": round(plain_best / t_temp, 3),
     })
 
-    best_row = max((r for r in rows if r["mode"].startswith("greedy")),
-                   key=lambda r: r["ratio_vs_plain"])
+    best_row = max((r for r in rows if r["mode"] == "greedy"),
+                   key=lambda r: r["device_ratio_vs_plain"])
     doc = {
         "platform": platform,
         "device_kind": device_kind,
         "captured_unix": round(time.time(), 1),
         "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "elapsed_s": round(time.time() - t_start, 1),
-        "note": "speculative decoding on a TRAINED draft/target byte-LM "
-                "pair (docs corpus); accept_rate is platform-independent, "
-                "tokens/sec is fallback-grade on cpu",
-        "geometry": {"batch": BATCH, "prompt_len": PROMPT_LEN,
-                     "new_tokens": NEW_TOKENS,
+        "note": "speculative decoding on a TRAINED target (docs corpus) "
+                "with two drafts (independently trained tiny LM; "
+                "truncated first-layer view of the target itself); "
+                "accept_rate is platform-independent, tokens/sec is "
+                "fallback-grade on cpu",
+        "geometry": {"prompt_len": PROMPT_LEN, "new_tokens": NEW_TOKENS,
+                     "n_prompt_windows": n_prompts,
                      "target": "L4 d128 h4 ff384",
-                     "draft": "L1 d64 h2 ff128"},
+                     "drafts": list(drafts)},
         "trained_quality": quality,
-        "plain_tokens_per_sec": round(plain_tps, 1),
+        "plain_tokens_per_sec_b1": round(plain_tps, 1),
         "rows": rows,
-        "best_greedy": {"k": best_row["k"],
-                        "accept_rate": best_row["accept_rate"],
-                        "ratio_vs_plain": best_row["ratio_vs_plain"]},
+        "best_greedy": {"draft": best_row["draft"], "k": best_row["k"],
+                        "accept_rate": best_row["accept_rate_mean"],
+                        "device_ratio_vs_plain":
+                            best_row["device_ratio_vs_plain"]},
     }
     name = ("BENCH_DECODE_SPEC.json" if platform != "cpu"
             else "BENCH_DECODE_SPEC_CPU.json")
@@ -243,9 +298,11 @@ def main() -> int:
     with open(path, "w") as f:
         json.dump(doc, f, indent=2)
     print(json.dumps({"metric": "speculative_trained_accept_rate",
-                      "value": best_row["accept_rate"],
+                      "value": best_row["accept_rate_mean"],
                       "unit": "fraction",
-                      "ratio_vs_plain": best_row["ratio_vs_plain"],
+                      "draft": best_row["draft"],
+                      "device_ratio_vs_plain":
+                          best_row["device_ratio_vs_plain"],
                       "platform": platform,
                       "spec_artifact": name}))
     return 0
