@@ -1373,6 +1373,35 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
         4 * new_tokens / (time.perf_counter() - t0), 1)
     results["speculative_selfdraft_target_passes"] = (
         self_stats["target_passes"])
+    # the single-program DEVICE path (round 5): same acceptance, zero
+    # host traffic — on the tunneled chip (~65 ms host round trip per
+    # dispatch) this is where the lever lives.  Self-draft shows the
+    # orchestration ceiling at accept 1; the trained-pair eval
+    # (BENCH_DECODE_SPEC*.json) owns the realistic-accept rows.
+    from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (
+        speculative_generate_device,
+    )
+
+    speculative_generate_device(model, params, draft, draft_params,
+                                spec_prompt, new_tokens, k=4)  # compile
+    t0 = time.perf_counter()
+    _, dev_stats = speculative_generate_device(model, params, draft,
+                                               draft_params, spec_prompt,
+                                               new_tokens, k=4)
+    results["speculative_device_tokens_per_sec"] = round(
+        4 * new_tokens / (time.perf_counter() - t0), 1)
+    results["speculative_device_target_passes"] = (
+        dev_stats["target_passes"])
+    speculative_generate_device(model, params, model, params, spec_prompt,
+                                new_tokens, k=4)  # compile
+    t0 = time.perf_counter()
+    _, sd_stats = speculative_generate_device(model, params, model,
+                                              params, spec_prompt,
+                                              new_tokens, k=4)
+    results["speculative_device_selfdraft_tokens_per_sec"] = round(
+        4 * new_tokens / (time.perf_counter() - t0), 1)
+    results["speculative_device_selfdraft_target_passes"] = (
+        sd_stats["target_passes"])
     if n_dev >= 2:
         from neural_networks_parallel_training_with_mpi_tpu.parallel.sharding import (
             replicated_sharding,

@@ -200,7 +200,8 @@ class TrainConfig:
     # dispatch that dominates small models (MNIST MLP measured 0.011 MFU —
     # dispatch-bound, BENCH_FULL.json).  Trajectory-identical to k=1 (the
     # scan replays the same batches in the same order); 1 = off.
-    # Single-host, non-SP layouts (see ShardedLoader.epoch_groups).
+    # Single-host layouts (see ShardedLoader.epoch_groups); SP stacks
+    # through spmd.place_batch_stack.
     steps_per_dispatch: int = 1
     # virtual stage-slices per pipeline device (interleaved schedule,
     # parallel.pipeline): bubble fraction (pp-1)/(v*M + pp-1) instead of

@@ -201,20 +201,26 @@ class ShardedLoader:
         ONE host->device transfer (parallel.sharding.shard_batch_stack) —
         the data side of multi-step dispatch (--steps_per_dispatch).  The
         batches and their order are IDENTICAL to :meth:`epoch`'s (same
-        shuffle, same padding), so a k-step ``lax.scan`` over the stack
-        replays exactly the steps the per-step loop would run; the final
-        group of an epoch may be shorter.  ``rows`` is the group's real
-        (unpadded) row count for samples/sec accounting."""
+        shuffle, same padding, same seq permutation), so a k-step
+        ``lax.scan`` over the stack replays exactly the steps the
+        per-step loop would run; the final group of an epoch may be
+        shorter.  ``rows`` is the group's real (unpadded) row count for
+        samples/sec accounting.  Seq-parallel layouts stack through
+        ``spmd.place_batch_stack`` (seq-sharded dim 2)."""
         if self.multi_host:
             raise NotImplementedError(
                 "steps_per_dispatch > 1 is single-host for now: the "
                 "stacked group would need a make_global_batch variant "
                 "assembling per-process rows under the scan axis")
         if self.seq_axis:
-            raise NotImplementedError(
-                "steps_per_dispatch > 1 with sequence parallelism needs a "
-                "stacked spmd.place_batch (seq-sharded dim 2); run the "
-                "per-step loop on SP layouts")
+            from ..parallel import spmd
+
+            place = lambda group: spmd.place_batch_stack(
+                self.mesh, group, self.seq_axis,
+                batch_axes=self.batch_axes)
+        else:
+            place = lambda group: shd.shard_batch_stack(
+                self.mesh, group, self.batch_axes)
         host = (self._native.epoch(epoch, start_batch=start_step)
                 if self._native is not None
                 else self._host_batches(epoch, start_step))
@@ -226,13 +232,10 @@ class ShardedLoader:
             rows += self.batch_rows(step)
             step += 1
             if len(group) == k:
-                yield (shd.shard_batch_stack(self.mesh, group,
-                                             self.batch_axes),
-                       len(group), rows)
+                yield place(group), len(group), rows
                 group, rows = [], 0
         if group:
-            yield (shd.shard_batch_stack(self.mesh, group, self.batch_axes),
-                   len(group), rows)
+            yield place(group), len(group), rows
 
     def _pad(self, batch: Arrays) -> Arrays:
         padded = {}

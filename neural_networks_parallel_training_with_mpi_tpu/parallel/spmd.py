@@ -131,6 +131,26 @@ def place_batch(mesh: Mesh, batch: Batch, seq_axis: Optional[str],
             for k, v in batch.items()}
 
 
+def place_batch_stack(mesh: Mesh, batches, seq_axis: Optional[str],
+                      batch_axes: Tuple[str, ...] = DATA_AXES) -> Batch:
+    """Stack ``k`` host batches on a new LEADING scan axis and place them
+    with :func:`batch_specs`'s layout shifted one dim right: dim 0 (the
+    dispatch's step axis, consumed by ``lax.scan``) replicated, dim 1
+    over ``batch_axes``, dim 2 over 'seq' for rank>=3 non-mask leaves —
+    multi-step dispatch (--steps_per_dispatch) on the seq-parallel
+    layouts (the SP analogue of ``sharding.shard_batch_stack``)."""
+
+    def put(key, *xs):
+        x = jnp.stack([jnp.asarray(v) for v in xs])
+        if key == "mask" or x.ndim < 3 or not seq_axis:
+            spec = P(None, batch_axes, *([None] * (x.ndim - 2)))
+        else:
+            spec = P(None, batch_axes, seq_axis, *([None] * (x.ndim - 3)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return {k: put(k, *[b[k] for b in batches]) for k in batches[0]}
+
+
 def run_one_step(model, optimizer: Optimizer, mesh: Mesh, state: TrainState,
                  batch: Batch, loss_name: str = "cross_entropy",
                  seq_axis: str = "seq") -> Tuple[TrainState, jax.Array]:

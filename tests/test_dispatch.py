@@ -98,21 +98,27 @@ def test_cli_flag_plumbed():
     assert TrainConfig().steps_per_dispatch == 1   # default off
 
 
-def test_sp_layout_guarded():
-    """Sequence parallelism needs a stacked place_batch variant that does
-    not exist yet — the loader must say so, not silently misplace."""
-    cfg = TrainConfig(
-        lr=1e-3, nepochs=1, batch_size=8, full_batch=False,
-        optimizer="adam", loss="cross_entropy", log_every=0,
-        steps_per_dispatch=2,
-        data=DataConfig(dataset="lm", seq_len=32, n_samples=64),
-        model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
-                          n_heads=4, d_ff=64, vocab_size=256,
-                          max_seq_len=32, attention="ring"),
-        mesh=MeshConfig(data=4, seq=2))
-    tr = Trainer(cfg)
-    with pytest.raises(NotImplementedError, match="steps_per_dispatch"):
-        tr.fit()
+@pytest.mark.slow  # two SP shard_map fits (~40s); lane budget (round 5)
+def test_k2_trajectory_identical_seq_parallel():
+    """Ring-attention SP layout: epoch_groups stacks through
+    spmd.place_batch_stack (seq-sharded dim 2), and the scanned
+    shard_map step replays the per-step trajectory bitwise."""
+
+    def cfg(k):
+        return TrainConfig(
+            lr=1e-3, nepochs=2, batch_size=8, full_batch=False,
+            optimizer="adam", loss="cross_entropy", log_every=0,
+            steps_per_dispatch=k,
+            data=DataConfig(dataset="lm", seq_len=32, n_samples=48),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=256,
+                              max_seq_len=32, attention="ring"),
+            mesh=MeshConfig(data=4, seq=2))
+
+    p1, r1 = _fit_params(cfg(1))
+    p2, r2 = _fit_params(cfg(2))
+    assert r1["steps"] == r2["steps"]
+    _assert_tree_equal(p1, p2)
 
 
 def test_checkpoint_boundary_crossing():
