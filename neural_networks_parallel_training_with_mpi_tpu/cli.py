@@ -164,18 +164,45 @@ def _generate(args) -> int:
     return 0
 
 
+def _supervise(args, argv) -> int:
+    """--supervise N: run this same command under the crash-restart
+    supervisor (train.resilience.supervise; exit-code contract in that
+    module and DESIGN.md §6).  The child argv is this argv minus the
+    supervisor flags, plus --resume when a checkpoint dir is configured so
+    every relaunch continues from the newest snapshot."""
+    from .train.resilience import strip_supervisor_flags, supervise
+
+    child = strip_supervisor_flags(argv)
+    if args.checkpoint_dir and "--resume" not in child:
+        child.append("--resume")
+    pkg = __name__.rsplit(".", 1)[0]
+    return supervise([sys.executable, "-m", pkg, *child],
+                     max_restarts=args.supervise,
+                     backoff=args.supervise_backoff)
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
     args = build_argparser().parse_args(argv)
+    if getattr(args, "supervise", 0) > 0:
+        return _supervise(args, argv)  # before any backend init
     rc = _pin_platform(args)
     if rc:
         return rc
     if getattr(args, "generate", None) is not None:
         return _generate(args)
+    from .train.resilience import EXIT_ANOMALY, AnomalyAbort
     from .train.trainer import Trainer  # import after the platform pin
 
     cfg = config_from_args(args)
     trainer = Trainer(cfg)
-    result = trainer.fit()
+    try:
+        result = trainer.fit()
+    except AnomalyAbort as e:
+        # deterministic divergence: the last good checkpoint is preserved
+        # (no final save) and the supervisor must NOT relaunch
+        log(f"ERROR: anomaly abort: {e} (exit {EXIT_ANOMALY})")
+        return EXIT_ANOMALY
     log(f"done: final loss {result['final_loss']:.6f}, "
         f"{result['samples_per_sec']:.1f} samples/sec")
     val = {k: v for k, v in result.items() if k.startswith("val_")}

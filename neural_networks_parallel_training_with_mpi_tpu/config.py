@@ -198,8 +198,12 @@ class TrainConfig:
     # k optimizer steps per host dispatch (lax.scan over a device-staged
     # stack of k batches, VERDICT r4 item 6): amortizes the per-step host
     # dispatch that dominates small models (MNIST MLP measured 0.011 MFU —
-    # dispatch-bound, BENCH_FULL.json).  Trajectory-identical to k=1 (the
-    # scan replays the same batches in the same order); 1 = off.
+    # dispatch-bound, BENCH_FULL.json).  The scan replays the identical
+    # batches in the identical order, so on the explicit shard_map DP/SP
+    # paths the trajectory is BITWISE identical to k=1; on the GSPMD
+    # (tensor/fsdp) paths it is the same math within compile-fusion noise
+    # (XLA may fuse/reassociate differently inside the scan body —
+    # tests/test_dispatch.py bounds the drift).  1 = off.
     # Single-host layouts (see ShardedLoader.epoch_groups); SP stacks
     # through spmd.place_batch_stack.
     steps_per_dispatch: int = 1
@@ -254,6 +258,33 @@ class TrainConfig:
     # fail fast if no step completes within this many seconds (0 = off);
     # the reference hangs forever on a lost rank (utils.watchdog, §5.3)
     hang_timeout: float = 0.0
+    # ---- resilience (train.resilience; all defaults = off) ----
+    # guarded update: reject a step whose global gradient norm is
+    # non-finite (the update becomes a bitwise no-op on params/opt-state
+    # on every replica — ops.optim.with_skip_guard).  DP / DP x SP
+    # shard_map and GSPMD layouts.
+    skip_nonfinite: bool = False
+    # additionally reject steps whose global grad norm exceeds this
+    # (0 = off; > 0 implies skip_nonfinite — measured before clipping)
+    skip_threshold: float = 0.0
+    # roll back to the last checkpoint after this many CONSECUTIVE bad
+    # steps (non-finite or spiking loss); 0 = off.  Without a
+    # checkpoint_dir (or before the first snapshot) rolls back to the
+    # deterministic init.  With shuffle on, the post-rollback data order
+    # is re-drawn (ShardedLoader.order_salt) so a poisonous batch window
+    # is not replayed verbatim.
+    rollback_after: int = 0
+    # abort with exit code 44 (train.resilience.EXIT_ANOMALY) after this
+    # many rollbacks — a deterministic divergence the supervisor must NOT
+    # retry
+    max_rollbacks: int = 2
+    # loss-spike detector: a finite loss counts as bad when it exceeds
+    # this factor times the EMA of recent good losses (0 = off; only
+    # meaningful with rollback_after > 0)
+    loss_spike_factor: float = 0.0
+    # deterministic fault injection spec (utils.faults; falls back to the
+    # NNPT_FAULTS env var), e.g. "nan@5-8?max=4,crash@12?once=/tmp/m"
+    faults: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)
@@ -312,7 +343,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="k optimizer steps per host dispatch (lax.scan "
                         "over a device-staged batch stack) — amortizes "
                         "per-step dispatch overhead on small models; "
-                        "trajectory-identical to k=1")
+                        "same batches in the same order, so bitwise "
+                        "trajectory-identical to k=1 on the shard_map "
+                        "DP/SP paths, identical-within-fusion-noise on "
+                        "the GSPMD (tp/fsdp) paths")
     p.add_argument("--pp_interleave", type=int, default=1,
                    help="virtual stage-slices per pipeline device "
                         "(interleaved schedule: bubble / v at constant "
@@ -468,6 +502,38 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--hang_timeout", type=float, default=0.0,
                    help="abort with thread stacks if no step completes "
                         "within this many seconds (0 = off)")
+    # resilience (train.resilience; DESIGN.md §6)
+    _add_bool_flag(p, "skip-nonfinite", False,
+                   "guarded update: a step with a non-finite global grad "
+                   "norm is a bitwise no-op on params/opt-state (DP, "
+                   "DP x SP, GSPMD layouts)")
+    p.add_argument("--skip_threshold", type=float, default=0.0,
+                   help="also skip steps whose global grad norm exceeds "
+                        "this (0 = off; implies --skip-nonfinite)")
+    p.add_argument("--rollback_after", type=int, default=0,
+                   help="roll back to the last checkpoint after this many "
+                        "consecutive bad (non-finite/spiking-loss) steps "
+                        "(0 = off)")
+    p.add_argument("--max_rollbacks", type=int, default=2,
+                   help="abort with exit code 44 after this many "
+                        "rollbacks (the supervisor does not retry 44)")
+    p.add_argument("--loss_spike_factor", type=float, default=0.0,
+                   help="count a finite loss as bad when it exceeds this "
+                        "factor times the EMA of recent losses (0 = off)")
+    p.add_argument("--faults", type=str, default="",
+                   help="deterministic fault injection spec (utils.faults: "
+                        "'nan@5-8?max=4,crash@12?once=PATH,sigterm@9'; "
+                        "NNPT_FAULTS env var is the fallback)")
+    p.add_argument("--supervise", type=int, default=0, metavar="N",
+                   help="run under the crash-restart supervisor: relaunch "
+                        "this same command on crash/hang (exit 42/43/any "
+                        "crash) up to N times with exponential backoff; "
+                        "exit 0 and exit 44 (anomaly abort) stop.  With "
+                        "--checkpoint_dir each relaunch resumes from the "
+                        "newest snapshot (--resume is appended)")
+    p.add_argument("--supervise_backoff", type=float, default=1.0,
+                   help="initial supervisor backoff in seconds (doubles "
+                        "per restart, capped at 60s)")
     # launch-path flags (consumed by cli.main before any JAX backend init;
     # not part of TrainConfig).  The reference's launcher is mpiexec
     # (README.md:12); ours is the JAX platform choice + device mesh.
@@ -520,6 +586,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
         hang_timeout=args.hang_timeout,
+        skip_nonfinite=args.skip_nonfinite or args.skip_threshold > 0,
+        skip_threshold=args.skip_threshold,
+        rollback_after=args.rollback_after,
+        max_rollbacks=args.max_rollbacks,
+        loss_spike_factor=args.loss_spike_factor,
+        faults=args.faults,
     )
     cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
                           seq=args.sp, fsdp=args.fsdp, expert=args.ep)

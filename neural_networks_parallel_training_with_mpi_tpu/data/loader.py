@@ -49,20 +49,29 @@ def _thread_prefetch(gen: Iterator[Arrays], depth: int) -> Iterator[Arrays]:
     stop = threading.Event()
 
     def work():
+        def put(item) -> bool:
+            # EVERY handoff polls the stop event — including the _DONE
+            # sentinel and the exception handoff.  A plain q.put() there
+            # would park the worker forever when the consumer abandons the
+            # iterator with the queue full (e.g. an exception unwinding
+            # the train loop right at epoch end).
+            while True:
+                if stop.is_set():
+                    return False
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+
         try:
             for item in gen:
-                while True:
-                    if stop.is_set():
-                        return
-                    try:
-                        q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                if not put(item):
+                    return
         except BaseException as e:  # noqa: BLE001 — handed to the consumer
-            q.put(e)
+            put(e)
             return
-        q.put(_DONE)
+        put(_DONE)
 
     threading.Thread(target=work, daemon=True,
                      name="loader-prefetch").start()
@@ -125,6 +134,14 @@ class ShardedLoader:
         self.batch_size = self.n if full_batch else min(batch_size, self.n)
         self.shuffle = shuffle
         self.seed = seed
+        # anomaly-rollback re-draw (train.resilience): bumping the salt
+        # changes every SUBSEQUENT epoch order so a rolled-back run does
+        # not replay a poisonous batch window verbatim.  0 (the default)
+        # keeps the historical (seed, epoch) stream bitwise intact; the
+        # native (C++) batcher owns its own permutation and ignores the
+        # salt (rollback there replays the same order — still correct,
+        # just not re-drawn).
+        self.order_salt = 0
         self.remainder = remainder
         self.prefetch = prefetch
         self.multi_host = (jax.process_count() > 1 if multi_host is None
@@ -154,7 +171,9 @@ class ShardedLoader:
     def _epoch_order(self, epoch: int) -> np.ndarray:
         order = np.arange(self.n)
         if self.shuffle:
-            np.random.default_rng((self.seed, epoch)).shuffle(order)
+            key = ((self.seed, epoch) if not self.order_salt
+                   else (self.seed, epoch, self.order_salt))
+            np.random.default_rng(key).shuffle(order)
         return order
 
     def batch_rows(self, step: int) -> int:

@@ -342,6 +342,83 @@ def adafactor(lr: LR, b1: float = 0.0, decay_pow: float = 0.8,
                      state_specs=state_specs)
 
 
+class GuardedState(NamedTuple):
+    """Opt state of :func:`with_skip_guard`: the wrapped optimizer's state
+    plus a cumulative count of *rejected* updates.  Lives inside the jitted
+    step, so the skip decision costs no host round-trip; the host reads
+    ``skipped`` only off the hot path (end of training / rollback)."""
+
+    skipped: jax.Array  # int32 scalar — updates rejected so far
+    inner: Pytree
+
+
+def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
+    """Guard the wrapped update against non-finite (and optionally huge)
+    gradients: the update runs under a ``lax.cond`` on a scalar predicate
+    computed from the *global* gradient norm, so a bad step is a bitwise
+    no-op on params and inner optimizer state on every replica
+    identically — and the happy path pays only the norm reduction.
+
+    The predicate is ``isfinite(global_norm(grads))`` and, when
+    ``skip_threshold > 0``, additionally ``global_norm <= skip_threshold``
+    (measured on the raw reduced gradients, before any ``with_clipping``
+    the guard wraps — clipping would mask the anomaly the threshold is
+    there to catch).
+
+    Correctness requires the gradients this wrapper sees to be identical
+    on every shard that holds a given parameter — true wherever the update
+    runs on fully-reduced (post-psum) or global-view gradients: the
+    shard_map DP / DP x SP paths and the GSPMD path.  Layouts that call
+    ``optimizer.update`` on axis-sharded gradient *slices* (zero1's
+    scattered flat shard, pipeline stages, expert/tensor slicing) would
+    make the norm — and hence the skip decision — shard-local and
+    divergent; the Trainer refuses the guard there.
+
+    Semantics on a skipped step: ``TrainState.step`` still advances (it
+    counts attempted steps and drives the data order), while the inner
+    optimizer's ``count`` — and therefore the lr schedule — does not
+    (optimizer steps = applied updates).  ``GuardedState.skipped`` counts
+    the rejections.
+    """
+
+    def init(params: Pytree) -> GuardedState:
+        return GuardedState(jnp.zeros((), jnp.int32), opt.init(params))
+
+    def update(grads: Pytree, state: GuardedState, params: Pytree):
+        from jax import lax
+
+        norm = global_norm(grads)
+        ok = jnp.isfinite(norm)
+        if skip_threshold > 0:
+            ok = ok & (norm <= skip_threshold)
+
+        # lax.cond rather than tree_map(where): the predicate is a traced
+        # device scalar (no host divergence), and on the happy path only
+        # the apply branch's work runs — a where-select would add a full
+        # extra read+write pass over params AND optimizer state every
+        # step (measured +24% on a dispatch-bound CPU micro-model; the
+        # cond form is noise-level)
+        def apply(_):
+            new_params, new_inner = opt.update(grads, state.inner, params)
+            return new_params, GuardedState(state.skipped, new_inner)
+
+        def skip(_):
+            return params, GuardedState(state.skipped + 1, state.inner)
+
+        return lax.cond(ok, apply, skip, None)
+
+    def state_specs(ps, params=None):
+        from jax.sharding import PartitionSpec
+
+        if opt.state_specs is None:
+            raise ValueError(f"{opt.name} lacks state_specs")
+        return GuardedState(PartitionSpec(), opt.state_specs(ps, params))
+
+    return Optimizer(init, update,
+                     f"guard(thr={skip_threshold}):{opt.name}",
+                     state_specs=state_specs)
+
+
 def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:
     """Clip gradients by global L2 norm before the wrapped update.
 

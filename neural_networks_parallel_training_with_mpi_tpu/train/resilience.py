@@ -1,0 +1,231 @@
+"""Training resilience: anomaly policy, preemption-safe exit, supervisor.
+
+The reference's only failure mode is "hang forever in ``comm.gather``"
+(SURVEY.md §5.3).  The watchdog (``utils/watchdog.py``) already converts a
+lost peer into a loud exit; this module defends the *state itself* and the
+*job*:
+
+* :func:`ops.optim.with_skip_guard` (wired by the Trainer) rejects
+  non-finite / over-threshold updates inside the jitted step — a single bad
+  batch can no longer poison the replicated params.
+* :class:`ResilienceMonitor` is the host-side anomaly policy: it watches
+  the (one-step-lagged) loss stream the train loop already fetches, and
+  after ``rollback_after`` consecutive bad steps asks for a rollback to the
+  last checkpoint; after ``max_rollbacks`` rollbacks it aborts with
+  :class:`AnomalyAbort` (exit code :data:`EXIT_ANOMALY`).
+* :class:`GracefulShutdown` turns SIGTERM/SIGINT (TPU preemption, scheduler
+  eviction) into a flag the step loop checks at the next boundary: final
+  checkpoint, exit 0 — an external restart loses at most one step.
+* :func:`supervise` is the crash-restart supervisor: relaunch on crash with
+  exponential backoff and bounded restarts, interpreting the exit-code
+  contract below to decide retry-vs-stop.
+
+Exit-code contract (also consumed by ``tools/supervise.py``):
+
+===========  ============================================  =========
+code         meaning                                       supervisor
+===========  ============================================  =========
+0            run completed (or exited cleanly on SIGTERM)  stop
+42           watchdog: no step progress (hang)             retry
+43           peer loss: a collective raised                retry
+44           anomaly abort: rollback budget exhausted      stop
+other        crash (segfault, OOM, fault injection, ...)   retry
+===========  ============================================  =========
+"""
+
+from __future__ import annotations
+
+import math
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+EXIT_OK = 0
+EXIT_HANG = 42      # utils.watchdog.HangWatchdog
+EXIT_PEER = 43      # a collective raised (see tests/faulty_child.py)
+EXIT_ANOMALY = 44   # ResilienceMonitor exhausted its rollback budget
+
+# exit codes the supervisor must NOT retry: 0 is success; 44 is a
+# deterministic training failure that a relaunch would only replay
+_NO_RETRY = (EXIT_OK, EXIT_ANOMALY)
+
+
+class AnomalyAbort(RuntimeError):
+    """Training diverged past the rollback budget; maps to exit 44."""
+
+
+class ResilienceMonitor:
+    """Host-side anomaly policy over the step-loss stream.
+
+    A step is *bad* when its loss is non-finite, or — with
+    ``spike_factor > 0`` — exceeds ``spike_factor`` times the exponential
+    moving average of recent good losses (the EMA warms up over
+    ``warmup`` good steps before spike detection arms, so the noisy first
+    steps of a fresh init cannot trip it).
+
+    ``observe`` returns ``"ok"``, ``"bad"`` (bad, under the consecutive
+    threshold), ``"rollback"`` (restore the last checkpoint and keep
+    going) or ``"abort"`` (rollback budget exhausted — raise
+    :class:`AnomalyAbort`).  A rollback resets the EMA: the restored
+    params re-warm it.
+    """
+
+    def __init__(self, rollback_after: int, max_rollbacks: int = 2,
+                 spike_factor: float = 0.0, ema_beta: float = 0.9,
+                 warmup: int = 5):
+        if rollback_after < 1:
+            raise ValueError(f"rollback_after must be >= 1, got "
+                             f"{rollback_after}")
+        self.rollback_after = rollback_after
+        self.max_rollbacks = max_rollbacks
+        self.spike_factor = spike_factor
+        self.ema_beta = ema_beta
+        self.warmup = warmup
+        self.consecutive = 0   # bad steps since the last good one
+        self.rollbacks = 0     # rollbacks performed so far
+        self.bad_steps = 0     # total bad steps observed
+        self._ema: Optional[float] = None
+        self._n_good = 0
+
+    def observe(self, loss: float) -> str:
+        bad = not math.isfinite(loss)
+        if (not bad and self.spike_factor > 0 and self._ema is not None
+                and self._n_good >= self.warmup):
+            bad = loss > self.spike_factor * max(self._ema, 1e-12)
+        if not bad:
+            self.consecutive = 0
+            self._ema = (loss if self._ema is None
+                         else self.ema_beta * self._ema
+                         + (1.0 - self.ema_beta) * loss)
+            self._n_good += 1
+            return "ok"
+        self.bad_steps += 1
+        self.consecutive += 1
+        if self.consecutive < self.rollback_after:
+            return "bad"
+        self.consecutive = 0
+        if self.rollbacks >= self.max_rollbacks:
+            return "abort"
+        self.rollbacks += 1
+        self._ema = None
+        self._n_good = 0
+        return "rollback"
+
+    def stats(self) -> dict:
+        return {"bad_steps": self.bad_steps, "rollbacks": self.rollbacks}
+
+
+class GracefulShutdown:
+    """SIGTERM/SIGINT -> a flag the step loop polls at dispatch boundaries.
+
+    ``with GracefulShutdown() as stop:`` installs handlers (previous
+    handlers are restored on exit); ``stop.requested`` turns True on the
+    first signal.  A second signal of the same kind falls through to the
+    previous handler semantics via a hard re-raise — so an operator's
+    double-Ctrl-C still kills a wedged run.  Signal handlers only exist on
+    the main thread; elsewhere the context is an inert no-op (trainers
+    driven from worker threads keep working, without preemption safety).
+    """
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,
+                                                 signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._previous: dict = {}
+        self.requested = False
+        self.signum: Optional[int] = None
+
+    def _handler(self, signum, frame):
+        if self.requested:
+            # second signal: restore + re-raise so the default/previous
+            # disposition (usually: die now) takes over
+            prev = self._previous.get(signum, signal.SIG_DFL)
+            signal.signal(signum, prev)
+            signal.raise_signal(signum)
+            return
+        self.requested = True
+        self.signum = signum
+        print(f"[resilience] caught signal {signum}: finishing the current "
+              "step, writing a final checkpoint, exiting 0", file=sys.stderr,
+              flush=True)
+
+    def __enter__(self) -> "GracefulShutdown":
+        for s in self._signals:
+            try:
+                self._previous[s] = signal.signal(s, self._handler)
+            except ValueError:  # not the main thread: no handlers, no-op
+                self._previous.pop(s, None)
+                break
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._previous.clear()
+
+
+def strip_supervisor_flags(argv: Sequence[str]) -> List[str]:
+    """Remove ``--supervise [N]`` / ``--supervise_backoff [S]`` from an argv
+    so the supervised child runs the plain training entrypoint (handles
+    both ``--flag value`` and ``--flag=value`` forms)."""
+    flags = ("--supervise", "--supervise_backoff")
+    out: List[str] = []
+    skip = False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok in flags:
+            skip = True
+            continue
+        if any(tok.startswith(f + "=") for f in flags):
+            continue
+        out.append(tok)
+    return out
+
+
+def supervise(cmd: Sequence[str], max_restarts: int,
+              backoff: float = 1.0, backoff_cap: float = 60.0,
+              env: Optional[dict] = None,
+              log: Callable[[str], None] = None,
+              _sleep: Callable[[float], None] = time.sleep) -> int:
+    """Run ``cmd`` under the crash-restart policy; return the final exit
+    code.
+
+    ``max_restarts`` bounds RELAUNCHES (the initial launch is free).  Exit
+    0 and exit 44 stop immediately (see the module exit-code contract);
+    anything else — watchdog 42, peer-loss 43, crashes, signal deaths
+    (negative returncodes) — is retried with exponential backoff
+    ``backoff * 2^k`` capped at ``backoff_cap`` seconds.  The relaunched
+    command is identical; resume-from-newest-snapshot is the child's job
+    (``cli`` appends ``--resume`` when a checkpoint dir is configured).
+    """
+    if log is None:
+        log = lambda m: print(m, file=sys.stderr, flush=True)
+    attempt = 0
+    while True:
+        attempt += 1
+        log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
+        rc = subprocess.call(list(cmd), env=env)
+        if rc in _NO_RETRY:
+            if rc == EXIT_ANOMALY:
+                log("[supervise] child exited 44 (anomaly abort): "
+                    "deterministic training failure — not retrying")
+            else:
+                log("[supervise] child completed (exit 0)")
+            return rc
+        restarts_used = attempt - 1
+        if restarts_used >= max_restarts:
+            log(f"[supervise] giving up: {max_restarts} restarts exhausted "
+                f"(last exit {rc})")
+            return rc
+        delay = min(backoff * (2.0 ** restarts_used), backoff_cap)
+        reason = {EXIT_HANG: "watchdog hang",
+                  EXIT_PEER: "peer loss"}.get(rc, "crash")
+        log(f"[supervise] child exit {rc} ({reason}); relaunching in "
+            f"{delay:.1f}s ({restarts_used + 1}/{max_restarts})")
+        _sleep(delay)

@@ -244,6 +244,13 @@ class Trainer:
             batch_axes=self.batch_axes,
             backend=cfg.data.backend,
             seq_permutation=self.seq_permutation)
+        if int(cfg.steps_per_dispatch) > 1 and self.loader.multi_host:
+            # fail here, not lazily on the first epoch_groups iteration
+            # after step-builder compilation (ADVICE r5)
+            raise NotImplementedError(
+                "steps_per_dispatch > 1 is single-host for now: the "
+                "stacked group would need a make_global_batch variant "
+                "assembling per-process rows under the scan axis")
         # schedule domain: optimizer steps = train steps (accumulation is
         # inside the step), known once the loader fixes steps-per-epoch
         lr = schedules.make(
@@ -272,6 +279,27 @@ class Trainer:
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
+        # guarded update (train.resilience / DESIGN.md §6): reject
+        # non-finite or over-threshold steps inside the jitted step.  Wired
+        # where optimizer.update consumes fully-reduced or global-view
+        # gradients, so the skip predicate is identical on every replica:
+        # plain DP, DP x SP, and GSPMD.  Layouts whose update runs on
+        # axis-sharded gradient SLICES (zero1's scattered flat shard,
+        # pipeline stages, expert/tensor slicing) would make the norm —
+        # and hence the skip decision — shard-local and divergent.
+        self.guarded = cfg.skip_nonfinite or cfg.skip_threshold > 0
+        if self.guarded:
+            if (self.pipeline or self.expert or self.sp_tp or self.ep_tp
+                    or self.zero1):
+                raise NotImplementedError(
+                    "--skip-nonfinite/--skip_threshold (the guarded "
+                    "update) is wired into the plain DP, DP x seq, and "
+                    "GSPMD layouts, whose updates see the full reduced "
+                    "gradient; pipe/expert/seq-x-tensor/zero1 updates run "
+                    "on gradient slices where a shard-local norm would "
+                    "desynchronize the skip decision")
+            self.optimizer = optim_lib.with_skip_guard(
+                self.optimizer, cfg.skip_threshold)
         if self.pipeline:
             from ..parallel import pipeline as pp
 
@@ -476,6 +504,17 @@ class Trainer:
         if restored is None:
             return 0
         restored = self._reconcile_qkv_tp(ckpt, restored)
+        self._place_restored(restored)
+        # restore the anomaly-rollback order salt: a relaunch after a
+        # rollback must keep the re-drawn data order, not replay the
+        # poison window and re-spend the rollback budget on it
+        meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
+        self.loader.order_salt = int(meta.get("order_salt", 0))
+        return int(jax.device_get(self.state.step))
+
+    def _place_restored(self, restored: TrainState) -> None:
+        """Place a host-side restored state per this trainer's layout
+        (shared by resume and anomaly rollback)."""
         if self.pipeline:
             from ..parallel import pipeline as pp
 
@@ -508,6 +547,24 @@ class Trainer:
                                               self.optimizer)
         else:
             self.state = dp.replicate_state(restored, self.mesh)
+
+    def _rollback(self) -> int:
+        """Anomaly rollback (train.resilience): restore the newest
+        checkpoint (or the deterministic init when none exists yet) and
+        re-draw the subsequent data order so the poison window is not
+        replayed verbatim.  Returns the global step to resume from."""
+        from ..utils import checkpoint as ckpt
+
+        restored = None
+        if self.cfg.checkpoint_dir:
+            ckpt.wait_pending()  # an in-flight async write may be newest
+            restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
+        if restored is None:
+            self.init_state()  # no snapshot yet: back to step 0
+        else:
+            restored = self._reconcile_qkv_tp(ckpt, restored)
+            self._place_restored(restored)
+        self.loader.order_salt += 1
         return int(jax.device_get(self.state.step))
 
     def _reconcile_qkv_tp(self, ckpt, restored: TrainState) -> TrainState:
@@ -553,11 +610,16 @@ class Trainer:
             tree["blocks"] = b
             return tree
 
-        opt_state = restored.opt_state
-        if isinstance(opt_state, tuple):  # SGDState/AdamState
-            opt_state = type(opt_state)(*(fix(f) for f in opt_state))
+        def fix_state(st):
+            # recurse through NamedTuple slots (SGDState/AdamState, and
+            # the guard wrapper's GuardedState around them) down to the
+            # param-mirroring dicts fix() permutes
+            if isinstance(st, tuple) and type(st) is not tuple:
+                return type(st)(*(fix_state(f) for f in st))
+            return fix(st)
+
         return TrainState(step=restored.step, params=fix(restored.params),
-                          opt_state=opt_state)
+                          opt_state=fix_state(restored.opt_state))
 
     def save(self, final: bool = False) -> None:
         # every process calls in: checkpoint.save is leader-only for
@@ -568,10 +630,15 @@ class Trainer:
 
             # record the (shape-preserving, hence otherwise undetectable)
             # TP qkv permutation so maybe_resume can reconcile a different
-            # tensor-axis size; dense layouts record 1 explicitly
+            # tensor-axis size; dense layouts record 1 explicitly.  The
+            # rollback salt rides along so a supervised relaunch resumes
+            # with the re-drawn data order instead of replaying a poison
+            # window the in-process rollback already routed around.
             extra = {"qkv_tp": (int(self.mesh.shape.get("tensor", 1))
                                 if (self.pipeline or self.sp_tp
-                                    or self.ep_tp) else 1)}
+                                    or self.ep_tp) else 1),
+                     "order_salt": int(getattr(self.loader,
+                                               "order_salt", 0))}
             if self.cfg.async_checkpoint and not final:
                 ckpt.save_async(self.cfg.checkpoint_dir, self.state,
                                 extra_meta=extra)
@@ -608,83 +675,169 @@ class Trainer:
         # hang watchdog (SURVEY.md §5.3): with log_every on, the loop blocks
         # in device_get on the previous step's loss, so a stalled device
         # stalls the pats and the watchdog fires instead of hanging forever
+        from ..utils.faults import FaultPlan
         from ..utils.watchdog import HangWatchdog
+        from .resilience import (AnomalyAbort, GracefulShutdown,
+                                 ResilienceMonitor)
 
         watchdog = HangWatchdog(cfg.hang_timeout or None)
-        with profiler, watchdog:
-            for epoch in range(start_epoch, cfg.nepochs):
-                log(f"Starting epoch {epoch + 1}")  # reference banner, :152
-                epoch_t0 = time.perf_counter()
-                epoch_start_step = step % spe if epoch == start_epoch else 0
-                loss = None
-                if self.k_dispatch > 1:
-                    # (stacked k-batch, n_steps, rows) per host dispatch;
-                    # loss logging reports each dispatch's LAST step (the
-                    # intermediate losses live only inside the scan)
-                    dispatches = self.loader.epoch_groups(
-                        epoch, self.k_dispatch, start_step=epoch_start_step)
-                else:
-                    dispatches = (
-                        (b, 1, self.loader.batch_rows(epoch_start_step + i))
-                        for i, b in enumerate(self.loader.epoch(
-                            epoch, start_step=epoch_start_step)))
-                for batch, n_steps, rows in dispatches:
-                    # log when the dispatch CROSSED a log_every boundary
-                    # (== the modulo rule at n_steps=1; prev[3] is the
-                    # step count before that dispatch)
-                    if prev is not None and cfg.log_every and \
-                            prev[0] // cfg.log_every > prev[3] // cfg.log_every:
-                        last_loss = float(jax.device_get(prev[2]))
-                        self.metrics.write({
-                            "step": prev[0], "epoch": prev[1],
-                            "loss": last_loss,
-                            "samples_per_sec": thr.samples_per_sec,
-                        })
+        # anomaly policy (DESIGN.md §6): consumes the per-step loss
+        # futures at a fixed lag of two dispatches, so its device_get only
+        # ever waits on a step whose successor is already submitted — one
+        # dispatch stays in flight and the async pipeline keeps host prep
+        # overlapped with device compute (the pure lag-1 logging path
+        # semantics are unchanged when the monitor is off)
+        monitor = (ResilienceMonitor(cfg.rollback_after, cfg.max_rollbacks,
+                                     cfg.loss_spike_factor)
+                   if cfg.rollback_after > 0 else None)
+        monitor_q: list = []  # (step, loss future), observed at lag 2
+        fault_plan = FaultPlan.from_config(cfg.faults)
+        # preemption-safe exit: SIGTERM/SIGINT set a flag checked at each
+        # dispatch boundary -> final checkpoint -> exit 0 (<= 1 lost step)
+        shutdown = GracefulShutdown()
+        dispatches = None
+        try:
+            with profiler, watchdog, shutdown:
+                epoch = start_epoch
+                # in-epoch offset, consumed by the first epoch iteration only
+                # (and re-seeded by a rollback); mirrors the old
+                # `epoch == start_epoch` special case
+                mid_epoch_start = start_step % spe
+                while epoch < cfg.nepochs and not shutdown.requested:
+                    log(f"Starting epoch {epoch + 1}")  # reference banner, :152
+                    epoch_t0 = time.perf_counter()
+                    epoch_start_step = mid_epoch_start
+                    mid_epoch_start = 0
+                    loss = None
+                    rolled_back = False
                     if self.k_dispatch > 1:
-                        self.state, losses = self.multi_step(self.state,
-                                                             batch)
-                        loss = losses[-1]
+                        # (stacked k-batch, n_steps, rows) per host dispatch;
+                        # loss logging reports each dispatch's LAST step (the
+                        # intermediate losses live only inside the scan)
+                        dispatches = self.loader.epoch_groups(
+                            epoch, self.k_dispatch, start_step=epoch_start_step)
                     else:
-                        self.state, loss = self.train_step(self.state, batch)
-                    watchdog.pat()
-                    timer.tick()  # one tick per DISPATCH (= n_steps steps)
-                    thr.add(rows)
-                    before = step
-                    step += n_steps
-                    prev = (step, epoch, loss, before)
-                    # k>1 dispatches can stride over an exact multiple;
-                    # fire on every boundary CROSSING (== the k=1 modulo
-                    # rule when n_steps is 1)
-                    if (cfg.checkpoint_every and
-                            step // cfg.checkpoint_every
-                            > before // cfg.checkpoint_every):
-                        with watchdog.suspended():
-                            self.save()
-                    if (cfg.check_replicas_every and
-                            step // cfg.check_replicas_every
-                            > before // cfg.check_replicas_every):
-                        from ..utils import consistency
+                        dispatches = (
+                            (b, 1, self.loader.batch_rows(epoch_start_step + i))
+                            for i, b in enumerate(self.loader.epoch(
+                                epoch, start_step=epoch_start_step)))
+                    for batch, n_steps, rows in dispatches:
+                        if shutdown.requested:
+                            break
+                        if monitor is not None and len(monitor_q) >= 2:
+                            # observe at lag 2 (not the newest future): the
+                            # device_get then waits only on a step that
+                            # already has a successor submitted, so one
+                            # dispatch stays in flight and the async
+                            # pipeline keeps overlapping host batch prep
+                            # with device compute even when log_every > 1
+                            m_step, m_loss = monitor_q.pop(0)
+                            action = monitor.observe(
+                                float(jax.device_get(m_loss)))
+                            if action == "abort":
+                                raise AnomalyAbort(
+                                    f"training diverged at step {m_step}: "
+                                    f"{monitor.bad_steps} bad steps and the "
+                                    f"rollback budget (max_rollbacks="
+                                    f"{cfg.max_rollbacks}) is exhausted")
+                            if action == "rollback":
+                                with watchdog.suspended():
+                                    step = self._rollback()
+                                log(f"anomaly rollback #{monitor.rollbacks}: "
+                                    f"{cfg.rollback_after} consecutive bad "
+                                    f"steps — restored step {step}, re-drew "
+                                    "the data order")
+                                prev = None
+                                monitor_q.clear()
+                                rolled_back = True
+                                break
+                        # log when the dispatch CROSSED a log_every boundary
+                        # (== the modulo rule at n_steps=1; prev[3] is the
+                        # step count before that dispatch)
+                        if prev is not None and cfg.log_every and \
+                                prev[0] // cfg.log_every > prev[3] // cfg.log_every:
+                            last_loss = float(jax.device_get(prev[2]))
+                            self.metrics.write({
+                                "step": prev[0], "epoch": prev[1],
+                                "loss": last_loss,
+                                "samples_per_sec": thr.samples_per_sec,
+                            })
+                        if fault_plan is not None:
+                            batch = fault_plan.apply(step, batch)
+                        if self.k_dispatch > 1:
+                            self.state, losses = self.multi_step(self.state,
+                                                                 batch)
+                            loss = losses[-1]
+                        else:
+                            self.state, loss = self.train_step(self.state, batch)
+                        watchdog.pat()
+                        timer.tick()  # one tick per DISPATCH (= n_steps steps)
+                        thr.add(rows)
+                        before = step
+                        step += n_steps
+                        prev = (step, epoch, loss, before)
+                        if monitor is not None:
+                            monitor_q.append((step, loss))
+                        # k>1 dispatches can stride over an exact multiple;
+                        # fire on every boundary CROSSING (== the k=1 modulo
+                        # rule when n_steps is 1).  While the monitor's
+                        # bad-step streak is nonzero the snapshot is SKIPPED
+                        # (next boundary saves): checkpointing mid-anomaly
+                        # would capture possibly-diverged params and rotate
+                        # the last good snapshot toward deletion — the very
+                        # state rollback needs.  (The observation lag means
+                        # a boundary landing within ~2 dispatches of the
+                        # first bad step can still be captured; with the
+                        # guard on, params are protected regardless.)
+                        if (cfg.checkpoint_every and
+                                step // cfg.checkpoint_every
+                                > before // cfg.checkpoint_every and
+                                (monitor is None or monitor.consecutive == 0)):
+                            with watchdog.suspended():
+                                self.save()
+                        if (cfg.check_replicas_every and
+                                step // cfg.check_replicas_every
+                                > before // cfg.check_replicas_every):
+                            from ..utils import consistency
 
+                            with watchdog.suspended():
+                                consistency.assert_replicated(
+                                    self.state, what=f"train state @ step {step}")
+                    if rolled_back:
+                        epoch = step // spe
+                        mid_epoch_start = step % spe
+                        continue
+                    if shutdown.requested:
+                        # graceful preemption: materialize the last loss, then
+                        # fall through to the final save with <= 1 lost step
+                        if loss is not None:
+                            last_loss = float(jax.device_get(loss))
+                        break
+                    # per-epoch loss line (reference :224, but one global line
+                    # instead of N interleaved per-rank prints)
+                    if loss is not None:
+                        last_loss = float(jax.device_get(loss))
+                    log(f"epoch {epoch + 1}: loss {last_loss:.6f} "
+                        f"({time.perf_counter() - epoch_t0:.3f}s)")
+                    # periodic held-out eval (the reference's :213-220 intent)
+                    if (self.val_data is not None and cfg.eval_every
+                            and (epoch + 1) % cfg.eval_every == 0):
                         with watchdog.suspended():
-                            consistency.assert_replicated(
-                                self.state, what=f"train state @ step {step}")
-                # per-epoch loss line (reference :224, but one global line
-                # instead of N interleaved per-rank prints)
-                if loss is not None:
-                    last_loss = float(jax.device_get(loss))
-                log(f"epoch {epoch + 1}: loss {last_loss:.6f} "
-                    f"({time.perf_counter() - epoch_t0:.3f}s)")
-                # periodic held-out eval (the reference's :213-220 intent)
-                if (self.val_data is not None and cfg.eval_every
-                        and (epoch + 1) % cfg.eval_every == 0):
-                    with watchdog.suspended():
-                        ev = self.evaluate(self.val_data)
-                    last_eval = (step, ev)
-                    log("validation: " + ", ".join(
-                        f"{k} {v:.6f}" for k, v in sorted(ev.items())))
-                    self.metrics.write({"step": step, "epoch": epoch,
-                                        **{f"val_{k}": v
-                                           for k, v in ev.items()}})
+                            ev = self.evaluate(self.val_data)
+                        last_eval = (step, ev)
+                        log("validation: " + ", ".join(
+                            f"{k} {v:.6f}" for k, v in sorted(ev.items())))
+                        self.metrics.write({"step": step, "epoch": epoch,
+                                            **{f"val_{k}": v
+                                               for k, v in ev.items()}})
+                    epoch += 1
+        finally:
+            # deterministic prefetch-worker release: an exception escaping
+            # this frame (AnomalyAbort, a re-raised async-write failure)
+            # keeps it alive in the traceback, so the abandoned dispatch
+            # generator would otherwise park its loader thread until GC
+            if dispatches is not None and hasattr(dispatches, "close"):
+                dispatches.close()
         if prev is not None and cfg.log_every and \
                 prev[0] // cfg.log_every > prev[3] // cfg.log_every:
             self.metrics.write({"step": prev[0], "epoch": prev[1],
@@ -695,6 +848,21 @@ class Trainer:
                   "steps": step,
                   "samples_per_sec": thr.samples_per_sec,
                   **timer.stats()}
+        if shutdown.requested:
+            # preemption-safe exit: the final save above already drained
+            # pending async writes and snapshotted the current step — an
+            # external restart (--resume / the supervisor) loses <= 1 step
+            log(f"preempted (signal {shutdown.signum}): final checkpoint "
+                f"at step {step}, exiting 0")
+            result["preempted"] = True
+        if monitor is not None:
+            result["rollbacks"] = monitor.rollbacks
+            result["bad_steps"] = monitor.bad_steps
+        if self.guarded:
+            # GuardedState.skipped: cumulative rejected updates — read
+            # once here, off the hot path
+            result["skipped_updates"] = int(
+                jax.device_get(self.state.opt_state.skipped))
         # achieved model FLOPs/s (fwd + ~2x bwd per optimizer step), from
         # the model's own accounting — None for unaccounted architectures
         sample_shape = (1,) + tuple(self.data["x"].shape[1:])

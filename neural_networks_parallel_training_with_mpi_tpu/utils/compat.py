@@ -1,0 +1,42 @@
+"""JAX version compatibility shims.
+
+The framework targets the current JAX API surface (``jax.shard_map`` with
+``check_vma``, promoted to the top-level namespace in jax 0.6); older
+runtimes (e.g. 0.4.x, where shard_map still lives in
+``jax.experimental.shard_map`` and the kwarg is ``check_rep``) are adapted
+here so the whole SPMD layer — and every test that drives it — runs
+unmodified.  Imported for its side effect from the package ``__init__``,
+before any module touches ``jax.shard_map``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+            # check_vma is the current name of the old check_rep flag
+            # (the varying-manual-axes / replication-invariance check)
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+
+        def axis_size(axis_name):
+            # psum over a literal 1 is folded to the static axis size at
+            # trace time (no collective is emitted) — the pre-0.6 idiom
+            # for the mapped-axis size inside shard_map/pmap bodies
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+install()

@@ -1,0 +1,156 @@
+"""Deterministic fault injection for resilience testing.
+
+Drives the full skip -> rollback -> restart -> converge story end to end
+(tests/test_resilience.py) without flaky timing: every fault fires at an
+exact global step, on every replica identically.
+
+Spec grammar (``--faults`` / the ``NNPT_FAULTS`` env var), comma-separated::
+
+    kind@start[-end][?opt[&opt...]]
+
+kinds
+    ``nan``      poison the batch so the step's loss (and hence every
+                 gradient) is NaN — the canonical bad batch the guarded
+                 update must reject.  Implemented by NaN-ing the batch's
+                 ``mask`` leaf (float on every dataset, multiplied into
+                 every loss term), so it works for int token batches too.
+    ``crash``    die abruptly (``os._exit(1)``) — a segfault/OOM stand-in
+                 the supervisor must relaunch.
+    ``sigterm``  send SIGTERM to this process — a preemption stand-in the
+                 graceful-shutdown path must absorb (exit 0 + checkpoint).
+
+options
+    ``max=N``     fire at most N times over this process's lifetime
+                  (in-memory counter) — lets a NaN window be *passable*
+                  after a rollback replays it.
+    ``once=PATH`` fire at most once per PATH lifetime: the marker file is
+                  created at fire time, and the fault never fires while it
+                  exists — survives a process restart, so a supervised
+                  relaunch does not re-crash at the same step.
+
+Steps are the Trainer's global step counter *about to be executed*; with
+``--steps_per_dispatch k > 1`` the granularity is the dispatch boundary
+(the fault applies to the whole k-step group whose first step falls in the
+window).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+ENV_VAR = "NNPT_FAULTS"
+KINDS = ("nan", "crash", "sigterm")
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str
+    start: int
+    end: int                      # inclusive
+    max_fires: Optional[int] = None
+    once_marker: Optional[str] = None
+    fires: int = 0
+
+    def should_fire(self, step: int) -> bool:
+        if not (self.start <= step <= self.end):
+            return False
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.once_marker and Path(self.once_marker).exists():
+            return False
+        return True
+
+    def mark_fired(self) -> None:
+        self.fires += 1
+        if self.once_marker:
+            p = Path(self.once_marker)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text("fired\n")
+
+
+def _parse_one(item: str) -> _Fault:
+    head, _, opts = item.partition("?")
+    kind, _, window = head.partition("@")
+    kind = kind.strip()
+    if kind not in KINDS:
+        raise ValueError(f"unknown fault kind {kind!r} in {item!r} "
+                         f"(choices: {', '.join(KINDS)})")
+    if not window:
+        raise ValueError(f"fault {item!r} lacks '@step' (e.g. 'nan@5-8')")
+    lo, _, hi = window.partition("-")
+    start = int(lo)
+    end = int(hi) if hi else start
+    if end < start:
+        raise ValueError(f"fault window {window!r} ends before it starts")
+    max_fires: Optional[int] = None
+    once_marker: Optional[str] = None
+    for opt in filter(None, opts.split("&")):
+        key, _, val = opt.partition("=")
+        if key == "max":
+            max_fires = int(val)
+        elif key == "once":
+            if not val:
+                raise ValueError(f"once= needs a marker path in {item!r}")
+            once_marker = val
+        else:
+            raise ValueError(f"unknown fault option {key!r} in {item!r}")
+    return _Fault(kind, start, end, max_fires, once_marker)
+
+
+class FaultPlan:
+    """Parsed fault schedule; the Trainer calls :meth:`apply` once per
+    dispatch with the global step about to run and the (device-placed)
+    batch, and receives the possibly-poisoned batch back."""
+
+    def __init__(self, faults: List[_Fault]):
+        self.faults = faults
+
+    @staticmethod
+    def parse(spec: str) -> Optional["FaultPlan"]:
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        return FaultPlan([_parse_one(s.strip())
+                          for s in spec.split(",") if s.strip()])
+
+    @staticmethod
+    def from_config(cfg_spec: str = "") -> Optional["FaultPlan"]:
+        """Config spec wins; falls back to the ``NNPT_FAULTS`` env var (the
+        channel a supervisor-launched child inherits)."""
+        return FaultPlan.parse(cfg_spec or os.environ.get(ENV_VAR, ""))
+
+    def apply(self, step: int, batch: Dict) -> Dict:
+        for f in self.faults:
+            if not f.should_fire(step):
+                continue
+            f.mark_fired()
+            if f.kind == "crash":
+                print(f"[faults] injected crash at step {step}",
+                      file=sys.stderr, flush=True)
+                sys.stderr.flush()
+                os._exit(1)
+            if f.kind == "sigterm":
+                print(f"[faults] injected SIGTERM at step {step}",
+                      file=sys.stderr, flush=True)
+                os.kill(os.getpid(), signal.SIGTERM)
+                continue  # the loop's shutdown flag breaks at the NEXT step
+            # nan: multiplying by NaN keeps the leaf's placement/sharding
+            # (a fresh full_like would force a reshard inside the step);
+            # NaN*0 == NaN, so padded rows poison the loss sum too
+            print(f"[faults] injected NaN batch at step {step}",
+                  file=sys.stderr, flush=True)
+            batch = dict(batch)
+            if "mask" in batch:
+                batch["mask"] = batch["mask"] * float("nan")
+            else:  # no mask leaf: poison every float leaf directly
+                import jax.numpy as jnp
+
+                batch = {k: (v * float("nan")
+                             if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                         for k, v in batch.items()}
+        return batch

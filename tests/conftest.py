@@ -39,10 +39,17 @@ import pytest  # noqa: E402
 
 # Core-lane wall-clock budget (VERDICT r4 item 8: the lane doubled from ~5
 # to ~10 min in one round with no brake).  Every `-m "not slow"` session
-# appends its duration to .lane_times.jsonl and FAILS the run if it blew
-# the budget — growth now breaks CI loudly instead of compounding
-# silently.  Heavyweight additions belong in the full lane (@slow).
+# appends its duration to .lane_times.jsonl.  A single over-budget run only
+# WARNS (ADVICE r5: a green run on a temporarily slow/shared machine must
+# not exit 1 on elapsed time alone); the run FAILS only when it also blows
+# the machine's own rolling median by a wide margin — i.e. the lane itself
+# grew, not the host slowed down.  Heavyweight additions belong in the full
+# lane (@slow).
 CORE_LANE_BUDGET_S = 600.0
+# fail threshold: max(budget, this factor x median of recent recorded runs)
+CORE_LANE_MEDIAN_FACTOR = 1.4
+_LANE_TIMES = os.path.join(os.path.dirname(__file__), "..",
+                           ".lane_times.jsonl")
 _session_t0 = None
 
 
@@ -51,6 +58,29 @@ def pytest_sessionstart(session):
     import time as _time
 
     _session_t0 = _time.time()
+
+
+def _lane_median(n_recent: int = 10):
+    """Median duration of the last ``n_recent`` recorded UNDER-BUDGET full
+    core-lane runs (None when there is no usable history).  Two filters
+    keep the baseline honest: subset runs (tests <= 100) must not drag it
+    down, and over-budget runs must not ratchet it up — otherwise steady
+    lane growth would raise its own fail threshold forever and the brake
+    (VERDICT r4 item 8) would never engage.  The baseline therefore
+    freezes at this machine's last healthy level: growth is bounded at
+    CORE_LANE_MEDIAN_FACTOR x that."""
+    import json as _json
+    import statistics as _stats
+
+    try:
+        with open(_LANE_TIMES) as f:
+            secs = [r["seconds"] for r in map(_json.loads, f)
+                    if isinstance(r.get("seconds"), (int, float))
+                    and r.get("tests", 0) > 100
+                    and not r.get("over_budget")]
+    except (OSError, ValueError):
+        return None
+    return _stats.median(secs[-n_recent:]) if secs else None
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -64,23 +94,41 @@ def pytest_sessionfinish(session, exitstatus):
         return  # full lane / targeted runs are unbudgeted
     elapsed = _time.time() - _session_t0
     n = session.testscollected
+    median = _lane_median()
+    # headroom over THIS machine's recent history; without history the
+    # budget alone can only warn (a slow machine's first run must not fail)
+    fail_at = (max(CORE_LANE_BUDGET_S, CORE_LANE_MEDIAN_FACTOR * median)
+               if median is not None else None)
     rec = {"t_iso": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime()),
            "seconds": round(elapsed, 1), "tests": n,
            "budget_s": CORE_LANE_BUDGET_S,
+           "median_s": round(median, 1) if median is not None else None,
            "over_budget": elapsed > CORE_LANE_BUDGET_S}
     try:
-        with open(os.path.join(os.path.dirname(__file__), "..",
-                               ".lane_times.jsonl"), "a") as f:
+        with open(_LANE_TIMES, "a") as f:
             f.write(_json.dumps(rec) + "\n")
     except OSError:
         pass
     if elapsed > CORE_LANE_BUDGET_S and n > 100:
         # n > 100 guards against budget-failing a filtered subset run
         # that happens to pass -m "not slow"
-        session.exitstatus = 1
-        print(f"\nCORE LANE OVER BUDGET: {elapsed:.0f}s > "
-              f"{CORE_LANE_BUDGET_S:.0f}s — move the heaviest new tests "
-              f"to the full lane (@pytest.mark.slow)", flush=True)
+        if fail_at is not None and elapsed > fail_at:
+            session.exitstatus = 1
+            print(f"\nCORE LANE OVER BUDGET: {elapsed:.0f}s > "
+                  f"{CORE_LANE_BUDGET_S:.0f}s budget AND > "
+                  f"{fail_at:.0f}s ({CORE_LANE_MEDIAN_FACTOR}x this "
+                  f"machine's {median:.0f}s rolling median) — the lane "
+                  "grew; move the heaviest new tests to the full lane "
+                  "(@pytest.mark.slow)", flush=True)
+        elif median is not None:
+            print(f"\nWARNING: core lane over budget ({elapsed:.0f}s > "
+                  f"{CORE_LANE_BUDGET_S:.0f}s) but within this machine's "
+                  f"rolling-median headroom (median {median:.0f}s) — not "
+                  "failing the run", flush=True)
+        else:
+            print(f"\nWARNING: core lane over budget ({elapsed:.0f}s > "
+                  f"{CORE_LANE_BUDGET_S:.0f}s); no .lane_times.jsonl "
+                  "history yet — not failing the run", flush=True)
 
 
 @pytest.fixture(scope="session")

@@ -59,7 +59,13 @@ def test_dense_blockwise_exact_vs_dense():
     for (b, t, h, d), chunk, causal in [
         ((2, 512, 4, 16), 128, True),
         ((2, 512, 4, 16), 128, False),
-        ((1, 96, 2, 8), 64, True),     # 96 % 64 != 0 -> whole-seq block
+        # 96 % 64 != 0 -> falls back to the LARGEST DIVISOR of t that
+        # fits the requested chunk: 48 here (two blocks), not one
+        # whole-seq block
+        ((1, 96, 2, 8), 64, True),
+        # prime T: the divisor fallback's worst case, q_chunk=1 -> t
+        # scan ticks of (B, H, 1, T) — still never the full (B, H, T, T)
+        ((1, 29, 2, 8), 16, True),
         ((2, 256, 2, 32), 256, True),  # chunk == T
     ]:
         q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)),

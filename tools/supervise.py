@@ -1,0 +1,63 @@
+"""Standalone crash-restart supervisor.
+
+Wraps ANY command with the framework's restart policy
+(``neural_networks_parallel_training_with_mpi_tpu.train.resilience``):
+relaunch on crash/hang with exponential backoff and bounded restarts,
+honoring the exit-code contract —
+
+* 0   run completed -> stop
+* 42  watchdog hang -> retry
+* 43  peer loss (a collective raised) -> retry
+* 44  anomaly abort (rollback budget exhausted) -> stop, do NOT retry
+* any other nonzero / signal death -> retry
+
+For training jobs the integrated form is usually what you want (it appends
+``--resume`` so relaunches continue from the newest snapshot)::
+
+    python -m neural_networks_parallel_training_with_mpi_tpu \
+        --supervise 3 --checkpoint_dir /ckpt --checkpoint_every 50 ...
+
+This script is the generic wrapper for everything else (a bench loop, a
+watcher, a multi-host launcher that itself execs the trainer)::
+
+    python tools/supervise.py --max-restarts 3 --backoff 2 -- \
+        python -m neural_networks_parallel_training_with_mpi_tpu --resume ...
+
+Exits with the wrapped command's final exit code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (  # noqa: E402
+    supervise,
+)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="relaunch a command on crash with exponential backoff "
+                    "(exit 0 and exit 44 stop; see module docstring)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="relaunches allowed after the initial run")
+    p.add_argument("--backoff", type=float, default=1.0,
+                   help="initial backoff seconds (doubles per restart)")
+    p.add_argument("--backoff-cap", type=float, default=60.0)
+    p.add_argument("cmd", nargs=argparse.REMAINDER,
+                   help="the command to run (prefix with -- to stop flag "
+                        "parsing)")
+    args = p.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
+    if not cmd:
+        p.error("no command given (usage: supervise.py [flags] -- cmd ...)")
+    return supervise(cmd, max_restarts=args.max_restarts,
+                     backoff=args.backoff, backoff_cap=args.backoff_cap)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
