@@ -61,26 +61,22 @@ TPU_LATEST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # single shared core, so transient load skews any single window by +-10%
 _CPU_TIMING_REPS = 3
 
-# Peak dense bf16 FLOPs/s per chip by device_kind substring (public specs).
-_PEAK_FLOPS = (
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12), ("v5e", 197e12), ("v5", 197e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 46e12),
-)
-
-
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 def peak_flops(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for key, val in _PEAK_FLOPS:
-        if key in kind:
-            return val
-    if "tpu" in kind or "axon" in kind:
-        return 197e12  # conservative default: v5e-class
-    return None
+    """Chip peak dense bf16 FLOPs/s (None off-TPU) — kept as bench's public
+    name; the table and the per-step FLOPs formula live in
+    train.telemetry (the telemetry subsystem's MFU accounting), so bench,
+    the trainer's metrics stream and tools/big_lm_sweep.py all divide by
+    the same numbers.  (Lazy import: bench must stay import-light until
+    the platform is pinned.)"""
+    from neural_networks_parallel_training_with_mpi_tpu.train.telemetry import (
+        peak_flops_per_chip,
+    )
+
+    return peak_flops_per_chip(device_kind)
 
 
 # ---------------------------------------------------------------------------
@@ -351,9 +347,13 @@ def bench_framework(config_name: str, batch_override: int | None = None,
     log(f"[{config_name}] final loss {loss_val:.5f}")
 
     # MFU: matmul/conv FLOPs for one optimizer step = fwd + ~2x fwd for the
-    # backward, over every chip's peak.  Single source: Module.fwd_flops.
-    fwd = model.fwd_flops(raw_batch["x"].shape)
-    train_flops = None if fwd is None else 3.0 * fwd
+    # backward, over every chip's peak.  Single source:
+    # train.telemetry.train_step_flops (which consults Module.fwd_flops).
+    from neural_networks_parallel_training_with_mpi_tpu.train.telemetry import (
+        train_step_flops,
+    )
+
+    train_flops = train_step_flops(model, raw_batch["x"].shape)
     param_bytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
                       for l in jax.tree_util.tree_leaves(state.params))
     kind = devices[0].device_kind

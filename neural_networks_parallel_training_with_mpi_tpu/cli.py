@@ -169,16 +169,35 @@ def _supervise(args, argv) -> int:
     supervisor (train.resilience.supervise; exit-code contract in that
     module and DESIGN.md §6).  The child argv is this argv minus the
     supervisor flags, plus --resume when a checkpoint dir is configured so
-    every relaunch continues from the newest snapshot."""
+    every relaunch continues from the newest snapshot.
+
+    With --telemetry_dir the supervisor additionally (a) watches the
+    child's heartbeat.json when --hang_timeout is set — an external hang
+    detector that works even when the child process is frozen whole,
+    armed at 4x the in-process timeout so the child's own watchdog fires
+    first — and (b) points the relaunch log at the child's
+    postmortem.json flight-recorder dump after an abnormal exit."""
+    import os
+
     from .train.resilience import strip_supervisor_flags, supervise
 
     child = strip_supervisor_flags(argv)
     if args.checkpoint_dir and "--resume" not in child:
         child.append("--resume")
+    heartbeat = postmortem = None
+    heartbeat_timeout = 0.0
+    if getattr(args, "telemetry_dir", None):
+        heartbeat = os.path.join(args.telemetry_dir, "heartbeat.json")
+        postmortem = os.path.join(args.telemetry_dir, "postmortem.json")
+        if getattr(args, "hang_timeout", 0.0) > 0:
+            heartbeat_timeout = max(4.0 * args.hang_timeout, 60.0)
     pkg = __name__.rsplit(".", 1)[0]
     return supervise([sys.executable, "-m", pkg, *child],
                      max_restarts=args.supervise,
-                     backoff=args.supervise_backoff)
+                     backoff=args.supervise_backoff,
+                     heartbeat_path=heartbeat,
+                     heartbeat_timeout=heartbeat_timeout,
+                     postmortem_path=postmortem)
 
 
 def main(argv=None) -> int:

@@ -199,10 +199,11 @@ class TrainConfig:
     # stack of k batches, VERDICT r4 item 6): amortizes the per-step host
     # dispatch that dominates small models (MNIST MLP measured 0.011 MFU —
     # dispatch-bound, BENCH_FULL.json).  The scan replays the identical
-    # batches in the identical order, so on the explicit shard_map DP/SP
-    # paths the trajectory is BITWISE identical to k=1; on the GSPMD
-    # (tensor/fsdp) paths it is the same math within compile-fusion noise
-    # (XLA may fuse/reassociate differently inside the scan body —
+    # batches in the identical order, so on the plain-DP shard_map path
+    # the trajectory is BITWISE identical to k=1; on the GSPMD
+    # (tensor/fsdp) paths AND the ring-attention SP stacked dispatch it is
+    # the same math within compile-fusion noise (XLA fuses the scanned
+    # body differently than the standalone step —
     # tests/test_dispatch.py bounds the drift).  1 = off.
     # Single-host layouts (see ShardedLoader.epoch_groups); SP stacks
     # through spmd.place_batch_stack.
@@ -248,6 +249,20 @@ class TrainConfig:
     # observability (SURVEY.md §5.1/5.5)
     profile_dir: Optional[str] = None
     metrics_jsonl: Optional[str] = None
+    # ---- telemetry (train.telemetry; DESIGN.md §7; all off by default) --
+    # directory for the telemetry artifacts: metrics.jsonl (per-step
+    # grad/param norms, update ratio, loss, mfu, step time), heartbeat.json
+    # (run-health snapshot, refreshed per dispatch), postmortem.json
+    # (flight-recorder dump on crash/rollback/abort/hang/SIGTERM).
+    # None = telemetry off (zero cost).
+    telemetry_dir: Optional[str] = None
+    # fetch + record the on-device metrics every N steps (boundary-crossing
+    # rule, like log_every/checkpoint_every); 0 disables the metrics stream
+    # while keeping heartbeat + flight-recorder events
+    metrics_every: int = 1
+    # flight-recorder ring size (last N step records + events kept for the
+    # postmortem dump); 0 disables the recorder
+    flight_recorder: int = 64
     # evaluate on the validation split every N epochs (0 = only after
     # training); needs data.val_fraction > 0
     eval_every: int = 0
@@ -344,9 +359,10 @@ def build_argparser() -> argparse.ArgumentParser:
                         "over a device-staged batch stack) — amortizes "
                         "per-step dispatch overhead on small models; "
                         "same batches in the same order, so bitwise "
-                        "trajectory-identical to k=1 on the shard_map "
-                        "DP/SP paths, identical-within-fusion-noise on "
-                        "the GSPMD (tp/fsdp) paths")
+                        "trajectory-identical to k=1 on the plain-DP "
+                        "shard_map path, identical-within-fusion-noise "
+                        "on the GSPMD (tp/fsdp) and ring-attention SP "
+                        "paths")
     p.add_argument("--pp_interleave", type=int, default=1,
                    help="virtual stage-slices per pipeline device "
                         "(interleaved schedule: bubble / v at constant "
@@ -496,6 +512,21 @@ def build_argparser() -> argparse.ArgumentParser:
                    "write periodic checkpoints on a background thread")
     p.add_argument("--profile_dir", type=str, default=None)
     p.add_argument("--metrics_jsonl", type=str, default=None)
+    p.add_argument("--telemetry_dir", type=str, default=None,
+                   help="telemetry subsystem (train.telemetry): writes "
+                        "metrics.jsonl (per-step grad/param norms, "
+                        "update ratio, loss, mfu), heartbeat.json "
+                        "(run-health, per dispatch) and postmortem.json "
+                        "(flight-recorder dump on crash/rollback/abort/"
+                        "SIGTERM) under this directory")
+    p.add_argument("--metrics_every", type=int, default=1,
+                   help="fetch + record on-device metrics every N steps "
+                        "(needs --telemetry_dir; 0 keeps heartbeat/"
+                        "postmortem but no metrics stream)")
+    p.add_argument("--flight_recorder", type=int, default=64, metavar="N",
+                   help="flight-recorder ring size: last N step records/"
+                        "events dumped to postmortem.json on abnormal "
+                        "exit (0 = off)")
     p.add_argument("--check_replicas_every", type=int, default=0,
                    help="assert replicated state is bit-identical across "
                         "device shards every N steps (0 = off)")
@@ -583,6 +614,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,
         metrics_jsonl=args.metrics_jsonl,
+        telemetry_dir=args.telemetry_dir,
+        metrics_every=args.metrics_every,
+        flight_recorder=args.flight_recorder,
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
         hang_timeout=args.hang_timeout,

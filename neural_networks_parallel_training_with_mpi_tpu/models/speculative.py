@@ -35,7 +35,13 @@ Cache bookkeeping rides the same invariant as the server's bucketed
 prefill: positions past the accepted point hold stale K/V from rejected
 proposals, but decode masks keys ``<= pos`` and every position is
 REWRITTEN by the pass that next visits it before it becomes visible, so
-no rewind is ever needed — "rollback" is free.
+no rewind is ever needed — "rollback" is free.  ONE position escapes
+that invariant: after a fully-accepted round the draft never saw its
+own last proposal (the round advances past it, so no later pass
+rewrites it), which would leave a permanent ZERO draft-K/V entry that
+every subsequent draft step attends.  Both paths therefore run a single
+catch-up draft step there (see the ``n_acc == r`` blocks), keeping the
+draft cache dense — pinned by the draft-cache-density regression tests.
 
 Both models run their standard chunked forward
 (``models.generate._forward_chunk``), so GQA, RoPE, SwiGLU, int8
@@ -115,7 +121,8 @@ def speculative_generate(target: Transformer, target_params,
                          prompt: jax.Array, max_new_tokens: int,
                          k: int = 4, kv_quant: bool = False,
                          temperature: float = 0.0,
-                         key: Optional[jax.Array] = None
+                         key: Optional[jax.Array] = None,
+                         debug_state: Optional[dict] = None
                          ) -> Tuple[jax.Array, dict]:
     """Speculative decode -> ``(tokens (B, P + N), stats)``.
 
@@ -252,6 +259,7 @@ def speculative_generate(target: Transformer, target_params,
         # commit accepted proposals + the next token (the bonus slot may
         # not EXIST when the tail round's proposals were all accepted
         # and land exactly on the last position)
+        round_pos = pos
         if n_acc:
             tokens[:, pos + 1:pos + 1 + n_acc] = proposals[:, :n_acc]
         if pos + 1 + n_acc < total:
@@ -259,6 +267,20 @@ def speculative_generate(target: Transformer, target_params,
             pos += n_acc + 1
         else:
             pos += n_acc
+        if n_acc == r and pos < total - 1:
+            # fully-accepted round: the draft loop fed positions
+            # round_pos .. round_pos+r-1, so the LAST proposal's position
+            # (round_pos + r, now a committed token) has no draft K/V —
+            # and the next round starts at round_pos + r + 1 (the bonus),
+            # so unlike a rejection it would never be rewritten: every
+            # later draft step would attend a zero K/V entry there.  One
+            # catch-up draft step (logits discarded) keeps the draft
+            # cache dense (regression: tests/test_speculative.py
+            # draft-cache-density tests).
+            _, d_caches = d_step(draft_params, d_caches,
+                                 jnp.asarray(proposals[:, r - 1:r]),
+                                 round_pos + r)
+            stats["draft_steps"] += 1
         stats["target_passes"] += 1
         stats["rounds"] += 1
         stats["accepted_total"] += n_acc
@@ -267,6 +289,9 @@ def speculative_generate(target: Transformer, target_params,
         # before the mask can expose them (module docstring) — no rewind
     stats["accept_rate"] = (stats["accepted_total"]
                             / max(1, stats["proposed_total"]))
+    if debug_state is not None:
+        # test hook (draft-cache-density regression): final caches + pos
+        debug_state.update(d_caches=d_caches, t_caches=t_caches, pos=pos)
     return jnp.asarray(tokens), stats
 
 
@@ -276,7 +301,8 @@ def speculative_generate(target: Transformer, target_params,
 
 @functools.lru_cache(maxsize=32)
 def _spec_device_program(target: Transformer, draft: Transformer,
-                         total: int, p: int, k: int, b: int):
+                         total: int, p: int, k: int, b: int,
+                         debug_caches: bool = False):
     """One jitted (t_params, d_params, prompt) -> (tokens, stats-pytree)
     program for the whole greedy speculative decode (round 5).
 
@@ -318,7 +344,8 @@ def _spec_device_program(target: Transformer, draft: Transformer,
         st = dict(tokens=tokens, pos=jnp.asarray(p, i32),
                   t_caches=t_caches, d_caches=d_caches,
                   rounds=jnp.zeros((), i32),
-                  accepted=jnp.zeros((), i32))
+                  accepted=jnp.zeros((), i32),
+                  fills=jnp.zeros((), i32))
 
         def full_cond(st):
             return st["pos"] < total - 1 - k
@@ -343,12 +370,31 @@ def _spec_device_program(target: Transformer, draft: Transformer,
             want = jnp.argmax(vl, -1).astype(i32)           # (B, k+1)
             agree = (props == want[:, :k]).astype(i32)
             n_acc = jnp.min(jnp.sum(jnp.cumprod(agree, axis=1), axis=1))
+
+            def fill_last_kv(dc):
+                # fully-accepted round: the draft scan fed positions
+                # pos..pos+k-1, leaving the last proposal's position
+                # (pos + k, committed when n_acc == k) with ZERO draft
+                # K/V that no later visit rewrites (the next round starts
+                # at pos + k + 1) — run one catch-up draft step so later
+                # rounds never attend a zero entry.  pos + k < total - 1
+                # by full_cond, so the write stays in-buffer.  On a
+                # partial accept the entry IS rewritten before it becomes
+                # visible (the standard no-rewind invariant), so the cond
+                # skips the extra forward.
+                _, dc = _forward_chunk(draft, d_params, dc,
+                                       props[:, k - 1:k], pos + k)
+                return dc
+
+            d_caches = jax.lax.cond(n_acc == k, fill_last_kv,
+                                    lambda dc: dc, d_caches)
             tokens = jax.lax.dynamic_update_slice(st["tokens"], want,
                                                   (0, pos + 1))
             return dict(tokens=tokens, pos=pos + n_acc + 1,
                         t_caches=t_caches, d_caches=d_caches,
                         rounds=st["rounds"] + 1,
-                        accepted=st["accepted"] + n_acc)
+                        accepted=st["accepted"] + n_acc,
+                        fills=st["fills"] + (n_acc == k).astype(i32))
 
         st = jax.lax.while_loop(full_cond, full_round, st)
 
@@ -371,7 +417,11 @@ def _spec_device_program(target: Transformer, draft: Transformer,
             t_tick, (st["tokens"], st["t_caches"], st["pos"],
                      jnp.zeros((), jnp.int32)), None, length=k)
         stats = dict(rounds=st["rounds"], accepted=st["accepted"],
-                     tail_steps=tail_steps)
+                     tail_steps=tail_steps, fills=st["fills"])
+        if debug_caches:
+            # test hook (draft-cache-density regression): the ring-phase
+            # draft cache rides out of the jitted program
+            return tokens, stats, (st["d_caches"], st["pos"])
         return tokens, stats
 
     return jax.jit(run)
@@ -409,9 +459,12 @@ def speculative_generate_device(target: Transformer, target_params,
     rounds = int(dstats["rounds"])
     accepted = int(dstats["accepted"])
     tail = int(dstats["tail_steps"])
+    fills = int(dstats["fills"])
     stats = {
         "target_passes": 1 + rounds + tail,   # prefill + verifies + tail
-        "draft_steps": k * rounds,
+        # proposals + the catch-up forward per fully-accepted round (the
+        # draft-KV density fill) — same accounting as the host path
+        "draft_steps": k * rounds + fills,
         "rounds": rounds,
         "accepted_total": accepted,
         "proposed_total": k * rounds,

@@ -67,6 +67,12 @@ class Optimizer:
     # param tree; mirror-layout optimizers ignore it, and callers that
     # cannot supply one (the zero1 flat-buffer path) pass None
     state_specs: Optional[Callable[..., Pytree]] = None
+    # update(grads, state, params, norm) for wrappers whose decision
+    # depends on the global gradient norm (with_skip_guard): a caller that
+    # already computed the norm — the telemetry metrics path — hands it in
+    # so the step pays ONE norm reduction, not two.  None for optimizers
+    # that have no use for it; callers fall back to plain ``update``.
+    update_with_norm: Optional[Callable[..., Tuple[Pytree, Pytree]]] = None
 
 
 class SGDState(NamedTuple):
@@ -384,10 +390,12 @@ def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
     def init(params: Pytree) -> GuardedState:
         return GuardedState(jnp.zeros((), jnp.int32), opt.init(params))
 
-    def update(grads: Pytree, state: GuardedState, params: Pytree):
+    def update_with_norm(grads: Pytree, state: GuardedState, params: Pytree,
+                         norm: jax.Array):
+        """The guard with a caller-supplied global grad norm (the telemetry
+        metrics path computes it anyway — one reduction, shared)."""
         from jax import lax
 
-        norm = global_norm(grads)
         ok = jnp.isfinite(norm)
         if skip_threshold > 0:
             ok = ok & (norm <= skip_threshold)
@@ -407,6 +415,9 @@ def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
 
         return lax.cond(ok, apply, skip, None)
 
+    def update(grads: Pytree, state: GuardedState, params: Pytree):
+        return update_with_norm(grads, state, params, global_norm(grads))
+
     def state_specs(ps, params=None):
         from jax.sharding import PartitionSpec
 
@@ -416,7 +427,8 @@ def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
 
     return Optimizer(init, update,
                      f"guard(thr={skip_threshold}):{opt.name}",
-                     state_specs=state_specs)
+                     state_specs=state_specs,
+                     update_with_norm=update_with_norm)
 
 
 def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:

@@ -171,7 +171,8 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
                     donate: bool = True,
                     accum_steps: int = 1,
                     update_sharding: str = "replicated",
-                    grad_clip: float = 0.0
+                    grad_clip: float = 0.0,
+                    with_metrics: bool = False
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: (state, batch) -> (state, loss).
@@ -202,9 +203,25 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     On the replicated path pass ``grad_clip=0`` and wrap the optimizer with
     ``optim.with_clipping`` instead (there the full mean gradient is local,
     so the wrapper's norm is already global).
+
+    ``with_metrics=True`` returns ``(state, metrics)`` instead of
+    ``(state, loss)``: the on-device telemetry vector
+    (``train.telemetry.METRIC_KEYS`` — loss, global grad norm, param norm,
+    update/param ratio, cumulative skip-guard rejections), computed on the reduced
+    gradients so it is identical on every replica, with the update math
+    untouched (params stay bitwise-equal to the metrics-off step).
+    Replicated-update path only: zero1 updates a scattered gradient SHARD,
+    where these whole-tree norms would be shard-local.
     """
     if grad_reduction not in ("global_mean", "per_shard_mean", "local"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
+    if with_metrics and update_sharding == "zero1":
+        raise ValueError("with_metrics needs the replicated update (zero1 "
+                         "consumes a scattered gradient shard — whole-tree "
+                         "norms would be shard-local)")
+    if with_metrics and grad_reduction == "local":
+        raise ValueError("with_metrics is meaningless under the 'local' "
+                         "measurement ablation (replicas diverge)")
     if update_sharding not in ("replicated", "zero1"):
         raise ValueError(f"unknown update_sharding {update_sharding!r}")
     if update_sharding == "zero1" and grad_reduction != "global_mean":
@@ -248,6 +265,13 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(g, DATA_AXES), local_mean)
             loss = lax.pmean(s / jnp.maximum(c, 1.0), DATA_AXES)
+        if with_metrics:
+            from ..train import telemetry
+
+            new_params, new_opt, metrics = telemetry.update_with_metrics(
+                optimizer, grads, state.opt_state, state.params, loss)
+            return (TrainState(state.step + 1, new_params, new_opt),
+                    metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), loss

@@ -58,7 +58,8 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                           loss_name: str = "mse",
                           example_batch: Optional[Batch] = None,
                           donate: bool = True,
-                          accum_steps: int = 1):
+                          accum_steps: int = 1,
+                          with_metrics: bool = False):
     """(state, batch) -> (state, loss), global semantics, sharded by
     annotation.  The loss is the exact masked global-batch mean.
 
@@ -68,6 +69,12 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     row block intact, so no resharding), and loss/grad *sums* accumulate
     over a ``lax.scan`` before the single update — the unsplit math with
     lower peak activation memory.
+
+    ``with_metrics=True`` returns ``(state, metrics)``: the on-device
+    telemetry vector (train.telemetry.METRIC_KEYS) computed in global
+    view — gradients here are logically whole arrays, so the norms are
+    exact by construction and the partitioner inserts whatever reductions
+    the TP/FSDP layout needs.  Update math unchanged.
     """
     if example_batch is None:
         raise ValueError("example_batch required to derive batch specs")
@@ -123,6 +130,13 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
             s, c, grads = sum_and_grads(state.params, batch)
         loss = s / c
         grads = jax.tree_util.tree_map(lambda g: g / c, grads)
+        if with_metrics:
+            from ..train import telemetry
+
+            new_params, new_opt, metrics = telemetry.update_with_metrics(
+                optimizer, grads, state.opt_state, state.params, loss)
+            return (TrainState(state.step + 1, new_params, new_opt),
+                    metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), loss

@@ -55,7 +55,8 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                          example_batch: Optional[Batch] = None,
                          accum_steps: int = 1,
                          update_sharding: str = "replicated",
-                         grad_clip: float = 0.0):
+                         grad_clip: float = 0.0,
+                         with_metrics: bool = False):
     """(state, batch) -> (state, loss) jitted over data x seq axes.
 
     ``seq_axis`` should be set iff the model's attention is ring/ulysses and
@@ -82,6 +83,10 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
             "grad_clip is only applied inside the zero1 update; on the "
             "replicated path wrap the optimizer with optim.with_clipping "
             "instead of silently not clipping")
+    if with_metrics and update_sharding == "zero1":
+        raise ValueError("with_metrics needs the replicated update (zero1 "
+                         "consumes a scattered gradient shard — whole-tree "
+                         "norms would be shard-local)")
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     extra = (seq_axis,) if use_seq else ()
     reduce_axes = DATA_AXES + extra
@@ -106,6 +111,13 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
         grads = jax.tree_util.tree_map(
             lambda g: lax.psum(g, reduce_axes) / total, grads)
         loss = lax.psum(s, reduce_axes) / total
+        if with_metrics:
+            from ..train import telemetry
+
+            new_params, new_opt, metrics = telemetry.update_with_metrics(
+                optimizer, grads, state.opt_state, state.params, loss)
+            return (TrainState(state.step + 1, new_params, new_opt),
+                    metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
         return TrainState(state.step + 1, new_params, new_opt), loss

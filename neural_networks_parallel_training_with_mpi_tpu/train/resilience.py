@@ -188,10 +188,90 @@ def strip_supervisor_flags(argv: Sequence[str]) -> List[str]:
     return out
 
 
+def heartbeat_age_s(path: str, now: Optional[float] = None
+                    ) -> Optional[float]:
+    """Seconds since the telemetry heartbeat file was last refreshed
+    (mtime-based: train.telemetry's atomic replace bumps it on every
+    write), or None if absent.  Lives HERE, stdlib-only, because the
+    generic supervisor (tools/supervise.py) wraps arbitrary commands on
+    hosts that may not even have JAX installed — it must never pull in
+    the jax-importing telemetry module; telemetry re-exports this."""
+    import os
+
+    try:
+        mtime = os.stat(path).st_mtime
+    except OSError:
+        return None
+    return max(0.0, (time.time() if now is None else now) - mtime)
+
+
+def _run_child(cmd: Sequence[str], env: Optional[dict],
+               heartbeat_path: Optional[str], heartbeat_timeout: float,
+               log: Callable[[str], None]) -> int:
+    """One child launch.  Without a heartbeat watch this is a plain
+    blocking call.  With one, the supervisor polls the telemetry
+    ``heartbeat.json`` (train.telemetry writes it atomically per dispatch)
+    and a child whose heartbeat goes stale is killed and reported as
+    :data:`EXIT_HANG` — the EXTERNAL complement to the in-process
+    ``utils.watchdog.HangWatchdog``, covering the failure mode where the
+    whole host process (watchdog thread included) is frozen.
+
+    The monitor ARMS at the child's first heartbeat write (mtime newer
+    than the launch) — the same discipline as the in-process watchdog's
+    first-``pat()`` arming: the first step's XLA/Mosaic compile can take
+    arbitrarily long and must never be killed as a hang, and a leftover
+    heartbeat from a previous run must not count either.  The symmetric
+    cost: a child frozen BEFORE its first dispatch is not caught by this
+    monitor (nor by the in-process one)."""
+    if not (heartbeat_path and heartbeat_timeout > 0):
+        return subprocess.call(list(cmd), env=env)
+    child = subprocess.Popen(list(cmd), env=env)
+    started = time.time()
+    poll_s = max(0.05, min(heartbeat_timeout / 4.0, 5.0))
+    armed = False
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            return rc
+        age = heartbeat_age_s(heartbeat_path)
+        if not armed:
+            # arm only once THIS child has written the heartbeat
+            # (mtime after launch <=> age < runtime)
+            if age is not None and age < time.time() - started:
+                armed = True
+            else:
+                time.sleep(poll_s)
+                continue
+        idle = age if age is not None else time.time() - started
+        if idle > heartbeat_timeout:
+            log(f"[supervise] heartbeat stale for {idle:.0f}s "
+                f"(> {heartbeat_timeout:.0f}s): killing child "
+                f"{child.pid} as hung")
+            child.terminate()
+            try:
+                child.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                child.kill()
+                child.wait()
+            # deliberately EXIT_HANG even when the SIGTERM was absorbed
+            # gracefully (the child checkpoints and exits 0): that 0
+            # means "clean final snapshot", NOT "job finished" — a
+            # stalled-but-signal-responsive child must be retried, not
+            # reported complete.  A healthy tail phase is protected by
+            # Telemetry.alive() beats during checkpoint/eval, and a
+            # spuriously killed near-done run converges in one resumed
+            # relaunch.
+            return EXIT_HANG
+        time.sleep(poll_s)
+
+
 def supervise(cmd: Sequence[str], max_restarts: int,
               backoff: float = 1.0, backoff_cap: float = 60.0,
               env: Optional[dict] = None,
               log: Callable[[str], None] = None,
+              heartbeat_path: Optional[str] = None,
+              heartbeat_timeout: float = 0.0,
+              postmortem_path: Optional[str] = None,
               _sleep: Callable[[float], None] = time.sleep) -> int:
     """Run ``cmd`` under the crash-restart policy; return the final exit
     code.
@@ -203,6 +283,11 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     ``backoff * 2^k`` capped at ``backoff_cap`` seconds.  The relaunched
     command is identical; resume-from-newest-snapshot is the child's job
     (``cli`` appends ``--resume`` when a checkpoint dir is configured).
+
+    ``heartbeat_path`` + ``heartbeat_timeout`` arm the external hang
+    detector (see :func:`_run_child`).  ``postmortem_path``: when a child
+    dies abnormally and the telemetry flight recorder dumped a postmortem
+    during THIS child's lifetime, the relaunch log points at it.
     """
     if log is None:
         log = lambda m: print(m, file=sys.stderr, flush=True)
@@ -210,7 +295,19 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     while True:
         attempt += 1
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
-        rc = subprocess.call(list(cmd), env=env)
+        launched = time.time()
+        rc = _run_child(cmd, env, heartbeat_path, heartbeat_timeout, log)
+        # any ABNORMAL exit — including the no-retry anomaly abort (44),
+        # whose dump is the flagship black-box case — gets the pointer
+        if rc != EXIT_OK and postmortem_path:
+            try:
+                import os as _os
+
+                if _os.stat(postmortem_path).st_mtime >= launched - 1.0:
+                    log(f"[supervise] child left a postmortem: "
+                        f"{postmortem_path}")
+            except OSError:
+                pass
         if rc in _NO_RETRY:
             if rc == EXIT_ANOMALY:
                 log("[supervise] child exited 44 (anomaly abort): "

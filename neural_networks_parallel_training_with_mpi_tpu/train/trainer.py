@@ -10,6 +10,7 @@ those are absent in the reference).
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Any, Dict, Optional
 
@@ -26,6 +27,7 @@ from ..parallel import data_parallel as dp
 from ..parallel.mesh import describe, make_mesh, world_setup
 from ..utils import profiling, prng
 from ..utils.logging import MetricsLogger, Throughput, is_leader, log
+from . import telemetry as telemetry_lib
 from .state import TrainState
 
 
@@ -300,6 +302,16 @@ class Trainer:
                     "desynchronize the skip decision")
             self.optimizer = optim_lib.with_skip_guard(
                 self.optimizer, cfg.skip_threshold)
+        # on-device telemetry metrics (train.telemetry, DESIGN.md §7):
+        # wired exactly where the skip guard is wired — the update consumes
+        # fully-reduced (DP / DP x SP shard_map) or global-view (GSPMD)
+        # gradients, so the whole-tree norms are identical on every
+        # replica.  Sliced-update layouts (pipe/expert/seq-x-tensor/zero1)
+        # fall back to the loss-only telemetry stream.
+        self.telemetry_metrics = bool(
+            cfg.telemetry_dir and cfg.metrics_every > 0 and not self.zero1
+            and not (self.pipeline or self.expert or self.sp_tp
+                     or self.ep_tp))
         if self.pipeline:
             from ..parallel import pipeline as pp
 
@@ -381,7 +393,8 @@ class Trainer:
                 seq_axis="seq", example_batch=example,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,
-                grad_clip=cfg.grad_clip if self.zero1 else 0.0)
+                grad_clip=cfg.grad_clip if self.zero1 else 0.0,
+                with_metrics=self.telemetry_metrics)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -392,7 +405,8 @@ class Trainer:
             example = next(iter(self.loader.epoch(0)))
             self.train_step = gspmd.make_gspmd_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
-                example_batch=example, accum_steps=cfg.accum_steps)
+                example_batch=example, accum_steps=cfg.accum_steps,
+                with_metrics=self.telemetry_metrics)
             self.eval_step = gspmd.make_gspmd_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -403,7 +417,8 @@ class Trainer:
                 grad_reduction=cfg.grad_reduction,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,
-                grad_clip=cfg.grad_clip if self.zero1 else 0.0)
+                grad_clip=cfg.grad_clip if self.zero1 else 0.0,
+                with_metrics=self.telemetry_metrics)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
@@ -413,7 +428,9 @@ class Trainer:
         # small models (the reference pays a gather-average-send round trip
         # EVERY step, :149-211; MNIST MLP measured dispatch-bound at 0.011
         # MFU).  The scan replays the identical batches in the identical
-        # order, so trajectories match k=1 exactly (tests/test_dispatch.py).
+        # order: bitwise-identical to k=1 on the plain-DP shard_map path,
+        # same-math-within-compile-noise on the scanned GSPMD/SP bodies
+        # (tests/test_dispatch.py bounds the drift).
         self.k_dispatch = max(1, int(cfg.steps_per_dispatch))
         if self.k_dispatch > 1:
             from jax import lax
@@ -427,6 +444,11 @@ class Trainer:
             # one, and k>1 exists to cut overhead, not add copies
             self.multi_step = jax.jit(multi, donate_argnums=0)
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
+        dev = self.mesh.devices.flat[0]
+        self.telemetry = telemetry_lib.Telemetry(
+            cfg, self.model, tuple(self.data["x"].shape[1:]),
+            n_devices=int(self.mesh.devices.size),
+            device_kind=dev.device_kind, platform=dev.platform)
         self.state: Optional[TrainState] = None
 
     # ---- state lifecycle -------------------------------------------------
@@ -628,6 +650,9 @@ class Trainer:
         if self.cfg.checkpoint_dir:
             from ..utils import checkpoint as ckpt
 
+            # checkpoint writes emit no dispatches; keep the external
+            # stale-heartbeat monitor from reading a long write as a hang
+            self.telemetry.alive()
             # record the (shape-preserving, hence otherwise undetectable)
             # TP qkv permutation so maybe_resume can reconcile a different
             # tensor-axis size; dense layouts record 1 explicitly.  The
@@ -680,7 +705,12 @@ class Trainer:
         from .resilience import (AnomalyAbort, GracefulShutdown,
                                  ResilienceMonitor)
 
-        watchdog = HangWatchdog(cfg.hang_timeout or None)
+        # the watchdog's last act before exit 42 is a flight-recorder
+        # dump: the postmortem then says what the run was doing when the
+        # device wedged (telemetry.emergency_dump is a no-op when off)
+        watchdog = HangWatchdog(
+            cfg.hang_timeout or None,
+            on_timeout=lambda: telemetry_lib.emergency_dump("hang"))
         # anomaly policy (DESIGN.md §6): consumes the per-step loss
         # futures at a fixed lag of two dispatches, so its device_get only
         # ever waits on a step whose successor is already submitted — one
@@ -747,6 +777,10 @@ class Trainer:
                                     f"{cfg.rollback_after} consecutive bad "
                                     f"steps — restored step {step}, re-drew "
                                     "the data order")
+                                # postmortem now + a straddling re-dump
+                                # after the first post-rollback record
+                                self.telemetry.on_rollback(
+                                    step, monitor.rollbacks)
                                 prev = None
                                 monitor_q.clear()
                                 rolled_back = True
@@ -765,11 +799,21 @@ class Trainer:
                         if fault_plan is not None:
                             batch = fault_plan.apply(step, batch)
                         if self.k_dispatch > 1:
-                            self.state, losses = self.multi_step(self.state,
-                                                                 batch)
-                            loss = losses[-1]
+                            self.state, outs = self.multi_step(self.state,
+                                                               batch)
+                            # each dispatch reports its LAST step (the
+                            # intermediate outputs live inside the scan;
+                            # the 'skipped' metric is the guard's
+                            # CUMULATIVE counter exactly so this slice
+                            # cannot lose mid-dispatch fires)
+                            out = jax.tree_util.tree_map(lambda x: x[-1],
+                                                         outs)
                         else:
-                            self.state, loss = self.train_step(self.state, batch)
+                            self.state, out = self.train_step(self.state,
+                                                              batch)
+                        # telemetry layouts return the on-device metrics
+                        # dict; everything downstream keys off the loss
+                        loss = out["loss"] if isinstance(out, dict) else out
                         watchdog.pat()
                         timer.tick()  # one tick per DISPATCH (= n_steps steps)
                         thr.add(rows)
@@ -778,6 +822,9 @@ class Trainer:
                         prev = (step, epoch, loss, before)
                         if monitor is not None:
                             monitor_q.append((step, loss))
+                        # lag-2 fetch + metrics record + heartbeat refresh
+                        self.telemetry.on_dispatch(step, epoch, before, out,
+                                                   n_steps, rows)
                         # k>1 dispatches can stride over an exact multiple;
                         # fire on every boundary CROSSING (== the k=1 modulo
                         # rule when n_steps is 1).  While the monitor's
@@ -838,11 +885,28 @@ class Trainer:
             # generator would otherwise park its loader thread until GC
             if dispatches is not None and hasattr(dispatches, "close"):
                 dispatches.close()
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                # abnormal exit (anomaly abort, crash): the flight
+                # recorder's dump is the black box a relaunch reads —
+                # then release the telemetry/metrics handles (the normal
+                # path closes them at the end of fit; without this an
+                # aborted fit leaks the jsonl fd and leaves the module
+                # _ACTIVE pointing at a dead run's directory)
+                self.telemetry.on_abnormal_exit(exc)
+                self.metrics.close()
+                self.telemetry.close()
         if prev is not None and cfg.log_every and \
                 prev[0] // cfg.log_every > prev[3] // cfg.log_every:
             self.metrics.write({"step": prev[0], "epoch": prev[1],
                                 "loss": last_loss,
                                 "samples_per_sec": thr.samples_per_sec})
+        # drain the telemetry lag queue (every queued future is complete
+        # by now) and write the final heartbeat at the real step (in the
+        # heartbeat-only metrics_every=0 mode no record carries one)
+        self.telemetry.flush(step=step)
+        if shutdown.requested:
+            self.telemetry.on_preempted(shutdown.signum, step)
         self.save(final=True)
         result = {"final_loss": last_loss,
                   "steps": step,
@@ -864,11 +928,15 @@ class Trainer:
             result["skipped_updates"] = int(
                 jax.device_get(self.state.opt_state.skipped))
         # achieved model FLOPs/s (fwd + ~2x bwd per optimizer step), from
-        # the model's own accounting — None for unaccounted architectures
+        # the single-source analytic accounting (train.telemetry /
+        # Module.fwd_flops) — None for unaccounted architectures
         sample_shape = (1,) + tuple(self.data["x"].shape[1:])
-        fps = self.model.fwd_flops(sample_shape)
-        if fps is not None:
-            result["model_flops_per_sec"] = 3.0 * fps * thr.samples_per_sec
+        step_flops = telemetry_lib.train_step_flops(self.model, sample_shape)
+        if step_flops is not None:
+            result["model_flops_per_sec"] = step_flops * thr.samples_per_sec
+            if self.telemetry.enabled:
+                result["mfu"] = (result["model_flops_per_sec"]
+                                 / self.telemetry.peak_total)
         # peak device memory where the backend reports it (TPU HBM; {} on
         # CPU) — the observability the reference's prints never had.
         # PROCESS-lifetime high-water mark (the runtime never resets it),
@@ -890,6 +958,7 @@ class Trainer:
                                     **{f"val_{k}": v for k, v in ev.items()}})
             result.update({f"val_{k}": v for k, v in ev.items()})
         self.metrics.close()
+        self.telemetry.close()
         return result
 
     def _eval_params(self):
@@ -923,6 +992,9 @@ class Trainer:
         sums: Dict[str, float] = {}
         totals: Dict[str, float] = {}
         for batch in loader.epoch(0):
+            # eval emits no train dispatches; beat the heartbeat so the
+            # external staleness monitor doesn't kill a long eval tail
+            self.telemetry.alive()
             m = jax.device_get(self.eval_step(params, batch))
             c = float(m.pop("count"))
             ec = float(m.pop("example_count", c))

@@ -133,6 +133,16 @@ class FaultPlan:
                 print(f"[faults] injected crash at step {step}",
                       file=sys.stderr, flush=True)
                 sys.stderr.flush()
+                try:
+                    # a real segfault could not do this, but the injected
+                    # stand-in exercises the flight recorder's black-box
+                    # contract: die WITH a postmortem for the supervisor's
+                    # relaunch log to point at (train.telemetry)
+                    from ..train import telemetry
+
+                    telemetry.emergency_dump(f"crash@{step} (injected)")
+                except Exception:
+                    pass
                 os._exit(1)
             if f.kind == "sigterm":
                 print(f"[faults] injected SIGTERM at step {step}",

@@ -38,10 +38,13 @@ class HangWatchdog:
     """
 
     def __init__(self, timeout_s: Optional[float], what: str = "train step",
-                 _exit=os._exit):
+                 _exit=os._exit, on_timeout=None):
         self.timeout_s = timeout_s
         self.what = what
         self._exit = _exit  # injectable for tests
+        # best-effort last act before the hard exit (the Trainer hooks the
+        # telemetry flight-recorder dump here); must never block the exit
+        self.on_timeout = on_timeout
         self._beat: Optional[float] = None  # None until armed by first pat
         self._suspended = 0
         self._stop = threading.Event()
@@ -84,6 +87,11 @@ class HangWatchdog:
                     sys.stderr.flush()
                 except Exception:
                     pass
+                if self.on_timeout is not None:
+                    try:
+                        self.on_timeout()
+                    except Exception:
+                        pass  # the dump is best-effort; exit regardless
                 self._exit(42)
                 return  # only reached with an injected _exit (tests)
 

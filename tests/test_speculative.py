@@ -261,3 +261,55 @@ def test_device_zero_tokens_schema():
                                              prompt, 0)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(prompt))
     assert "proposed_total" in stats and stats["rounds"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Draft-cache density (the fully-accepted-round K/V gap)
+# ---------------------------------------------------------------------------
+
+
+def _interior_zero_positions(caches):
+    """Positions with an all-zero K row in ANY draft layer, below the
+    highest written position — a zero there is attended by every later
+    draft step (decode masks keys <= pos, and nothing rewrites it)."""
+    zeros = set()
+    for layer in caches:
+        k = np.asarray(layer["k"][0])                  # (T, heads, hd)
+        norms = np.linalg.norm(k, axis=(-1, -2))
+        written = np.nonzero(norms)[0]
+        if written.size:
+            zeros.update(i for i in range(int(written.max()))
+                         if norms[i] == 0.0)
+    return sorted(zeros)
+
+
+def test_host_draft_cache_density_after_full_accept_rounds():
+    """Regression (draft-KV gap): after a fully-accepted round the draft
+    had never seen its own last proposal, leaving a permanent zero K/V
+    entry at that position which every later draft step attended —
+    self-draft (accept rate 1, every round fully accepted) made EVERY
+    round leave one.  The catch-up draft step must keep the cache dense:
+    no interior zero rows below the last drafted position."""
+    target, tp = _model(layers=2, seed=0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    dbg = {}
+    _, stats = speculative_generate(target, tp, target, tp, prompt, 16,
+                                    k=4, debug_state=dbg)
+    assert stats["accept_rate"] == 1.0  # rounds really were full accepts
+    assert _interior_zero_positions(dbg["d_caches"]) == []
+
+
+def test_device_draft_cache_density_after_full_accept_rounds():
+    """Same invariant for the single-program device path (the lax.cond
+    catch-up inside full_round)."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.speculative import (
+        _spec_device_program,
+    )
+
+    target, tp = _model(layers=2, seed=0)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    p, n, k = 3, 16, 4
+    _, stats, (d_caches, _pos) = _spec_device_program(
+        target, target, p + n, p, k, 1, True)(tp, tp, prompt)
+    assert int(stats["accepted"]) == k * int(stats["rounds"])  # full accepts
+    assert _interior_zero_positions(d_caches) == []

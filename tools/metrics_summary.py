@@ -1,0 +1,197 @@
+"""Render a telemetry run's health from its --telemetry_dir artifacts.
+
+Reads the metrics JSONL (per-step grad/param norms, update ratio, loss,
+mfu, step time — train.telemetry) plus heartbeat.json / postmortem.json
+when present, and prints percentiles and trends::
+
+    python tools/metrics_summary.py RUN_DIR            # a --telemetry_dir
+    python tools/metrics_summary.py metrics.jsonl      # a bare JSONL
+    python tools/metrics_summary.py RUN_DIR --last 200 # tail window only
+    python tools/metrics_summary.py RUN_DIR --json     # machine-readable
+
+Zero dependencies beyond the stdlib — usable on a host with no JAX, e.g.
+to triage a run directory copied off a pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[i]
+
+
+def load_records(path: str, last: int = 0) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of a live run
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records[-last:] if last > 0 else records
+
+
+def _series(records, key) -> List[float]:
+    out = []
+    for r in records:
+        v = r.get(key)
+        if isinstance(v, (int, float)) and math.isfinite(v):
+            out.append(float(v))
+    return out
+
+
+def _stat_row(name: str, vals: List[float], unit: str = "") -> Optional[str]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return (f"  {name:<14} p50 {_percentile(s, 0.50):.6g}   "
+            f"p95 {_percentile(s, 0.95):.6g}   max {s[-1]:.6g}"
+            + (f" {unit}" if unit else ""))
+
+
+def summarize(records: List[Dict[str, Any]],
+              windowed: bool = False) -> Dict[str, Any]:
+    steps = _series(records, "step")
+    losses = _series(records, "loss")
+    out: Dict[str, Any] = {
+        "n_records": len(records),
+        "step_first": int(steps[0]) if steps else None,
+        "step_last": int(steps[-1]) if steps else None,
+    }
+    if losses:
+        out["loss_first"] = losses[0]
+        out["loss_last"] = losses[-1]
+        out["loss_min"] = min(losses)
+        nonfinite = sum(1 for r in records
+                        if isinstance(r.get("loss"), float)
+                        and not math.isfinite(r["loss"]))
+        out["nonfinite_losses"] = nonfinite
+    for key in ("grad_norm", "param_norm", "update_ratio",
+                "step_time_ms", "samples_per_sec", "mfu"):
+        vals = sorted(_series(records, key))
+        if vals:
+            out[key] = {"p50": _percentile(vals, 0.50),
+                        "p95": _percentile(vals, 0.95),
+                        "max": vals[-1]}
+    # 'skipped' is the guard's CUMULATIVE rejection counter per record
+    # (train.telemetry) — total fires = sum of positive increments, which
+    # also stays correct across a rollback's counter rewind.  With a
+    # --last window, seed from the first visible value so fires BEFORE
+    # the window are not attributed to it.
+    skipped = _series(records, "skipped")
+    total = 0
+    prev = skipped[0] if (windowed and skipped) else 0.0
+    for v in skipped:
+        if v > prev:
+            total += int(v - prev)
+        prev = v
+    out["skipped_updates"] = total
+    return out
+
+
+def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
+                heartbeat: Optional[Dict[str, Any]],
+                heartbeat_age: Optional[float],
+                postmortem: Optional[Dict[str, Any]]) -> str:
+    lines = [f"records: {summary['n_records']} "
+             f"(steps {summary.get('step_first')} -> "
+             f"{summary.get('step_last')})"]
+    if "loss_last" in summary:
+        lines.append(f"  loss           {summary['loss_first']:.6g} -> "
+                     f"{summary['loss_last']:.6g} "
+                     f"(min {summary['loss_min']:.6g})")
+        if summary.get("nonfinite_losses"):
+            lines.append(f"  NON-FINITE losses: "
+                         f"{summary['nonfinite_losses']}")
+    for key, unit in (("grad_norm", ""), ("param_norm", ""),
+                      ("update_ratio", ""), ("step_time_ms", "ms"),
+                      ("samples_per_sec", "samples/s"), ("mfu", "")):
+        row = _stat_row(key, _series(records, key), unit)
+        if row:
+            lines.append(row)
+    if summary.get("skipped_updates"):
+        lines.append(f"  skipped updates: {summary['skipped_updates']} "
+                     "(guarded steps rejected — see postmortem/events)")
+    if heartbeat is not None:
+        age = ("?" if heartbeat_age is None
+               else f"{heartbeat_age:.1f}s ago")
+        rate = heartbeat.get("steps_per_sec_ema")
+        lines.append(f"heartbeat: step {heartbeat.get('step')} ({age})"
+                     + (f", {rate:.2f} steps/s EMA" if rate else "")
+                     + (" [FINAL]" if heartbeat.get("final") else ""))
+    if postmortem is not None:
+        lines.append(f"postmortem: {postmortem.get('reason')!r} with "
+                     f"{postmortem.get('n_records')} records "
+                     f"at {postmortem.get('written_iso')}")
+        events = [r for r in postmortem.get("records", [])
+                  if r.get("kind") == "event"]
+        for e in events[-5:]:
+            lines.append(f"  event: {e.get('event')} @ step "
+                         f"{e.get('step')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="a --telemetry_dir or a metrics JSONL file")
+    ap.add_argument("--last", type=int, default=0,
+                    help="summarize only the last N records")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    heartbeat = postmortem = None
+    heartbeat_age = None
+    if os.path.isdir(args.path):
+        metrics_path = os.path.join(args.path, "metrics.jsonl")
+        hb_path = os.path.join(args.path, "heartbeat.json")
+        pm_path = os.path.join(args.path, "postmortem.json")
+        for p, slot in ((hb_path, "hb"), (pm_path, "pm")):
+            try:
+                with open(p) as f:
+                    doc = json.load(f)
+                if slot == "hb":
+                    heartbeat = doc
+                    heartbeat_age = max(0.0,
+                                        time.time() - os.stat(p).st_mtime)
+                else:
+                    postmortem = doc
+            except (OSError, ValueError):
+                pass
+    else:
+        metrics_path = args.path
+    try:
+        records = load_records(metrics_path, last=args.last)
+    except OSError as e:
+        print(f"ERROR: cannot read {metrics_path}: {e}", file=sys.stderr)
+        return 2
+    summary = summarize(records, windowed=args.last > 0)
+    if args.json:
+        summary["heartbeat"] = heartbeat
+        summary["heartbeat_age_s"] = heartbeat_age
+        summary["postmortem_reason"] = (postmortem or {}).get("reason")
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_text(summary, records, heartbeat, heartbeat_age,
+                          postmortem))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,18 @@ def main(argv=None) -> int:
     p.add_argument("--backoff", type=float, default=1.0,
                    help="initial backoff seconds (doubles per restart)")
     p.add_argument("--backoff-cap", type=float, default=60.0)
+    p.add_argument("--telemetry-dir", default=None,
+                   help="the child's --telemetry_dir: watch its "
+                        "heartbeat.json for staleness (with "
+                        "--heartbeat-timeout) and point the relaunch log "
+                        "at its postmortem.json after abnormal exits")
+    p.add_argument("--heartbeat-timeout", type=float, default=0.0,
+                   help="kill the child as hung (exit-42 retry) when its "
+                        "heartbeat goes stale for this many seconds "
+                        "(0 = off; needs --telemetry-dir or --heartbeat)")
+    p.add_argument("--heartbeat", default=None,
+                   help="explicit heartbeat file (overrides the "
+                        "--telemetry-dir derived path)")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the command to run (prefix with -- to stop flag "
                         "parsing)")
@@ -55,8 +67,22 @@ def main(argv=None) -> int:
     cmd = args.cmd[1:] if args.cmd[:1] == ["--"] else args.cmd
     if not cmd:
         p.error("no command given (usage: supervise.py [flags] -- cmd ...)")
+    import os
+
+    heartbeat = args.heartbeat or (
+        os.path.join(args.telemetry_dir, "heartbeat.json")
+        if args.telemetry_dir else None)
+    if args.heartbeat_timeout > 0 and not heartbeat:
+        p.error("--heartbeat-timeout needs a heartbeat file to watch: "
+                "pass --telemetry-dir (the child's --telemetry_dir) or "
+                "--heartbeat")
+    postmortem = (os.path.join(args.telemetry_dir, "postmortem.json")
+                  if args.telemetry_dir else None)
     return supervise(cmd, max_restarts=args.max_restarts,
-                     backoff=args.backoff, backoff_cap=args.backoff_cap)
+                     backoff=args.backoff, backoff_cap=args.backoff_cap,
+                     heartbeat_path=heartbeat,
+                     heartbeat_timeout=args.heartbeat_timeout,
+                     postmortem_path=postmortem)
 
 
 if __name__ == "__main__":

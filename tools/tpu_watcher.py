@@ -21,6 +21,11 @@ Coordination files (repo root):
 
 * ``.tpu_watch_pause``  — create to make the watcher skip probing (e.g.
   while a foreground CPU benchmark needs the single core to itself).
+  Pauses EXPIRE: a pause file whose mtime is older than ~30 min
+  (``PAUSE_MAX_AGE_S``) is ignored with a ``stale_pause_ignored`` log
+  event — a forgotten pause must never eat another round's chip windows
+  (VERDICT r5 item 2); touch the file periodically to hold a longer pause.
+  The file itself must never be committed.
 * ``.tpu_watch_busy``   — written by the watcher while it is running the
   priority list (the chip is exclusive; a concurrent foreground probe
   would both fail and perturb the measurement).
@@ -50,6 +55,32 @@ from neural_networks_parallel_training_with_mpi_tpu.utils import (  # noqa: E402
 LOG_PATH = os.path.join(REPO, "TPU_WATCH.jsonl")
 PAUSE_PATH = os.path.join(REPO, ".tpu_watch_pause")
 BUSY_PATH = os.path.join(REPO, ".tpu_watch_busy")
+# A pause older than this is STALE and ignored (VERDICT r5 item 2: a
+# forgotten pause file once ate a whole round of chip windows).  Pausers
+# needing longer must touch the file periodically.
+PAUSE_MAX_AGE_S = 30 * 60.0
+
+
+_warned_stale_pause_mtime = None
+
+
+def pause_active(now: float = None) -> bool:
+    """True only while a FRESH pause file exists; a stale one (mtime older
+    than PAUSE_MAX_AGE_S) is ignored so it can never eat another round."""
+    global _warned_stale_pause_mtime
+
+    try:
+        mtime = os.stat(PAUSE_PATH).st_mtime
+    except OSError:
+        return False
+    age = (time.time() if now is None else now) - mtime
+    if age <= PAUSE_MAX_AGE_S:
+        return True
+    if _warned_stale_pause_mtime != mtime:  # log once per stale file
+        _warned_stale_pause_mtime = mtime
+        log_event({"event": "stale_pause_ignored", "age_s": round(age, 1),
+                   "max_age_s": PAUSE_MAX_AGE_S})
+    return False
 
 # The priority list, in VERDICT r3's order.  Each item: (name, argv-tail,
 # timeout_s).  Timeouts are generous (first Mosaic compile of a 12-layer LM
@@ -225,7 +256,7 @@ def main() -> int:
     attempt = 0
     while True:
         attempt += 1
-        if os.path.exists(PAUSE_PATH):
+        if pause_active():
             log_event({"event": "probe", "attempt": attempt,
                        "outcome": "paused"})
         else:
