@@ -136,8 +136,14 @@ def _generate(args) -> int:
         if restored is None:
             log(f"ERROR: no checkpoint under {cfg.checkpoint_dir}")
             return 2
-        params = _dense_decode_params(restored.params, model,
-                                      ckpt.read_meta(cfg.checkpoint_dir))
+        # meta of the generation actually restored (the fallback chain can
+        # land below an unquarantinable corrupt newest) — an unpinned read
+        # could return a different generation's qkv_tp and silently
+        # garble the decode weights
+        params = _dense_decode_params(
+            restored.params, model,
+            ckpt.read_meta(cfg.checkpoint_dir,
+                           step=int(jax.device_get(restored.step))))
         log(f"restored step {int(jax.device_get(restored.step))} from "
             f"{cfg.checkpoint_dir}")
     else:
@@ -197,7 +203,8 @@ def _supervise(args, argv) -> int:
                      backoff=args.supervise_backoff,
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=heartbeat_timeout,
-                     postmortem_path=postmortem)
+                     postmortem_path=postmortem,
+                     ckpt_dir=args.checkpoint_dir)
 
 
 def main(argv=None) -> int:

@@ -242,6 +242,10 @@ class TrainConfig:
     # checkpointing (extension beyond reference parity, SURVEY.md §5.4)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # steps; 0 = only at end
+    # retain the newest K committed snapshots (0 = keep all); pruning
+    # never deletes the last VERIFIED snapshot (utils.checkpoint,
+    # DESIGN.md §8)
+    checkpoint_keep: int = 3
     resume: bool = False
     # overlap periodic checkpoint writes with compute (background writer;
     # the final save is always synchronous)
@@ -298,7 +302,9 @@ class TrainConfig:
     # meaningful with rollback_after > 0)
     loss_spike_factor: float = 0.0
     # deterministic fault injection spec (utils.faults; falls back to the
-    # NNPT_FAULTS env var), e.g. "nan@5-8?max=4,crash@12?once=/tmp/m"
+    # NNPT_FAULTS env var), e.g. "nan@5-8?max=4,crash@12?once=/tmp/m";
+    # I/O kinds torn_ckpt/corrupt_ckpt/ckpt_ioerr target the checkpoint
+    # durability layer (DESIGN.md §8)
     faults: str = ""
 
     def to_json(self) -> str:
@@ -507,7 +513,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(default 1.25)")
     p.add_argument("--checkpoint_dir", type=str, default=None)
     p.add_argument("--checkpoint_every", type=int, default=0)
-    _add_bool_flag(p, "resume", False, "resume from checkpoint_dir")
+    p.add_argument("--checkpoint_keep", type=int, default=3, metavar="K",
+                   help="retain the newest K committed snapshots (0 = keep "
+                        "all); pruning never deletes the last VERIFIED "
+                        "snapshot (tools/ckpt_fsck.py audits a dir)")
+    _add_bool_flag(p, "resume", False, "resume from checkpoint_dir "
+                   "(newest VERIFIED snapshot; corrupt/torn generations "
+                   "are quarantined and fallen back past)")
     _add_bool_flag(p, "async-checkpoint", False,
                    "write periodic checkpoints on a background thread")
     p.add_argument("--profile_dir", type=str, default=None)
@@ -554,7 +566,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--faults", type=str, default="",
                    help="deterministic fault injection spec (utils.faults: "
                         "'nan@5-8?max=4,crash@12?once=PATH,sigterm@9'; "
-                        "NNPT_FAULTS env var is the fallback)")
+                        "I/O kinds torn_ckpt/corrupt_ckpt/ckpt_ioerr hit "
+                        "the checkpoint durability layer; NNPT_FAULTS env "
+                        "var is the fallback)")
     p.add_argument("--supervise", type=int, default=0, metavar="N",
                    help="run under the crash-restart supervisor: relaunch "
                         "this same command on crash/hang (exit 42/43/any "
@@ -610,6 +624,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         shuffle=args.shuffle,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        checkpoint_keep=args.checkpoint_keep,
         resume=args.resume,
         async_checkpoint=args.async_checkpoint,
         profile_dir=args.profile_dir,

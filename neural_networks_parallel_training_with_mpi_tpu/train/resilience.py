@@ -205,6 +205,50 @@ def heartbeat_age_s(path: str, now: Optional[float] = None
     return max(0.0, (time.time() if now is None else now) - mtime)
 
 
+_ckpt_manifest_mod = None
+
+
+def _ckpt_manifest():
+    """utils/ckpt_manifest.py loaded BY FILE PATH (cached) — the regular
+    relative import would execute utils/__init__, whose prng/logging pull
+    jax; this module stays importable on the jax-less ops hosts the
+    generic supervisor (tools/supervise.py) is meant for, same trick as
+    tools/ckpt_fsck.py."""
+    global _ckpt_manifest_mod
+    if _ckpt_manifest_mod is None:
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "utils", "ckpt_manifest.py")
+        spec = importlib.util.spec_from_file_location(
+            "_nnpt_ckpt_manifest", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _ckpt_manifest_mod = mod
+    return _ckpt_manifest_mod
+
+
+def _restore_target(ckpt_dir: str):
+    """(step, n_bad): newest snapshot passing FULL manifest verification,
+    plus how many NEWER generations fail it — exactly the set the child's
+    restore will quarantine on its way down the chain.  Walks newest-first
+    and stops hashing at the first verified generation (restore's own
+    discipline: with multi-GB snapshots, sha256ing every older generation
+    would add minutes of supervisor downtime per relaunch for one log
+    line).  The verification itself is utils.ckpt_manifest — stdlib-only,
+    same logic tools/ckpt_fsck.py runs — so the supervisor reports what a
+    relaunch will actually resume from, not what merely exists on disk."""
+    cm = _ckpt_manifest()
+    bad = 0
+    for step, path in reversed(cm.snapshot_steps(ckpt_dir)):
+        if cm.verify(path):
+            bad += 1
+        else:
+            return step, bad
+    return None, bad
+
+
 def _run_child(cmd: Sequence[str], env: Optional[dict],
                heartbeat_path: Optional[str], heartbeat_timeout: float,
                log: Callable[[str], None]) -> int:
@@ -272,6 +316,7 @@ def supervise(cmd: Sequence[str], max_restarts: int,
               heartbeat_path: Optional[str] = None,
               heartbeat_timeout: float = 0.0,
               postmortem_path: Optional[str] = None,
+              ckpt_dir: Optional[str] = None,
               _sleep: Callable[[float], None] = time.sleep) -> int:
     """Run ``cmd`` under the crash-restart policy; return the final exit
     code.
@@ -288,6 +333,10 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     detector (see :func:`_run_child`).  ``postmortem_path``: when a child
     dies abnormally and the telemetry flight recorder dumped a postmortem
     during THIS child's lifetime, the relaunch log points at it.
+    ``ckpt_dir``: before each relaunch, log the newest VERIFIED snapshot
+    (full manifest-checksum pass, utils.ckpt_manifest) the child's
+    ``--resume`` will land on — so an operator tailing the supervisor sees
+    immediately whether a crash mid-checkpoint cost a generation.
     """
     if log is None:
         log = lambda m: print(m, file=sys.stderr, flush=True)
@@ -325,4 +374,30 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                   EXIT_PEER: "peer loss"}.get(rc, "crash")
         log(f"[supervise] child exit {rc} ({reason}); relaunching in "
             f"{delay:.1f}s ({restarts_used + 1}/{max_restarts})")
+        if ckpt_dir:
+            step, bad = _restore_target(ckpt_dir)
+            if step is not None:
+                log(f"[supervise] relaunch resumes from verified snapshot "
+                    f"step {step}"
+                    + (f" ({bad} unverified generation(s) will be "
+                       "quarantined on restore)" if bad else ""))
+            else:
+                cm = _ckpt_manifest()
+                legacy = any(
+                    (p / "meta.json").exists()
+                    and not (p / cm.MANIFEST).exists()
+                    for _, p in cm.snapshot_steps(ckpt_dir))
+                if legacy:
+                    # the child's restore REFUSES on pre-durability dirs
+                    # rather than silently restarting from step 0 — say
+                    # so instead of promising a from-scratch run
+                    log("[supervise] no verified snapshot in "
+                        f"{ckpt_dir} but pre-manifest snapshot(s) exist: "
+                        "the relaunch will refuse to start — run "
+                        "tools/ckpt_fsck.py --adopt to trust them")
+                else:
+                    log("[supervise] no verified snapshot in "
+                        f"{ckpt_dir}: relaunch restarts from scratch"
+                        + (f" ({bad} unverified generation(s) — "
+                           "tools/ckpt_fsck.py)" if bad else ""))
         _sleep(delay)

@@ -529,8 +529,12 @@ class Trainer:
         self._place_restored(restored)
         # restore the anomaly-rollback order salt: a relaunch after a
         # rollback must keep the re-drawn data order, not replay the
-        # poison window and re-spend the rollback budget on it
-        meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
+        # poison window and re-spend the rollback budget on it.  Read the
+        # meta of the generation restore ACTUALLY loaded (its step) — the
+        # newest committed dir can be a different, corrupt generation when
+        # quarantine failed (read-only fs) or this is a non-leader process
+        meta = ckpt.read_meta(self.cfg.checkpoint_dir,
+                              step=int(jax.device_get(self.state.step))) or {}
         self.loader.order_salt = int(meta.get("order_salt", 0))
         return int(jax.device_get(self.state.step))
 
@@ -606,7 +610,12 @@ class Trainer:
         as already permuted when resuming INTO a TP layout."""
         tp = (int(self.mesh.shape.get("tensor", 1))
               if (self.pipeline or self.sp_tp or self.ep_tp) else 1)
-        meta = ckpt.read_meta(self.cfg.checkpoint_dir) or {}
+        # meta of the generation actually restored, not the newest on disk
+        # (they differ when the fallback chain skipped an unquarantinable
+        # corrupt generation) — a mismatched qkv_tp would silently
+        # mis-permute the qkv columns of an older generation's weights
+        meta = ckpt.read_meta(self.cfg.checkpoint_dir,
+                              step=int(np.asarray(restored.step))) or {}
         saved_tp = int(meta.get("qkv_tp", 1))
         if saved_tp == tp:
             return restored
@@ -666,12 +675,13 @@ class Trainer:
                                                "order_salt", 0))}
             if self.cfg.async_checkpoint and not final:
                 ckpt.save_async(self.cfg.checkpoint_dir, self.state,
+                                keep=self.cfg.checkpoint_keep,
                                 extra_meta=extra)
             else:
                 if final:  # drain in-flight writes before the last snapshot
                     ckpt.wait_pending()
                 ckpt.save(self.cfg.checkpoint_dir, self.state,
-                          extra_meta=extra)
+                          keep=self.cfg.checkpoint_keep, extra_meta=extra)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -797,7 +807,9 @@ class Trainer:
                                 "samples_per_sec": thr.samples_per_sec,
                             })
                         if fault_plan is not None:
-                            batch = fault_plan.apply(step, batch)
+                            # I/O fault kinds need the checkpoint dir
+                            batch = fault_plan.apply(
+                                step, batch, ckpt_dir=cfg.checkpoint_dir)
                         if self.k_dispatch > 1:
                             self.state, outs = self.multi_step(self.state,
                                                                batch)

@@ -2,8 +2,8 @@
 the reference saves nothing; its only state transfer is the initial
 state-dict bcast at dataParallelTraining_NN_MPI.py:87).
 
-Layout: ``<dir>/ckpt-<step>/`` per snapshot, newest-wins restore, optional
-retention of the last K snapshots.  Two serialization paths:
+Layout: ``<dir>/ckpt-<step>/`` per snapshot, newest-VERIFIED-wins restore,
+optional retention of the last K snapshots.  Two serialization paths:
 
 * **npz** (default): plain-numpy pytree snapshot — ``state.npz`` (leaves) +
   ``treedef.pkl`` (structure) + ``meta.json`` (step).  Used whenever the
@@ -13,6 +13,16 @@ retention of the last K snapshots.  Two serialization paths:
   state on a multi-host mesh), ``jax.device_get`` would raise — each
   process must write only its own shards.  Orbax's StandardCheckpointer
   implements exactly that protocol, so we delegate to it.
+
+Durability (DESIGN.md §8): every snapshot is committed by a checksummed
+``manifest.json`` (utils.ckpt_manifest) written last, after fsync of the
+payload files and the directory — a dir without a valid manifest is an
+uncommitted snapshot, never a crash.  ``restore()`` verifies the manifest
+before unpickling anything; a corrupt/torn generation is logged,
+quarantined (renamed ``corrupt-ckpt-<step>``) and the next-newest verified
+snapshot is restored instead, so one rotted ``state.npz`` can never turn a
+recoverable crash into a permanently dead job.  Pruning never deletes the
+last verified snapshot.
 
 Restore validates structure and leaf shapes/dtypes against the caller's
 live state so a checkpoint from a different model/optimizer config fails
@@ -32,8 +42,11 @@ import jax
 import numpy as np
 
 from ..train.state import TrainState
+from . import ckpt_manifest
+from .logging import log
 
 _CKPT_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-" + _CKPT_PREFIX
 # async writer bookkeeping: one write at a time (_write_lock), joinable
 # threads (wait_pending), failures drained under _err_lock and re-raised on
 # the caller's thread
@@ -41,6 +54,20 @@ _write_lock = threading.Lock()
 _err_lock = threading.Lock()
 _pending: List[threading.Thread] = []
 _async_errors: List[BaseException] = []
+
+# I/O fault injection (utils.faults: torn_ckpt / ckpt_ioerr) — armed by
+# FaultPlan.apply at an exact step, consumed by the NEXT snapshot write
+_io_fault: List[str] = []
+
+
+def inject_io_fault(kind: str) -> None:
+    """Arm a checkpoint-writer fault (``torn_ckpt`` | ``ckpt_ioerr``); the
+    next ``_write_npz`` entry consumes it.  Test-only, via utils.faults."""
+    _io_fault.append(kind)
+
+
+def _consume_io_fault() -> Optional[str]:
+    return _io_fault.pop(0) if _io_fault else None
 
 
 def _drain_errors() -> List[BaseException]:
@@ -55,18 +82,46 @@ def _is_fully_addressable(state: Any) -> bool:
                for l in jax.tree_util.tree_leaves(state))
 
 
-def _snapshot_dirs(d: Path):
-    """[(step, path)] sorted ascending; tolerates foreign dirs."""
-    out = []
+def _snapshot_dirs(d: Path, committed: bool = False):
+    """[(step, path)] sorted ascending (ckpt_manifest.snapshot_steps).
+    With ``committed`` only dirs carrying a manifest count — torn/
+    uncommitted writes are invisible to latest_step/read_meta/pruning."""
+    return [(s, p) for s, p in ckpt_manifest.snapshot_steps(d)
+            if not committed or (p / ckpt_manifest.MANIFEST).exists()]
+
+
+def _sweep_tmp(d: Path) -> None:
+    """Remove stale ``.tmp-ckpt-*`` staging dirs — a crash mid-write used
+    to leak them forever unless the exact same step was re-saved."""
     if not d.exists():
-        return out
+        return
     for p in d.iterdir():
-        if p.is_dir() and p.name.startswith(_CKPT_PREFIX):
-            try:
-                out.append((int(p.name[len(_CKPT_PREFIX):]), p))
-            except ValueError:
-                continue
-    return sorted(out)
+        if p.is_dir() and p.name.startswith(_TMP_PREFIX):
+            shutil.rmtree(p, ignore_errors=True)
+
+
+def _prune(d: Path, keep: int, trusted: Optional[Path] = None) -> None:
+    """Drop committed snapshots beyond the newest ``keep`` — but never the
+    last VERIFIED one: pruning only proceeds once some retained snapshot
+    is known good, so a run whose recent generations all rotted cannot
+    delete the only restorable state left on disk.  ``trusted`` is a
+    generation THIS call just committed from checksums it computed itself
+    — counting it verified by manifest presence skips re-reading and
+    re-hashing a snapshot written microseconds ago (on the writer path
+    that is always the newest kept one, so the guard costs nothing)."""
+    if not keep:
+        return
+    committed = _snapshot_dirs(d, committed=True)
+    doomed, kept = committed[:-keep], committed[-keep:]
+    if not doomed:
+        return
+    if not any(p == trusted or not ckpt_manifest.verify(p)
+               for _, p in reversed(kept)):
+        log(f"checkpoint: NOT pruning {len(doomed)} old snapshot(s) — no "
+            f"retained snapshot in {d} verifies; run tools/ckpt_fsck.py")
+        return
+    for _, old in doomed:
+        shutil.rmtree(old, ignore_errors=True)
 
 
 def save(directory: str, state: TrainState, keep: int = 3,
@@ -89,31 +144,52 @@ def save(directory: str, state: TrainState, keep: int = 3,
     if _is_fully_addressable(state):
         if jax.process_index() == 0:
             _write_npz(d, step, jax.device_get(state), keep, extra_meta)
-            return target
-    else:  # multi-host sharded: orbax shard-parallel write
-        import orbax.checkpoint as ocp
-
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(target.absolute() / "orbax",
-                       jax.tree_util.tree_map(lambda x: x, state))
-        if jax.process_index() == 0:
-            (target / "meta.json").write_text(json.dumps(
-                {"step": step, "format": "orbax", **(extra_meta or {})}))
-    if keep and jax.process_index() == 0:
-        for _, old in _snapshot_dirs(d)[:-keep]:
-            shutil.rmtree(old, ignore_errors=True)
+        return target
+    _write_orbax(d, target, step, state, extra_meta)
+    if jax.process_index() == 0:
+        _prune(d, keep, trusted=target)
     return target
+
+
+def _write_orbax(d: Path, target: Path, step: int, state: Any,
+                 extra_meta: Optional[dict]) -> None:
+    """Shard-parallel orbax write, committed by the same manifest protocol
+    as npz: shards first, then ``meta.json``, then the checksummed
+    ``manifest.json`` written last after fsync — a crash anywhere before
+    the manifest leaves an uncommitted dir restore skips, instead of the
+    old half-snapshot (shards without meta.json) restore died on."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(target.absolute() / "orbax",
+                   jax.tree_util.tree_map(lambda x: x, state))
+    if jax.process_index() == 0:
+        (target / "meta.json").write_text(json.dumps(
+            {"step": step, "format": "orbax", **(extra_meta or {})}))
+        ckpt_manifest.commit(target, {"step": step, "format": "orbax"})
+        ckpt_manifest.fsync_path(d)  # the ckpt-<step> dirent itself
 
 
 def _write_npz(d: Path, step: int, host_state: Any, keep: int,
                extra_meta: Optional[dict] = None) -> None:
     """Serialized (lock-held) atomic npz snapshot write + pruning; runs on
-    the caller's thread (sync save) or the writer thread (async save)."""
+    the caller's thread (sync save) or the writer thread (async save).
+
+    Commit protocol: payload streams to ``.tmp-ckpt-<step>`` exactly as
+    the legacy writer did (no in-memory copy of a multi-GB state), the
+    manifest's checksums come from the page-cached read-back (~1 GB/s,
+    and the cheapest end-to-end check that what landed is what we meant),
+    everything is fsync'd, the manifest written last inside the staging
+    dir, then one atomic rename publishes the committed snapshot and the
+    parent dir is fsync'd."""
     with _write_lock:
+        fault = _consume_io_fault()
+        if fault == "ckpt_ioerr":
+            raise OSError(f"injected ckpt_ioerr fault (step {step})")
         target = d / f"{_CKPT_PREFIX}{step}"
-        tmp = d / f".tmp-{_CKPT_PREFIX}{step}"
-        if tmp.exists():
-            shutil.rmtree(tmp)
+        tmp = d / f"{_TMP_PREFIX}{step}"
+        d.mkdir(parents=True, exist_ok=True)
+        _sweep_tmp(d)
         tmp.mkdir(parents=True)
         leaves, treedef = jax.tree_util.tree_flatten(host_state)
         np.savez(tmp / "state.npz", **{f"leaf_{i}": np.asarray(l)
@@ -121,12 +197,43 @@ def _write_npz(d: Path, step: int, host_state: Any, keep: int,
         (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
         (tmp / "meta.json").write_text(json.dumps(
             {"step": step, "format": "npz", **(extra_meta or {})}))
+        if fault == "torn_ckpt":
+            _die_torn(d, tmp, target, step)
+        ckpt_manifest.commit(
+            tmp, {"step": step, "format": "npz", "leaves": len(leaves)})
         if target.exists():
             shutil.rmtree(target)
         tmp.rename(target)
-        if keep:
-            for _, old in _snapshot_dirs(d)[:-keep]:
-                shutil.rmtree(old, ignore_errors=True)
+        ckpt_manifest.fsync_path(d)
+        _prune(d, keep, trusted=target)
+
+
+def _die_torn(d: Path, tmp: Path, target: Path, step: int) -> None:
+    """Injected torn write (utils.faults ``torn_ckpt``): publish the
+    payload WITHOUT a manifest — the on-disk state a non-atomic writer
+    leaves when the machine dies after the payload, before the commit
+    marker — then die as if SIGKILLed mid-checkpoint.  Restore must treat
+    the dir as uncommitted and fall back to the previous generation."""
+    import os
+    import signal
+    import sys
+
+    if target.exists():
+        shutil.rmtree(target)
+    tmp.rename(target)
+    ckpt_manifest.fsync_path(d)
+    print(f"[faults] injected torn checkpoint write at step {step}: "
+          f"published {target.name} without a manifest, dying (SIGKILL)",
+          file=sys.stderr, flush=True)
+    try:
+        # same black-box contract as the crash fault: die WITH a
+        # postmortem for the supervisor's relaunch log to point at
+        from ..train import telemetry
+
+        telemetry.emergency_dump(f"torn_ckpt@{step} (injected)")
+    except Exception:
+        pass
+    os.kill(os.getpid(), signal.SIGKILL)
 
 
 def save_async(directory: str, state: TrainState, keep: int = 3,
@@ -167,26 +274,36 @@ def save_async(directory: str, state: TrainState, keep: int = 3,
     _pending[:] = [p for p in _pending if p.is_alive()]
 
 
-def wait_pending() -> None:
-    """Join all in-flight async checkpoint writes; re-raise their errors."""
+def _join_pending() -> None:
+    """Join in-flight writer threads WITHOUT draining their errors (those
+    surface on the next save_async/wait_pending, whose callers expect
+    them).  restore() calls this so a mid-run rollback can never race the
+    writer thread's pruning of the very snapshot it is about to read."""
     for t in list(_pending):
         t.join()
     _pending.clear()
+
+
+def wait_pending() -> None:
+    """Join all in-flight async checkpoint writes; re-raise their errors."""
+    _join_pending()
     err = _drain_errors()
     if err:
         raise RuntimeError("async checkpoint write failed") from err[0]
 
 
 def latest_step(directory: str) -> Optional[int]:
-    snaps = _snapshot_dirs(Path(directory))
+    """Newest COMMITTED snapshot step (torn/uncommitted dirs don't count)."""
+    snaps = _snapshot_dirs(Path(directory), committed=True)
     return snaps[-1][0] if snaps else None
 
 
 def read_meta(directory: str, step: Optional[int] = None) -> Optional[dict]:
-    """meta.json of the newest (or a specific) snapshot; None when the
-    directory has no snapshot or a legacy layout without metadata."""
+    """meta.json of the newest committed (or a specific) snapshot; None
+    when the directory has no committed snapshot or a legacy layout
+    without metadata."""
     d = Path(directory)
-    snaps = _snapshot_dirs(d)
+    snaps = _snapshot_dirs(d, committed=True)
     if not snaps:
         return None
     if step is not None:
@@ -202,14 +319,52 @@ def read_meta(directory: str, step: Optional[int] = None) -> Optional[dict]:
         return None
 
 
+def verify(directory: str, step: Optional[int] = None) -> bool:
+    """With ``step``: True when that generation carries a valid manifest
+    AND every payload file matches its checksum.  With ``step=None``:
+    True when ANY generation does, walking newest-first — the same chain
+    :func:`restore` follows, so this is the pre-flight for "can a restore
+    succeed?" (a torn newest write above a good older snapshot answers
+    True, because restore will fall back past it)."""
+    snaps = _snapshot_dirs(Path(directory))
+    if step is not None:
+        snaps = [(s, p) for s, p in snaps if s == step]
+    return any(not ckpt_manifest.verify(p) for _, p in reversed(snaps))
+
+
+def _quarantine(path: Path, step: int, problems: List[str]) -> None:
+    """Leader-side quarantine + loud log (non-leader processes see the
+    same verification failure and skip the generation identically)."""
+    log(f"checkpoint: snapshot {path.name} FAILED verification "
+        f"({problems[0]}{' ...' if len(problems) > 1 else ''})")
+    if jax.process_index() != 0:
+        return
+    try:
+        q = ckpt_manifest.quarantine(path)
+        log(f"checkpoint: quarantined {path.name} -> {q.name}; falling "
+            "back to the next-newest verified snapshot "
+            "(tools/ckpt_fsck.py inspects/repairs quarantined dirs)")
+    except OSError as e:
+        log(f"checkpoint: could not quarantine {path.name}: {e}")
+
+
 def restore(directory: str, template: Optional[TrainState] = None,
             step: Optional[int] = None) -> Optional[TrainState]:
-    """Load the newest (or a specific) snapshot; ``template`` (the freshly-
-    initialized, placed state) gates structure/shape compatibility and, for
-    orbax snapshots, provides the target shardings."""
+    """Load the newest VERIFIED (or a specific) snapshot; ``template`` (the
+    freshly-initialized, placed state) gates structure/shape/dtype
+    compatibility and, for orbax snapshots, provides the target shardings.
+
+    Every candidate's manifest is checked before anything is unpickled; a
+    generation that fails (torn write, bit rot, truncation) is quarantined
+    and the chain falls back to the next-newest one — returning None only
+    when no verified snapshot is left.  An explicit ``step=`` request
+    raises instead of silently substituting a different generation."""
+    _join_pending()  # never race an in-flight writer's pruning
     d = Path(directory)
+    if jax.process_index() == 0:
+        _sweep_tmp(d)
     snaps = _snapshot_dirs(d)
-    # legacy flat layout (state.npz directly in `directory`)
+    # legacy flat layout (state.npz directly in `directory`, pre-manifest)
     if not snaps and (d / "state.npz").exists():
         return _restore_npz(d, template)
     if not snaps:
@@ -219,9 +374,54 @@ def restore(directory: str, template: Optional[TrainState] = None,
         if not match:
             raise ValueError(f"no checkpoint for step {step} in {directory}; "
                              f"have {[s for s, _ in snaps]}")
-        path = match[0]
-    else:
-        path = snaps[-1][1]
+        problems = ckpt_manifest.verify(match[0])
+        if problems:
+            raise ValueError(
+                f"checkpoint {match[0].name} fails verification: "
+                f"{'; '.join(problems)} — run tools/ckpt_fsck.py, or drop "
+                "step= to fall back to the newest verified snapshot")
+        return _load_snapshot(match[0], template)
+    # a manifest-less dir NEWER than the newest committed generation is
+    # torn-writer debris (quarantine it); one OLDER — or in a directory
+    # with no committed generation at all — is indistinguishable from a
+    # snapshot written by a pre-durability build, and quarantining those
+    # would silently restart a long run from scratch on upgrade.  Skip
+    # them untouched; if nothing else restores, refuse loudly below and
+    # let the operator adjudicate (ckpt_fsck --adopt trusts legacy dirs;
+    # deleting the directory accepts the fresh start).
+    committed = [s for s, p in snaps
+                 if (p / ckpt_manifest.MANIFEST).exists()]
+    newest_committed = max(committed) if committed else None
+    maybe_legacy: List[str] = []
+    for s, path in reversed(snaps):
+        problems = ckpt_manifest.verify(path)
+        if not problems:
+            if maybe_legacy:
+                log(f"checkpoint: left {len(maybe_legacy)} manifest-less "
+                    f"snapshot(s) untouched ({', '.join(maybe_legacy)}) — "
+                    "pre-durability build? tools/ckpt_fsck.py --adopt "
+                    "makes them restorable")
+            return _load_snapshot(path, template)
+        if (not (path / ckpt_manifest.MANIFEST).exists()
+                and (path / "meta.json").exists()
+                and (newest_committed is None or s < newest_committed)):
+            maybe_legacy.append(path.name)
+            continue
+        _quarantine(path, s, problems)
+    if maybe_legacy:
+        raise RuntimeError(
+            f"{directory} holds {len(maybe_legacy)} snapshot(s) with "
+            "meta.json but no manifest and nothing newer verifies — a "
+            "pre-durability build wrote them, or the only checkpoint ever "
+            "written tore: refusing to quarantine them and silently "
+            "restart from step 0.  Run `tools/ckpt_fsck.py --adopt` to "
+            "trust them, or remove the directory to start fresh")
+    log(f"checkpoint: no verified snapshot left in {directory}")
+    return None
+
+
+def _load_snapshot(path: Path, template: Optional[TrainState]
+                   ) -> TrainState:
     meta = json.loads((path / "meta.json").read_text())
     if meta.get("format") == "orbax":
         import orbax.checkpoint as ocp
@@ -252,4 +452,11 @@ def _restore_npz(path: Path, template: Optional[TrainState]
                 raise ValueError(
                     f"checkpoint leaf {i} shape {tuple(saved.shape)} != "
                     f"expected {w_shape} — wrong model config?")
+            w_dtype = np.dtype(getattr(want, "dtype",
+                                       np.asarray(want).dtype))
+            if np.dtype(saved.dtype) != w_dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i} dtype {np.dtype(saved.dtype)} != "
+                    f"expected {w_dtype} — wrong precision/optimizer "
+                    "config?")
     return jax.tree_util.tree_unflatten(treedef, leaves)

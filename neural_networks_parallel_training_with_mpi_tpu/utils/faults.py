@@ -19,6 +19,23 @@ kinds
     ``sigterm``  send SIGTERM to this process — a preemption stand-in the
                  graceful-shutdown path must absorb (exit 0 + checkpoint).
 
+I/O faults against the checkpoint durability layer (DESIGN.md §8 — the
+first two need the trainer's ``checkpoint_dir``, threaded through
+``apply``):
+
+    ``torn_ckpt``    arm the checkpoint writer so its NEXT snapshot write
+                     publishes the payload but dies (SIGKILL) before the
+                     manifest commit marker — the torn-write state restore
+                     must treat as uncommitted and fall back past.
+    ``corrupt_ckpt`` flip bytes in the middle of the newest committed
+                     snapshot's largest payload file (bit rot / partial
+                     overwrite stand-in) — restore must quarantine the
+                     generation and fall back.
+    ``ckpt_ioerr``   arm the checkpoint writer to raise OSError on its
+                     next write (full disk / lost mount stand-in) — the
+                     async error channel must surface it on the caller's
+                     thread, with older snapshots intact.
+
 options
     ``max=N``     fire at most N times over this process's lifetime
                   (in-memory counter) — lets a NaN window be *passable*
@@ -44,7 +61,8 @@ from pathlib import Path
 from typing import Dict, List, Optional
 
 ENV_VAR = "NNPT_FAULTS"
-KINDS = ("nan", "crash", "sigterm")
+KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
+         "ckpt_ioerr")
 
 
 @dataclasses.dataclass
@@ -102,6 +120,43 @@ def _parse_one(item: str) -> _Fault:
     return _Fault(kind, start, end, max_fires, once_marker)
 
 
+def _corrupt_newest(ckpt_dir: Optional[str], step: int) -> None:
+    """``corrupt_ckpt``: XOR 8 bytes in the middle of the newest committed
+    snapshot's largest payload file — deterministic bit rot the manifest
+    checksums must catch at the next restore."""
+    import jax
+
+    from . import checkpoint as ckpt_lib
+    from . import ckpt_manifest
+
+    if jax.process_index() != 0:
+        # leader-only: on a shared filesystem an even process count would
+        # XOR the same bytes twice and self-cancel the injected rot
+        return
+    if not ckpt_dir:
+        print(f"[faults] corrupt_ckpt at step {step}: no checkpoint_dir "
+              "configured, nothing to corrupt", file=sys.stderr, flush=True)
+        return
+    snaps = ckpt_lib._snapshot_dirs(Path(ckpt_dir), committed=True)
+    if not snaps:
+        print(f"[faults] corrupt_ckpt at step {step}: no committed "
+              "snapshot yet, nothing to corrupt", file=sys.stderr,
+              flush=True)
+        return
+    _, snap = snaps[-1]
+    victim = max(ckpt_manifest.payload_files(snap),
+                 key=lambda p: p.stat().st_size)
+    size = victim.stat().st_size
+    with open(victim, "r+b") as f:
+        f.seek(size // 2)
+        chunk = f.read(8)
+        f.seek(size // 2)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    print(f"[faults] injected corruption at step {step}: flipped "
+          f"{len(chunk)} bytes in {snap.name}/{victim.name}",
+          file=sys.stderr, flush=True)
+
+
 class FaultPlan:
     """Parsed fault schedule; the Trainer calls :meth:`apply` once per
     dispatch with the global step about to run and the (device-placed)
@@ -124,11 +179,22 @@ class FaultPlan:
         channel a supervisor-launched child inherits)."""
         return FaultPlan.parse(cfg_spec or os.environ.get(ENV_VAR, ""))
 
-    def apply(self, step: int, batch: Dict) -> Dict:
+    def apply(self, step: int, batch: Dict,
+              ckpt_dir: Optional[str] = None) -> Dict:
         for f in self.faults:
             if not f.should_fire(step):
                 continue
             f.mark_fired()
+            if f.kind in ("torn_ckpt", "ckpt_ioerr"):
+                from . import checkpoint as ckpt_lib
+
+                print(f"[faults] armed {f.kind} for the next checkpoint "
+                      f"write (step {step})", file=sys.stderr, flush=True)
+                ckpt_lib.inject_io_fault(f.kind)
+                continue
+            if f.kind == "corrupt_ckpt":
+                _corrupt_newest(ckpt_dir, step)
+                continue
             if f.kind == "crash":
                 print(f"[faults] injected crash at step {step}",
                       file=sys.stderr, flush=True)

@@ -66,6 +66,21 @@ def test_template_mismatch_fails_loudly(tmp_path):
         ckpt.restore(str(tmp_path), other)
 
 
+def test_template_dtype_mismatch_fails_loudly(tmp_path):
+    """Same-shape, different-dtype template (e.g. a float64 re-init
+    against a float32 snapshot) fails loudly — the module docstring has
+    always promised shape AND dtype validation."""
+    state = make_state()
+    ckpt.save(str(tmp_path), state)
+    widened = jax.tree_util.tree_map(
+        lambda x: (np.asarray(x, np.float64)
+                   if np.issubdtype(np.asarray(x).dtype, np.floating)
+                   else np.asarray(x)),
+        jax.device_get(state))
+    with pytest.raises(ValueError, match="dtype"):
+        ckpt.restore(str(tmp_path), widened)
+
+
 def test_trainer_resume_continues_exactly(tmp_path):
     """Train 4 epochs straight vs 2 epochs + checkpoint + resume 2 more:
     identical final weights (determinism = per-(seed,epoch) shuffle order)."""

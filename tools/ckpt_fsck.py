@@ -1,0 +1,206 @@
+"""Checkpoint fsck: verify, repair, and triage a checkpoint directory.
+
+Walks every generation under a ``--checkpoint_dir`` and runs the same
+manifest verification ``restore()`` uses (per-file sha256 + size against
+``manifest.json`` — utils.ckpt_manifest, DESIGN.md §8), then reports what a
+resume would actually do::
+
+    python tools/ckpt_fsck.py CKPT_DIR                 # audit, exit 0/1
+    python tools/ckpt_fsck.py CKPT_DIR --quarantine    # rename corrupt dirs,
+                                                       # sweep stale tmp dirs
+    python tools/ckpt_fsck.py CKPT_DIR --adopt         # write manifests for
+                                                       # trusted pre-manifest
+                                                       # (legacy) snapshots
+    python tools/ckpt_fsck.py CKPT_DIR --json          # machine-readable
+    python tools/ckpt_fsck.py CKPT_DIR --telemetry-dir RUN_DIR
+                                                       # postmortem pointer
+
+Exit codes: 0 = a verified restore target exists, 1 = none does,
+2 = usage/IO error.
+
+Zero dependencies beyond the stdlib — usable on a host with no JAX
+(``utils/ckpt_manifest.py`` is loaded by file path, sidestepping the
+jax-importing package ``__init__``), e.g. to triage a checkpoint dir
+copied off a pod before deciding whether a job is worth relaunching.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import time
+
+_MANIFEST_PY = (pathlib.Path(__file__).resolve().parent.parent
+                / "neural_networks_parallel_training_with_mpi_tpu"
+                / "utils" / "ckpt_manifest.py")
+
+
+def _load_manifest_mod():
+    spec = importlib.util.spec_from_file_location("_ckpt_manifest",
+                                                  _MANIFEST_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+cm = _load_manifest_mod()
+
+CKPT_PREFIX = cm.CKPT_PREFIX
+TMP_PREFIX = ".tmp-" + CKPT_PREFIX
+
+
+def scan(d: pathlib.Path):
+    """{'snapshots': [(step, path)], 'tmp': [path], 'quarantined': [path]}
+    — everything checkpoint-shaped under the directory, sorted."""
+    snaps, tmp, quarantined = [], [], []
+    for p in sorted(d.iterdir()):
+        if not p.is_dir():
+            continue
+        if p.name.startswith(TMP_PREFIX):
+            tmp.append(p)
+        elif p.name.startswith(cm.QUARANTINE_PREFIX):
+            quarantined.append(p)
+        elif p.name.startswith(CKPT_PREFIX):
+            try:
+                snaps.append((int(p.name[len(CKPT_PREFIX):]), p))
+            except ValueError:
+                continue
+    return {"snapshots": sorted(snaps), "tmp": tmp,
+            "quarantined": quarantined}
+
+
+def _snapshot_meta(path: pathlib.Path):
+    try:
+        return json.loads((path / "meta.json").read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def fsck(d: pathlib.Path, quarantine: bool = False, adopt: bool = False):
+    """Verify every generation; return the report dict.  ``adopt`` builds
+    a manifest for manifest-less dirs the operator declares trusted (e.g.
+    snapshots written before the durability protocol existed) — the
+    checksums then pin today's bytes, so later rot IS caught.  ``adopt``
+    runs before verification; ``quarantine`` acts on whatever still
+    fails it."""
+    report = {"dir": str(d), "generations": [], "stale_tmp": [],
+              "quarantined_earlier": [], "restore_target": None,
+              "actions": []}
+    state = scan(d)
+    for p in state["tmp"]:
+        report["stale_tmp"].append(p.name)
+        if quarantine:
+            import shutil
+
+            shutil.rmtree(p, ignore_errors=True)
+            report["actions"].append(f"removed stale tmp {p.name}")
+    report["quarantined_earlier"] = [p.name for p in state["quarantined"]]
+    for step, p in state["snapshots"]:
+        if adopt and not (p / cm.MANIFEST).exists():
+            meta = _snapshot_meta(p)
+            if meta:
+                cm.commit(p, {"step": meta.get("step", step),
+                              "format": meta.get("format", "npz")})
+                report["actions"].append(f"adopted {p.name} (manifest "
+                                         "built from current bytes)")
+            else:
+                report["actions"].append(
+                    f"cannot adopt {p.name}: no readable meta.json")
+        problems = cm.verify(p)
+        gen = {"name": p.name, "step": step,
+               "status": "ok" if not problems else "corrupt",
+               "problems": problems,
+               # legacy-shaped: pre-durability snapshot (meta.json but no
+               # manifest) — restore refuses rather than quarantines these
+               "legacy": (not (p / cm.MANIFEST).exists()
+                          and (p / "meta.json").exists())}
+        if not problems:
+            man = cm.read(p) or {}
+            gen["format"] = man.get("format")
+            gen["files"] = len(man.get("files", {}))
+            report["restore_target"] = {"name": p.name, "step": step}
+        elif quarantine:
+            q = cm.quarantine(p)
+            gen["quarantined_as"] = q.name
+            report["actions"].append(f"quarantined {p.name} -> {q.name}")
+        report["generations"].append(gen)
+    return report
+
+
+def render(report, telemetry_dir=None) -> str:
+    lines = [f"checkpoint dir: {report['dir']}"]
+    for g in report["generations"]:
+        if g["status"] == "ok":
+            lines.append(f"  {g['name']:<16} ok       "
+                         f"({g.get('format')}, {g.get('files')} files)")
+        else:
+            head = g["problems"][0] if g["problems"] else "?"
+            lines.append(f"  {g['name']:<16} CORRUPT  {head}"
+                         + (f" (+{len(g['problems']) - 1} more)"
+                            if len(g["problems"]) > 1 else "")
+                         + (f" -> {g['quarantined_as']}"
+                            if "quarantined_as" in g else ""))
+    for name in report["stale_tmp"]:
+        lines.append(f"  {name:<16} stale tmp (uncommitted write)")
+    for name in report["quarantined_earlier"]:
+        lines.append(f"  {name:<16} quarantined earlier")
+    for act in report["actions"]:
+        lines.append(f"  action: {act}")
+    if report["restore_target"]:
+        t = report["restore_target"]
+        lines.append(f"restore target: {t['name']} (step {t['step']})")
+    else:
+        legacy = any(g.get("legacy") for g in report["generations"])
+        lines.append("restore target: NONE — no generation verifies; "
+                     + ("a resume will REFUSE to start (pre-manifest "
+                        "snapshots present — --adopt trusts them)"
+                        if legacy else "a resume restarts from scratch"))
+    if telemetry_dir:
+        pm = os.path.join(telemetry_dir, "postmortem.json")
+        if os.path.exists(pm):
+            try:
+                doc = json.load(open(pm))
+                age = time.time() - os.stat(pm).st_mtime
+                lines.append(f"postmortem: {pm} ({doc.get('reason')!r}, "
+                             f"{age / 60:.0f} min old) — "
+                             "tools/metrics_summary.py renders it")
+            except (OSError, ValueError):
+                lines.append(f"postmortem: {pm} (unreadable)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="a --checkpoint_dir (holds ckpt-<step>/)")
+    ap.add_argument("--quarantine", action="store_true",
+                    help="rename corrupt/uncommitted generations to "
+                         "corrupt-ckpt-<step> and remove stale tmp dirs "
+                         "(the same action restore takes lazily)")
+    ap.add_argument("--adopt", action="store_true",
+                    help="build manifests for TRUSTED manifest-less "
+                         "(pre-durability) snapshots so restore accepts "
+                         "them; checksums pin the current bytes")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON object")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="the run's --telemetry_dir: point at its "
+                         "postmortem.json when a restore had to fall back")
+    args = ap.parse_args(argv)
+    d = pathlib.Path(args.dir)
+    if not d.is_dir():
+        print(f"ERROR: {d} is not a directory", file=sys.stderr)
+        return 2
+    report = fsck(d, quarantine=args.quarantine, adopt=args.adopt)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report, telemetry_dir=args.telemetry_dir))
+    return 0 if report["restore_target"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

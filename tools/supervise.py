@@ -60,6 +60,11 @@ def main(argv=None) -> int:
     p.add_argument("--heartbeat", default=None,
                    help="explicit heartbeat file (overrides the "
                         "--telemetry-dir derived path)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="the child's --checkpoint_dir: before each "
+                        "relaunch, log the newest VERIFIED snapshot "
+                        "(manifest checksums, utils.ckpt_manifest) the "
+                        "child's --resume will land on")
     p.add_argument("cmd", nargs=argparse.REMAINDER,
                    help="the command to run (prefix with -- to stop flag "
                         "parsing)")
@@ -82,7 +87,8 @@ def main(argv=None) -> int:
                      backoff=args.backoff, backoff_cap=args.backoff_cap,
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=args.heartbeat_timeout,
-                     postmortem_path=postmortem)
+                     postmortem_path=postmortem,
+                     ckpt_dir=args.checkpoint_dir)
 
 
 if __name__ == "__main__":
