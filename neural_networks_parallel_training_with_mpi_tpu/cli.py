@@ -217,7 +217,8 @@ def main(argv=None) -> int:
         return rc
     if getattr(args, "generate", None) is not None:
         return _generate(args)
-    from .train.resilience import EXIT_ANOMALY, AnomalyAbort
+    from .train.resilience import (EXIT_ANOMALY, EXIT_SDC, AnomalyAbort,
+                                   SDCAbort)
     from .train.trainer import Trainer  # import after the platform pin
 
     cfg = config_from_args(args)
@@ -229,6 +230,13 @@ def main(argv=None) -> int:
         # (no final save) and the supervisor must NOT relaunch
         log(f"ERROR: anomaly abort: {e} (exit {EXIT_ANOMALY})")
         return EXIT_ANOMALY
+    except SDCAbort as e:
+        # silent data corruption the run must not survive: a replay-
+        # reproducible (software) divergence, or a device past its strike
+        # budget — no final save (it would snapshot corrupt state), and
+        # the supervisor must NOT relaunch (it would replay the bug)
+        log(f"ERROR: SDC abort: {e} (exit {EXIT_SDC})")
+        return EXIT_SDC
     log(f"done: final loss {result['final_loss']:.6f}, "
         f"{result['samples_per_sec']:.1f} samples/sec")
     val = {k: v for k, v in result.items() if k.startswith("val_")}

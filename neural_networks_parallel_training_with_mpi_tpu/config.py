@@ -272,8 +272,31 @@ class TrainConfig:
     eval_every: int = 0
     # verify replicated state stays bit-identical across device shards
     # every N steps (0 = off) — the SPMD analogue of a race detector
-    # (utils.consistency; SURVEY.md §5.2: the reference has none)
+    # (utils.consistency; SURVEY.md §5.2: the reference has none).
+    # Since the SDC layer (DESIGN.md §9) this routes through the same
+    # O(1) on-device fingerprint as sdc_check_every, fetched at the lag-2
+    # discipline (it no longer drains the async pipeline), but stays
+    # DETECT-ONLY: a divergence localizes, triages and then raises
+    # instead of healing.
     check_replicas_every: int = 0
+    # ---- silent-data-corruption defense (utils.consistency, DESIGN.md
+    # §9; all defaults = off) ----
+    # fingerprint the replicated train state every N steps (0 = off): a
+    # jitted per-device (uint32 digest, float fold) pair — O(1) host
+    # traffic per check, fetched at the monitor's lag-2 discipline.  On
+    # mismatch: localize the diverged leaves/shards (majority vote),
+    # replay the last step from a consistency-restored state to triage
+    # deterministic-bug vs transient-fault, then heal or abort (exit 45)
+    sdc_check_every: int = 0
+    # heal transient divergence in place (restore replication from the
+    # majority shard; cross-host divergence rolls back to the newest
+    # verified checkpoint instead) and keep training.  False = detect,
+    # localize, triage, then raise — the pre-SDC assert contract
+    sdc_heal: bool = True
+    # abort with exit 45 once any single device has caused this many
+    # transient (healed) divergences — repeated strikes mean failing
+    # hardware, not weather
+    sdc_strikes: int = 3
     # fail fast if no step completes within this many seconds (0 = off);
     # the reference hangs forever on a lost rank (utils.watchdog, §5.3)
     hang_timeout: float = 0.0
@@ -540,8 +563,25 @@ def build_argparser() -> argparse.ArgumentParser:
                         "events dumped to postmortem.json on abnormal "
                         "exit (0 = off)")
     p.add_argument("--check_replicas_every", type=int, default=0,
-                   help="assert replicated state is bit-identical across "
-                        "device shards every N steps (0 = off)")
+                   help="verify replicated state is bit-identical across "
+                        "device shards every N steps (0 = off); detect-"
+                        "only — on divergence the run localizes, triages "
+                        "and raises (use --sdc_check_every to heal)")
+    p.add_argument("--sdc_check_every", type=int, default=0,
+                   help="silent-data-corruption defense: fingerprint the "
+                        "replicated state every N steps (O(1) on-device "
+                        "check, lag-2 fetch); on mismatch localize the "
+                        "diverged leaf/shard, replay-triage deterministic "
+                        "vs transient, and heal (or abort, exit 45)")
+    _add_bool_flag(p, "sdc-heal", True,
+                   "heal transient divergence from the majority shard "
+                   "(cross-host: roll back to the newest verified "
+                   "checkpoint) and keep training; --no-sdc-heal = "
+                   "detect + triage, then raise")
+    p.add_argument("--sdc_strikes", type=int, default=3,
+                   help="abort with exit 45 after this many transient "
+                        "(healed) divergences localized to the same "
+                        "device — failing hardware, not weather")
     p.add_argument("--hang_timeout", type=float, default=0.0,
                    help="abort with thread stacks if no step completes "
                         "within this many seconds (0 = off)")
@@ -573,9 +613,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="run under the crash-restart supervisor: relaunch "
                         "this same command on crash/hang (exit 42/43/any "
                         "crash) up to N times with exponential backoff; "
-                        "exit 0 and exit 44 (anomaly abort) stop.  With "
-                        "--checkpoint_dir each relaunch resumes from the "
-                        "newest snapshot (--resume is appended)")
+                        "exit 0, exit 44 (anomaly abort) and exit 45 (SDC "
+                        "abort) stop.  With --checkpoint_dir each relaunch "
+                        "resumes from the newest snapshot (--resume is "
+                        "appended)")
     p.add_argument("--supervise_backoff", type=float, default=1.0,
                    help="initial supervisor backoff in seconds (doubles "
                         "per restart, capped at 60s)")
@@ -634,6 +675,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         flight_recorder=args.flight_recorder,
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
+        sdc_check_every=args.sdc_check_every,
+        sdc_heal=args.sdc_heal,
+        sdc_strikes=args.sdc_strikes,
         hang_timeout=args.hang_timeout,
         skip_nonfinite=args.skip_nonfinite or args.skip_threshold > 0,
         skip_threshold=args.skip_threshold,

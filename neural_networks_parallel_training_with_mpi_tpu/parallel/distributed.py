@@ -69,24 +69,59 @@ def allgather_host_array(x: Any) -> Any:
     return multihost_utils.process_allgather(x)
 
 
+def cross_host_report(x: Any, atol: float = 0.0) -> dict:
+    """The cross-host divergence SWEEP (one allgather of the pytree, then
+    pure host math): compare every process's value against process 0 and
+    report — not just assert — which processes diverge, per leaf.
+
+    Returns ``{leaf_name: {"processes": [...], "max_abs_diff": float}}``;
+    empty == all hosts agree.  The result is computed from the *gathered*
+    data, so it is identical on every process — the symmetry the
+    trainer's SDC incident path relies on (every host takes the same
+    branch after the sweep).  NaN on one side counts as maximal
+    divergence (inf); positions where ALL processes hold NaN are
+    lockstep.  Single-process worlds report healthy without
+    communicating."""
+    if not is_multi_host():
+        return {}
+    gathered = allgather_host_array(x)
+    report: dict = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(gathered)
+    for path, leaf in flat:
+        leaf = np.asarray(leaf)
+        ref = leaf[0]
+        bad: list = []
+        worst = 0.0
+        for i in range(1, leaf.shape[0]):
+            a = np.asarray(leaf[i], np.float64)
+            r = np.asarray(ref, np.float64)
+            diff = np.where(np.isnan(a) & np.isnan(r), 0.0, np.abs(a - r))
+            m = float(np.max(diff, initial=0.0))
+            if np.isnan(m):
+                m = float("inf")
+            if m > atol:
+                bad.append(i)
+                worst = max(worst, m)
+        if bad:
+            report[jax.tree_util.keystr(path) or "value"] = {
+                "processes": bad, "max_abs_diff": worst}
+    return report
+
+
 def assert_same_across_hosts(x: Any, name: str = "value",
                              atol: float = 0.0) -> None:
     """Debug check that a host value is bitwise (or atol-close) identical on
     every process — the property the reference only asserts in comments
-    (replica lockstep, :206-211)."""
-    if not is_multi_host():
-        return
-    gathered = allgather_host_array(x)
-
-    def check(leaf):
-        ref = leaf[0]
-        for i in range(1, leaf.shape[0]):
-            if not np.allclose(leaf[i], ref, atol=atol, rtol=0):
-                raise AssertionError(
-                    f"{name}: process {i} diverges from process 0 "
-                    f"(max abs diff {np.abs(leaf[i] - ref).max()})")
-
-    jax.tree_util.tree_map(check, gathered)
+    (replica lockstep, :206-211).  The reporting form (which the SDC
+    localization consumes) is :func:`cross_host_report`; this wrapper
+    keeps the assert contract."""
+    report = cross_host_report(x, atol=atol)
+    if report:
+        leaf, info = next(iter(report.items()))
+        raise AssertionError(
+            f"{name}: process(es) {info['processes']} diverge from "
+            f"process 0 at {leaf} (max abs diff {info['max_abs_diff']}; "
+            f"{len(report)} leaves total)")
 
 
 def local_device_count() -> int:

@@ -20,6 +20,15 @@ lost peer into a loud exit; this module defends the *state itself* and the
   exponential backoff and bounded restarts, interpreting the exit-code
   contract below to decide retry-vs-stop.
 
+* :class:`SDCPolicy` is the silent-data-corruption strike ledger
+  (DESIGN.md §9): the trainer's fingerprint monitor charges each
+  transient, healed divergence to the device (or peer host) it was
+  localized to; a device exceeding the strike budget — or a divergence
+  the replay triage proves DETERMINISTIC — raises :class:`SDCAbort`
+  (exit code :data:`EXIT_SDC`, no retry: a relaunch would replay a
+  software bug, and a chip past its strike budget needs draining, not
+  another restart).
+
 Exit-code contract (also consumed by ``tools/supervise.py``):
 
 ===========  ============================================  =========
@@ -29,6 +38,8 @@ code         meaning                                       supervisor
 42           watchdog: no step progress (hang)             retry
 43           peer loss: a collective raised                retry
 44           anomaly abort: rollback budget exhausted      stop
+45           SDC abort: deterministic replica divergence   stop
+             or per-device strike budget exhausted
 other        crash (segfault, OOM, fault injection, ...)   retry
 ===========  ============================================  =========
 """
@@ -46,14 +57,46 @@ EXIT_OK = 0
 EXIT_HANG = 42      # utils.watchdog.HangWatchdog
 EXIT_PEER = 43      # a collective raised (see tests/faulty_child.py)
 EXIT_ANOMALY = 44   # ResilienceMonitor exhausted its rollback budget
+EXIT_SDC = 45       # deterministic replica divergence / SDC strike budget
 
-# exit codes the supervisor must NOT retry: 0 is success; 44 is a
-# deterministic training failure that a relaunch would only replay
-_NO_RETRY = (EXIT_OK, EXIT_ANOMALY)
+# exit codes the supervisor must NOT retry: 0 is success; 44 and 45 are
+# deterministic training failures that a relaunch would only replay
+_NO_RETRY = (EXIT_OK, EXIT_ANOMALY, EXIT_SDC)
 
 
 class AnomalyAbort(RuntimeError):
     """Training diverged past the rollback budget; maps to exit 44."""
+
+
+class SDCAbort(RuntimeError):
+    """Silent data corruption the run must not survive: the replay triage
+    proved the divergence deterministic (a software bug a relaunch would
+    replay), or one device blew its transient-strike budget (hardware
+    that needs draining).  Maps to exit 45 — the supervisor does not
+    retry."""
+
+
+class SDCPolicy:
+    """Per-device strike ledger for TRANSIENT (replay-clean, healed)
+    divergences.  ``record(devices)`` charges one strike to each named
+    device and returns the devices now over budget (empty == keep going).
+    One flaky step is weather; the same chip diverging ``strikes`` times
+    is a failing part."""
+
+    def __init__(self, strikes: int = 3):
+        if strikes < 1:
+            raise ValueError(f"sdc strike budget must be >= 1, got "
+                             f"{strikes}")
+        self.strikes = strikes
+        self.counts: dict = {}
+        self.incidents = 0   # fingerprint mismatches observed
+        self.healed = 0      # transient incidents healed in-process
+
+    def record(self, devices: Sequence[str]) -> List[str]:
+        self.incidents += 1
+        for d in devices:
+            self.counts[d] = self.counts.get(d, 0) + 1
+        return [d for d in devices if self.counts[d] >= self.strikes]
 
 
 class ResilienceMonitor:
@@ -361,6 +404,10 @@ def supervise(cmd: Sequence[str], max_restarts: int,
             if rc == EXIT_ANOMALY:
                 log("[supervise] child exited 44 (anomaly abort): "
                     "deterministic training failure — not retrying")
+            elif rc == EXIT_SDC:
+                log("[supervise] child exited 45 (SDC abort): "
+                    "deterministic replica divergence or device strike "
+                    "budget exhausted — not retrying")
             else:
                 log("[supervise] child completed (exit 0)")
             return rc

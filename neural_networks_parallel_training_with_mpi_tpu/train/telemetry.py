@@ -502,6 +502,29 @@ class Telemetry:
             pass
         self.recorder.dump(reason)
 
+    def on_sdc(self, record: Dict[str, Any]) -> None:
+        """A silent-data-corruption incident (train/trainer.py's
+        fingerprint monitor): write the full record into the telemetry
+        stream (``kind: "sdc"`` in metrics.jsonl — tools/sdc_report.py
+        renders these), log a flight-recorder event, and dump a
+        postmortem — an SDC is exactly the event class the black box
+        exists for, whether or not the run survives it."""
+        if not self.enabled:
+            return
+        rec = {"kind": "sdc",
+               "t": round(time.perf_counter() - self._t0, 6), **record}
+        self.recorder.event(
+            "sdc", int(record.get("step", -1)),
+            verdict=record.get("verdict"), action=record.get("action"),
+            leaves=record.get("leaves"), devices=record.get("devices"))
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        self.recorder.dump("sdc")
+        # straddle: re-dump after the next step record so the postmortem
+        # tail shows whether the run kept training past the incident
+        self.recorder.arm_dump("sdc")
+
     def on_preempted(self, signum: int, step: int) -> None:
         if not self.enabled:
             return

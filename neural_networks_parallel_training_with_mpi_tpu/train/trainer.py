@@ -422,6 +422,41 @@ class Trainer:
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
+        # fault schedule parsed once (utils.faults; fit reuses it so
+        # max=/once= counters survive across epochs).  The deterministic
+        # desync (desync@N?det) is consumed HERE, at step-build time: it
+        # wraps the jitted step so one replica drifts inside the program
+        # itself — the software-bug stand-in the SDC replay triage must
+        # prove deterministic (DESIGN.md §9)
+        from ..utils.faults import FaultPlan
+
+        self.fault_plan = FaultPlan.from_config(cfg.faults)
+        det = self.fault_plan.det_desync() if self.fault_plan else None
+        if det is not None:
+            if (self.pipeline or self.expert or self.sp_tp or self.ep_tp
+                    or self.gspmd or self.zero1):
+                raise NotImplementedError(
+                    "desync?det perturbs the fully-replicated train state "
+                    "inside the step; it is wired on the plain DP and "
+                    "DP x seq layouts")
+            from ..utils.faults import wrap_step_with_desync
+
+            self.train_step = wrap_step_with_desync(
+                self.train_step, self.mesh, det.start, det.eps)
+        # silent-data-corruption defense (utils.consistency, DESIGN.md
+        # §9): --sdc_check_every fingerprints the replicated state at
+        # this cadence and heals transient divergence; the legacy
+        # --check_replicas_every rides the same fingerprint path (same
+        # lag-2 fetch discipline — the old host-side full-state fetch
+        # stalled the async pipeline exactly the way DESIGN §7 warns
+        # against) but stays detect-only: no healing, a divergence
+        # localizes, triages and raises.
+        self.sdc_every = (int(cfg.sdc_check_every)
+                          or int(cfg.check_replicas_every))
+        self.sdc_heal = bool(cfg.sdc_heal) and int(cfg.sdc_check_every) > 0
+        self._fp = None           # consistency.Fingerprinter, built in fit
+        self._sdc_policy = None   # resilience.SDCPolicy
+        self._sdc_batch = None    # last dispatched batch, for replay triage
         # multi-step dispatch (--steps_per_dispatch k, VERDICT r4 item 6):
         # one jitted lax.scan runs k optimizer steps over a device-staged
         # batch stack, amortizing the per-step host dispatch that dominates
@@ -593,6 +628,176 @@ class Trainer:
         self.loader.order_salt += 1
         return int(jax.device_get(self.state.step))
 
+    # ---- silent-data-corruption defense (DESIGN.md §9) -------------------
+    def _sdc_observe(self, at_step: int, fp, watchdog,
+                     draining: bool = False) -> str:
+        """Consume one lag-2 fingerprint: fetch the tiny per-device digest
+        vector, form the GLOBAL verdict (in a multi-host world the digests
+        are allgathered, so every process computes the identical verdict
+        and takes the same branch — the incident path contains
+        collectives), and on mismatch run the incident pipeline.  Returns
+        ``"ok"``, ``"healed"`` or ``"rollback"``."""
+        from ..parallel import distributed
+        from ..utils import consistency
+
+        digests, folds = consistency.Fingerprinter.fetch(fp)
+        if distributed.is_multi_host():
+            mat = np.asarray(distributed.allgather_host_array(digests))
+        else:
+            mat = digests[None, :]
+        verdict = consistency.digest_report(mat)
+        if not verdict:
+            return "ok"
+        return self._sdc_incident(at_step, verdict, folds, watchdog,
+                                  draining)
+
+    def _sdc_incident(self, at_step: int, fp_verdict: dict, folds,
+                      watchdog, draining: bool) -> str:
+        """Fingerprint mismatch: localize → record → replay-triage → heal
+        or abort.  ``fp_verdict`` is identical on every process (computed
+        from gathered digests), so every branch that reaches a collective
+        is taken by all processes together; only the purely-local heal
+        (device_put of the majority shard) differs per host."""
+        from ..parallel import distributed
+        from ..utils import consistency
+        from .resilience import SDCAbort
+
+        cfg = self.cfg
+        log(f"[sdc] fingerprint mismatch detected for step {at_step} "
+            f"(checked at lag 2): localizing...")
+        with watchdog.suspended():
+            # ---- localize: which leaf, which shard, which device -------
+            report = consistency.divergence_report(self.state)
+            cross = {}
+            if fp_verdict.get("cross"):
+                # cross-host sweep: each host's shard-0 content digest
+                # per leaf, gathered and compared (collective; symmetric
+                # because fp_verdict is)
+                cross = distributed.cross_host_report(
+                    consistency.leaf_digests(self.state))
+            devices = sorted({d for r in report.values()
+                              for d in r["devices"]})
+            # ---- replay triage: deterministic bug vs transient fault ---
+            # re-execute the last dispatch from a consistency-restored
+            # state (majority-shard heal of the pre-replay snapshot) and
+            # fingerprint the result: a software bug (lying out_spec,
+            # miscompiled collective, desync?det) re-diverges every time;
+            # a cosmic ray does not.  The replay input is a COPY — the
+            # step donates its argument, and the healed state must
+            # survive to continue training.
+            healed, _ = consistency.heal_replication(self.state, report)
+            replay_verdict = "unknown"
+            if self._sdc_batch is not None and self._fp is not None:
+                import jax.numpy as jnp
+
+                replay_in = jax.tree_util.tree_map(jnp.copy, healed)
+                step_fn = (self.multi_step if self.k_dispatch > 1
+                           else self.train_step)
+                replay_out, _ = step_fn(replay_in, self._sdc_batch)
+                r_digests, _rf = consistency.Fingerprinter.fetch(
+                    self._fp.compute(replay_out))
+                if distributed.is_multi_host():
+                    r_mat = np.asarray(
+                        distributed.allgather_host_array(r_digests))
+                else:
+                    r_mat = r_digests[None, :]
+                replay_verdict = ("deterministic"
+                                  if consistency.digest_report(r_mat)
+                                  else "transient")
+            # ---- decide + record --------------------------------------
+            cross_procs = list(fp_verdict.get("cross", []))
+            strike_keys = devices + [f"process:{p}" for p in cross_procs]
+            record = {
+                "step": int(at_step),
+                "leaves": {k: {"shards": r["shards"],
+                               "devices": r["devices"],
+                               "max_abs_diff": float(r["max_abs_diff"]),
+                               "n_bad_elements": int(r["n_bad_elements"])}
+                           for k, r in report.items()},
+                "devices": devices,
+                "cross_host": {k: v["processes"] for k, v in cross.items()}
+                              if cross else {},
+                "float_folds": [float(f) for f in folds],
+                "verdict": replay_verdict,
+            }
+            if replay_verdict == "deterministic":
+                record["action"] = "abort_deterministic"
+                self.telemetry.on_sdc(record)
+                names = (sorted(report) or sorted(cross)
+                         or ["<unlocalized>"])
+                raise SDCAbort(
+                    f"replica divergence at step {at_step} REPRODUCED on "
+                    f"replay from a consistency-restored state — "
+                    f"deterministic software bug in the step function "
+                    f"(diverged leaves: {names[:5]}); a relaunch would "
+                    "replay it.  Suspects: a shard_map out_spec claiming "
+                    "replication the math does not guarantee (check_vma "
+                    "off), a nondeterministic kernel, or an injected "
+                    "desync?det")
+            exhausted = self._sdc_policy.record(strike_keys)
+            if exhausted:
+                record["action"] = "abort_strikes"
+                record["strikes"] = dict(self._sdc_policy.counts)
+                self.telemetry.on_sdc(record)
+                raise SDCAbort(
+                    f"transient replica divergence at step {at_step}, but "
+                    f"{exhausted} exceeded the strike budget "
+                    f"(--sdc_strikes {cfg.sdc_strikes}; counts "
+                    f"{self._sdc_policy.counts}) — repeatedly flaky "
+                    "hardware; drain the device instead of relaunching")
+            if not self.sdc_heal:
+                record["action"] = "detect_only"
+                self.telemetry.on_sdc(record)
+                worst = sorted(((k, r["max_abs_diff"])
+                                for k, r in report.items()),
+                               key=lambda kv: -kv[1])[:5]
+                raise AssertionError(
+                    f"replica divergence in train state @ step {at_step}: "
+                    f"{len(report)} replicated leaves differ across device "
+                    f"shards (worst: {worst}; cross-host: "
+                    f"{record['cross_host']}); replay says "
+                    f"{replay_verdict}.  Healing is off on this path — "
+                    "use --sdc_check_every/--sdc_heal to heal instead of "
+                    "dying")
+            if cross_procs or (cross and not report):
+                # hosts disagree while each host is internally consistent:
+                # a local majority vote cannot pick the truth — roll back
+                # to the newest VERIFIED checkpoint (identical bytes on
+                # every host, DESIGN.md §8 machinery)
+                record["action"] = "rollback"
+                self.telemetry.on_sdc(record)
+                if draining:
+                    # transient + recoverable, so NOT SDCAbort/45 (the
+                    # supervisor would refuse to relaunch a perfectly
+                    # retryable job): die as a plain crash — the relaunch
+                    # resumes from the newest verified checkpoint, which
+                    # is exactly the mid-run rollback action anyway
+                    raise RuntimeError(
+                        f"[sdc] cross-host divergence detected at step "
+                        f"{at_step} during the final drain — refusing to "
+                        "write a final snapshot from unreconcilable "
+                        "state; relaunch/resume from the newest verified "
+                        "checkpoint")
+                return "rollback"
+            # transient, local, under budget: HEAL — restore replication
+            # from the majority shard and keep training
+            record["action"] = "healed"
+            record["strikes"] = dict(self._sdc_policy.counts)
+            self.telemetry.on_sdc(record)
+            if report:
+                self.state = healed
+                self._sdc_policy.healed += 1
+                log(f"[sdc] transient divergence healed at step {at_step}: "
+                    f"{len(report)} leaf/leaves restored from the majority "
+                    f"shard (implicated: {devices}; strikes "
+                    f"{self._sdc_policy.counts})")
+            else:
+                # a PEER host healed its local divergence this round; this
+                # host had nothing to repair
+                log(f"[sdc] divergence at step {at_step} localized to a "
+                    "peer host's shards; no local repair needed")
+            return "healed"
+
     def _reconcile_qkv_tp(self, ckpt, restored: TrainState) -> TrainState:
         """The TP qkv column permutation is shape-preserving, so a
         checkpoint written under a different tensor-axis size is
@@ -710,10 +915,9 @@ class Trainer:
         # hang watchdog (SURVEY.md §5.3): with log_every on, the loop blocks
         # in device_get on the previous step's loss, so a stalled device
         # stalls the pats and the watchdog fires instead of hanging forever
-        from ..utils.faults import FaultPlan
         from ..utils.watchdog import HangWatchdog
         from .resilience import (AnomalyAbort, GracefulShutdown,
-                                 ResilienceMonitor)
+                                 ResilienceMonitor, SDCPolicy)
 
         # the watchdog's last act before exit 42 is a flight-recorder
         # dump: the postmortem then says what the run was doing when the
@@ -731,11 +935,66 @@ class Trainer:
                                      cfg.loss_spike_factor)
                    if cfg.rollback_after > 0 else None)
         monitor_q: list = []  # (step, loss future), observed at lag 2
-        fault_plan = FaultPlan.from_config(cfg.faults)
+        fault_plan = self.fault_plan
+        # SDC fingerprint monitor (DESIGN.md §9): one jitted O(1) digest
+        # per check, queued and fetched at the same lag-2 discipline as
+        # the loss monitor — routine checking never drains the pipeline
+        sdc_q: list = []  # (step, fingerprint futures), observed at lag 2
+        if self.sdc_every:
+            from ..parallel import distributed
+            from ..utils import consistency
+
+            fpr = consistency.Fingerprinter(self.state, self.mesh)
+            if fpr.n_leaves and (fpr.n_local_shards > 1
+                                 or distributed.is_multi_host()):
+                self._fp = fpr
+                self._sdc_policy = SDCPolicy(cfg.sdc_strikes)
+            else:
+                self._fp = None
+                log("[sdc] replica checking disabled: no replicated "
+                    "leaves with >= 2 device shards in this layout/mesh")
         # preemption-safe exit: SIGTERM/SIGINT set a flag checked at each
         # dispatch boundary -> final checkpoint -> exit 0 (<= 1 lost step)
         shutdown = GracefulShutdown()
         dispatches = None
+
+        def do_rollback(why: str) -> None:
+            """Shared rollback bookkeeping (anomaly monitor + SDC
+            cross-host heal): restore the newest verified snapshot,
+            re-draw the data order, dump/rearm the postmortem, and reset
+            BOTH lag queues — their futures belong to the abandoned
+            timeline.  The caller breaks out of the dispatch loop."""
+            nonlocal step, prev, rolled_back
+            with watchdog.suspended():
+                step = self._rollback()
+            log(f"{why} — restored step {step}, re-drew the data order")
+            # postmortem now + a straddling re-dump after the first
+            # post-rollback record
+            self.telemetry.on_rollback(step,
+                                       monitor.rollbacks if monitor else 0)
+            prev = None
+            monitor_q.clear()
+            sdc_q.clear()
+            rolled_back = True
+
+        def sdc_pump(keep: int, draining: bool = False) -> str:
+            """Observe queued SDC fingerprints down to ``keep`` entries.
+            ``keep=1`` is the routine lag-2 discipline (one dispatch
+            stays in flight); ``keep=0`` drains — used right before a
+            snapshot and at the end of the run, so state the fingerprint
+            has not yet cleared can never be captured to disk unobserved.
+            Returns "ok", "healed" (queue cleared: pre-heal fingerprints
+            are stale) or "rollback" (the caller rolls back)."""
+            while len(sdc_q) > keep:
+                act = self._sdc_observe(*sdc_q.pop(0), watchdog=watchdog,
+                                        draining=draining)
+                if act == "healed":
+                    sdc_q.clear()
+                    return "healed"
+                if act == "rollback":
+                    return "rollback"
+            return "ok"
+
         try:
             with profiler, watchdog, shutdown:
                 epoch = start_epoch
@@ -781,19 +1040,11 @@ class Trainer:
                                     f"rollback budget (max_rollbacks="
                                     f"{cfg.max_rollbacks}) is exhausted")
                             if action == "rollback":
-                                with watchdog.suspended():
-                                    step = self._rollback()
-                                log(f"anomaly rollback #{monitor.rollbacks}: "
-                                    f"{cfg.rollback_after} consecutive bad "
-                                    f"steps — restored step {step}, re-drew "
-                                    "the data order")
-                                # postmortem now + a straddling re-dump
-                                # after the first post-rollback record
-                                self.telemetry.on_rollback(
-                                    step, monitor.rollbacks)
-                                prev = None
-                                monitor_q.clear()
-                                rolled_back = True
+                                do_rollback(
+                                    f"anomaly rollback "
+                                    f"#{monitor.rollbacks}: "
+                                    f"{cfg.rollback_after} consecutive "
+                                    "bad steps")
                                 break
                         # log when the dispatch CROSSED a log_every boundary
                         # (== the modulo rule at n_steps=1; prev[3] is the
@@ -810,6 +1061,15 @@ class Trainer:
                             # I/O fault kinds need the checkpoint dir
                             batch = fault_plan.apply(
                                 step, batch, ckpt_dir=cfg.checkpoint_dir)
+                            # SDC kinds (bitflip/desync) corrupt one
+                            # replica shard of the device-placed state
+                            self.state = fault_plan.apply_state(step,
+                                                                self.state)
+                        if self._fp is not None:
+                            # retained for the replay triage (batches are
+                            # not donated; holding one dispatch's worth
+                            # of rows is the entire cost)
+                            self._sdc_batch = batch
                         if self.k_dispatch > 1:
                             self.state, outs = self.multi_step(self.state,
                                                                batch)
@@ -848,20 +1108,44 @@ class Trainer:
                         # a boundary landing within ~2 dispatches of the
                         # first bad step can still be captured; with the
                         # guard on, params are protected regardless.)
+                        if (self._fp is not None and
+                                step // self.sdc_every
+                                > before // self.sdc_every):
+                            # dispatch the fingerprint on the state the
+                            # step just produced (async — its buffers are
+                            # still valid here; the NEXT dispatch's
+                            # donation is sequenced after this read), and
+                            # observe at lag 2 like the loss monitor.
+                            # Runs BEFORE the snapshot block below, so a
+                            # corruption this boundary can surface is
+                            # handled before anything reaches disk.
+                            sdc_q.append((step, self._fp.compute(self.state)))
+                            act = sdc_pump(keep=1)
+                            if act == "rollback":
+                                # cross-host divergence: the local
+                                # majority is no reference — restore the
+                                # newest verified checkpoint (identical
+                                # on every host, DESIGN.md §8 machinery)
+                                do_rollback("[sdc] cross-host divergence")
+                                break
                         if (cfg.checkpoint_every and
                                 step // cfg.checkpoint_every
                                 > before // cfg.checkpoint_every and
                                 (monitor is None or monitor.consecutive == 0)):
+                            # a snapshot must never capture state the
+                            # fingerprint queue has not cleared yet: the
+                            # corrupt bytes would reach disk and rotate
+                            # the last good generation toward deletion —
+                            # the SDC analogue of the bad-streak skip
+                            # above.  Draining costs nothing extra here:
+                            # these futures are older than the state
+                            # device_get the save itself stalls on.
+                            if sdc_pump(keep=0) == "rollback":
+                                do_rollback("[sdc] cross-host divergence "
+                                            "at a snapshot boundary")
+                                break
                             with watchdog.suspended():
                                 self.save()
-                        if (cfg.check_replicas_every and
-                                step // cfg.check_replicas_every
-                                > before // cfg.check_replicas_every):
-                            from ..utils import consistency
-
-                            with watchdog.suspended():
-                                consistency.assert_replicated(
-                                    self.state, what=f"train state @ step {step}")
                     if rolled_back:
                         epoch = step // spe
                         mid_epoch_start = step % spe
@@ -890,6 +1174,11 @@ class Trainer:
                                             **{f"val_{k}": v
                                                for k, v in ev.items()}})
                     epoch += 1
+                # drain the SDC lag queue before the final save: every
+                # queued fingerprint is complete by now, and a divergence
+                # detected here must still heal (or abort) BEFORE the
+                # final snapshot can capture corrupt state
+                sdc_pump(keep=0, draining=True)
         finally:
             # deterministic prefetch-worker release: an exception escaping
             # this frame (AnomalyAbort, a re-raised async-write failure)
@@ -934,6 +1223,9 @@ class Trainer:
         if monitor is not None:
             result["rollbacks"] = monitor.rollbacks
             result["bad_steps"] = monitor.bad_steps
+        if self._sdc_policy is not None:
+            result["sdc_incidents"] = self._sdc_policy.incidents
+            result["sdc_healed"] = self._sdc_policy.healed
         if self.guarded:
             # GuardedState.skipped: cumulative rejected updates — read
             # once here, off the hot path

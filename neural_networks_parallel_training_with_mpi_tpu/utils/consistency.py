@@ -1,28 +1,65 @@
-"""Replica-consistency checking (the SPMD analogue of race detection).
+"""Silent-data-corruption defense (the SPMD analogue of ECC + race
+detection).
 
 The reference has no sanitizers (SURVEY.md §5.2); its correctness rests on
 an *implicit* invariant — every rank's model/optimizer state stays
 bit-identical because every rank applies the identical averaged gradient
-(dataParallelTraining_NN_MPI.py:206-211).  A lost message or a
-nondeterministic kernel would silently desynchronize replicas, and nothing
-in the reference would ever notice.
+(dataParallelTraining_NN_MPI.py:206-211).  A lost message, a
+nondeterministic kernel or a flaky chip would silently desynchronize
+replicas, and nothing in the reference would ever notice.
 
-Here the invariant is explicit and checkable: replicated arrays (sharding
-``P()``) must hold bit-identical values on every device shard.  Divergence
-can only come from a bug (e.g. a ``shard_map`` body whose out_spec claims
-replication the math doesn't guarantee, hidden by ``check_vma=False``) or
-from flaky hardware — both things a periodic check catches early.  The
-Trainer exposes it as ``--check_replicas_every N``.
+Here the invariant is explicit, checkable, and — new in this layer —
+*cheap to check and survivable when it breaks* (DESIGN.md §9).  Three
+tiers:
+
+1. **Fingerprint (fast path, O(1) host traffic)** — :class:`Fingerprinter`
+   builds one jitted ``shard_map`` program that folds every replicated
+   leaf into a per-device ``(uint32 digest, float32 fold)`` pair: the
+   digest is a bit-exact positional fold of the raw bit patterns (any
+   single flipped bit changes it, NaNs included), the float fold is an
+   advisory magnitude.  The output is a tiny ``(n_devices,)`` vector, so
+   the host fetches a few bytes per check instead of the whole state, and
+   the fetch rides the trainer's lag-2 discipline — the async pipeline
+   never drains.
+2. **Localization (slow path, on mismatch only)** —
+   :func:`divergence_report` fetches every shard once, groups shards by a
+   byte-exact hash, elects the *majority* group as the reference (so a
+   corrupt shard 0 cannot masquerade as truth), and names the diverged
+   leaves, shard indices, devices and magnitudes.
+   :func:`replica_divergence` / :func:`check_replicas` /
+   :func:`assert_replicated` remain the simple shard-0-referenced
+   debug API.
+3. **Heal** — :func:`heal_replication` rebuilds each diverged replicated
+   leaf from its majority shard, restoring bit-identical replication
+   without killing the run (the trainer's replay triage decides whether
+   healing is sound — ``train/trainer.py``; cross-host divergence heals
+   by checkpoint rollback instead).
+
+The Trainer exposes the fast path as ``--sdc_check_every N`` (and routes
+the legacy ``--check_replicas_every`` through it); ``utils/faults.py``'s
+``bitflip``/``desync`` kinds inject the corruption this module exists to
+catch.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 Pytree = Any
+
+
+def _to_host(shard_data) -> np.ndarray:
+    """The single host-copy point: every device->host fetch of a shard in
+    this module goes through here, exactly once per shard (tests
+    monkeypatch it to count copies)."""
+    return np.asarray(shard_data)
 
 
 def _leaf_paths(tree: Pytree):
@@ -31,34 +68,52 @@ def _leaf_paths(tree: Pytree):
         yield jax.tree_util.keystr(path), leaf
 
 
+def _is_replicated(leaf) -> bool:
+    sharding = getattr(leaf, "sharding", None)
+    return (sharding is not None
+            and getattr(sharding, "is_fully_replicated", False))
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: localization (host-side slow path)
+# ---------------------------------------------------------------------------
+
 def replica_divergence(tree: Pytree) -> Dict[str, float]:
     """Max |shard - shard0| per *replicated* leaf, over this process's
     addressable shards.  Non-replicated (genuinely sharded) leaves and
-    non-jax leaves are skipped.  An all-zero result is the healthy state."""
+    non-jax leaves are skipped.  An all-zero result is the healthy state.
+    A NaN-poisoned shard reports ``inf`` (a NaN is never "close"): the diff
+    is compared with explicit NaN handling, ignoring only positions where
+    BOTH shards hold NaN (bit-identically poisoned replicas are still in
+    lockstep)."""
     out: Dict[str, float] = {}
     for name, leaf in _leaf_paths(tree):
-        sharding = getattr(leaf, "sharding", None)
-        if sharding is None or not getattr(sharding, "is_fully_replicated", False):
+        if not _is_replicated(leaf):
             continue
         shards = leaf.addressable_shards
         if len(shards) < 2:
             continue
-        ref = np.asarray(shards[0].data)
+        # one host copy per shard (including the reference) — no re-fetch
+        # inside the comparison loop
+        datas = [_to_host(s.data) for s in shards]
+        ref = datas[0]
         worst = 0.0
-        for s in shards[1:]:
-            arr = np.asarray(s.data)
+        for arr in datas[1:]:
             if arr.dtype != ref.dtype or arr.shape != ref.shape:
                 worst = float("inf")
                 break
             # jnp.issubdtype, not np: ml_dtypes' bfloat16/float16 extension
             # dtypes are not np.floating subdtypes, and falling into the
             # exact-equality branch would report inf for a 1-ulp divergence
-            import jax.numpy as jnp
-
             if jnp.issubdtype(ref.dtype, jnp.floating):
-                worst = max(worst, float(
-                    np.max(np.abs(arr.astype(np.float64)
-                                  - ref.astype(np.float64)), initial=0.0)))
+                a = arr.astype(np.float64)
+                r = ref.astype(np.float64)
+                diff = np.abs(a - r)
+                # both-NaN positions are bit-for-purpose identical; a NaN
+                # on ONE side is maximal divergence, not "0.0 < atol"
+                diff = np.where(np.isnan(a) & np.isnan(r), 0.0, diff)
+                m = float(np.max(diff, initial=0.0))
+                worst = max(worst, float("inf") if np.isnan(m) else m)
             elif not np.array_equal(arr, ref):
                 worst = float("inf")
         out[name] = worst
@@ -86,3 +141,283 @@ def assert_replicated(tree: Pytree, atol: float = 0.0,
             f"differ across device shards (worst: {worst}); a shard_map "
             "out_spec probably claims replication the computation does not "
             "guarantee, or hardware is flaky")
+
+
+def divergence_report(tree: Pytree) -> Dict[str, Dict[str, Any]]:
+    """Localize divergence: for each diverged replicated leaf, elect the
+    *majority* shard group (byte-exact hash vote — a corrupt shard 0 must
+    not be mistaken for the reference) and name the minority.
+
+    Returns ``{leaf_name: {shards, devices, reference_shard,
+    max_abs_diff, n_bad_elements}}`` over this process's addressable
+    shards; empty == locally healthy.  Each shard is fetched exactly once
+    (this is the slow path, but there is no reason to make it slower)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, leaf in _leaf_paths(tree):
+        if not _is_replicated(leaf):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) < 2:
+            continue
+        datas = [_to_host(s.data) for s in shards]
+        groups: Dict[bytes, List[int]] = {}
+        for i, d in enumerate(datas):
+            groups.setdefault(hashlib.sha1(d.tobytes()).digest(),
+                              []).append(i)
+        if len(groups) == 1:
+            continue
+        # majority vote; ties break toward the group holding the lowest
+        # shard index (deterministic, and shard-0-compatible when 1v1)
+        majority = max(groups.values(), key=lambda g: (len(g), -min(g)))
+        ref_idx = majority[0]
+        ref = datas[ref_idx]
+        bad = sorted(i for i in range(len(datas)) if i not in majority)
+        max_diff = 0.0
+        n_bad = 0
+        for i in bad:
+            arr = datas[i]
+            if arr.dtype != ref.dtype or arr.shape != ref.shape:
+                max_diff = float("inf")
+                n_bad = int(max(np.size(arr), np.size(ref)))
+                continue
+            if jnp.issubdtype(ref.dtype, jnp.floating):
+                a = arr.astype(np.float64)
+                r = ref.astype(np.float64)
+                both_nan = np.isnan(a) & np.isnan(r)
+                diff = np.where(both_nan, 0.0, np.abs(a - r))
+                m = float(np.max(diff, initial=0.0))
+                max_diff = max(max_diff,
+                               float("inf") if np.isnan(m) else m)
+                n_bad += int(np.sum(~((a == r) | both_nan)))
+            else:
+                n_bad += int(np.sum(arr != ref))
+                max_diff = float("inf")
+        out[name] = {
+            "shards": bad,
+            "devices": [str(shards[i].device) for i in bad],
+            "reference_shard": ref_idx,
+            "max_abs_diff": max_diff,
+            "n_bad_elements": n_bad,
+        }
+    return out
+
+
+def leaf_digests(tree: Pytree) -> Dict[str, np.ndarray]:
+    """Per-replicated-leaf 64-bit content digest of this process's shard 0
+    — the small host pytree the cross-host sweep gathers
+    (``parallel.distributed.cross_host_report``) to name WHICH leaf and
+    host diverged when each host's local shards agree internally but the
+    hosts disagree with each other.  O(state) host traffic: slow path
+    only.  Encoded as a (2,) uint32 pair, not one uint64: the sweep's
+    comparison promotes to float64, which is exact for uint32 but drops
+    bits above 2**53."""
+    out: Dict[str, np.ndarray] = {}
+    for name, leaf in _leaf_paths(tree):
+        if not _is_replicated(leaf):
+            continue
+        shards = leaf.addressable_shards
+        if not shards:
+            continue
+        digest = hashlib.sha1(_to_host(shards[0].data).tobytes()).digest()
+        out[name] = np.frombuffer(digest[:8], dtype=np.uint32).copy()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: heal — restore replication from the majority shard
+# ---------------------------------------------------------------------------
+
+def rebuild_replicated_leaf(leaf, shard_datas: List[np.ndarray]):
+    """Rebuild a replicated leaf from per-addressable-shard host arrays —
+    the one shared primitive behind healing (majority data on every
+    shard) and SDC fault injection (one shard's data perturbed).
+
+    Strictly PROCESS-LOCAL (single-device puts + array assembly, never a
+    global ``device_put``): healing is asymmetric by design, so it must
+    not contain a collective a healthy peer would have to join.  Each
+    host array is REALLY copied (``np.array``), because ``np.asarray`` of
+    a shard's ``.data`` can be a zero-copy view of the device buffer and
+    ``device_put`` of such a view aliases the source instead of
+    materializing a fresh buffer (found by the 2-process lane)."""
+    shards = leaf.addressable_shards
+    arrays = [jax.device_put(np.array(d), s.device)
+              for d, s in zip(shard_datas, shards)]
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, arrays)
+
+
+def heal_replication(tree: Pytree,
+                     report: Optional[Dict[str, Dict[str, Any]]] = None
+                     ) -> Tuple[Pytree, Dict[str, Dict[str, Any]]]:
+    """Rebuild every locally-diverged replicated leaf from its majority
+    shard (one host round trip per healed leaf — the heal path is rare by
+    definition).  Healthy leaves keep their identity.  Returns
+    ``(healed_tree, report)``; with an empty report the input tree is
+    returned unchanged.  Process-local by construction — see
+    :func:`rebuild_replicated_leaf`."""
+    if report is None:
+        report = divergence_report(tree)
+    if not report:
+        return tree, report
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = []
+    for path, leaf in flat:
+        name = jax.tree_util.keystr(path)
+        if name in report:
+            shards = leaf.addressable_shards
+            ref = _to_host(shards[report[name]["reference_shard"]].data)
+            leaf = rebuild_replicated_leaf(leaf, [ref] * len(shards))
+        leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves), report
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: the on-device fingerprint (fast path)
+# ---------------------------------------------------------------------------
+
+def _bits_i32(x: jax.Array) -> jax.Array:
+    """Raw bit pattern of ``x`` as a flat int32 vector (floats bitcast at
+    their native width so every mantissa/exponent/sign bit — NaN payloads
+    included — lands in the fold; narrower ints/bools zero-extend).  The
+    fold runs in int32, not uint32: two's-complement wraparound is the
+    same arithmetic mod 2**32 and XLA:CPU vectorizes it measurably
+    better."""
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        nbits = jnp.dtype(x.dtype).itemsize * 8
+        if nbits == 32:
+            return lax.bitcast_convert_type(x, jnp.int32).ravel()
+        x = lax.bitcast_convert_type(x, jnp.dtype(f"uint{nbits}"))
+    return x.astype(jnp.int32).ravel()
+
+
+class Fingerprinter:
+    """One jitted program that folds the replicated leaves of a state
+    pytree into a per-device ``(digest, fold)`` pair.
+
+    * ``digest`` (32-bit): sum over elements of ``bits * pos_i`` mod
+      2**32 (``pos_i`` a pseudorandom odd positional factor), chained
+      across leaves with an FNV-style multiply — the odd factor makes any
+      single-element change (any flipped bit, any NaN) alter the digest
+      *deterministically*, and modular addition is reduction-order-
+      independent, so the digest is bit-stable across compilations.
+      Healthy replicas agree bit-exactly; that is the whole check.
+    * ``fold`` (float32): sum of |x| over a strided sample per device —
+      an advisory magnitude for the incident record, never the detector.
+
+    Built once per run from the state's structure+shardings (both stable
+    across steps, rollbacks and heals); ``compute`` is async (returns
+    device futures — O(1) dispatch); ``fetch`` pulls only the local
+    entries of the tiny output vector.
+    """
+
+    def __init__(self, tree: Pytree, mesh):
+        self.mesh = mesh
+        self.paths: List[str] = []
+        n_shards = 0
+        for name, leaf in _leaf_paths(tree):
+            if _is_replicated(leaf):
+                self.paths.append(name)
+                n_shards = max(n_shards, len(leaf.addressable_shards))
+        self.n_leaves = len(self.paths)
+        self.n_local_shards = n_shards
+        if not self.n_leaves:
+            self._fn = None
+            return
+        axes = tuple(mesh.axis_names)
+
+        def device_fp(leaves: List[jax.Array]):
+            h = jnp.int32(-2128831035)  # FNV offset basis mod 2**32
+            fold = jnp.float32(0.0)
+            for x in leaves:
+                u = _bits_i32(x)
+                # pseudorandom ODD positional factor (Fibonacci hashing
+                # constant): a change to any single element i changes the
+                # sum by delta * pos_i, and pos_i odd + delta != 0 mod
+                # 2**32 guarantees the product is nonzero — every single
+                # flipped bit is detected, deterministically.  The
+                # pseudorandom (not 2i+1) factor also keeps whole-leaf
+                # changes of constant-valued leaves from folding through
+                # the structured sum(2i+1) = n**2, which cancels mod
+                # 2**32 for power-of-two-heavy bit patterns.  This is the
+                # cheapest fold measured that keeps both properties
+                # (DESIGN.md §9: ~0.9 ns/element on XLA:CPU).
+                pos = (jnp.arange(u.shape[0], dtype=jnp.int32)
+                       * jnp.int32(-1640531527)) | jnp.int32(1)
+                h = h * jnp.int32(16777619) + jnp.sum(u * pos,
+                                                      dtype=jnp.int32)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    # advisory magnitude only (the digest is the
+                    # detector): a strided sample keeps this second pass
+                    # off the memory-bandwidth bill
+                    fold = fold + jnp.sum(jnp.abs(
+                        x.ravel()[::64].astype(jnp.float32)))
+            return h.reshape(1), fold.reshape(1)
+
+        mapped = jax.shard_map(device_fp, mesh=mesh,
+                               in_specs=(P(),),
+                               out_specs=(P(axes), P(axes)),
+                               check_vma=False)
+        self._fn = jax.jit(mapped)
+
+    def _leaves(self, tree: Pytree) -> List[jax.Array]:
+        by_name = {name: leaf for name, leaf in _leaf_paths(tree)}
+        return [by_name[p] for p in self.paths]
+
+    def compute(self, tree: Pytree) -> Optional[tuple]:
+        """Dispatch the fingerprint program on the current state; returns
+        the (digest, fold) device futures without any host sync — fetch
+        them later, at the lag-2 discipline."""
+        if self._fn is None:
+            return None
+        return self._fn(self._leaves(tree))
+
+    @staticmethod
+    def fetch(fp: tuple) -> Tuple[np.ndarray, np.ndarray]:
+        """Host copies of the LOCAL entries of the fingerprint vector
+        (multi-host safe: only addressable shards are touched).  A few
+        bytes per device — this is the entire routine host traffic."""
+        digest_arr, fold_arr = fp
+        digests = np.concatenate(
+            [_to_host(s.data) for s in digest_arr.addressable_shards])
+        folds = np.concatenate(
+            [_to_host(s.data) for s in fold_arr.addressable_shards])
+        return digests.astype(np.uint32), folds.astype(np.float32)
+
+
+def digests_differ(digests: np.ndarray) -> bool:
+    """True when this process's per-device digests are not bit-identical
+    (== at least one local replica shard diverged)."""
+    return bool(digests.size > 1 and np.any(digests != digests[0]))
+
+
+def digest_report(all_digests: np.ndarray) -> Dict[str, Any]:
+    """Global fingerprint verdict from the gathered ``(n_processes,
+    n_local_devices)`` digest matrix — pure host math, identical on every
+    process that holds the same gathered input (the symmetry the trainer's
+    multi-host incident path relies on).
+
+    Returns ``{}`` when healthy, else ``{"local": [process indices whose
+    own devices disagree], "cross": [process indices whose (internally
+    consistent) digest differs from the majority], "majority": digest}``.
+    """
+    mat = np.asarray(all_digests, dtype=np.uint32)
+    if mat.ndim == 1:
+        mat = mat[None, :]
+    local_bad = [p for p in range(mat.shape[0])
+                 if np.any(mat[p] != mat[p, 0])]
+    firsts = [int(v) for v in mat[:, 0]]
+    counts: Dict[int, int] = {}
+    first_seen: Dict[int, int] = {}
+    for p, v in enumerate(firsts):
+        counts[v] = counts.get(v, 0) + 1
+        first_seen.setdefault(v, p)
+    # majority vote over per-process digests; ties convict the HIGHER
+    # process index (break toward the digest seen first), so a 1v1
+    # two-host split is reported deterministically rather than by
+    # whichever digest happens to sort lower
+    majority = max(counts, key=lambda v: (counts[v], -first_seen[v]))
+    cross_bad = [p for p in range(mat.shape[0])
+                 if p not in local_bad and firsts[p] != majority]
+    if not local_bad and not cross_bad:
+        return {}
+    return {"local": local_bad, "cross": cross_bad, "majority": majority}

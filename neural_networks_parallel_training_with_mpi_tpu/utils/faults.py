@@ -36,6 +36,29 @@ first two need the trainer's ``checkpoint_dir``, threaded through
                      async error channel must surface it on the caller's
                      thread, with older snapshots intact.
 
+Silent-data-corruption faults against the replica-consistency layer
+(DESIGN.md §9 — these perturb the TRAIN STATE, so the trainer threads it
+through :meth:`FaultPlan.apply_state`):
+
+    ``bitflip``      flip one bit in ONE replica shard of a (named or
+                     deterministically chosen) replicated param leaf —
+                     the cosmic-ray / flaky-HBM stand-in the on-device
+                     fingerprint must detect, localize to the exact
+                     shard, triage as transient by replay, and heal.
+                     Options: ``param=SUBSTR`` (leaf path substring;
+                     default: pick by ``start %% n_candidates``),
+                     ``shard=K`` (default 1), ``bit=B`` (default 12 — a
+                     float32 mantissa bit, so the value stays finite).
+    ``desync``       perturb one shard of a replicated OPTIMIZER-state
+                     leaf (add ``eps=V``, default 1e-3) — a lost/garbled
+                     update stand-in, transient like ``bitflip``.  With
+                     the ``det`` option the perturbation instead moves
+                     INTO the jitted step function (every replica but the
+                     first drifts a little more every step from
+                     ``start``): the replay triage then reproduces the
+                     divergence and must abort with EXIT_SDC (45) —
+                     the deterministic-software-bug verdict.
+
 options
     ``max=N``     fire at most N times over this process's lifetime
                   (in-memory counter) — lets a NaN window be *passable*
@@ -44,6 +67,8 @@ options
                   created at fire time, and the fault never fires while it
                   exists — survives a process restart, so a supervised
                   relaunch does not re-crash at the same step.
+    ``param=``/``shard=``/``bit=``/``eps=``/``det``
+                  SDC-fault knobs, see ``bitflip``/``desync`` above.
 
 Steps are the Trainer's global step counter *about to be executed*; with
 ``--steps_per_dispatch k > 1`` the granularity is the dispatch boundary
@@ -62,7 +87,10 @@ from typing import Dict, List, Optional
 
 ENV_VAR = "NNPT_FAULTS"
 KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
-         "ckpt_ioerr")
+         "ckpt_ioerr", "bitflip", "desync")
+# kinds that perturb the train state (FaultPlan.apply_state) rather than
+# the batch/process (FaultPlan.apply)
+STATE_KINDS = ("bitflip", "desync")
 
 
 @dataclasses.dataclass
@@ -72,6 +100,11 @@ class _Fault:
     end: int                      # inclusive
     max_fires: Optional[int] = None
     once_marker: Optional[str] = None
+    param: Optional[str] = None   # bitflip/desync: leaf-path substring
+    shard: int = 1                # bitflip/desync: victim replica shard
+    bit: int = 12                 # bitflip: bit index within the element
+    eps: float = 1e-3             # desync: perturbation magnitude
+    det: bool = False             # desync: deterministic in-step variant
     fires: int = 0
 
     def should_fire(self, step: int) -> bool:
@@ -105,19 +138,30 @@ def _parse_one(item: str) -> _Fault:
     end = int(hi) if hi else start
     if end < start:
         raise ValueError(f"fault window {window!r} ends before it starts")
-    max_fires: Optional[int] = None
-    once_marker: Optional[str] = None
+    fault = _Fault(kind, start, end)
     for opt in filter(None, opts.split("&")):
         key, _, val = opt.partition("=")
         if key == "max":
-            max_fires = int(val)
+            fault.max_fires = int(val)
         elif key == "once":
             if not val:
                 raise ValueError(f"once= needs a marker path in {item!r}")
-            once_marker = val
+            fault.once_marker = val
+        elif key == "param":
+            fault.param = val
+        elif key == "shard":
+            fault.shard = int(val)
+        elif key == "bit":
+            fault.bit = int(val)
+        elif key == "eps":
+            fault.eps = float(val)
+        elif key == "det":
+            fault.det = True
         else:
             raise ValueError(f"unknown fault option {key!r} in {item!r}")
-    return _Fault(kind, start, end, max_fires, once_marker)
+    if fault.det and kind != "desync":
+        raise ValueError(f"option 'det' only applies to desync, not {kind!r}")
+    return fault
 
 
 def _corrupt_newest(ckpt_dir: Optional[str], step: int) -> None:
@@ -157,10 +201,118 @@ def _corrupt_newest(ckpt_dir: Optional[str], step: int) -> None:
           file=sys.stderr, flush=True)
 
 
+def _replicated_float_leaves(tree):
+    """(name, leaf) for fully-replicated float leaves with >= 2 local
+    shards — the candidate victims for the SDC fault kinds.  Replication
+    detection is utils.consistency's (lazy import: this module stays
+    jax-free until a fault actually fires)."""
+    import jax.numpy as jnp
+
+    from . import consistency
+
+    for name, leaf in consistency._leaf_paths(tree):
+        if (consistency._is_replicated(leaf)
+                and len(leaf.addressable_shards) >= 2
+                and jnp.issubdtype(leaf.dtype, jnp.floating)):
+            yield name, leaf
+
+
+def flip_bit_in_shard(leaf, shard_idx: int, bit: int,
+                      elem: Optional[int] = None):
+    """Rebuild a replicated leaf with one bit flipped in ONE replica
+    shard (default element: the middle of the flat buffer) — physically
+    diverged shards behind a sharding that still claims replication,
+    which is exactly what a hardware SDC looks like.  Also used directly
+    by tests/distributed_child.py's cross-host sweep."""
+    import numpy as np
+
+    from . import consistency
+
+    shards = leaf.addressable_shards
+    shard_idx %= len(shards)
+    datas = [np.array(s.data) for s in shards]
+    victim = datas[shard_idx]
+    width = victim.dtype.itemsize * 8
+    flat = victim.view(f"uint{width}").reshape(-1)
+    elem = flat.shape[0] // 2 if elem is None else elem % flat.shape[0]
+    flat[elem] ^= np.asarray(1 << (bit % width), flat.dtype)
+    return consistency.rebuild_replicated_leaf(leaf, datas)
+
+
+def perturb_shard(leaf, shard_idx: int, eps: float):
+    """Rebuild a replicated leaf with ``eps`` added to every element of
+    ONE replica shard (the ``desync`` kind's lost/garbled-update
+    stand-in)."""
+    import numpy as np
+
+    from . import consistency
+
+    shards = leaf.addressable_shards
+    shard_idx %= len(shards)
+    datas = [np.array(s.data) for s in shards]
+    datas[shard_idx] = (datas[shard_idx]
+                        + np.asarray(eps, datas[shard_idx].dtype)).astype(
+        datas[shard_idx].dtype)
+    return consistency.rebuild_replicated_leaf(leaf, datas)
+
+
+def _replace_leaf(tree, name: str, new_leaf):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [new_leaf if jax.tree_util.keystr(path) == name else leaf
+              for path, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def wrap_step_with_desync(step_fn, mesh, start: int, eps: float):
+    """The DETERMINISTIC desync (``desync@N?det``): wrap a train step so
+    that, from global step ``start`` on, every device but the first adds
+    ``eps * device_index`` to the first float param leaf INSIDE the jitted
+    program — a stand-in for a shard_map out_spec that lies about
+    replication or a miscompiled collective.  Because the bug lives in
+    the step function, the SDC replay triage reproduces it and must
+    return the deterministic verdict (abort, EXIT_SDC)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    axes = tuple(mesh.axis_names)
+
+    def perturb(state):
+        lin = None
+        for a in axes:
+            i = lax.axis_index(a)
+            lin = i if lin is None else lin * lax.axis_size(a) + i
+        scale = jnp.where(state.step >= start, jnp.float32(eps),
+                          jnp.float32(0.0))
+        flat, treedef = jax.tree_util.tree_flatten(state.params)
+        for k, leaf in enumerate(flat):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                flat[k] = leaf + (scale * lin.astype(jnp.float32)
+                                  ).astype(leaf.dtype)
+                break
+        return state._replace(
+            params=jax.tree_util.tree_unflatten(treedef, flat))
+
+    mapped = jax.jit(jax.shard_map(perturb, mesh=mesh, in_specs=(P(),),
+                                   out_specs=P(), check_vma=False))
+
+    def wrapped(state, batch):
+        state, out = step_fn(state, batch)
+        return mapped(state), out
+
+    return wrapped
+
+
 class FaultPlan:
     """Parsed fault schedule; the Trainer calls :meth:`apply` once per
     dispatch with the global step about to run and the (device-placed)
-    batch, and receives the possibly-poisoned batch back."""
+    batch, and receives the possibly-poisoned batch back.  State-kind
+    faults (``bitflip``/``desync``) go through :meth:`apply_state`
+    instead; the deterministic desync is consumed at step-build time via
+    :meth:`det_desync`."""
 
     def __init__(self, faults: List[_Fault]):
         self.faults = faults
@@ -179,9 +331,63 @@ class FaultPlan:
         channel a supervisor-launched child inherits)."""
         return FaultPlan.parse(cfg_spec or os.environ.get(ENV_VAR, ""))
 
+    def det_desync(self) -> Optional[_Fault]:
+        """The deterministic in-step desync spec, if any (consumed by the
+        Trainer at step-build time — it cannot fire from apply_state)."""
+        for f in self.faults:
+            if f.kind == "desync" and f.det:
+                return f
+        return None
+
+    def apply_state(self, step: int, state, what: str = "train state"):
+        """Fire any due state-kind faults (``bitflip``/``desync``) against
+        the device-placed train state; returns the possibly-corrupted
+        state.  Single-process injection (the multi-host sweep injects via
+        :func:`flip_bit_in_shard` directly in tests/distributed_child.py).
+        """
+        for f in self.faults:
+            if (f.kind not in STATE_KINDS or f.det
+                    or not f.should_fire(step)):
+                continue
+            target = (state.params if f.kind == "bitflip"
+                      else state.opt_state)
+            cands = list(_replicated_float_leaves(target))
+            if not cands:
+                print(f"[faults] {f.kind} at step {step}: no replicated "
+                      f"float leaves in {what} to corrupt", file=sys.stderr,
+                      flush=True)
+                continue
+            f.mark_fired()
+            if f.param:
+                named = [c for c in cands if f.param in c[0]]
+                if not named:
+                    raise ValueError(
+                        f"{f.kind} param={f.param!r} matches no replicated "
+                        f"float leaf (candidates: "
+                        f"{[n for n, _ in cands]})")
+                name, leaf = named[0]
+            else:
+                name, leaf = cands[f.start % len(cands)]
+            if f.kind == "bitflip":
+                new_leaf = flip_bit_in_shard(leaf, f.shard, f.bit)
+                detail = f"bit {f.bit}"
+            else:
+                new_leaf = perturb_shard(leaf, f.shard, f.eps)
+                detail = f"eps {f.eps}"
+            print(f"[faults] injected {f.kind} at step {step}: {detail} in "
+                  f"shard {f.shard % len(leaf.addressable_shards)} of "
+                  f"{name}", file=sys.stderr, flush=True)
+            target = _replace_leaf(target, name, new_leaf)
+            state = (state._replace(params=target)
+                     if f.kind == "bitflip"
+                     else state._replace(opt_state=target))
+        return state
+
     def apply(self, step: int, batch: Dict,
               ckpt_dir: Optional[str] = None) -> Dict:
         for f in self.faults:
+            if f.kind in STATE_KINDS:
+                continue  # apply_state's job (det: step-build time)
             if not f.should_fire(step):
                 continue
             f.mark_fired()

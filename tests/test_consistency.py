@@ -44,22 +44,72 @@ def test_planted_divergence_is_caught(mesh8):
 
 
 def test_trainer_flag_runs_checks(mesh8, monkeypatch):
+    """--check_replicas_every now rides the SDC fingerprint path
+    (DESIGN.md §9): one O(1) on-device digest per boundary, fetched at
+    the lag-2 discipline, instead of the old host-side full-state fetch
+    that drained the async pipeline."""
     cfg = TrainConfig(
         nepochs=1, batch_size=16, full_batch=False,
         check_replicas_every=1,
         data=DataConfig(dataset="regression", n_samples=64),
         mesh=MeshConfig(data=8),
     )
-    calls = []
-    real = consistency.assert_replicated
-    monkeypatch.setattr(consistency, "assert_replicated",
-                        lambda tree, **kw: calls.append(1) or real(tree, **kw))
+    computes, fetches = [], []
+    real_compute = consistency.Fingerprinter.compute
+    real_fetch = consistency.Fingerprinter.fetch
+    monkeypatch.setattr(
+        consistency.Fingerprinter, "compute",
+        lambda self, tree: computes.append(1) or real_compute(self, tree))
+    monkeypatch.setattr(
+        consistency.Fingerprinter, "fetch",
+        staticmethod(lambda fp: fetches.append(1) or real_fetch(fp)))
     t = Trainer(cfg)
     result = t.fit()  # healthy run: checks pass silently
     assert np.isfinite(result["final_loss"])
     # the flag must actually fire once per step (bug class B1: parsed-but-
-    # ignored flags are the reference's signature failure)
-    assert len(calls) == result["steps"]
+    # ignored flags are the reference's signature failure), and every
+    # queued fingerprint must be fetched (lag-2 + end-of-run drain)
+    assert len(computes) == result["steps"]
+    assert len(fetches) == result["steps"]
+    assert result["sdc_incidents"] == 0
+
+
+def test_nan_poisoned_replica_reported_diverged(mesh8):
+    """Satellite regression: a NaN in the shard diff used to make
+    ``np.max`` return NaN and ``max(worst, nan)`` keep 0.0 — a
+    NaN-poisoned replica was reported HEALTHY and dropped by the
+    ``v > atol`` filter.  It must report inf and be flagged."""
+    liar = jax.jit(jax.shard_map(
+        lambda: (jnp.where(jax.lax.axis_index("data") == 3,
+                           jnp.float32(jnp.nan), jnp.float32(1.0))
+                 * jnp.ones((2, 2))),
+        mesh=mesh8, in_specs=(), out_specs=P(), check_vma=False))
+    div = consistency.replica_divergence({"bad": liar()})
+    assert div["['bad']"] == float("inf")
+    assert consistency.check_replicas({"bad": liar()})  # not filtered out
+    with pytest.raises(AssertionError, match="replica divergence"):
+        consistency.assert_replicated({"bad": liar()})
+
+
+def test_identically_nan_replicas_are_lockstep(mesh8):
+    # every shard NaN at the same position: bit-for-bit lockstep, healthy
+    bad = jax.device_put(jnp.full((2, 2), jnp.nan),
+                         NamedSharding(mesh8, P()))
+    assert consistency.check_replicas({"x": bad}) == {}
+
+
+def test_one_host_copy_per_shard(mesh8, monkeypatch):
+    """Satellite micro-test: replica_divergence fetches each shard to the
+    host exactly once (the reference shard included — no re-fetch per
+    comparison)."""
+    tree = {"w": jax.device_put(jnp.ones((4, 4)), NamedSharding(mesh8, P())),
+            "b": jax.device_put(jnp.zeros((3,)), NamedSharding(mesh8, P()))}
+    calls = []
+    real = consistency._to_host
+    monkeypatch.setattr(consistency, "_to_host",
+                        lambda s: calls.append(1) or real(s))
+    consistency.replica_divergence(tree)
+    assert len(calls) == 2 * 8  # two leaves x eight shards, exactly
 
 
 def test_bfloat16_divergence_reports_magnitude(mesh8):

@@ -1,0 +1,163 @@
+"""Render a run's silent-data-corruption history from its --telemetry_dir.
+
+Reads the ``kind: "sdc"`` records train/trainer.py's fingerprint monitor
+writes into metrics.jsonl (DESIGN.md §9), plus postmortem.json's sdc
+events when present, and prints the triage view an operator needs before
+deciding whether to drain a chip::
+
+    python tools/sdc_report.py RUN_DIR            # a --telemetry_dir
+    python tools/sdc_report.py metrics.jsonl      # a bare JSONL
+    python tools/sdc_report.py RUN_DIR --json     # machine-readable
+
+Shows: incident count by action (healed / rollback / abort), per-device
+strike counts, a diverged-leaf histogram, and the last replay verdict
+(transient = hardware weather; deterministic = a software bug exit 45
+already refused to relaunch).
+
+Zero dependencies beyond the stdlib — usable on a host with no JAX, e.g.
+to triage a run directory copied off a pod (same contract as
+tools/ckpt_fsck.py and tools/metrics_summary.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_sdc_records(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line of a live run
+            if isinstance(rec, dict) and rec.get("kind") == "sdc":
+                records.append(rec)
+    return records
+
+
+def postmortem_sdc_events(pm: Optional[Dict[str, Any]]
+                          ) -> List[Dict[str, Any]]:
+    if not pm:
+        return []
+    return [r for r in pm.get("records", [])
+            if r.get("kind") == "event" and r.get("event") == "sdc"]
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    actions = collections.Counter(r.get("action", "?") for r in records)
+    strikes: Dict[str, int] = {}
+    leaves: collections.Counter = collections.Counter()
+    for r in records:
+        for d in r.get("devices", []):
+            strikes[d] = strikes.get(d, 0) + 1
+        # one strike per PROCESS per INCIDENT (the trainer's ledger
+        # semantics) — not per diverged leaf, which would inflate a
+        # single multi-leaf incident into several strikes
+        procs = {proc for plist in (r.get("cross_host") or {}).values()
+                 for proc in plist}
+        for proc in procs:
+            key = f"process:{proc}"
+            strikes[key] = strikes.get(key, 0) + 1
+        for leaf in (r.get("leaves") or {}):
+            leaves[leaf] += 1
+    # the trainer's own running strike ledger (recorded on heal/abort) is
+    # authoritative when present — it survives incidents this file only
+    # partially captured (e.g. a torn tail)
+    for r in records:
+        for d, n in (r.get("strikes") or {}).items():
+            strikes[d] = max(strikes.get(d, 0), int(n))
+    last = records[-1] if records else None
+    return {
+        "n_incidents": len(records),
+        "actions": dict(actions),
+        "device_strikes": dict(sorted(strikes.items(),
+                                      key=lambda kv: -kv[1])),
+        "leaf_histogram": dict(leaves.most_common()),
+        "last_step": last.get("step") if last else None,
+        "last_verdict": last.get("verdict") if last else None,
+        "last_action": last.get("action") if last else None,
+    }
+
+
+def render_text(summary: Dict[str, Any],
+                records: List[Dict[str, Any]],
+                pm_events: List[Dict[str, Any]]) -> str:
+    if not summary["n_incidents"] and not pm_events:
+        return "no SDC incidents recorded"
+    lines = [f"SDC incidents: {summary['n_incidents']}"
+             + (f" (actions: " + ", ".join(
+                 f"{k} x{v}" for k, v in sorted(summary["actions"].items()))
+                + ")" if summary["actions"] else "")]
+    if summary["device_strikes"]:
+        lines.append("per-device strikes:")
+        for d, n in summary["device_strikes"].items():
+            lines.append(f"  {d:<24} {n}")
+    if summary["leaf_histogram"]:
+        lines.append("diverged leaves:")
+        for leaf, n in summary["leaf_histogram"].items():
+            lines.append(f"  {leaf:<40} x{n}")
+    if summary["last_verdict"] is not None:
+        lines.append(f"last incident: step {summary['last_step']}, replay "
+                     f"verdict {summary['last_verdict']!r}, action "
+                     f"{summary['last_action']!r}")
+        if summary["last_verdict"] == "deterministic":
+            lines.append("  -> DETERMINISTIC divergence: software bug; the "
+                         "run aborted with exit 45 and a relaunch would "
+                         "replay it")
+        elif summary["last_action"] == "abort_strikes":
+            lines.append("  -> strike budget exhausted: drain the device "
+                         "before relaunching")
+    for e in pm_events[-3:]:
+        lines.append(f"postmortem event: step {e.get('step')}, verdict "
+                     f"{e.get('verdict')!r}, action {e.get('action')!r}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="a --telemetry_dir or a metrics JSONL file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    pm = None
+    if os.path.isdir(args.path):
+        metrics_path = os.path.join(args.path, "metrics.jsonl")
+        try:
+            with open(os.path.join(args.path, "postmortem.json")) as f:
+                pm = json.load(f)
+        except (OSError, ValueError):
+            pass
+    else:
+        metrics_path = args.path
+    try:
+        records = load_sdc_records(metrics_path)
+    except OSError as e:
+        print(f"ERROR: cannot read {metrics_path}: {e}", file=sys.stderr)
+        return 2
+    events = postmortem_sdc_events(pm)
+    summary = summarize(records)
+    if args.json:
+        summary["postmortem_sdc_events"] = events
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_text(summary, records, events))
+    # exit 1 when the history says "do not just relaunch": a deterministic
+    # verdict or an exhausted strike budget (mirrors ckpt_fsck's 0/1 idiom)
+    bad = (summary.get("last_verdict") == "deterministic"
+           or "abort_strikes" in summary.get("actions", {}))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

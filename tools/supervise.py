@@ -9,6 +9,8 @@ honoring the exit-code contract —
 * 42  watchdog hang -> retry
 * 43  peer loss (a collective raised) -> retry
 * 44  anomaly abort (rollback budget exhausted) -> stop, do NOT retry
+* 45  SDC abort (deterministic replica divergence or a device past its
+      strike budget) -> stop, do NOT retry
 * any other nonzero / signal death -> retry
 
 For training jobs the integrated form is usually what you want (it appends
@@ -42,7 +44,7 @@ from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (  #
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="relaunch a command on crash with exponential backoff "
-                    "(exit 0 and exit 44 stop; see module docstring)")
+                    "(exit 0, 44 and 45 stop; see module docstring)")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="relaunches allowed after the initial run")
     p.add_argument("--backoff", type=float, default=1.0,
