@@ -182,7 +182,14 @@ def _supervise(args, argv) -> int:
     detector that works even when the child process is frozen whole,
     armed at 4x the in-process timeout so the child's own watchdog fires
     first — and (b) points the relaunch log at the child's
-    postmortem.json flight-recorder dump after an abnormal exit."""
+    postmortem.json flight-recorder dump after an abnormal exit.
+
+    With --elastic the supervisor reacts to repeated peer-loss exits
+    (43/42) by probing the surviving topology — the coordinator-aware
+    ``parallel.mesh.probe_world``, driven by the same env channel the
+    child's world_setup reads — and relaunching at the shrunken world;
+    a probe below --min_devices parks/polls, then exits 46
+    (DESIGN.md §10)."""
     import os
 
     from .train.resilience import strip_supervisor_flags, supervise
@@ -197,14 +204,28 @@ def _supervise(args, argv) -> int:
         postmortem = os.path.join(args.telemetry_dir, "postmortem.json")
         if getattr(args, "hang_timeout", 0.0) > 0:
             heartbeat_timeout = max(4.0 * args.hang_timeout, 60.0)
+    probe = None
+    if getattr(args, "elastic", False):
+        def probe():
+            # imported lazily: pulls jax (module only — the probe itself
+            # runs in a subprocess, so the supervisor process never
+            # initializes a backend)
+            from .parallel.mesh import probe_world
+
+            return probe_world(log=lambda m: print(m, file=sys.stderr,
+                                                   flush=True))
     pkg = __name__.rsplit(".", 1)[0]
     return supervise([sys.executable, "-m", pkg, *child],
                      max_restarts=args.supervise,
                      backoff=args.supervise_backoff,
+                     backoff_cap=args.supervise_backoff_max,
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=heartbeat_timeout,
                      postmortem_path=postmortem,
-                     ckpt_dir=args.checkpoint_dir)
+                     ckpt_dir=args.checkpoint_dir,
+                     elastic=getattr(args, "elastic", False),
+                     min_devices=getattr(args, "min_devices", 0),
+                     probe=probe)
 
 
 def main(argv=None) -> int:
@@ -217,13 +238,14 @@ def main(argv=None) -> int:
         return rc
     if getattr(args, "generate", None) is not None:
         return _generate(args)
-    from .train.resilience import (EXIT_ANOMALY, EXIT_SDC, AnomalyAbort,
-                                   SDCAbort)
+    from .train.resilience import (EXIT_ANOMALY, EXIT_CAPACITY, EXIT_PEER,
+                                   EXIT_SDC, AnomalyAbort, CapacityAbort,
+                                   SDCAbort, is_peer_error)
     from .train.trainer import Trainer  # import after the platform pin
 
     cfg = config_from_args(args)
-    trainer = Trainer(cfg)
     try:
+        trainer = Trainer(cfg)
         result = trainer.fit()
     except AnomalyAbort as e:
         # deterministic divergence: the last good checkpoint is preserved
@@ -237,6 +259,36 @@ def main(argv=None) -> int:
         # the supervisor must NOT relaunch (it would replay the bug)
         log(f"ERROR: SDC abort: {e} (exit {EXIT_SDC})")
         return EXIT_SDC
+    except CapacityAbort as e:
+        # the healthy world is below --min_devices: no-retry exit 46 —
+        # relaunching cannot create chips (DESIGN.md §10)
+        log(f"ERROR: capacity abort: {e} (exit {EXIT_CAPACITY})")
+        return EXIT_CAPACITY
+    except Exception as e:
+        # peer/transport loss (a collective raised, world formation timed
+        # out): exit 43 so the supervisor retries — and, under --elastic,
+        # counts the loss toward its probe-and-shrink streak.  Anything
+        # else stays a crash (traceback, rc 1): also retried, but never
+        # misread as a topology signal.
+        if not is_peer_error(e):
+            raise
+        # full traceback first: the classifier is heuristic, and a
+        # misread software crash must stay diagnosable from the log
+        import traceback
+
+        traceback.print_exc()
+        log(f"ERROR: peer loss: {type(e).__name__}: {e} "
+            f"(exit {EXIT_PEER})")
+        # hard exit: after a lost peer the distributed client's background
+        # threads LOG(FATAL) during interpreter teardown, overriding a
+        # normal return with SIGABRT — which the supervisor would count as
+        # an anonymous crash instead of the peer-loss streak the elastic
+        # policy needs
+        import os
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(EXIT_PEER)
     log(f"done: final loss {result['final_loss']:.6f}, "
         f"{result['samples_per_sec']:.1f} samples/sec")
     val = {k: v for k, v in result.items() if k.startswith("val_")}

@@ -327,8 +327,39 @@ class TrainConfig:
     # deterministic fault injection spec (utils.faults; falls back to the
     # NNPT_FAULTS env var), e.g. "nan@5-8?max=4,crash@12?once=/tmp/m";
     # I/O kinds torn_ckpt/corrupt_ckpt/ckpt_ioerr target the checkpoint
-    # durability layer (DESIGN.md §8)
+    # durability layer (DESIGN.md §8); capacity kinds peer_kill/peer_hang/
+    # device_loss target the elastic restart layer (DESIGN.md §10)
     faults: str = ""
+    # ---- elastic degraded-capacity restart (DESIGN.md §10; off by
+    # default) ----
+    # allow this run to CONTINUE SMALLER after permanent capacity loss:
+    # resume accepts a checkpoint saved by a different world size (the
+    # cross-world reshard path), and the supervisor reacts to repeated
+    # peer-loss exits by probing the surviving topology and relaunching
+    # at the shrunken world instead of looping through a world_setup that
+    # can never re-form
+    elastic: bool = False
+    # refuse to run below this many healthy global devices: the trainer
+    # exits 46 (EXIT_CAPACITY, no-retry) at startup, and the elastic
+    # supervisor parks/polls then exits 46 when a probe can never meet
+    # the floor (0 = no floor)
+    min_devices: int = 0
+    # what an elastic resume onto a DIFFERENT dp width preserves:
+    #   global     - keep the global batch (loss trajectory comparable);
+    #                per-device rows grow by old_dp/new_dp, and grad
+    #                accumulation is raised by the same factor to bound
+    #                per-device microbatch memory
+    #   per_device - keep per-device rows (memory profile comparable);
+    #                the global batch shrinks — the effective-batch
+    #                change is logged to telemetry (kind=topology)
+    elastic_batch: str = "global"
+    # bound host-level collectives (barrier/broadcast/allgather — the
+    # transport under consistency/SDC verdicts): a peer dying
+    # mid-collective converts an indefinite DCN stall into postmortem +
+    # exit 43 after this many seconds (0 = unbounded, the historical
+    # behavior; NNPT_COLLECTIVE_TIMEOUT_S is the env form a supervisor
+    # hands its children)
+    collective_timeout: float = 0.0
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), default=str)
@@ -619,7 +650,40 @@ def build_argparser() -> argparse.ArgumentParser:
                         "appended)")
     p.add_argument("--supervise_backoff", type=float, default=1.0,
                    help="initial supervisor backoff in seconds (doubles "
-                        "per restart, capped at 60s)")
+                        "per restart, jittered -50%% downward, hard-capped "
+                        "at --supervise_backoff_max)")
+    p.add_argument("--supervise_backoff_max", type=float, default=60.0,
+                   help="supervisor backoff cap in seconds — a HARD bound "
+                        "on the relaunch delay (jitter only shortens); "
+                        "combined with the jitter it keeps a pod's worth "
+                        "of supervisors from relaunching against a "
+                        "recovering coordinator in lockstep")
+    # elastic degraded-capacity restart (DESIGN.md §10)
+    _add_bool_flag(p, "elastic", False,
+                   "survive permanent capacity loss by continuing "
+                   "smaller: resume accepts checkpoints from a different "
+                   "world size (cross-world reshard), and --supervise "
+                   "probes + relaunches at the shrunken world after "
+                   "repeated peer-loss exits")
+    p.add_argument("--min_devices", type=int, default=0, metavar="N",
+                   help="capacity floor: refuse to train below N healthy "
+                        "global devices — the trainer exits 46 "
+                        "(EXIT_CAPACITY, no-retry) and the elastic "
+                        "supervisor parks/polls then exits 46 when a "
+                        "probe can never meet the floor (0 = no floor)")
+    p.add_argument("--elastic_batch", choices=["global", "per_device"],
+                   default="global",
+                   help="elastic resume onto a different dp width: keep "
+                        "the global batch (raising grad accumulation to "
+                        "bound per-device memory) or keep the per-device "
+                        "batch (shrinking the global batch; the change "
+                        "is logged to telemetry)")
+    p.add_argument("--collective_timeout", type=float, default=0.0,
+                   metavar="S",
+                   help="bound host-level collectives: a peer dying "
+                        "mid-barrier/allgather converts the stall into "
+                        "postmortem + exit 43 after S seconds (0 = "
+                        "unbounded)")
     # launch-path flags (consumed by cli.main before any JAX backend init;
     # not part of TrainConfig).  The reference's launcher is mpiexec
     # (README.md:12); ours is the JAX platform choice + device mesh.
@@ -685,6 +749,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         max_rollbacks=args.max_rollbacks,
         loss_spike_factor=args.loss_spike_factor,
         faults=args.faults,
+        elastic=args.elastic,
+        min_devices=args.min_devices,
+        elastic_batch=args.elastic_batch,
+        collective_timeout=args.collective_timeout,
     )
     cfg.mesh = MeshConfig(data=args.dp, tensor=args.tp, pipe=args.pp,
                           seq=args.sp, fsdp=args.fsdp, expert=args.ep)

@@ -182,6 +182,34 @@ class ShardedLoader:
         bs = self.batch_size
         return min(bs, self.n - step * bs)
 
+    def consumed_samples(self, global_step: int) -> int:
+        """Dataset sample-slots consumed after ``global_step`` steps —
+        the WORLD-SIZE-INDEPENDENT progress coordinate checkpoint meta
+        carries (DESIGN.md §10): the per-epoch order is derived from
+        (seed, epoch, order_salt) alone, so two loaders with different
+        batch sizes / dp widths walk the SAME sample permutation and only
+        cut it into batches differently.  Counts order slots, so padded
+        rows don't distort it and a full epoch is exactly ``n``."""
+        spe = self.steps_per_epoch
+        full_epochs, in_epoch = divmod(global_step, spe)
+        return full_epochs * self.n + min(in_epoch * self.batch_size,
+                                          self.n)
+
+    def start_for_samples(self, samples: int) -> tuple:
+        """(epoch, start_step) under THIS loader's batch size for a run
+        that has already consumed ``samples`` order slots — the inverse
+        of :meth:`consumed_samples` for an elastic resume whose batch
+        size changed with the world.  A sample offset that no longer
+        lands on a batch boundary rounds DOWN (re-trains up to
+        batch_size-1 samples rather than silently skipping any), so the
+        resumed stream remains a permutation of the original epoch."""
+        epoch, offset = divmod(max(0, int(samples)), self.n)
+        if offset >= self.steps_per_epoch * self.batch_size:
+            # the old batch size covered the epoch tail this one drops
+            # (remainder='drop'): start the next epoch
+            return epoch + 1, 0
+        return epoch, offset // self.batch_size
+
     def epoch(self, epoch: int, start_step: int = 0
               ) -> Iterator[Dict[str, jax.Array]]:
         """Yield device-placed global batches for one epoch.  ``start_step``

@@ -19,15 +19,106 @@ its host-level primitives map here as:
 Single-process runs degrade to no-ops/identity, so the same training script
 works from a laptop CPU to a multi-host pod (unlike the reference, whose
 cluster path was never run — README.md:10).
+
+Peer-loss containment (DESIGN.md §10): the host-level collectives here —
+barrier, broadcast, allgather, and hence every consistency/SDC verdict
+that rides them — optionally run under a BOUNDED timeout
+(``--collective_timeout`` / the ``NNPT_COLLECTIVE_TIMEOUT_S`` env var).
+A peer that died mid-collective turns an indefinite DCN stall into a
+loud postmortem + clean ``exit 43`` (EXIT_PEER, retryable), which is the
+signal the elastic supervisor's probe-and-shrink policy consumes.  The
+stuck gloo/grpc call itself cannot be cancelled from Python — the
+process must die, exactly like the watchdog's exit-42 contract.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import os
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+COLLECTIVE_TIMEOUT_ENV = "NNPT_COLLECTIVE_TIMEOUT_S"
+_timeout_override: Optional[float] = None
+
+
+class CollectiveTimeout(RuntimeError):
+    """A host-level collective did not complete within the bound — a peer
+    is gone or wedged.  Raised by :func:`_bounded`; the public wrappers
+    convert it into a postmortem + ``os._exit(EXIT_PEER)`` because the
+    underlying native call is still blocked and cannot be unwound."""
+
+
+def set_collective_timeout(seconds: Optional[float]) -> None:
+    """Process-wide bound for host collectives (None/0 = unbounded, the
+    historical behavior).  The Trainer wires ``--collective_timeout``
+    through here; the env var covers supervisor-launched children."""
+    global _timeout_override
+    _timeout_override = seconds
+
+
+def collective_timeout_s() -> float:
+    if _timeout_override is not None:
+        return float(_timeout_override)
+    try:
+        return float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def _bounded(fn: Callable[[], Any], what: str,
+             timeout_s: Optional[float] = None) -> Any:
+    """Run a blocking host collective with a bound: the call executes on a
+    daemon worker thread and the caller waits ``timeout_s``; overrun
+    raises :class:`CollectiveTimeout` (the worker — and the native call
+    under it — stays stuck, which is why the public wrappers exit).
+    Unbounded (timeout 0/None) calls run inline with zero overhead."""
+    t = collective_timeout_s() if timeout_s is None else timeout_s
+    if not t or t <= 0:
+        return fn()
+    box: list = []
+
+    def work():
+        try:
+            box.append(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 — re-raised on caller
+            box.append(("err", e))
+
+    worker = threading.Thread(target=work, daemon=True,
+                              name=f"collective-{what}")
+    worker.start()
+    worker.join(t)
+    if not box:
+        raise CollectiveTimeout(
+            f"host collective {what!r} did not complete within {t:.0f}s "
+            "— peer lost or DCN stalled")
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
+
+def _die_peer_loss(what: str, exc: CollectiveTimeout) -> None:
+    """Convert a timed-out collective into the clean peer-loss exit: dump
+    the flight recorder (the postmortem says WHICH collective stalled),
+    log, and ``os._exit(EXIT_PEER)`` — the blocked native call cannot be
+    unwound, so a normal raise would just die later and uglier."""
+    import sys
+
+    from ..train.resilience import EXIT_PEER
+
+    print(f"[distributed] {exc} — exiting {EXIT_PEER} (peer loss) for the "
+          "supervisor to retry or degrade", file=sys.stderr, flush=True)
+    try:
+        from ..train import telemetry
+
+        telemetry.emergency_dump(f"peer loss: {what} timed out")
+    except Exception:
+        pass
+    os._exit(EXIT_PEER)
 
 
 def is_multi_host() -> bool:
@@ -36,12 +127,17 @@ def is_multi_host() -> bool:
 
 def barrier(name: str = "barrier") -> None:
     """Block until every process reaches this point (fail-fast replacement
-    for the reference's implicit gather barrier, :185)."""
+    for the reference's implicit gather barrier, :185).  With a collective
+    timeout configured, a lost peer converts the block into exit 43."""
     if not is_multi_host():
         return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    try:
+        _bounded(lambda: multihost_utils.sync_global_devices(name),
+                 f"barrier:{name}")
+    except CollectiveTimeout as e:
+        _die_peer_loss(f"barrier:{name}", e)
 
 
 def broadcast_host_array(x: Any, is_source: bool = None) -> Any:
@@ -56,17 +152,29 @@ def broadcast_host_array(x: Any, is_source: bool = None) -> Any:
 
     if is_source is None:
         is_source = jax.process_index() == 0
-    return multihost_utils.broadcast_one_to_all(x, is_source=is_source)
+    try:
+        return _bounded(
+            lambda: multihost_utils.broadcast_one_to_all(
+                x, is_source=is_source), "broadcast")
+    except CollectiveTimeout as e:
+        _die_peer_loss("broadcast", e)
 
 
 def allgather_host_array(x: Any) -> Any:
     """Gather a per-process pytree to every process (the reference's
-    ``comm.gather`` + redistribution, :185-203, minus the root bottleneck)."""
+    ``comm.gather`` + redistribution, :185-203, minus the root
+    bottleneck).  This is the transport under every consistency/SDC
+    verdict, so the bounded-timeout conversion here is what keeps a peer
+    dying mid-incident from wedging the survivors."""
     if not is_multi_host():
         return jax.tree_util.tree_map(lambda v: np.asarray(v)[None], x)
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(x)
+    try:
+        return _bounded(lambda: multihost_utils.process_allgather(x),
+                        "allgather")
+    except CollectiveTimeout as e:
+        _die_peer_loss("allgather", e)
 
 
 def cross_host_report(x: Any, atol: float = 0.0) -> dict:

@@ -29,6 +29,16 @@ lost peer into a loud exit; this module defends the *state itself* and the
   software bug, and a chip past its strike budget needs draining, not
   another restart).
 
+* Elastic degraded-capacity restart (DESIGN.md §10): with
+  ``elastic=True``, :func:`supervise` reacts to REPEATED peer-loss exits
+  (43, and hangs-after-peer-loss 42) by probing the surviving topology
+  (bounded — ``parallel.mesh.probe_world`` or :func:`default_probe`) and
+  relaunching the child at the probed, shrunken world instead of looping
+  forever through a ``world_setup`` that can never re-form the old one.
+  When the probe finds fewer than ``min_devices``, the supervisor parks
+  and re-polls with backoff until either capacity returns or the restart
+  budget runs out, then exits :data:`EXIT_CAPACITY` (46, no-retry).
+
 Exit-code contract (also consumed by ``tools/supervise.py``):
 
 ===========  ============================================  =========
@@ -36,10 +46,13 @@ code         meaning                                       supervisor
 ===========  ============================================  =========
 0            run completed (or exited cleanly on SIGTERM)  stop
 42           watchdog: no step progress (hang)             retry
-43           peer loss: a collective raised                retry
+43           peer loss: a collective raised/timed out or   retry
+             world formation failed (typed, mesh errors)
 44           anomaly abort: rollback budget exhausted      stop
 45           SDC abort: deterministic replica divergence   stop
              or per-device strike budget exhausted
+46           capacity abort: healthy devices stayed below  stop
+             --min_devices for the whole restart budget
 other        crash (segfault, OOM, fault injection, ...)   retry
 ===========  ============================================  =========
 """
@@ -47,6 +60,7 @@ other        crash (segfault, OOM, fault injection, ...)   retry
 from __future__ import annotations
 
 import math
+import random
 import signal
 import subprocess
 import sys
@@ -55,17 +69,83 @@ from typing import Callable, List, Optional, Sequence
 
 EXIT_OK = 0
 EXIT_HANG = 42      # utils.watchdog.HangWatchdog
-EXIT_PEER = 43      # a collective raised (see tests/faulty_child.py)
+EXIT_PEER = 43      # a collective raised/timed out, or world formation
+                    # failed (parallel.mesh typed errors)
 EXIT_ANOMALY = 44   # ResilienceMonitor exhausted its rollback budget
 EXIT_SDC = 45       # deterministic replica divergence / SDC strike budget
+EXIT_CAPACITY = 46  # healthy capacity stayed below --min_devices
 
 # exit codes the supervisor must NOT retry: 0 is success; 44 and 45 are
-# deterministic training failures that a relaunch would only replay
-_NO_RETRY = (EXIT_OK, EXIT_ANOMALY, EXIT_SDC)
+# deterministic training failures that a relaunch would only replay; 46
+# means the hardware floor cannot be met — relaunching cannot create chips
+_NO_RETRY = (EXIT_OK, EXIT_ANOMALY, EXIT_SDC, EXIT_CAPACITY)
+
+# exit codes that count toward the elastic peer-loss streak: explicit
+# peer loss, and hangs (a dead peer often presents as a stalled
+# collective killed by the watchdog/heartbeat monitor, exit 42)
+_PEER_LOSS_CODES = (EXIT_PEER, EXIT_HANG)
 
 
 class AnomalyAbort(RuntimeError):
     """Training diverged past the rollback budget; maps to exit 44."""
+
+
+class CapacityAbort(RuntimeError):
+    """The healthy world is smaller than ``--min_devices`` and cannot be
+    relaunched into compliance; maps to exit 46 — the supervisor does not
+    retry (a relaunch cannot create chips; an operator/autoscaler must)."""
+
+
+# substrings that mark a raised exception as peer/transport loss — the
+# failure class the CLI converts to EXIT_PEER so (a) the supervisor's
+# exit-code contract sees 43 instead of an anonymous crash and (b) the
+# elastic streak counts it.  Name-based plus message-based: the concrete
+# types (XlaRuntimeError, gloo's RuntimeError) live in jaxlib and vary by
+# version, and this module must not import them.
+_PEER_ERROR_TYPES = ("XlaRuntimeError", "CollectiveTimeout",
+                     "WorldFormationError", "CoordinatorUnreachable",
+                     "PeerMissing")
+# multi-word / suffixed phrases only: a bare "peer"/"connection"/
+# "unavailable" would misread ordinary crashes (a FileNotFoundError whose
+# path contains "peer", a "CUDA unavailable" backend error) as peer loss
+# and burn the restart budget — or worse, the elastic shrink streak — on
+# a bug a relaunch can never fix
+_PEER_ERROR_MARKERS = ("gloo", "all-reduce", "allreduce",
+                       "broken pipe", "connection reset",
+                       "connection refused", "connection closed",
+                       "closed by peer", "lost peer", "connect failed",
+                       "failed to connect", "recv failure", "recv error",
+                       "deadline exceeded", "unavailable:",
+                       "socket closed", "socket timeout",
+                       "barrier timed out", "heartbeat timed out",
+                       "coordinator unreachable", "peer down")
+# ...and statuses that are NEVER transport, checked first: an OOM also
+# arrives as XlaRuntimeError, and reading it as peer loss feeds the
+# elastic shrink streak — where the default global-batch policy then
+# GROWS per-device rows, making the relaunch OOM harder, in a loop
+_NON_PEER_MARKERS = ("resource_exhausted", "out of memory",
+                     "out-of-memory", "invalid_argument",
+                     "failed_precondition", "permission_denied")
+
+
+def is_peer_error(exc: BaseException) -> bool:
+    """Does this exception look like a lost/unreachable peer rather than
+    a software crash?  Used by the CLI to map an escaped collective/
+    world-formation failure to exit 43.  Deliberately biased toward
+    classifying AS peer loss: both classes are retried, and the only
+    behavioral difference is that 43 counts toward the elastic
+    probe-and-shrink streak — the correct reaction to a repeated
+    ambiguous transport failure anyway.  Non-transport statuses
+    (RESOURCE_EXHAUSTED, INVALID_ARGUMENT, ...) beat the type match:
+    they name a deterministic local failure even when the carrier type
+    is the same XlaRuntimeError a dead peer raises."""
+    msg = str(exc).lower()
+    if any(m in msg for m in _NON_PEER_MARKERS):
+        return False
+    for klass in type(exc).__mro__:
+        if klass.__name__ in _PEER_ERROR_TYPES:
+            return True
+    return any(m in msg for m in _PEER_ERROR_MARKERS)
 
 
 class SDCAbort(RuntimeError):
@@ -212,10 +292,14 @@ class GracefulShutdown:
 
 
 def strip_supervisor_flags(argv: Sequence[str]) -> List[str]:
-    """Remove ``--supervise [N]`` / ``--supervise_backoff [S]`` from an argv
-    so the supervised child runs the plain training entrypoint (handles
-    both ``--flag value`` and ``--flag=value`` forms)."""
-    flags = ("--supervise", "--supervise_backoff")
+    """Remove the supervisor-only flags (``--supervise [N]``,
+    ``--supervise_backoff [S]``, ``--supervise_backoff_max [S]``) from an
+    argv so the supervised child runs the plain training entrypoint
+    (handles both ``--flag value`` and ``--flag=value`` forms).  The
+    elastic flags (``--elastic``, ``--min_devices``) deliberately STAY:
+    the child enforces the capacity floor itself (exit 46) even when its
+    supervisor is a dumb generic wrapper."""
+    flags = ("--supervise", "--supervise_backoff", "--supervise_backoff_max")
     out: List[str] = []
     skip = False
     for tok in argv:
@@ -229,6 +313,88 @@ def strip_supervisor_flags(argv: Sequence[str]) -> List[str]:
             continue
         out.append(tok)
     return out
+
+
+# world-configuration env keys the degraded relaunch rewrites (mirrors
+# parallel/mesh.py's channel; duplicated as STRINGS so this module stays
+# importable on jax-less ops hosts)
+_COORD_ENV_KEYS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
+_NUM_PROCESSES_ENV = "NNPT_NUM_PROCESSES"
+_PROCESS_ID_ENV = "NNPT_PROCESS_ID"
+DEGRADED_ENV = "NNPT_ELASTIC_DEGRADED"  # marks a shrunken-world child
+
+
+def degrade_env(env: dict, probe: dict) -> dict:
+    """Rewrite a child environment to the probed (shrunken) world: the
+    coordinator rendezvous is dropped entirely and the child forms a
+    single-process local world.  Returns the same dict, mutated.
+
+    Only collapse-to-single-process is supported — every shipped probe
+    (``probe_world``'s local fallback, :func:`default_probe`) reports
+    ``n_processes=1`` when degraded; a degraded but still-multi-process
+    world would need surviving-rank reassignment no local probe can
+    answer (which rank dropped out?), so that case raises instead of
+    relaunching a child with a stale, possibly out-of-range
+    ``NNPT_PROCESS_ID``."""
+    n_proc = int(probe.get("n_processes", 1))
+    if n_proc > 1:
+        raise ValueError(
+            "degraded multi-process worlds are unsupported (probe "
+            f"reported n_processes={n_proc}): surviving peer ranks "
+            "cannot be reassigned from a local probe")
+    for k in _COORD_ENV_KEYS:
+        env.pop(k, None)
+    env[_NUM_PROCESSES_ENV] = "1"
+    env[_PROCESS_ID_ENV] = "0"
+    env[DEGRADED_ENV] = str(int(probe.get("n_devices", 0)))
+    return env
+
+
+_PROBE_LOCAL_SRC = (
+    "import jax, json; print('PROBE_WORLD|' + json.dumps("
+    "{'n_processes': jax.process_count(), "
+    "'n_devices': jax.device_count(), "
+    "'local_devices': jax.local_device_count()}))"
+)
+
+
+def default_probe(timeout_s: float = 60.0,
+                  env: Optional[dict] = None) -> Optional[dict]:
+    """LOCAL capacity probe for the generic supervisor: a subprocess (jax
+    only there — this module stays importable without it) reports this
+    host's healthy device count under a hard timeout.  Coordinator env
+    keys are stripped so the probe can never block on a dead rendezvous;
+    the coordinator-aware probe is ``parallel.mesh.probe_world`` (the
+    integrated CLI wires that one).  Returns the probe dict or None.
+
+    A local probe of a formerly-multi-process world is by definition a
+    DEGRADED view (mirroring ``probe_world``'s ``degraded =
+    bool(coordinator_address)``): it reports ``degraded=True`` whenever
+    the environment had configured a bigger world, so the supervisor's
+    elastic path actually rewrites the child env instead of looping the
+    dead rendezvous forever."""
+    import os
+
+    env = dict(os.environ if env is None else env)
+    had_world = (any(k in env for k in _COORD_ENV_KEYS)
+                 or int(env.get(_NUM_PROCESSES_ENV) or 1) > 1)
+    for k in _COORD_ENV_KEYS:
+        env.pop(k, None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        out = subprocess.run([sys.executable, "-c", _PROBE_LOCAL_SRC],
+                             capture_output=True, text=True, env=env,
+                             timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PROBE_WORLD|"):
+            import json
+
+            res = json.loads(line.split("|", 1)[1])
+            res["degraded"] = had_world
+            return res
+    return None
 
 
 def heartbeat_age_s(path: str, now: Optional[float] = None
@@ -273,23 +439,24 @@ def _ckpt_manifest():
 
 
 def _restore_target(ckpt_dir: str):
-    """(step, n_bad): newest snapshot passing FULL manifest verification,
-    plus how many NEWER generations fail it — exactly the set the child's
-    restore will quarantine on its way down the chain.  Walks newest-first
-    and stops hashing at the first verified generation (restore's own
-    discipline: with multi-GB snapshots, sha256ing every older generation
-    would add minutes of supervisor downtime per relaunch for one log
-    line).  The verification itself is utils.ckpt_manifest — stdlib-only,
-    same logic tools/ckpt_fsck.py runs — so the supervisor reports what a
-    relaunch will actually resume from, not what merely exists on disk."""
+    """(step, n_bad, path): newest snapshot passing FULL manifest
+    verification, plus how many NEWER generations fail it — exactly the
+    set the child's restore will quarantine on its way down the chain.
+    Walks newest-first and stops hashing at the first verified generation
+    (restore's own discipline: with multi-GB snapshots, sha256ing every
+    older generation would add minutes of supervisor downtime per
+    relaunch for one log line).  The verification itself is
+    utils.ckpt_manifest — stdlib-only, same logic tools/ckpt_fsck.py runs
+    — so the supervisor reports what a relaunch will actually resume
+    from, not what merely exists on disk."""
     cm = _ckpt_manifest()
     bad = 0
     for step, path in reversed(cm.snapshot_steps(ckpt_dir)):
         if cm.verify(path):
             bad += 1
         else:
-            return step, bad
-    return None, bad
+            return step, bad, path
+    return None, bad, None
 
 
 def _run_child(cmd: Sequence[str], env: Optional[dict],
@@ -360,17 +527,45 @@ def supervise(cmd: Sequence[str], max_restarts: int,
               heartbeat_timeout: float = 0.0,
               postmortem_path: Optional[str] = None,
               ckpt_dir: Optional[str] = None,
-              _sleep: Callable[[float], None] = time.sleep) -> int:
+              jitter: float = 0.5,
+              elastic: bool = False,
+              min_devices: int = 0,
+              probe: Optional[Callable[[], Optional[dict]]] = None,
+              elastic_after: int = 2,
+              _sleep: Callable[[float], None] = time.sleep,
+              _rand: Callable[[], float] = random.random) -> int:
     """Run ``cmd`` under the crash-restart policy; return the final exit
     code.
 
     ``max_restarts`` bounds RELAUNCHES (the initial launch is free).  Exit
-    0 and exit 44 stop immediately (see the module exit-code contract);
-    anything else — watchdog 42, peer-loss 43, crashes, signal deaths
-    (negative returncodes) — is retried with exponential backoff
-    ``backoff * 2^k`` capped at ``backoff_cap`` seconds.  The relaunched
-    command is identical; resume-from-newest-snapshot is the child's job
-    (``cli`` appends ``--resume`` when a checkpoint dir is configured).
+    0, 44, 45 and 46 stop immediately (see the module exit-code
+    contract); anything else — watchdog 42, peer-loss 43, crashes, signal
+    deaths (negative returncodes) — is retried with exponential backoff
+    ``backoff * 2^k`` capped at ``backoff_cap`` seconds and multiplied by
+    a uniform jitter in ``[1-jitter, 1]`` — downward only, so
+    ``backoff_cap`` stays a HARD upper bound an operator can size against
+    a preemption-notice window, and the spread survives at the cap (an
+    upward jitter clamped to the cap re-synchronizes every host at
+    exactly ``backoff_cap`` once the doubling saturates): every host of a
+    pod relaunches after the same failure, and pure deterministic
+    doubling would hammer a recovering coordinator with a thundering herd
+    at the exact same instants.  The relaunched command is identical;
+    resume-from-newest-snapshot is the child's job (``cli`` appends
+    ``--resume`` when a checkpoint dir is configured).
+
+    ``elastic``: after ``elastic_after`` CONSECUTIVE peer-loss exits
+    (43/42 — a world that keeps failing to re-form), run ``probe`` (a
+    bounded topology discovery, e.g. ``parallel.mesh.probe_world``;
+    defaults to the local :func:`default_probe`) and relaunch at the
+    probed world: a degraded probe rewrites the child's world env
+    (:func:`degrade_env`) so the child forms the SMALLER world and rides
+    its elastic restore path.  A probe below ``min_devices`` parks and
+    re-polls with the same backoff, consuming the restart budget;
+    exhausting it returns :data:`EXIT_CAPACITY` (46, no-retry).  Only
+    the supervisor of the original rank 0 ever degrades: two partition
+    survivors independently relaunching as single-process leaders would
+    split-brain the shared checkpoint dir, so non-zero ranks are fenced
+    to same-world retries.
 
     ``heartbeat_path`` + ``heartbeat_timeout`` arm the external hang
     detector (see :func:`_run_child`).  ``postmortem_path``: when a child
@@ -383,12 +578,31 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     """
     if log is None:
         log = lambda m: print(m, file=sys.stderr, flush=True)
+
+    def next_delay(restarts_used: int) -> float:
+        d = min(backoff * (2.0 ** restarts_used), backoff_cap)
+        if jitter > 0:
+            d *= 1.0 - jitter * _rand()
+        return d
+
     attempt = 0
+    peer_streak = 0
+    child_env = dict(env) if env is not None else None
+    # original world configuration, for grow-back: a degraded relaunch
+    # rewrites child_env, and a LATER probe that finds the full world
+    # healthy again must restore these keys — otherwise the child keeps
+    # forming the small world while the log reports the full topology
+    import os as _os
+
+    _world_keys = _COORD_ENV_KEYS + (_NUM_PROCESSES_ENV, _PROCESS_ID_ENV)
+    orig_world = {k: (env if env is not None else _os.environ).get(k)
+                  for k in _world_keys}
     while True:
         attempt += 1
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
         launched = time.time()
-        rc = _run_child(cmd, env, heartbeat_path, heartbeat_timeout, log)
+        rc = _run_child(cmd, child_env, heartbeat_path, heartbeat_timeout,
+                        log)
         # any ABNORMAL exit — including the no-retry anomaly abort (44),
         # whose dump is the flagship black-box case — gets the pointer
         if rc != EXIT_OK and postmortem_path:
@@ -408,24 +622,32 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                 log("[supervise] child exited 45 (SDC abort): "
                     "deterministic replica divergence or device strike "
                     "budget exhausted — not retrying")
+            elif rc == EXIT_CAPACITY:
+                log("[supervise] child exited 46 (capacity abort): the "
+                    "healthy world is below --min_devices — not retrying "
+                    "(a relaunch cannot create chips)")
             else:
                 log("[supervise] child completed (exit 0)")
             return rc
+        peer_streak = peer_streak + 1 if rc in _PEER_LOSS_CODES else 0
         restarts_used = attempt - 1
         if restarts_used >= max_restarts:
             log(f"[supervise] giving up: {max_restarts} restarts exhausted "
                 f"(last exit {rc})")
             return rc
-        delay = min(backoff * (2.0 ** restarts_used), backoff_cap)
+        delay = next_delay(restarts_used)
         reason = {EXIT_HANG: "watchdog hang",
                   EXIT_PEER: "peer loss"}.get(rc, "crash")
         log(f"[supervise] child exit {rc} ({reason}); relaunching in "
             f"{delay:.1f}s ({restarts_used + 1}/{max_restarts})")
         if ckpt_dir:
-            step, bad = _restore_target(ckpt_dir)
+            step, bad, path = _restore_target(ckpt_dir)
             if step is not None:
+                cm = _ckpt_manifest()
+                world = cm.world_line(cm.snapshot_meta(path))
                 log(f"[supervise] relaunch resumes from verified snapshot "
                     f"step {step}"
+                    + (f" [{world}]" if world else "")
                     + (f" ({bad} unverified generation(s) will be "
                        "quarantined on restore)" if bad else ""))
             else:
@@ -448,3 +670,107 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                         + (f" ({bad} unverified generation(s) — "
                            "tools/ckpt_fsck.py)" if bad else ""))
         _sleep(delay)
+        # ---- elastic probe-and-shrink (DESIGN.md §10) --------------------
+        # only after REPEATED peer loss: one 43 can be a transient blip a
+        # same-world retry absorbs; a streak means the old world cannot
+        # re-form and looping the relaunch through world_setup forever is
+        # the exact failure mode this policy exists to break.
+        if not (elastic and peer_streak >= elastic_after):
+            continue
+        # split-brain fence: during a partition EVERY surviving host's
+        # supervisor reaches this point, and each local probe reports a
+        # degraded single-process world — if all of them relaunched as
+        # process 0, two divergent leaders would interleave writes over
+        # the same shared checkpoint dir.  Only the supervisor of the
+        # ORIGINAL rank 0 may continue alone, and rank 0 must be
+        # POSITIVELY identified: a multi-process world whose rank came
+        # from some other channel (no NNPT_PROCESS_ID) fences too —
+        # "every host assumes it is rank 0" is exactly the split brain.
+        # The others retry at the current world until their budget runs
+        # out (an operator, or the healed rank 0, owns the next move).
+        orig_multi = (any(orig_world.get(k) for k in _COORD_ENV_KEYS)
+                      or int(orig_world.get(_NUM_PROCESSES_ENV) or 1) > 1)
+        pid_raw = orig_world.get(_PROCESS_ID_ENV)
+        if orig_multi and (pid_raw is None or int(pid_raw) != 0):
+            log("[supervise] elastic: original rank "
+                f"{'unknown (no ' + _PROCESS_ID_ENV + ')' if pid_raw is None else pid_raw}"
+                " is fenced from degraded relaunch (only a positively-"
+                "identified rank 0 may continue as a shrunken world — "
+                "two partition survivors must not both become single-"
+                "process leaders over the same checkpoint dir); "
+                "retrying at the current world")
+            continue
+        prober = probe if probe is not None else default_probe
+        floor = max(1, int(min_devices))
+        parked = False
+        while True:
+            res = prober()
+            if res is None and not parked:
+                # no topology answer and no evidence of a shortfall:
+                # retrying at the current world is the conservative move
+                # (the streak is kept, so the next loss re-probes)
+                log("[supervise] elastic probe failed (no topology "
+                    "answer); retrying at the current world")
+                break
+            n = int(res.get("n_devices", 0)) if res is not None else -1
+            if res is not None and n >= floor:
+                if res.get("degraded"):
+                    try:
+                        child_env = degrade_env(
+                            dict(child_env if child_env is not None
+                                 else _os.environ), res)
+                    except ValueError as e:
+                        # keep the streak (like the probe-failure path):
+                        # the next peer loss re-probes immediately
+                        log(f"[supervise] {e}; retrying at the current "
+                            "world")
+                        break
+                    log(f"[supervise] topology probe: {n} healthy "
+                        f"device(s) across "
+                        f"{res.get('n_processes', '?')} process(es) — "
+                        "relaunching at the DEGRADED world")
+                else:
+                    log(f"[supervise] topology probe: {n} healthy "
+                        f"device(s) across "
+                        f"{res.get('n_processes', '?')} process(es)")
+                    if (child_env is not None
+                            and DEGRADED_ENV in child_env):
+                        # grow-back: the probe formed the FULL world
+                        # again after a degraded relaunch — restore the
+                        # original world configuration so the child
+                        # actually rejoins it (the elastic restore path
+                        # reshards 2->4 too)
+                        for k, v in orig_world.items():
+                            if v is None:
+                                child_env.pop(k, None)
+                            else:
+                                child_env[k] = v
+                        child_env.pop(DEGRADED_ENV, None)
+                        log("[supervise] probe reports the full world "
+                            "healthy: restoring the original topology "
+                            "for the relaunch (grow-back)")
+                peer_streak = 0
+                break
+            # capacity below the floor — or, once PARKED, a transient
+            # probe failure (relaunching on it would let the child's own
+            # floor check convert a known shortfall into a permanent
+            # no-retry exit 46): park and re-poll with backoff,
+            # consuming the restart budget so a floor that can never be
+            # met terminates as a typed no-retry exit instead of an
+            # infinite poll loop
+            parked = True
+            shown = (f"{n} healthy device(s)" if res is not None
+                     else "no topology answer (probe failed)")
+            attempt += 1
+            restarts_used = attempt - 1
+            if restarts_used >= max_restarts:
+                log(f"[supervise] capacity shortfall: probe found "
+                    f"{shown} < --min_devices {floor} and the "
+                    f"restart budget is exhausted — exiting "
+                    f"{EXIT_CAPACITY} (capacity abort)")
+                return EXIT_CAPACITY
+            delay = next_delay(restarts_used)
+            log(f"[supervise] capacity shortfall: {shown} "
+                f"< --min_devices {floor}; re-probing in {delay:.1f}s "
+                f"({restarts_used + 1}/{max_restarts})")
+            _sleep(delay)

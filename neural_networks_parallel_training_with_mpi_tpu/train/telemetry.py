@@ -525,6 +525,29 @@ class Telemetry:
         # tail shows whether the run kept training past the incident
         self.recorder.arm_dump("sdc")
 
+    def on_topology(self, step: int, change: Dict[str, Any]) -> None:
+        """An elastic topology change (train/trainer.py's preflight): the
+        run resumed on a different world than the one that saved its
+        checkpoint.  Not a failure — no postmortem — but it IS the moment
+        the effective batch/accumulation semantics may have changed, so
+        the record goes into the metrics stream (``kind: "topology"``,
+        rendered by tools/metrics_summary.py) and the flight-recorder
+        ring (a later postmortem should show the run was degraded)."""
+        if not self.enabled:
+            return
+        rec = {"kind": "topology", "step": int(step),
+               "t": round(time.perf_counter() - self._t0, 6), **change}
+        self.recorder.event(
+            "topology", int(step),
+            from_devices=(change.get("from_world") or {}).get("n_devices"),
+            to_devices=(change.get("to_world") or {}).get("n_devices"),
+            policy=change.get("policy"),
+            batch_size=change.get("batch_size"),
+            accum_steps=change.get("accum_steps"))
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
     def on_preempted(self, signum: int, step: int) -> None:
         if not self.enabled:
             return

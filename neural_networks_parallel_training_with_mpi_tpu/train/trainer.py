@@ -35,6 +35,20 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, data=None):
         self.cfg = cfg
         world_setup()
+        # capacity floor (DESIGN.md §10): a world below --min_devices must
+        # not train at all — exit 46 (no-retry) instead of running a
+        # degraded job the operator said is too small to be useful
+        if cfg.min_devices and jax.device_count() < cfg.min_devices:
+            from .resilience import CapacityAbort
+
+            raise CapacityAbort(
+                f"{jax.device_count()} healthy device(s) < --min_devices "
+                f"{cfg.min_devices}: refusing to train below the capacity "
+                "floor (exit 46; raise capacity or lower --min_devices)")
+        if cfg.collective_timeout > 0:
+            from ..parallel import distributed
+
+            distributed.set_collective_timeout(cfg.collective_timeout)
         self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
         self.seq_parallel = self.mesh.shape.get("seq", 1) > 1
         self.pipeline = self.mesh.shape.get("pipe", 1) > 1
@@ -227,6 +241,19 @@ class Trainer:
         self.batch_axes = (("data", "fsdp", "expert")
                            if (self.expert or self.ep_tp)
                            else ("data", "fsdp"))
+        # elastic preflight (DESIGN.md §10): an elastic resume whose
+        # checkpoint was saved by a DIFFERENT dp width applies the batch
+        # policy BEFORE the loader/schedule/step builders are constructed,
+        # so every downstream consumer sees the adjusted config
+        self._topology_change = None
+        self._restored_world = None
+        # maps the CONTINUING global step counter onto this loader's
+        # (epoch, in-epoch) position after an elastic batch-size change:
+        # position_steps = step + _step_offset (0 except on that path);
+        # _resume_plan keeps the (epoch, in-epoch step) the offset maps to
+        self._step_offset = 0
+        self._resume_plan = None
+        cfg = self.cfg = self._elastic_preflight(cfg)
         # striped attention: tokens reorder round-robin over the seq shards
         # (balanced causal blocks — parallel.sequence.striped_permutation);
         # the loader applies the permutation to inputs AND targets, so the
@@ -549,15 +576,89 @@ class Trainer:
             self.state = dp.replicate_state(state, self.mesh)
         return self.state
 
+    def _elastic_preflight(self, cfg: TrainConfig) -> TrainConfig:
+        """Detect a cross-world elastic resume BEFORE the loader and step
+        builders exist, and apply the ``--elastic_batch`` policy
+        (DESIGN.md §10).  Keyed to the newest VERIFIED generation — the
+        one restore() will actually land on — not merely the newest
+        committed one: a corrupt newest generation saved by a
+        different-sized world (say a degraded dp=2 save above healthy
+        dp=8 history) would otherwise derive the policy from metadata of
+        a snapshot that restore quarantines and falls back past.  The
+        extra checksum pass happens once per process start, on the same
+        chain restore re-verifies moments later."""
+        if not (cfg.elastic and cfg.resume and cfg.checkpoint_dir):
+            return cfg
+        import dataclasses
+        import math
+
+        from ..utils import checkpoint as ckpt
+
+        step = ckpt.newest_verified_step(cfg.checkpoint_dir)
+        meta = (ckpt.read_meta(cfg.checkpoint_dir, step=step)
+                if step is not None else None) or {}
+        saved = meta.get("saved_world") or {}
+        saved_dp = int(saved.get("dp") or 0)
+        new_dp = int(np.prod([self.mesh.shape[a]
+                              for a in self.batch_axes]))
+        if not saved_dp or saved_dp == new_dp:
+            return cfg
+        change = {
+            "from_world": saved,
+            "to_world": {"n_devices": jax.device_count(),
+                         "n_processes": jax.process_count(),
+                         "dp": new_dp},
+            "policy": cfg.elastic_batch,
+            "batch_size": [cfg.batch_size, cfg.batch_size],
+            "accum_steps": [cfg.accum_steps, cfg.accum_steps],
+        }
+        if cfg.elastic_batch == "per_device" and not cfg.full_batch:
+            # keep per-device rows: shrink/grow the global batch with the
+            # world; round to a multiple of the new dp so padding stays
+            # padding, never a silent second batch-size change
+            new_bs = max(new_dp,
+                         (round(cfg.batch_size * new_dp / saved_dp)
+                          // new_dp) * new_dp or new_dp)
+            change["batch_size"][1] = new_bs
+            cfg = dataclasses.replace(cfg, batch_size=new_bs)
+        elif cfg.elastic_batch == "global" and saved_dp > new_dp:
+            # keep the global batch: per-device rows grow by
+            # saved_dp/new_dp — raise grad accumulation by the same
+            # factor to bound per-device microbatch memory, but only
+            # when the per-shard rows stay divisible (accumulation
+            # reshapes the local shard into microbatches)
+            factor = math.ceil(saved_dp / new_dp)
+            new_accum = cfg.accum_steps * factor
+            bs = (self.data["x"].shape[0] if cfg.full_batch
+                  else cfg.batch_size)
+            per_shard = math.ceil(bs / new_dp)
+            if per_shard % new_accum == 0:
+                change["accum_steps"][1] = new_accum
+                cfg = dataclasses.replace(cfg, accum_steps=new_accum)
+        self._topology_change = change
+        log(f"[elastic] resuming a dp={saved_dp} checkpoint on dp="
+            f"{new_dp} ({saved.get('n_devices', '?')} -> "
+            f"{jax.device_count()} devices), policy="
+            f"{cfg.elastic_batch}: batch {change['batch_size'][0]} -> "
+            f"{change['batch_size'][1]}, accum "
+            f"{change['accum_steps'][0]} -> {change['accum_steps'][1]}")
+        return cfg
+
     def maybe_resume(self) -> int:
         """Restores state and returns the exact global step to resume from
         (checkpoint extension).  Mid-epoch checkpoints resume at the right
-        batch within the epoch — no step is replayed."""
+        batch within the epoch — no step is replayed.  Elastic resumes
+        onto a different world ride the reshard path (utils.checkpoint)
+        and, when the batch size changed with the world, re-derive the
+        (epoch, in-epoch step) start from the world-size-independent
+        ``consumed_samples`` meta so the sample stream stays a permutation
+        of the original epoch."""
         if not (self.cfg.resume and self.cfg.checkpoint_dir):
             return 0
         from ..utils import checkpoint as ckpt
 
-        restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
+        restored = ckpt.restore(self.cfg.checkpoint_dir, self.state,
+                                elastic=self.cfg.elastic)
         if restored is None:
             return 0
         restored = self._reconcile_qkv_tp(ckpt, restored)
@@ -571,7 +672,39 @@ class Trainer:
         meta = ckpt.read_meta(self.cfg.checkpoint_dir,
                               step=int(jax.device_get(self.state.step))) or {}
         self.loader.order_salt = int(meta.get("order_salt", 0))
-        return int(jax.device_get(self.state.step))
+        if self.cfg.elastic:
+            # topology lineage: a shrunken world's own saves must carry
+            # the ORIGINAL topology forward, not shadow it — propagate
+            # the oldest restored_world on record, else the saving world
+            self._restored_world = (meta.get("restored_world")
+                                    or meta.get("saved_world"))
+        start_step = int(jax.device_get(self.state.step))
+        self._remap_step_offset(meta, start_step)
+        return start_step
+
+    def _remap_step_offset(self, meta: dict, start_step: int) -> None:
+        """After a batch-size-changing elastic resume, map the restored
+        generation's step counter onto THIS loader's (epoch, in-epoch)
+        position via the world-size-independent ``consumed_samples``
+        meta.  Keyed to the generation actually restored — an anomaly
+        rollback that falls back to an older (possibly old-world)
+        snapshot must recompute the offset for THAT step, not keep the
+        one derived for the generation the run originally resumed."""
+        self._step_offset = 0
+        self._resume_plan = None
+        if (self._topology_change is None
+                or self._topology_change["batch_size"][0]
+                == self._topology_change["batch_size"][1]
+                or meta.get("consumed_samples") is None):
+            return
+        plan = self.loader.start_for_samples(
+            int(meta["consumed_samples"]))
+        spe = max(self.loader.steps_per_epoch, 1)
+        self._resume_plan = plan
+        self._step_offset = plan[0] * spe + plan[1] - start_step
+        log(f"[elastic] batch size changed with the world: resuming "
+            f"at epoch {plan[0]}, in-epoch step {plan[1]} from "
+            f"consumed_samples={meta['consumed_samples']}")
 
     def _place_restored(self, restored: TrainState) -> None:
         """Place a host-side restored state per this trainer's layout
@@ -619,12 +752,24 @@ class Trainer:
         restored = None
         if self.cfg.checkpoint_dir:
             ckpt.wait_pending()  # an in-flight async write may be newest
-            restored = ckpt.restore(self.cfg.checkpoint_dir, self.state)
+            # elastic rides along: right after a degraded relaunch the
+            # newest verified snapshot can still be the OLD world's
+            restored = ckpt.restore(self.cfg.checkpoint_dir, self.state,
+                                    elastic=self.cfg.elastic)
         if restored is None:
             self.init_state()  # no snapshot yet: back to step 0
+            self._step_offset = 0
+            self._resume_plan = None
         else:
             restored = self._reconcile_qkv_tp(ckpt, restored)
             self._place_restored(restored)
+            step = int(jax.device_get(self.state.step))
+            # the fallback chain may land on an OLDER generation than
+            # the one the elastic resume was keyed to: re-derive the
+            # step->position offset from that generation's meta
+            self._remap_step_offset(
+                ckpt.read_meta(self.cfg.checkpoint_dir, step=step) or {},
+                step)
         self.loader.order_salt += 1
         return int(jax.device_get(self.state.step))
 
@@ -873,11 +1018,30 @@ class Trainer:
             # rollback salt rides along so a supervised relaunch resumes
             # with the re-drawn data order instead of replaying a poison
             # window the in-process rollback already routed around.
+            # saved_world enriches checkpoint.current_world with the
+            # layout facts only the trainer knows (dp width, mesh shape,
+            # update sharding — what the cross-world reshard path keys
+            # off); restored_world carries the ORIGINAL topology lineage
+            # so a shrunken world's saves never shadow where the run
+            # started; consumed_samples is the world-size-independent
+            # progress coordinate an elastic resume with a different
+            # batch size maps through (DESIGN.md §10).
+            step_now = int(jax.device_get(self.state.step))
             extra = {"qkv_tp": (int(self.mesh.shape.get("tensor", 1))
                                 if (self.pipeline or self.sp_tp
                                     or self.ep_tp) else 1),
                      "order_salt": int(getattr(self.loader,
-                                               "order_salt", 0))}
+                                               "order_salt", 0)),
+                     "saved_world": {
+                         "dp": int(self.loader.dp),
+                         "mesh": {k: int(v)
+                                  for k, v in self.mesh.shape.items()},
+                         "update_sharding": self.cfg.update_sharding},
+                     "consumed_samples":
+                         self.loader.consumed_samples(
+                             step_now + self._step_offset)}
+            if self._restored_world:
+                extra["restored_world"] = self._restored_world
             if self.cfg.async_checkpoint and not final:
                 ckpt.save_async(self.cfg.checkpoint_dir, self.state,
                                 keep=self.cfg.checkpoint_keep,
@@ -895,7 +1059,13 @@ class Trainer:
             self.init_state()
         spe = max(self.loader.steps_per_epoch, 1)
         start_step = self.maybe_resume()
-        start_epoch = start_step // spe
+        # _step_offset is 0 except after an elastic resume whose batch
+        # size changed with the world — there the continuing step counter
+        # maps onto a different (epoch, in-epoch) position
+        start_epoch = (start_step + self._step_offset) // spe
+        if self._topology_change is not None:
+            self.telemetry.on_topology(
+                int(start_step), dict(self._topology_change))
         log(f"mesh: {describe(self.mesh)} | model: {cfg.model.arch} "
             f"({self.model.n_params():,} params) | "
             f"{self.loader.n} samples, {self.loader.steps_per_epoch} steps/epoch")
@@ -1001,7 +1171,7 @@ class Trainer:
                 # in-epoch offset, consumed by the first epoch iteration only
                 # (and re-seeded by a rollback); mirrors the old
                 # `epoch == start_epoch` special case
-                mid_epoch_start = start_step % spe
+                mid_epoch_start = (start_step + self._step_offset) % spe
                 while epoch < cfg.nepochs and not shutdown.requested:
                     log(f"Starting epoch {epoch + 1}")  # reference banner, :152
                     epoch_t0 = time.perf_counter()
@@ -1147,8 +1317,8 @@ class Trainer:
                             with watchdog.suspended():
                                 self.save()
                     if rolled_back:
-                        epoch = step // spe
-                        mid_epoch_start = step % spe
+                        epoch = (step + self._step_offset) // spe
+                        mid_epoch_start = (step + self._step_offset) % spe
                         continue
                     if shutdown.requested:
                         # graceful preemption: materialize the last loss, then

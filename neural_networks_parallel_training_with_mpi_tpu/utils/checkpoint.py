@@ -124,6 +124,17 @@ def _prune(d: Path, keep: int, trusted: Optional[Path] = None) -> None:
         shutil.rmtree(old, ignore_errors=True)
 
 
+def current_world() -> dict:
+    """The SAVING topology (DESIGN.md §10): recorded in every snapshot's
+    meta.json AND manifest so a later restore onto a different world can
+    (a) detect the mismatch before unpickling anything and (b) drive the
+    elastic reshard path.  Callers (the Trainer) merge in layout facts
+    only they know — dp shard count, mesh axis sizes, update_sharding."""
+    return {"n_devices": jax.device_count(),
+            "n_processes": jax.process_count(),
+            "local_devices": jax.local_device_count()}
+
+
 def save(directory: str, state: TrainState, keep: int = 3,
          extra_meta: Optional[dict] = None) -> Path:
     """Write ``<directory>/ckpt-<step>/``; prune to the newest ``keep``.
@@ -131,7 +142,11 @@ def save(directory: str, state: TrainState, keep: int = 3,
     ``extra_meta`` is merged into ``meta.json`` — callers record layout
     facts the pytree itself cannot express (e.g. the pipeline path's
     tensor-axis qkv column permutation, which is shape-preserving and
-    therefore undetectable at restore time without metadata).
+    therefore undetectable at restore time without metadata).  The saving
+    topology (``saved_world``) is always recorded — in meta.json and in
+    the manifest — so a restore onto a different device count knows what
+    it is loading; trainer callers enrich it with dp/mesh/update_sharding
+    facts and carry the ``restored_world`` lineage alongside.
 
     Safe for sharded (non-addressable) state: falls back to orbax, where
     every process participates and writes its own shards — callers must
@@ -141,11 +156,14 @@ def save(directory: str, state: TrainState, keep: int = 3,
     step = int(jax.device_get(state.step))
     d = Path(directory)
     target = d / f"{_CKPT_PREFIX}{step}"
+    extra = dict(extra_meta or {})
+    extra["saved_world"] = {**current_world(),
+                            **(extra.get("saved_world") or {})}
     if _is_fully_addressable(state):
         if jax.process_index() == 0:
-            _write_npz(d, step, jax.device_get(state), keep, extra_meta)
+            _write_npz(d, step, jax.device_get(state), keep, extra)
         return target
-    _write_orbax(d, target, step, state, extra_meta)
+    _write_orbax(d, target, step, state, extra)
     if jax.process_index() == 0:
         _prune(d, keep, trusted=target)
     return target
@@ -166,7 +184,9 @@ def _write_orbax(d: Path, target: Path, step: int, state: Any,
     if jax.process_index() == 0:
         (target / "meta.json").write_text(json.dumps(
             {"step": step, "format": "orbax", **(extra_meta or {})}))
-        ckpt_manifest.commit(target, {"step": step, "format": "orbax"})
+        ckpt_manifest.commit(target, {
+            "step": step, "format": "orbax",
+            "saved_world": (extra_meta or {}).get("saved_world")})
         ckpt_manifest.fsync_path(d)  # the ckpt-<step> dirent itself
 
 
@@ -200,7 +220,8 @@ def _write_npz(d: Path, step: int, host_state: Any, keep: int,
         if fault == "torn_ckpt":
             _die_torn(d, tmp, target, step)
         ckpt_manifest.commit(
-            tmp, {"step": step, "format": "npz", "leaves": len(leaves)})
+            tmp, {"step": step, "format": "npz", "leaves": len(leaves),
+                  "saved_world": (extra_meta or {}).get("saved_world")})
         if target.exists():
             shutil.rmtree(target)
         tmp.rename(target)
@@ -259,6 +280,9 @@ def save_async(directory: str, state: TrainState, keep: int = 3,
         return
     step = int(jax.device_get(state.step))
     host_state = jax.device_get(state)  # device sync happens here, once
+    extra_meta = dict(extra_meta or {})
+    extra_meta["saved_world"] = {**current_world(),
+                                 **(extra_meta.get("saved_world") or {})}
 
     def work():
         try:
@@ -319,6 +343,21 @@ def read_meta(directory: str, step: Optional[int] = None) -> Optional[dict]:
         return None
 
 
+def newest_verified_step(directory: str) -> Optional[int]:
+    """Step of the generation :func:`restore` will actually land on: the
+    newest committed snapshot whose FULL manifest-checksum pass is clean,
+    walking the same newest-first fallback chain restore follows.  None
+    when no generation verifies.  Callers that must key decisions to the
+    restored state BEFORE restore runs (the trainer's elastic batch
+    policy — a corrupt newest generation saved by a different-sized world
+    must not mis-derive it) use this instead of trusting the newest
+    committed meta."""
+    for s, p in reversed(_snapshot_dirs(Path(directory), committed=True)):
+        if not ckpt_manifest.verify(p):
+            return s
+    return None
+
+
 def verify(directory: str, step: Optional[int] = None) -> bool:
     """With ``step``: True when that generation carries a valid manifest
     AND every payload file matches its checksum.  With ``step=None``:
@@ -349,7 +388,8 @@ def _quarantine(path: Path, step: int, problems: List[str]) -> None:
 
 
 def restore(directory: str, template: Optional[TrainState] = None,
-            step: Optional[int] = None) -> Optional[TrainState]:
+            step: Optional[int] = None,
+            elastic: bool = False) -> Optional[TrainState]:
     """Load the newest VERIFIED (or a specific) snapshot; ``template`` (the
     freshly-initialized, placed state) gates structure/shape/dtype
     compatibility and, for orbax snapshots, provides the target shardings.
@@ -358,7 +398,16 @@ def restore(directory: str, template: Optional[TrainState] = None,
     generation that fails (torn write, bit rot, truncation) is quarantined
     and the chain falls back to the next-newest one — returning None only
     when no verified snapshot is left.  An explicit ``step=`` request
-    raises instead of silently substituting a different generation."""
+    raises instead of silently substituting a different generation.
+
+    ``elastic`` (DESIGN.md §10) arms the cross-world reshard path: a
+    snapshot whose ``saved_world`` differs from the current topology is
+    loaded anyway — replicated state is world-shape-independent (the host
+    pytree re-places under any mesh), zero1's flat per-dp-padded buffers
+    are re-padded for the new data-axis size (strictly zero padding moves;
+    a nonzero tail raises instead of dropping state), and orbax snapshots
+    reshard through the template's target shardings.  Without ``elastic``
+    a shape mismatch stays the loud error it always was."""
     _join_pending()  # never race an in-flight writer's pruning
     d = Path(directory)
     if jax.process_index() == 0:
@@ -380,7 +429,7 @@ def restore(directory: str, template: Optional[TrainState] = None,
                 f"checkpoint {match[0].name} fails verification: "
                 f"{'; '.join(problems)} — run tools/ckpt_fsck.py, or drop "
                 "step= to fall back to the newest verified snapshot")
-        return _load_snapshot(match[0], template)
+        return _load_snapshot(match[0], template, elastic)
     # a manifest-less dir NEWER than the newest committed generation is
     # torn-writer debris (quarantine it); one OLDER — or in a directory
     # with no committed generation at all — is indistinguishable from a
@@ -401,7 +450,7 @@ def restore(directory: str, template: Optional[TrainState] = None,
                     f"snapshot(s) untouched ({', '.join(maybe_legacy)}) — "
                     "pre-durability build? tools/ckpt_fsck.py --adopt "
                     "makes them restorable")
-            return _load_snapshot(path, template)
+            return _load_snapshot(path, template, elastic)
         if (not (path / ckpt_manifest.MANIFEST).exists()
                 and (path / "meta.json").exists()
                 and (newest_committed is None or s < newest_committed)):
@@ -420,24 +469,63 @@ def restore(directory: str, template: Optional[TrainState] = None,
     return None
 
 
-def _load_snapshot(path: Path, template: Optional[TrainState]
-                   ) -> TrainState:
+def _load_snapshot(path: Path, template: Optional[TrainState],
+                   elastic: bool = False) -> TrainState:
     meta = json.loads((path / "meta.json").read_text())
+    saved_world = meta.get("saved_world") or {}
+    if (elastic and saved_world
+            and saved_world.get("n_devices") != jax.device_count()):
+        log(f"checkpoint: elastic restore of a "
+            f"{saved_world.get('n_devices')}-device snapshot onto "
+            f"{jax.device_count()} device(s) ({path.name})")
     if meta.get("format") == "orbax":
         import orbax.checkpoint as ocp
 
         if template is None:
             raise ValueError("orbax restore requires a template state")
         with ocp.StandardCheckpointer() as ckptr:
+            # the template's shardings are the TARGET: orbax reads each
+            # process's needed byte ranges, so an M-device world restores
+            # an N-device snapshot natively (the orbax half of the
+            # elastic reshard path)
             return ckptr.restore(path.absolute() / "orbax", template)
-    return _restore_npz(path, template)
+    return _restore_npz(path, template, elastic=elastic, meta=meta)
 
 
-def _restore_npz(path: Path, template: Optional[TrainState]
-                 ) -> TrainState:
+def _repad_flat(saved: np.ndarray, new_len: int, leaf_idx: int
+                ) -> np.ndarray:
+    """Re-pad a zero1 flat state buffer for a new data-axis size: the
+    saved length is ``ceil(P/N)*N`` (P true entries + zero padding), the
+    target ``ceil(P/M)*M`` — only zeros may move.  A nonzero tail means
+    the buffer is NOT padding (wrong leaf, or a layout this path does not
+    understand) and truncating it would silently drop optimizer state —
+    raise instead."""
+    cur = np.asarray(saved)
+    if new_len < cur.shape[0]:
+        tail = cur[new_len:]
+        if np.any(tail != 0):
+            raise ValueError(
+                f"cannot reshard checkpoint leaf {leaf_idx}: truncating "
+                f"{cur.shape[0]} -> {new_len} would drop "
+                f"{int(np.count_nonzero(tail))} nonzero entries — not "
+                "zero1 padding; wrong model/optimizer config?")
+        return np.ascontiguousarray(cur[:new_len])
+    out = np.zeros((new_len,), cur.dtype)
+    out[:cur.shape[0]] = cur
+    return out
+
+
+def _restore_npz(path: Path, template: Optional[TrainState],
+                 elastic: bool = False,
+                 meta: Optional[dict] = None) -> TrainState:
     data = np.load(path / "state.npz")
     leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
     treedef = pickle.loads((path / "treedef.pkl").read_bytes())
+    # zero1's flat opt-state buffers are padded to a multiple of the
+    # SAVING world's data-axis size; under elastic restore a pure-padding
+    # length mismatch on a 1-D leaf is resharded, not rejected
+    zero1 = ((meta or {}).get("saved_world") or {}).get(
+        "update_sharding") == "zero1"
     if template is not None:
         t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
         if t_treedef != treedef:
@@ -446,17 +534,41 @@ def _restore_npz(path: Path, template: Optional[TrainState]
                 f"expected {t_treedef} — wrong model/optimizer config, or a "
                 "checkpoint written by an older framework version (e.g. "
                 "SGDState gained a 'count' field)?")
+        # only OPT-STATE leaves are zero1 flat buffers: a 1-D model
+        # param (bias, norm scale) whose length changed is a config
+        # mismatch that must refuse, not be silently zero-extended.
+        # TrainState flattens field-ordered (step, params, opt_state),
+        # so opt-state leaves are exactly the trailing ones.
+        opt_start = len(t_leaves)
+        if zero1 and hasattr(template, "opt_state"):
+            opt_start -= len(jax.tree_util.tree_leaves(
+                template.opt_state))
+        resharded = []
         for i, (saved, want) in enumerate(zip(leaves, t_leaves)):
             w_shape = tuple(np.shape(want))
-            if tuple(saved.shape) != w_shape:
-                raise ValueError(
-                    f"checkpoint leaf {i} shape {tuple(saved.shape)} != "
-                    f"expected {w_shape} — wrong model config?")
             w_dtype = np.dtype(getattr(want, "dtype",
                                        np.asarray(want).dtype))
+            if tuple(saved.shape) != w_shape:
+                if (elastic and zero1 and i >= opt_start
+                        and saved.ndim == 1
+                        and len(w_shape) == 1
+                        and np.dtype(saved.dtype) == w_dtype):
+                    leaves[i] = _repad_flat(saved, w_shape[0], i)
+                    resharded.append(i)
+                    continue
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {tuple(saved.shape)} != "
+                    f"expected {w_shape} — wrong model config?"
+                    + ("" if elastic else
+                       " (a zero1 snapshot from a different world size "
+                       "needs the elastic reshard path: --elastic)"))
             if np.dtype(saved.dtype) != w_dtype:
                 raise ValueError(
                     f"checkpoint leaf {i} dtype {np.dtype(saved.dtype)} != "
                     f"expected {w_dtype} — wrong precision/optimizer "
                     "config?")
+        if resharded:
+            log(f"checkpoint: resharded {len(resharded)} zero1 flat "
+                f"leaf/leaves for the new data-axis size (leaf "
+                f"{resharded[:4]}{'...' if len(resharded) > 4 else ''})")
     return jax.tree_util.tree_unflatten(treedef, leaves)

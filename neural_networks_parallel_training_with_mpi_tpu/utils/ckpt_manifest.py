@@ -163,6 +163,44 @@ def verify(snap_dir: Path) -> List[str]:
     return problems
 
 
+def snapshot_meta(snap_dir: Path) -> dict:
+    """The snapshot's ``meta.json`` dict ({} when absent/unreadable) —
+    the stdlib-side read shared by fsck and the supervisor's relaunch
+    report (the jax-side twin is utils.checkpoint.read_meta)."""
+    try:
+        meta = json.loads((Path(snap_dir) / "meta.json").read_text())
+    except (OSError, ValueError):
+        return {}
+    return meta if isinstance(meta, dict) else {}
+
+
+def world_line(meta: dict) -> str:
+    """One-line rendering of a snapshot's topology lineage for audit logs:
+    the SAVING world always, plus the world the run had originally
+    restored from when they differ (a shrunken world re-saving must not
+    silently shadow the original topology — DESIGN.md §10).  Empty string
+    for pre-elastic snapshots without world metadata."""
+    saved = meta.get("saved_world")
+    if not isinstance(saved, dict):
+        return ""
+
+    def fmt(w: dict) -> str:
+        parts = [f"{w.get('n_devices', '?')}d"]
+        if w.get("n_processes", 1) != 1:
+            parts.append(f"{w['n_processes']}p")
+        if w.get("dp"):
+            parts.append(f"dp={w['dp']}")
+        if w.get("update_sharding") not in (None, "replicated"):
+            parts.append(str(w["update_sharding"]))
+        return "/".join(parts)
+
+    line = f"saved_world {fmt(saved)}"
+    restored = meta.get("restored_world")
+    if isinstance(restored, dict) and restored != saved:
+        line += f", restored_world {fmt(restored)}"
+    return line
+
+
 def quarantine(snap_dir: Path) -> Path:
     """Rename a failed snapshot out of the restore namespace
     (``ckpt-8`` -> ``corrupt-ckpt-8``, ``.1``/``.2``... on collision) so

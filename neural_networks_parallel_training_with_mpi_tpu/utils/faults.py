@@ -59,6 +59,26 @@ through :meth:`FaultPlan.apply_state`):
                      divergence and must abort with EXIT_SDC (45) —
                      the deterministic-software-bug verdict.
 
+Capacity-loss faults against the elastic restart layer (DESIGN.md §10 —
+these drive the supervisor's probe-and-shrink policy end to end; all
+three honor ``proc=K`` to pick the victim process in a multi-host
+world):
+
+    ``peer_kill``    SIGKILL this process mid-run — no cleanup, no
+                     goodbye: the dead-host stand-in.  Survivors must
+                     fail fast (bounded collectives / watchdog -> exit
+                     42/43) and their elastic supervisor must probe and
+                     relaunch at the shrunken world.
+    ``peer_hang``    wedge this process in an uninterruptible host-side
+                     sleep — the frozen-host stand-in whose PEERS must
+                     convert the stalled collective into exit 43 (the
+                     victim's own watchdog may also fire, exit 42).
+    ``device_loss``  this process reports losing a local device: dump a
+                     postmortem and exit 43 (EXIT_PEER) — the runtime-
+                     lost-a-chip stand-in the supervisor retries or,
+                     under ``--elastic`` with repeated losses, degrades
+                     through a topology probe.
+
 options
     ``max=N``     fire at most N times over this process's lifetime
                   (in-memory counter) — lets a NaN window be *passable*
@@ -69,6 +89,9 @@ options
                   relaunch does not re-crash at the same step.
     ``param=``/``shard=``/``bit=``/``eps=``/``det``
                   SDC-fault knobs, see ``bitflip``/``desync`` above.
+    ``proc=K``    fire only on process index K (default: every process) —
+                  selects the victim of the capacity-loss kinds in a
+                  multi-host world.
 
 Steps are the Trainer's global step counter *about to be executed*; with
 ``--steps_per_dispatch k > 1`` the granularity is the dispatch boundary
@@ -87,10 +110,22 @@ from typing import Dict, List, Optional
 
 ENV_VAR = "NNPT_FAULTS"
 KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
-         "ckpt_ioerr", "bitflip", "desync")
+         "ckpt_ioerr", "bitflip", "desync", "peer_kill", "peer_hang",
+         "device_loss")
 # kinds that perturb the train state (FaultPlan.apply_state) rather than
 # the batch/process (FaultPlan.apply)
 STATE_KINDS = ("bitflip", "desync")
+
+
+def _process_index() -> int:
+    """This process's world rank (0 when jax is absent/uninitialized) —
+    lazy so parsing stays jax-free."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 @dataclasses.dataclass
@@ -105,6 +140,7 @@ class _Fault:
     bit: int = 12                 # bitflip: bit index within the element
     eps: float = 1e-3             # desync: perturbation magnitude
     det: bool = False             # desync: deterministic in-step variant
+    proc: Optional[int] = None    # fire only on this process index
     fires: int = 0
 
     def should_fire(self, step: int) -> bool:
@@ -157,6 +193,8 @@ def _parse_one(item: str) -> _Fault:
             fault.eps = float(val)
         elif key == "det":
             fault.det = True
+        elif key == "proc":
+            fault.proc = int(val)
         else:
             raise ValueError(f"unknown fault option {key!r} in {item!r}")
     if fault.det and kind != "desync":
@@ -347,6 +385,8 @@ class FaultPlan:
         """
         for f in self.faults:
             if (f.kind not in STATE_KINDS or f.det
+                    or (f.proc is not None
+                        and _process_index() != f.proc)
                     or not f.should_fire(step)):
                 continue
             target = (state.params if f.kind == "bitflip"
@@ -388,9 +428,41 @@ class FaultPlan:
         for f in self.faults:
             if f.kind in STATE_KINDS:
                 continue  # apply_state's job (det: step-build time)
+            if f.proc is not None and _process_index() != f.proc:
+                continue  # another process is the victim
             if not f.should_fire(step):
                 continue
             f.mark_fired()
+            if f.kind == "peer_kill":
+                # die like a dead host: SIGKILL, no cleanup, no goodbye —
+                # the peers' containment (bounded collectives/watchdog)
+                # and the elastic supervisor are what is under test
+                print(f"[faults] injected peer_kill at step {step}: "
+                      "SIGKILL (dead-host stand-in)", file=sys.stderr,
+                      flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+            if f.kind == "peer_hang":
+                print(f"[faults] injected peer_hang at step {step}: "
+                      "wedging this process (frozen-host stand-in)",
+                      file=sys.stderr, flush=True)
+                import time
+
+                while True:  # peers must contain; our watchdog may fire
+                    time.sleep(3600)
+            if f.kind == "device_loss":
+                print(f"[faults] injected device_loss at step {step}: "
+                      "reporting a lost local device, exiting 43",
+                      file=sys.stderr, flush=True)
+                try:
+                    from ..train import telemetry
+
+                    telemetry.emergency_dump(
+                        f"device_loss@{step} (injected)")
+                except Exception:
+                    pass
+                from ..train.resilience import EXIT_PEER
+
+                os._exit(EXIT_PEER)
             if f.kind in ("torn_ckpt", "ckpt_ioerr"):
                 from . import checkpoint as ckpt_lib
 

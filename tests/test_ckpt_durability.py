@@ -401,12 +401,15 @@ def test_resume_falls_back_to_verified(tmp_path, mesh8):
 
 def test_supervisor_restore_target_report(tmp_path):
     """resilience._restore_target: newest fully-verified step + count of
-    unverified generations (what the relaunch log prints)."""
-    assert res_lib._restore_target(str(tmp_path / "nope")) == (None, 0)
+    unverified generations (what the relaunch log prints) + the verified
+    generation's path (for the topology line)."""
+    assert res_lib._restore_target(str(tmp_path / "nope")) == (None, 0, None)
     for s in (1, 2, 3):
         ckpt.save(str(tmp_path), make_state(step=s), keep=0)
     _flip_bytes(tmp_path / "ckpt-3" / "state.npz")
-    assert res_lib._restore_target(str(tmp_path)) == (2, 1)
+    step, bad, path = res_lib._restore_target(str(tmp_path))
+    assert (step, bad) == (2, 1)
+    assert path.name == "ckpt-2"
 
 
 # ----------------------------------------------------------- fsck tool

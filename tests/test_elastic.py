@@ -1,0 +1,900 @@
+"""Elastic degraded-capacity restart (DESIGN.md §10).
+
+The load-bearing properties:
+
+* a world that cannot re-form raises TYPED errors with a bounded timeout
+  (``CoordinatorUnreachable`` vs ``PeerMissing``) instead of the native
+  fatal abort, so the supervisor's exit-43 peer-loss streak can drive
+  the elastic probe-and-shrink policy;
+* cross-world checkpoint resharding: an N-device snapshot restores onto
+  M != N devices bitwise-identically for replicated DP, and zero1's flat
+  per-dp-padded buffers re-pad without ever dropping a nonzero entry;
+* topology lineage: a shrunken world's own saves carry ``saved_world``
+  AND ``restored_world`` so they never shadow where the job started;
+* data-order continuity: ``consumed_samples`` is the world-size-
+  independent progress coordinate — a resumed run with a different batch
+  size walks the SAME per-epoch sample permutation;
+* the chaos lane proves the acceptance scenario end to end: peer_kill
+  mid-run -> supervised relaunch at world=1 -> resharded restore ->
+  finite loss -> exit 0; with --min_devices 2 the same scenario exits 46
+  without a degraded relaunch.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.config import (
+    DataConfig, MeshConfig, TrainConfig, build_argparser, config_from_args,
+)
+from neural_networks_parallel_training_with_mpi_tpu.data.loader import (
+    ShardedLoader,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+    distributed,
+)
+from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (
+    CoordinatorUnreachable, PeerMissing, WorldFormationError, make_mesh,
+    world_setup,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train import (
+    resilience,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+    Trainer,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import (
+    checkpoint as ckpt,
+    ckpt_manifest,
+    faults as faults_lib,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _mesh(devices, dp):
+    return make_mesh(MeshConfig(data=dp), devices=devices[:dp])
+
+
+def _cfg(dp, ckpt_dir, **kw):
+    base = dict(nepochs=1, full_batch=False, batch_size=8, lr=1e-3,
+                momentum=0.9, data=DataConfig(n_samples=64),
+                mesh=MeshConfig(data=dp), checkpoint_dir=str(ckpt_dir),
+                checkpoint_every=2, elastic=True, resume=True)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _host_leaves(state):
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(jax.device_get(state))]
+
+
+# ------------------------------------------------- exit-code contract
+
+
+def test_exit_capacity_pinned():
+    assert resilience.EXIT_CAPACITY == 46
+    assert resilience.EXIT_CAPACITY in resilience._NO_RETRY
+    # the elastic streak counts explicit peer loss AND watchdog hangs (a
+    # dead peer often presents as a stalled collective killed as 42)
+    assert set(resilience._PEER_LOSS_CODES) == {42, 43}
+
+
+def test_strip_supervisor_flags_keeps_elastic():
+    argv = ["--elastic", "--min_devices", "2", "--supervise", "3",
+            "--supervise_backoff_max=5", "--supervise_backoff", "1",
+            "--lr", "0.1"]
+    # the child keeps the elastic flags (it enforces the floor itself);
+    # only the supervisor-loop knobs are stripped
+    assert resilience.strip_supervisor_flags(argv) == [
+        "--elastic", "--min_devices", "2", "--lr", "0.1"]
+
+
+def test_is_peer_error_classification():
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert resilience.is_peer_error(XlaRuntimeError("INTERNAL: foo"))
+    assert resilience.is_peer_error(
+        ValueError("UNKNOWN: Gloo all-reduce failed: Connection reset"))
+    assert resilience.is_peer_error(PeerMissing("rank 1 missing"))
+    assert resilience.is_peer_error(CoordinatorUnreachable("down"))
+    assert resilience.is_peer_error(
+        distributed.CollectiveTimeout("barrier did not complete"))
+    assert not resilience.is_peer_error(ValueError("bad model config"))
+    assert not resilience.is_peer_error(ZeroDivisionError())
+    # ordinary crashes whose message merely CONTAINS a network-ish word
+    # must stay crashes (traceback, rc 1) — a bare-substring match here
+    # burned the restart budget, and the elastic shrink streak, on bugs
+    # a relaunch can never fix
+    assert not resilience.is_peer_error(
+        FileNotFoundError("No such file: /data/peer_reviews.npz"))
+    assert not resilience.is_peer_error(RuntimeError("CUDA unavailable"))
+    assert not resilience.is_peer_error(
+        ValueError("distributed loader misconfigured"))
+    assert not resilience.is_peer_error(
+        RuntimeError("deadline for run exceeded by scheduler"))
+    # non-transport statuses beat the type match: an OOM also arrives
+    # as XlaRuntimeError, and reading it as peer loss would feed the
+    # shrink streak (whose global-batch policy GROWS per-device rows)
+    assert not resilience.is_peer_error(
+        XlaRuntimeError("RESOURCE_EXHAUSTED: out of memory allocating "
+                        "1073741824 bytes"))
+    assert not resilience.is_peer_error(
+        XlaRuntimeError("INVALID_ARGUMENT: shape mismatch"))
+    # bare network-adjacent words are not enough either
+    assert not resilience.is_peer_error(
+        OSError("could not bind socket on port 8080"))
+    assert not resilience.is_peer_error(
+        RuntimeError("collective ops module failed to import"))
+    assert not resilience.is_peer_error(
+        ValueError("invalid coordinator_address format"))
+
+
+def test_degrade_env():
+    env = {"COORDINATOR_ADDRESS": "h:1", "JAX_COORDINATOR_ADDRESS": "h:1",
+           "NNPT_NUM_PROCESSES": "4", "NNPT_PROCESS_ID": "2", "KEEP": "x"}
+    out = resilience.degrade_env(env, {"n_processes": 1, "n_devices": 2})
+    assert out is env
+    assert "COORDINATOR_ADDRESS" not in out
+    assert "JAX_COORDINATOR_ADDRESS" not in out
+    assert out["NNPT_NUM_PROCESSES"] == "1"
+    assert out["NNPT_PROCESS_ID"] == "0"
+    assert out[resilience.DEGRADED_ENV] == "2"
+    assert out["KEEP"] == "x"
+    # a degraded multi-process world is unsupported (no probe can answer
+    # rank reassignment): refuse loudly rather than relaunch a child with
+    # a stale, possibly out-of-range NNPT_PROCESS_ID
+    env2 = {"COORDINATOR_ADDRESS": "h:1", "NNPT_NUM_PROCESSES": "4"}
+    with pytest.raises(ValueError, match="n_processes=2"):
+        resilience.degrade_env(env2, {"n_processes": 2, "n_devices": 4})
+
+
+# ------------------------------------------------------- supervisor
+
+
+def _run_supervise(code_seq, **kw):
+    """Drive supervise() with a scripted child; returns (rc, log lines,
+    per-launch envs, slept delays)."""
+    it = iter(code_seq)
+    envs, delays, logs = [], [], []
+
+    def fake_call(cmd, env=None):
+        envs.append(dict(env) if env is not None else None)
+        return next(it)
+
+    orig = resilience.subprocess.call
+    resilience.subprocess.call = fake_call
+    try:
+        rc = resilience.supervise(
+            ["x"], log=logs.append, _sleep=delays.append,
+            **{"max_restarts": 5, "backoff": 1.0, **kw})
+    finally:
+        resilience.subprocess.call = orig
+    return rc, logs, envs, delays
+
+
+def test_backoff_jitter_and_cap():
+    """Satellite: jittered exponential backoff, capped at backoff_cap —
+    a pod's worth of supervisors must not relaunch in lockstep.  Jitter
+    is DOWNWARD-only ([1-jitter, 1]) so the cap stays a hard bound and
+    the spread survives once the doubling saturates at the cap."""
+    rands = iter([0.0, 1.0, 0.5, 0.5, 1.0])
+    rc, _, _, delays = _run_supervise(
+        [1, 1, 1, 1, 1, 0], backoff=1.0, backoff_cap=4.0, jitter=0.5,
+        _rand=lambda: next(rands))
+    assert rc == 0
+    # base delays 1,2,4(cap),4(cap),4(cap); factors 1, 0.5, 0.75,
+    # 0.75, 0.5 — never above the cap, still spread AT the cap
+    assert delays == [1.0, 1.0, 3.0, 3.0, 2.0]
+    assert all(d <= 4.0 for d in delays)
+    # jitter=0 is the exact historical doubling
+    rc, _, _, delays = _run_supervise([1, 1, 0], backoff=1.0,
+                                      backoff_cap=60.0, jitter=0.0)
+    assert delays == [1.0, 2.0]
+
+
+def test_supervise_elastic_degrades_after_streak():
+    """Two consecutive peer-loss exits trigger the probe; a degraded
+    probe rewrites the child env to the shrunken world."""
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return {"n_processes": 1, "n_devices": 2, "local_devices": 2,
+                "degraded": True}
+
+    rc, logs, envs, _ = _run_supervise(
+        [43, 42, 0], elastic=True, min_devices=1, probe=probe, backoff=0.0,
+        env={"COORDINATOR_ADDRESS": "h:1", "NNPT_NUM_PROCESSES": "2",
+             "NNPT_PROCESS_ID": "0"})
+    assert rc == 0 and probes == [1]
+    assert "COORDINATOR_ADDRESS" not in envs[2]
+    assert envs[2]["NNPT_NUM_PROCESSES"] == "1"
+    assert any("DEGRADED" in m for m in logs)
+    # a lone peer loss followed by a crash never probes (streak resets)
+    probes.clear()
+    rc, _, _, _ = _run_supervise([43, 1, 43, 0], elastic=True, probe=probe,
+                                 backoff=0.0)
+    assert rc == 0 and probes == []
+
+
+def test_supervise_elastic_fences_nonzero_rank():
+    """Split-brain fence: during a partition EVERY surviving host's
+    supervisor sees a peer-loss streak and a degraded local probe — if
+    all of them relaunched as process 0, two divergent leaders would
+    interleave writes over the same shared checkpoint dir.  Only the
+    original rank 0 may continue alone; the rest retry at the current
+    world until their budget runs out."""
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return {"n_processes": 1, "n_devices": 2, "local_devices": 2,
+                "degraded": True}
+
+    rc, logs, envs, _ = _run_supervise(
+        [43] * 6, elastic=True, probe=probe, backoff=0.0,
+        env={"COORDINATOR_ADDRESS": "h:1", "NNPT_NUM_PROCESSES": "2",
+             "NNPT_PROCESS_ID": "1"})
+    assert rc == 43 and probes == []            # never probed, never shrank
+    assert all(e["COORDINATOR_ADDRESS"] == "h:1" for e in envs)
+    assert all(e["NNPT_PROCESS_ID"] == "1" for e in envs)
+    assert any("fenced from degraded relaunch" in m for m in logs)
+    # a multi-process world whose rank came from some OTHER channel
+    # (no NNPT_PROCESS_ID) fences too: "every host assumes it is rank
+    # 0" is exactly the split brain the fence exists to prevent
+    rc, logs, _, _ = _run_supervise(
+        [43] * 6, elastic=True, probe=probe, backoff=0.0,
+        env={"COORDINATOR_ADDRESS": "h:1", "NNPT_NUM_PROCESSES": "2"})
+    assert rc == 43 and probes == []
+    assert any("rank unknown" in m for m in logs)
+    # a single-process original world has no peers to split-brain with:
+    # degrading (fewer local devices) stays allowed
+    probes.clear()
+    rc, _, _, _ = _run_supervise(
+        [43, 43, 0], elastic=True, probe=probe, backoff=0.0, env={})
+    assert rc == 0 and probes == [1]
+
+
+def test_supervise_probe_failure_retries_same_world():
+    probes = []
+
+    def probe():
+        probes.append(1)
+        return None
+
+    rc, logs, envs, _ = _run_supervise(
+        [43, 43, 0], elastic=True, probe=probe, backoff=0.0,
+        env={"COORDINATOR_ADDRESS": "h:1", "NNPT_PROCESS_ID": "0"})
+    assert rc == 0 and probes == [1]
+    assert envs[2]["COORDINATOR_ADDRESS"] == "h:1"  # world unchanged
+    assert any("retrying at the current world" in m for m in logs)
+
+
+def test_supervise_capacity_exhaustion_exits_46():
+    """A probe that can never meet --min_devices parks, consumes the
+    restart budget, and exits 46 naming the shortfall."""
+    rc, logs, envs, delays = _run_supervise(
+        [43, 43], max_restarts=4, elastic=True, min_devices=4, backoff=0.0,
+        probe=lambda: {"n_processes": 1, "n_devices": 1,
+                       "local_devices": 1, "degraded": True})
+    assert rc == 46
+    assert len(envs) == 2  # never relaunched below the floor
+    assert any("capacity shortfall" in m and "--min_devices 4" in m
+               for m in logs)
+    assert any("exiting 46" in m for m in logs)
+
+
+def test_supervise_parked_probe_failure_keeps_parking():
+    """Once PARKED on a known shortfall, a transient probe failure must
+    keep parking (consuming the budget), not relaunch below the floor —
+    the child's own floor check would turn that relaunch into a
+    permanent no-retry exit 46 while capacity is merely slow to
+    return."""
+    answers = iter([
+        {"n_processes": 1, "n_devices": 1, "degraded": True},  # shortfall
+        None,                                                  # blip
+        {"n_processes": 1, "n_devices": 1, "degraded": True},  # shortfall
+        None,
+    ])
+    rc, logs, envs, _ = _run_supervise(
+        [43, 43], max_restarts=5, elastic=True, min_devices=2,
+        backoff=0.0, probe=lambda: next(answers))
+    assert rc == 46
+    assert len(envs) == 2           # never relaunched below the floor
+    assert any("no topology answer (probe failed)" in m for m in logs)
+    assert any("exiting 46 (capacity abort)" in m for m in logs)
+
+
+def test_supervise_does_not_retry_exit_46():
+    rc, logs, envs, _ = _run_supervise([46], elastic=False)
+    assert rc == 46 and len(envs) == 1
+    assert any("not retrying" in m for m in logs)
+
+
+# ------------------------------------------- world formation (typed)
+
+
+def test_world_setup_dead_coordinator_typed_error():
+    """Satellite regression: a dead coordinator address raises the TYPED
+    CoordinatorUnreachable within the timeout — never a hang, never the
+    native fatal abort (the preflight rendezvous fires before
+    jax.distributed.initialize can)."""
+    t0 = time.monotonic()
+    with pytest.raises(CoordinatorUnreachable) as ei:
+        world_setup(coordinator_address=f"127.0.0.1:{_free_port()}",
+                    num_processes=2, process_id=1, timeout_s=3)
+    assert time.monotonic() - t0 < 30
+    assert "coordinator" in str(ei.value).lower()
+    assert isinstance(ei.value, WorldFormationError)
+
+
+def test_world_setup_missing_peer_typed_error():
+    """The coordinator role distinguishes its failure mode: the peers
+    never checked in -> PeerMissing naming the missing ranks."""
+    with pytest.raises(PeerMissing) as ei:
+        world_setup(coordinator_address=f"127.0.0.1:{_free_port()}",
+                    num_processes=2, process_id=0, timeout_s=2)
+    assert "rank(s) [1]" in str(ei.value)
+
+
+def test_world_setup_busy_preflight_port_typed_error():
+    """A coordinator that cannot bind the preflight rendezvous port must
+    fail TYPED (exit-43 retryable), never silently skip: the peers still
+    require the rendezvous, so a one-sided skip would make a fully
+    healthy world unformable whenever coordinator_port+1 is taken."""
+    blocker = None
+    for _ in range(10):
+        port = _free_port()
+        blocker = socket.socket()
+        blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            blocker.bind(("", port + 1))
+            blocker.listen(1)
+            break
+        except OSError:
+            blocker.close()
+            blocker = None
+    assert blocker is not None, "no adjacent free port pair found"
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(WorldFormationError) as ei:
+            world_setup(coordinator_address=f"127.0.0.1:{port}",
+                        num_processes=2, process_id=0, timeout_s=2)
+        assert time.monotonic() - t0 < 30
+        assert str(port + 1) in str(ei.value)
+        assert "NNPT_PREFLIGHT_PORT" in str(ei.value)
+    finally:
+        blocker.close()
+
+
+def test_collective_timeout_bounded():
+    """distributed._bounded: the containment primitive under every
+    cross-host barrier/allgather — overruns raise CollectiveTimeout,
+    completions pass through, exceptions re-raise, 0 = inline."""
+    assert distributed._bounded(lambda: 7, "t", timeout_s=5.0) == 7
+    assert distributed._bounded(lambda: 7, "t", timeout_s=0) == 7
+    with pytest.raises(distributed.CollectiveTimeout):
+        distributed._bounded(lambda: time.sleep(30), "stall",
+                             timeout_s=0.2)
+    with pytest.raises(ValueError):
+        distributed._bounded(lambda: (_ for _ in ()).throw(
+            ValueError("boom")), "t", timeout_s=5.0)
+    # config plumbing: explicit override wins over env
+    distributed.set_collective_timeout(12.5)
+    try:
+        assert distributed.collective_timeout_s() == 12.5
+    finally:
+        distributed.set_collective_timeout(None)
+    os.environ[distributed.COLLECTIVE_TIMEOUT_ENV] = "3"
+    try:
+        assert distributed.collective_timeout_s() == 3.0
+    finally:
+        del os.environ[distributed.COLLECTIVE_TIMEOUT_ENV]
+
+
+def test_capacity_fault_kinds_parse():
+    plan = faults_lib.FaultPlan.parse(
+        "peer_kill@5?proc=1,peer_hang@7?proc=0,device_loss@3?once=/tmp/x")
+    kinds = {f.kind: f for f in plan.faults}
+    assert kinds["peer_kill"].proc == 1
+    assert kinds["peer_hang"].proc == 0
+    assert kinds["device_loss"].once_marker == "/tmp/x"
+    # proc-gating: a fault owned by another process never fires here
+    plan2 = faults_lib.FaultPlan.parse("peer_kill@1?proc=7")
+    plan2.apply(1, {})  # would SIGKILL this process if mis-gated
+
+
+# ------------------------------------------------- data-order continuity
+
+
+def test_consumed_samples_and_inverse(mesh8):
+    data = {"x": np.random.randn(64, 2).astype(np.float32),
+            "y": np.random.randn(64, 1).astype(np.float32)}
+    ld8 = ShardedLoader(mesh8, data, batch_size=8)
+    assert ld8.steps_per_epoch == 8
+    assert ld8.consumed_samples(0) == 0
+    assert ld8.consumed_samples(3) == 24
+    assert ld8.consumed_samples(8) == 64      # exactly one epoch
+    assert ld8.consumed_samples(11) == 64 + 24
+    # inverse under the SAME batch size: exact roundtrip
+    for step in (0, 3, 8, 11):
+        ep, st = ld8.start_for_samples(ld8.consumed_samples(step))
+        assert ep * ld8.steps_per_epoch + st == step
+    # a batch-size change rounds DOWN to the batch boundary (re-train up
+    # to bs-1 samples, never skip any)
+    ld16 = ShardedLoader(mesh8, data, batch_size=16)
+    assert ld16.start_for_samples(24) == (0, 1)   # 24 = 1.5 x 16
+    assert ld16.start_for_samples(64) == (1, 0)
+    assert ld16.start_for_samples(64 + 24) == (1, 1)
+
+
+def test_same_epoch_permutation_across_batch_sizes(mesh8):
+    """The world-size-independence claim itself: (seed, epoch, salt)
+    fully determine the per-epoch sample order, so loaders with
+    different batch sizes walk the SAME permutation."""
+    data = {"x": np.arange(64, dtype=np.float32).reshape(64, 1),
+            "y": np.zeros((64, 1), np.float32)}
+    a = ShardedLoader(mesh8, data, batch_size=8)
+    b = ShardedLoader(mesh8, data, batch_size=16)
+    a.order_salt = b.order_salt = 1234
+    np.testing.assert_array_equal(a._epoch_order(3), b._epoch_order(3))
+
+
+# ------------------------------------------- cross-world resharding
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dp_from,dp_to", [(4, 2), (2, 1), (2, 4)])
+def test_elastic_restore_replicated_bitwise(tmp_path, devices,
+                                            dp_from, dp_to):
+    """Satellite: params restored N->M (shrink AND grow-back) are
+    bitwise-identical to the saved host state for replicated DP."""
+    t_from = Trainer(_cfg(dp_from, tmp_path, resume=False),
+                     mesh=_mesh(devices, dp_from))
+    t_from.fit()
+    saved = _host_leaves(t_from.state)
+
+    t_to = Trainer(_cfg(dp_to, tmp_path), mesh=_mesh(devices, dp_to))
+    t_to.init_state()
+    assert t_to.maybe_resume() == 8
+    for a, b in zip(saved, _host_leaves(t_to.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_elastic_restore_zero1_reshard(tmp_path, devices):
+    """zero1's flat per-dp-padded buffers re-pad for the new data-axis
+    size; the reassembled-then-resharded state round-trips bitwise back
+    to the original world, and only zeros ever move."""
+    t4 = Trainer(_cfg(4, tmp_path, resume=False, update_sharding="zero1"),
+                 mesh=_mesh(devices, 4))
+    t4.fit()
+    saved = _host_leaves(t4.state)
+
+    d2 = tmp_path / "w2"
+    t2 = Trainer(_cfg(2, tmp_path, update_sharding="zero1"),
+                 mesh=_mesh(devices, 2))
+    t2.init_state()
+    assert t2.maybe_resume() == 8
+    # re-save from the shrunken world (the layout facts the Trainer's
+    # own save path would record)
+    ckpt.save(str(d2), t2.state,
+              extra_meta={"saved_world": {"dp": 2,
+                                          "update_sharding": "zero1"}})
+
+    # grow back 2 -> 4: bitwise round trip against the original state
+    t4b = Trainer(_cfg(4, str(d2), update_sharding="zero1"),
+                  mesh=_mesh(devices, 4))
+    t4b.init_state()
+    assert t4b.maybe_resume() == 8
+    for a, b in zip(saved, _host_leaves(t4b.state)):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_zero1_mismatch_refused_without_elastic(tmp_path, devices):
+    """Without --elastic a cross-world zero1 snapshot stays the loud
+    shape error it always was (and the message points at --elastic)."""
+    t4 = Trainer(_cfg(4, tmp_path, resume=False, update_sharding="zero1"),
+                 mesh=_mesh(devices, 4))
+    t4.fit()
+    t2 = Trainer(_cfg(2, tmp_path, update_sharding="zero1", elastic=False),
+                 mesh=_mesh(devices, 2))
+    t2.init_state()
+    with pytest.raises(ValueError, match="--elastic"):
+        ckpt.restore(str(tmp_path), t2.state, elastic=False)
+
+
+def test_zero1_repad_restricted_to_opt_state(tmp_path):
+    """The elastic repad gate applies ONLY to opt-state flat buffers: a
+    1-D model param (bias, norm scale) whose length changed is a config
+    mismatch that must refuse loudly, never be silently zero-extended."""
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import (
+        TrainState,
+    )
+
+    world = {"saved_world": {"dp": 4, "update_sharding": "zero1"}}
+    saved = TrainState(step=jnp.asarray(3, jnp.int32),
+                       params={"b": jnp.arange(4, dtype=jnp.float32)},
+                       opt_state={"m": jnp.arange(8, dtype=jnp.float32)})
+    ckpt.save(str(tmp_path), saved, extra_meta=world)
+
+    # an opt-state flat buffer growing for the new dp: reshards
+    grown_opt = TrainState(step=jnp.zeros((), jnp.int32),
+                           params={"b": jnp.zeros(4, jnp.float32)},
+                           opt_state={"m": jnp.zeros(12, jnp.float32)})
+    out = ckpt.restore(str(tmp_path), grown_opt, elastic=True)
+    np.testing.assert_array_equal(
+        np.asarray(out.opt_state["m"]),
+        np.concatenate([np.arange(8, dtype=np.float32),
+                        np.zeros(4, np.float32)]))
+
+    # the SAME length mismatch on a 1-D param leaf stays a loud error
+    grown_param = TrainState(step=jnp.zeros((), jnp.int32),
+                             params={"b": jnp.zeros(6, jnp.float32)},
+                             opt_state={"m": jnp.zeros(8, jnp.float32)})
+    with pytest.raises(ValueError, match="wrong model config"):
+        ckpt.restore(str(tmp_path), grown_param, elastic=True)
+
+
+def test_repad_flat_never_drops_state():
+    from neural_networks_parallel_training_with_mpi_tpu.utils.checkpoint import (  # noqa: E501
+        _repad_flat,
+    )
+
+    buf = np.array([1., 2., 3., 0., 0., 0.], np.float32)
+    np.testing.assert_array_equal(_repad_flat(buf, 4, 0),
+                                  [1., 2., 3., 0.])
+    np.testing.assert_array_equal(_repad_flat(buf, 8, 0),
+                                  [1., 2., 3., 0., 0., 0., 0., 0.])
+    with pytest.raises(ValueError, match="nonzero"):
+        _repad_flat(np.array([1., 2., 3., 4.], np.float32), 3, 0)
+
+
+# ------------------------------------------------- topology lineage
+
+
+@pytest.mark.slow
+def test_saved_world_recorded_and_lineage_not_shadowed(tmp_path, devices):
+    """Satellite: checkpoint meta written by a shrunken world exposes
+    BOTH saved_world (the shrunken saver) and restored_world (the
+    original topology), and the fsck audit line renders them."""
+    t4 = Trainer(_cfg(4, tmp_path, resume=False), mesh=_mesh(devices, 4))
+    t4.fit()
+    meta = ckpt.read_meta(str(tmp_path))
+    assert meta["saved_world"]["dp"] == 4
+    assert meta["saved_world"]["n_devices"] == jax.device_count()
+    assert meta["consumed_samples"] == 64
+    assert "restored_world" not in meta
+    # the manifest carries the world too (stdlib side, for the
+    # supervisor's relaunch log)
+    man = json.loads(
+        (tmp_path / "ckpt-8" / ckpt_manifest.MANIFEST).read_text())
+    assert man["saved_world"]["dp"] == 4
+
+    t2 = Trainer(_cfg(2, tmp_path, nepochs=2), mesh=_mesh(devices, 2))
+    t2.fit()  # resumes dp=4 snapshot, trains epoch 2, saves as dp=2
+    meta2 = ckpt.read_meta(str(tmp_path))
+    assert meta2["saved_world"]["dp"] == 2
+    assert meta2["restored_world"]["dp"] == 4  # lineage carried forward
+
+    line = ckpt_manifest.world_line(meta2)
+    assert "dp=2" in line and "restored_world" in line and "dp=4" in line
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "ckpt_fsck.py"),
+         str(tmp_path)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "saved_world" in out.stdout and "dp=2" in out.stdout
+
+
+def test_world_line_rendering():
+    assert ckpt_manifest.world_line({}) == ""
+    assert ckpt_manifest.world_line(
+        {"saved_world": {"n_devices": 8, "n_processes": 2, "dp": 8,
+                         "update_sharding": "zero1"}}) == \
+        "saved_world 8d/2p/dp=8/zero1"
+    line = ckpt_manifest.world_line(
+        {"saved_world": {"n_devices": 1, "dp": 1},
+         "restored_world": {"n_devices": 2, "dp": 2}})
+    assert line == "saved_world 1d/dp=1, restored_world 2d/dp=2"
+
+
+# ------------------------------------------------- batch policy
+
+
+@pytest.mark.slow
+def test_elastic_batch_policy_global_raises_accum(tmp_path, devices):
+    t4 = Trainer(_cfg(4, tmp_path, resume=False), mesh=_mesh(devices, 4))
+    t4.fit()
+    t2 = Trainer(_cfg(2, tmp_path, elastic_batch="global"),
+                 mesh=_mesh(devices, 2))
+    assert t2.cfg.batch_size == 8          # global batch preserved
+    assert t2.cfg.accum_steps == 2         # memory bounded via accum
+    assert t2._topology_change["policy"] == "global"
+    assert t2._topology_change["accum_steps"] == [1, 2]
+
+
+@pytest.mark.slow
+def test_elastic_batch_policy_per_device_shrinks_batch(tmp_path, devices):
+    t4 = Trainer(_cfg(4, tmp_path, resume=False), mesh=_mesh(devices, 4))
+    t4.fit()
+    t2 = Trainer(_cfg(2, tmp_path, elastic_batch="per_device"),
+                 mesh=_mesh(devices, 2))
+    assert t2.cfg.batch_size == 4          # per-device rows preserved
+    assert t2.cfg.accum_steps == 1
+    assert t2._topology_change["batch_size"] == [8, 4]
+    # the resumed stream continues from the consumed-sample coordinate
+    t2.init_state()
+    start = t2.maybe_resume()
+    assert start == 8
+    # 64 samples consumed = exactly 1 epoch of the new 16-step loader
+    assert t2._resume_plan == (1, 0)
+    assert (start + t2._step_offset) == 16
+
+
+@pytest.mark.slow
+def test_rollback_remaps_step_offset(tmp_path, devices):
+    """An anomaly rollback re-derives the step->position offset from the
+    generation it actually lands on: the fallback chain can restore an
+    older (old-world) snapshot than the one the elastic resume was keyed
+    to, and a stale offset would walk the wrong sample window."""
+    t4 = Trainer(_cfg(4, tmp_path, resume=False), mesh=_mesh(devices, 4))
+    t4.fit()
+    t2 = Trainer(_cfg(2, tmp_path, elastic_batch="per_device"),
+                 mesh=_mesh(devices, 2))
+    t2.init_state()
+    start = t2.maybe_resume()
+    want = t2._step_offset
+    t2._step_offset = 999          # poison: rollback must not keep it
+    t2._resume_plan = None
+    assert t2._rollback() == start
+    assert t2._step_offset == want
+    assert t2._resume_plan == (1, 0)
+
+
+@pytest.mark.slow
+def test_topology_event_reaches_telemetry_and_summary(tmp_path, devices):
+    """The effective-batch change is logged to telemetry (kind=topology)
+    and tools/metrics_summary.py renders it."""
+    t4 = Trainer(_cfg(4, tmp_path, resume=False), mesh=_mesh(devices, 4))
+    t4.fit()
+    td = tmp_path / "telem"
+    t2 = Trainer(_cfg(2, tmp_path, nepochs=2, telemetry_dir=str(td),
+                      elastic_batch="global"), mesh=_mesh(devices, 2))
+    t2.fit()
+    recs = [json.loads(l)
+            for l in (td / "metrics.jsonl").read_text().splitlines()]
+    (topo,) = [r for r in recs if r.get("kind") == "topology"]
+    assert topo["policy"] == "global"
+    assert topo["from_world"]["dp"] == 4 and topo["to_world"]["dp"] == 2
+    assert topo["accum_steps"] == [1, 2]
+
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "metrics_summary.py"),
+         str(td)], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "topology:" in out.stdout and "dp 4 -> 2" in out.stdout
+
+
+# ------------------------------------------------- CLI plumbing
+
+
+def test_cli_flags_plumbed():
+    args = build_argparser().parse_args(
+        ["--elastic", "--min_devices", "2", "--elastic_batch",
+         "per_device", "--collective_timeout", "30",
+         "--supervise_backoff_max", "7"])
+    cfg = config_from_args(args)
+    assert cfg.elastic and cfg.min_devices == 2
+    assert cfg.elastic_batch == "per_device"
+    assert cfg.collective_timeout == 30.0
+    assert args.supervise_backoff_max == 7.0
+    # defaults: elastic off, no floor, unbounded collectives
+    cfg0 = config_from_args(build_argparser().parse_args([]))
+    assert not cfg0.elastic and cfg0.min_devices == 0
+    assert cfg0.collective_timeout == 0.0
+
+
+def test_tools_supervise_elastic_flags():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "supervise.py"), "--help"],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "--elastic" in out.stdout and "--min-devices" in out.stdout
+    assert "--probe-timeout" in out.stdout
+
+
+def test_trainer_enforces_min_devices_floor(tmp_path, devices):
+    """The capacity floor is the CHILD's own contract too: a Trainer
+    constructed below --min_devices raises CapacityAbort (-> exit 46)."""
+    with pytest.raises(resilience.CapacityAbort, match="min_devices"):
+        Trainer(_cfg(2, tmp_path, resume=False, min_devices=99),
+                mesh=_mesh(devices, 2))
+
+
+@pytest.mark.slow
+def test_cli_min_devices_floor_exits_46(tmp_path):
+    """The CHILD enforces the capacity floor itself (even under a dumb
+    generic supervisor): a world below --min_devices exits 46."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop(faults_lib.ENV_VAR, None)
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "neural_networks_parallel_training_with_mpi_tpu",
+         "--platform", "cpu", "--num_devices", "2", "--dataset",
+         "regression", "--n_samples", "16", "--nepochs", "1",
+         "--min_devices", "99"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=str(REPO))
+    assert out.returncode == 46, (out.stdout, out.stderr)
+    assert "capacity abort" in out.stdout + out.stderr
+
+
+# ------------------------------------------------- probes (subprocess)
+
+
+@pytest.mark.slow
+def test_default_probe_reports_local_topology():
+    res = resilience.default_probe(timeout_s=120)
+    assert res is not None
+    assert res["n_devices"] >= 1 and res["degraded"] is False
+
+
+@pytest.mark.slow
+def test_probe_world_dead_coordinator_degrades_locally():
+    """probe_world against a dead coordinator must neither hang nor
+    poison the caller: bounded subprocess, local-topology fallback with
+    degraded=True."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel.mesh import (  # noqa: E501
+        probe_world,
+    )
+
+    logs = []
+    res = probe_world(coordinator_address=f"127.0.0.1:{_free_port()}",
+                      num_processes=2, process_id=0, timeout_s=8,
+                      log=logs.append)
+    assert res is not None and res["degraded"] is True
+    assert res["n_processes"] == 1 and res["n_devices"] >= 1
+    assert any("local topology" in m for m in logs)
+
+
+# ------------------------------------------------- chaos lane (e2e)
+
+
+def _spawn_elastic_pair(tmp_path, extra_common=(), kill_step=5,
+                        nepochs=6, timeout_s=420):
+    """The acceptance scenario: a 2-process world (1 CPU device each)
+    where process 0 runs under the integrated elastic supervisor and
+    process 1 is SIGKILLed mid-run.  Returns (supervisor result, victim
+    result)."""
+    port = _free_port()
+    ck = tmp_path / "ckpt"
+    common = ["--platform", "cpu", "--dataset", "regression",
+              "--n_samples", "32", "--batch_size", "8", "--no-full-batch",
+              "--nepochs", str(nepochs), "--checkpoint_dir", str(ck),
+              "--checkpoint_every", "2", "--elastic",
+              "--hang_timeout", "15", "--collective_timeout", "10",
+              *extra_common]
+
+    def env_for(pid):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.pop(faults_lib.ENV_VAR, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["NNPT_NUM_PROCESSES"] = "2"
+        env["NNPT_PROCESS_ID"] = str(pid)
+        env["NNPT_WORLD_TIMEOUT_S"] = "12"
+        return env
+
+    pkg = "neural_networks_parallel_training_with_mpi_tpu"
+    sup = subprocess.Popen(
+        [sys.executable, "-m", pkg, *common, "--supervise", "4",
+         "--supervise_backoff", "0.2", "--supervise_backoff_max", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env_for(0), cwd=str(REPO))
+    victim = subprocess.Popen(
+        [sys.executable, "-m", pkg, *common,
+         "--faults", f"peer_kill@{kill_step}"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env_for(1), cwd=str(REPO))
+    try:
+        v_out, _ = victim.communicate(timeout=timeout_s)
+        s_out, _ = sup.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        victim.kill()
+        sup.kill()
+        pytest.fail("elastic chaos scenario did not complete in time")
+    return (sup.returncode, s_out), (victim.returncode, v_out)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_peer_kill_degrades_to_world1_and_completes(tmp_path):
+    """Acceptance: peer_kill mid-run -> supervised relaunch at world=1
+    via the topology probe -> resharded restore of the last verified
+    snapshot -> finite loss -> exit 0."""
+    (sup_rc, sup_out), (v_rc, v_out) = _spawn_elastic_pair(tmp_path)
+    assert v_rc == -9 or v_rc == 137, (v_rc, v_out[-500:])
+    assert "injected peer_kill" in v_out
+    assert sup_rc == 0, sup_out[-4000:]
+    # the probe found the shrunken world and the supervisor degraded
+    assert "topology probe: 1 healthy device(s)" in sup_out
+    assert "DEGRADED world" in sup_out
+    # the relaunch log names the saving topology of the restore target
+    assert "saved_world 2d/2p/dp=2" in sup_out
+    # the child rode the reshard path and the batch policy
+    assert "resuming a dp=2 checkpoint on dp=1" in sup_out
+    assert "elastic restore of a 2-device snapshot onto 1 device(s)" \
+        in sup_out
+    assert "done: final loss" in sup_out
+    assert "nan" not in sup_out.split("done: final loss", 1)[1][:40]
+    # the run really finished all epochs on the shrunken world
+    assert ckpt.latest_step(str(tmp_path / "ckpt")) == 24
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_peer_kill_below_min_devices_exits_46(tmp_path):
+    """Acceptance: the same scenario with --min_devices 2 exits 46
+    without a degraded relaunch, and the log names the shortfall."""
+    (sup_rc, sup_out), (v_rc, v_out) = _spawn_elastic_pair(
+        tmp_path, extra_common=("--min_devices", "2"), kill_step=3,
+        nepochs=4)
+    assert v_rc in (-9, 137), (v_rc, v_out[-500:])
+    assert sup_rc == 46, sup_out[-4000:]
+    assert "capacity shortfall" in sup_out
+    assert "--min_devices 2" in sup_out
+    assert "exiting 46 (capacity abort)" in sup_out
+    assert "DEGRADED world" not in sup_out  # never relaunched below floor
+
+
+@pytest.mark.chaos
+def test_device_loss_supervised_retry_resumes(tmp_path):
+    """device_loss: the runtime-lost-a-chip stand-in exits 43 and the
+    supervisor retries; with `once=` the relaunch resumes from the
+    newest snapshot and completes."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop(faults_lib.ENV_VAR, None)
+    marker = tmp_path / "lost"
+    out = subprocess.run(
+        [sys.executable, "-m",
+         "neural_networks_parallel_training_with_mpi_tpu",
+         "--platform", "cpu", "--num_devices", "2", "--dataset",
+         "regression", "--n_samples", "32", "--batch_size", "8",
+         "--no-full-batch", "--nepochs", "4",
+         "--checkpoint_dir", str(tmp_path / "c"),
+         "--checkpoint_every", "3",
+         "--faults", f"device_loss@9?once={marker}",
+         "--supervise", "2", "--supervise_backoff", "0.1"],
+        capture_output=True, text=True, timeout=360, env=env,
+        cwd=str(REPO))
+    text = out.stdout + out.stderr
+    assert out.returncode == 0, text[-3000:]
+    assert "injected device_loss" in text
+    assert "child exit 43 (peer loss)" in text
+    assert "[supervise] attempt 2" in text
+    assert marker.exists()
+    assert "[supervise] child completed" in text

@@ -73,13 +73,6 @@ def scan(d: pathlib.Path):
             "quarantined": quarantined}
 
 
-def _snapshot_meta(path: pathlib.Path):
-    try:
-        return json.loads((path / "meta.json").read_text())
-    except (OSError, ValueError):
-        return {}
-
-
 def fsck(d: pathlib.Path, quarantine: bool = False, adopt: bool = False):
     """Verify every generation; return the report dict.  ``adopt`` builds
     a manifest for manifest-less dirs the operator declares trusted (e.g.
@@ -101,7 +94,7 @@ def fsck(d: pathlib.Path, quarantine: bool = False, adopt: bool = False):
     report["quarantined_earlier"] = [p.name for p in state["quarantined"]]
     for step, p in state["snapshots"]:
         if adopt and not (p / cm.MANIFEST).exists():
-            meta = _snapshot_meta(p)
+            meta = cm.snapshot_meta(p)
             if meta:
                 cm.commit(p, {"step": meta.get("step", step),
                               "format": meta.get("format", "npz")})
@@ -111,9 +104,17 @@ def fsck(d: pathlib.Path, quarantine: bool = False, adopt: bool = False):
                 report["actions"].append(
                     f"cannot adopt {p.name}: no readable meta.json")
         problems = cm.verify(p)
+        meta = cm.snapshot_meta(p)
         gen = {"name": p.name, "step": step,
                "status": "ok" if not problems else "corrupt",
                "problems": problems,
+               # topology lineage (DESIGN.md §10): the SAVING world, and
+               # the original world when a shrunken run re-saved — a
+               # degraded world's snapshots must not shadow where the
+               # job started
+               "saved_world": meta.get("saved_world"),
+               "restored_world": meta.get("restored_world"),
+               "world": cm.world_line(meta),
                # legacy-shaped: pre-durability snapshot (meta.json but no
                # manifest) — restore refuses rather than quarantines these
                "legacy": (not (p / cm.MANIFEST).exists()
@@ -136,7 +137,9 @@ def render(report, telemetry_dir=None) -> str:
     for g in report["generations"]:
         if g["status"] == "ok":
             lines.append(f"  {g['name']:<16} ok       "
-                         f"({g.get('format')}, {g.get('files')} files)")
+                         f"({g.get('format')}, {g.get('files')} files"
+                         + (f", {g['world']}" if g.get("world") else "")
+                         + ")")
         else:
             head = g["problems"][0] if g["problems"] else "?"
             lines.append(f"  {g['name']:<16} CORRUPT  {head}"

@@ -102,6 +102,19 @@ def summarize(records: List[Dict[str, Any]],
             total += int(v - prev)
         prev = v
     out["skipped_updates"] = total
+    # elastic topology-change events (kind=topology, train.telemetry):
+    # the moments the run resumed on a different world than the one that
+    # saved its checkpoint — effective batch/accumulation may change there
+    out["topology_changes"] = [
+        {"step": r.get("step"),
+         "from_devices": (r.get("from_world") or {}).get("n_devices"),
+         "to_devices": (r.get("to_world") or {}).get("n_devices"),
+         "from_dp": (r.get("from_world") or {}).get("dp"),
+         "to_dp": (r.get("to_world") or {}).get("dp"),
+         "policy": r.get("policy"),
+         "batch_size": r.get("batch_size"),
+         "accum_steps": r.get("accum_steps")}
+        for r in records if r.get("kind") == "topology"]
     return out
 
 
@@ -128,6 +141,19 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
     if summary.get("skipped_updates"):
         lines.append(f"  skipped updates: {summary['skipped_updates']} "
                      "(guarded steps rejected — see postmortem/events)")
+    for t in summary.get("topology_changes", []):
+        bs = t.get("batch_size") or [None, None]
+        ac = t.get("accum_steps") or [None, None]
+        detail = []
+        if bs[0] != bs[1]:
+            detail.append(f"batch {bs[0]} -> {bs[1]}")
+        if ac[0] != ac[1]:
+            detail.append(f"accum {ac[0]} -> {ac[1]}")
+        lines.append(
+            f"topology: {t.get('from_devices')} -> {t.get('to_devices')} "
+            f"devices (dp {t.get('from_dp')} -> {t.get('to_dp')}) at step "
+            f"{t.get('step')}, policy {t.get('policy')}"
+            + (f" ({', '.join(detail)})" if detail else ""))
     if heartbeat is not None:
         age = ("?" if heartbeat_age is None
                else f"{heartbeat_age:.1f}s ago")

@@ -7,10 +7,14 @@ honoring the exit-code contract —
 
 * 0   run completed -> stop
 * 42  watchdog hang -> retry
-* 43  peer loss (a collective raised) -> retry
+* 43  peer loss (a collective raised/timed out or world formation
+      failed) -> retry; with --elastic, repeated 43/42 triggers the
+      topology probe + shrunken-world relaunch
 * 44  anomaly abort (rollback budget exhausted) -> stop, do NOT retry
 * 45  SDC abort (deterministic replica divergence or a device past its
       strike budget) -> stop, do NOT retry
+* 46  capacity abort (healthy devices stayed below --min-devices) ->
+      stop, do NOT retry (a relaunch cannot create chips)
 * any other nonzero / signal death -> retry
 
 For training jobs the integrated form is usually what you want (it appends
@@ -37,6 +41,7 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (  # noqa: E402
+    default_probe,
     supervise,
 )
 
@@ -44,12 +49,29 @@ from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (  #
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="relaunch a command on crash with exponential backoff "
-                    "(exit 0, 44 and 45 stop; see module docstring)")
+                    "(exit 0, 44, 45 and 46 stop; see module docstring)")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="relaunches allowed after the initial run")
     p.add_argument("--backoff", type=float, default=1.0,
-                   help="initial backoff seconds (doubles per restart)")
+                   help="initial backoff seconds (doubles per restart, "
+                        "jittered -50%% downward against thundering-herd "
+                        "relaunches; --backoff-cap stays a hard bound)")
     p.add_argument("--backoff-cap", type=float, default=60.0)
+    p.add_argument("--elastic", action="store_true",
+                   help="after repeated peer-loss exits (43/42), probe "
+                        "the surviving topology (a bounded subprocess "
+                        "probe) and relaunch at the shrunken world: the "
+                        "child env is rewritten so its world formation "
+                        "targets the degraded topology; each relaunch "
+                        "logs the probed device/process counts")
+    p.add_argument("--min-devices", type=int, default=0, metavar="N",
+                   help="with --elastic: park and re-poll while the "
+                        "probe reports fewer than N healthy devices, "
+                        "then exit 46 (capacity abort, no-retry) when "
+                        "the restart budget runs out")
+    p.add_argument("--probe-timeout", type=float, default=60.0,
+                   help="seconds the topology probe may spend before it "
+                        "counts as failed")
     p.add_argument("--telemetry-dir", default=None,
                    help="the child's --telemetry_dir: watch its "
                         "heartbeat.json for staleness (with "
@@ -90,7 +112,11 @@ def main(argv=None) -> int:
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=args.heartbeat_timeout,
                      postmortem_path=postmortem,
-                     ckpt_dir=args.checkpoint_dir)
+                     ckpt_dir=args.checkpoint_dir,
+                     elastic=args.elastic,
+                     min_devices=args.min_devices,
+                     probe=(lambda: default_probe(args.probe_timeout))
+                     if args.elastic else None)
 
 
 if __name__ == "__main__":
