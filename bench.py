@@ -1197,7 +1197,8 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
             continue
         if isinstance(doc, dict):
             return (doc.get("attention_artifact")
-                    or doc.get("decode_artifact"))
+                    or doc.get("decode_artifact")
+                    or doc.get("serve_artifact"))
     return None
 
 
@@ -1544,6 +1545,175 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
     return out_path
 
 
+def bench_serve(out_path: str = "BENCH_SERVE.json") -> str:
+    """The serving-subsystem bench (serve/): a CLOSED-LOOP load sweep of
+    the continuous-batching scheduler over the paged KV cache — tokens/s
+    and p50/p99 TTFT/ITL vs. offered load (concurrent clients) — plus
+    two targeted A/Bs: (1) concurrent-stream CAPACITY at equal device
+    cache memory, dense slot server vs. paged pool (the paged win is
+    measured by admitting streams until each refuses); (2) the dense
+    server's per-token host-sync fix (models/serve.py), old blocking
+    fetch vs. host-tracked completion, same workload.  On the CPU
+    fallback the absolute numbers are mechanism checks at tiny shapes;
+    the CURVES (latency vs. load, capacity ratio) are the evidence."""
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.models import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.models.serve import (
+        DecodeServer,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        Scheduler, ServeConfig, sweep_loads,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform not in ("cpu",)
+    cd = jnp.bfloat16 if on_tpu else jnp.float32
+    c = (_LM if on_tpu else
+         dict(vocab=256, seq=128, d_model=64, n_layers=2, n_heads=4,
+              d_ff=128))
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=cd))
+    params = model.init(prng.init_key(0))
+    results: dict = {"model": {k: c[k] for k in
+                               ("vocab", "seq", "d_model", "n_layers")}}
+
+    # --- closed-loop load sweep (>= 3 offered loads) -------------------
+    block_size = 16
+    slots = 8
+    max_len = c["seq"]
+    # a non-starved pool for the latency sweep: the question here is
+    # latency vs. load, not eviction policy (capacity A/B below covers
+    # the tight-pool regime)
+    num_blocks = 1 + slots * (max_len // block_size)
+    cfg = dict(slots=slots, num_blocks=num_blocks, block_size=block_size,
+               max_len=max_len, prefill_chunk=32)
+    loads = [2, 6, 12] if not on_tpu else [4, 16, 64]
+    reqs_per_client = 3
+
+    def make_sched():
+        return Scheduler(model, params, ServeConfig(**cfg))
+
+    # compile pass: pay every prefill bucket the sweep can draw (powers
+    # of two covering prompt_lens (4, 24) under prefill_chunk 32 ->
+    # buckets 8/16/32) plus the decode step, so no load point pays a
+    # mid-run compile as a fake TTFT outlier
+    warm = make_sched()
+    for plen in (5, 12, 24):
+        warm.submit(list(range(1, plen + 1)), 4)
+    warm.run_until_drained()
+    warm.close()
+    results["load_sweep"] = sweep_loads(
+        make_sched, loads, reqs_per_client, vocab_size=c["vocab"],
+        prompt_lens=(4, 24), max_new=(8, 24), seed=1)
+    results["serve_config"] = cfg
+
+    # --- capacity at EQUAL device cache memory -------------------------
+    # dense: 4 slots x max_len positions reserved up front.  paged: the
+    # same number of cache positions split into blocks (+1 sink block of
+    # overhead, disclosed).  Short streams (prompt 8 + 8 new = 16
+    # positions) admit until each server refuses — measured, not derived.
+    dense_slots = 4
+    eq_positions = dense_slots * max_len
+    paged_blocks = 1 + eq_positions // block_size      # +1: the sink
+    short_prompt, short_new = 8, 8
+    dense_srv = DecodeServer(model, params, slots=dense_slots,
+                             max_len=max_len)
+    dense_cap = 0
+    while dense_srv.submit([1 + dense_cap % 250] * short_prompt,
+                           short_new) is not None:
+        dense_cap += 1
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        PagedDecodeServer,
+    )
+
+    paged_srv = PagedDecodeServer(model, params,
+                                  slots=eq_positions // block_size,
+                                  num_blocks=paged_blocks,
+                                  block_size=block_size, max_len=max_len)
+    paged_cap = 0
+    while paged_srv.try_admit([1 + paged_cap % 250] * short_prompt,
+                              short_new) is not None:
+        paged_cap += 1
+    # paged admission reserves blocks for prompt+1 only; the honest
+    # capacity number is streams that can run END TO END concurrently
+    # (each needs blocks_for(prompt + new)); report both
+    per_stream = paged_srv.blocks_for(short_prompt + short_new)
+    results["capacity_equal_memory"] = {
+        "cache_positions": eq_positions,
+        "block_size": block_size,
+        "paged_pool_blocks": paged_blocks,
+        "stream_positions": short_prompt + short_new,
+        "dense_streams_admitted": dense_cap,
+        "paged_streams_admitted": paged_cap,
+        "paged_streams_end_to_end": (paged_blocks - 1) // per_stream,
+        "paged_over_dense": round(paged_cap / max(1, dense_cap), 2),
+    }
+
+    # --- the dense server's host-sync fix, measured --------------------
+    def serve_pass(sync_per_step: bool) -> float:
+        srv = DecodeServer(model, params, slots=4, max_len=max_len,
+                           sync_per_step=sync_per_step)
+        rng = np.random.default_rng(0)
+        lens = [3, 7, 12, 5, 9, 4, 14, 6]
+        new_tokens = 32 if not on_tpu else 64
+        pending = [(list(rng.integers(0, c["vocab"], (p,))), new_tokens)
+                   for p in lens]
+        done_tok = 0
+        t0 = time.perf_counter()
+        rids = []
+        while pending or rids:
+            while pending:
+                rid = srv.submit(*pending[0])
+                if rid is None:
+                    break
+                rids.append((rid, pending.pop(0)[1]))
+            srv.step()
+            for rid, n in list(rids):
+                if srv.done(rid):
+                    srv.result(rid)
+                    done_tok += n
+                    rids.remove((rid, n))
+        return round(done_tok / (time.perf_counter() - t0), 1)
+
+    serve_pass(False)                        # compile pass
+    best_async = best_sync = 0.0
+    for _ in range(1 if on_tpu else _CPU_TIMING_REPS):
+        best_async = max(best_async, serve_pass(False))
+        best_sync = max(best_sync, serve_pass(True))
+    results["dense_host_sync_fix"] = {
+        "tokens_per_sec_host_tracked": best_async,
+        "tokens_per_sec_per_step_fetch": best_sync,
+        "speedup": round(best_async / max(1e-9, best_sync), 3),
+        "note": ("the removed cost is a blocking per-token host<->device "
+                 "round trip; XLA:CPU dispatch is effectively "
+                 "synchronous, so the CPU delta is noise — the win is "
+                 "the async-dispatch pipeline on a real accelerator "
+                 "(the tunneled chip pays ~65 ms per host round trip, "
+                 "DESIGN.md 6b)") if not on_tpu else None,
+    }
+
+    results["platform"] = devices[0].platform
+    results["device_kind"] = devices[0].device_kind
+    results["n_devices"] = len(devices)
+    if not on_tpu:
+        results["note"] = ("CPU fallback mechanism check: tiny model, "
+                           "absolute tokens/s not meaningful; the load-"
+                           "latency curves and the capacity ratio are "
+                           "the platform-independent evidence")
+    out_path = _divert_cpu_overwrite(out_path, on_tpu)
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    log(f"serve bench -> {out_path}")
+    return out_path
+
+
 def resolve_platform(requested: str) -> tuple[str, list]:
     """Return ('cpu'|'accel', probe_history) after hang-proof spaced probes.
 
@@ -1726,6 +1896,15 @@ def main() -> int:
                          "BENCH_DECODE.json")
     ap.add_argument("--decode-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-subsystem bench (serve/): closed-loop "
+                         "load sweep of the paged-KV continuous-batching "
+                         "scheduler (tokens/s, p50/p99 TTFT/ITL vs. "
+                         "offered load), paged-vs-dense capacity at "
+                         "equal memory, host-sync-fix delta; write "
+                         "BENCH_SERVE.json")
+    ap.add_argument("--serve-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     ap.add_argument("--grad-reduction", choices=["global_mean", "local"],
@@ -1762,8 +1941,11 @@ def main() -> int:
     if args.decode_inproc:
         print(json.dumps({"decode_artifact": bench_decode()}))
         return 0
+    if args.serve_inproc:
+        print(json.dumps({"serve_artifact": bench_serve()}))
+        return 0
 
-    if args.attention or args.decode:
+    if args.attention or args.decode or args.serve:
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -1782,6 +1964,13 @@ def main() -> int:
             else:
                 path = bench_decode()
             print(json.dumps({"decode_artifact": path}))
+        if args.serve:
+            if choice == "cpu":
+                # single-device is the serve bench's natural CPU shape
+                path = _run_flag_cpu_child("--serve-inproc", 1)
+            else:
+                path = bench_serve()
+            print(json.dumps({"serve_artifact": path}))
         return 0
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]

@@ -26,7 +26,12 @@ Design (slot server):
   and their samples are discarded); masking happens host-side in the
   pos/active bookkeeping, which is exactly the continuous-batching
   contract: dead lanes cost FLOPs, not recompiles, and are reclaimed at
-  the next ``submit``.
+  the next ``submit``.  Completion detection is host-side too: positions
+  advance deterministically (+1 per active slot per step), so ``step()``
+  performs ZERO per-token device syncs — the old per-step blocking
+  ``device_get(self.pos)`` serialized the host against the device
+  pipeline every token (measured delta in BENCH_SERVE.json;
+  ``sync_per_step=True`` keeps the legacy fetch for that measurement).
 * Greedy (temperature=0) decode matches :func:`models.generate.generate`
   token-for-token per request — pinned by tests/test_serve.py — because
   each row's attention reduces over exactly the same values in the same
@@ -128,7 +133,8 @@ class DecodeServer:
     def __init__(self, model: Transformer, params: Pytree, slots: int = 4,
                  max_len: Optional[int] = None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 kv_quant: bool = False, prefill_chunk: int = 0):
+                 kv_quant: bool = False, prefill_chunk: int = 0,
+                 sync_per_step: bool = False):
         c = model.cfg
         self.model, self.params = model, params
         self.slots = int(slots)
@@ -145,6 +151,14 @@ class DecodeServer:
         self.tokens = jnp.zeros((self.slots, self.max_len), jnp.int32)
         self.pos = jnp.zeros((self.slots,), jnp.int32)
         self.active = np.zeros((self.slots,), bool)      # host-side
+        # host shadow of ``pos``: positions advance deterministically
+        # (one per active slot per step), so completion detection needs
+        # NO device fetch — the per-token blocking device_get this loop
+        # used to pay serialized every step against the device pipeline.
+        # ``sync_per_step=True`` restores the old fetch, kept ONLY so
+        # bench.py can measure the delta (BENCH_SERVE.json).
+        self._pos_host = np.zeros((self.slots,), np.int64)
+        self._sync_per_step = bool(sync_per_step)
         self.key = jax.random.PRNGKey(seed)
         # request bookkeeping (host): slot -> (request id, prompt_len,
         # target total length); results keyed by request id
@@ -194,6 +208,7 @@ class DecodeServer:
         row[p] = int(first)
         self.tokens = self.tokens.at[slot].set(jnp.asarray(row))
         self.pos = self.pos.at[slot].set(p)      # last written position
+        self._pos_host[slot] = p
         self.active[slot] = max_new_tokens > 1
         rid = self._rid
         self._rid += 1
@@ -212,9 +227,18 @@ class DecodeServer:
         self.caches, self.tokens, self.pos, self.key = self._step(
             self.params, self.caches, self.tokens, self.pos, active_dev,
             self.key)
-        pos = np.asarray(jax.device_get(self.pos))
+        if self._sync_per_step:
+            # measurement-only legacy path: block on the device every
+            # step (the host sync the default path no longer pays)
+            self._pos_host[:] = np.asarray(jax.device_get(self.pos))
+        else:
+            # positions advance deterministically: +1 per active slot.
+            # The device array clamps at max_len-1 but an active slot
+            # always finishes at target <= max_len first, so the shadow
+            # never diverges while it matters.
+            self._pos_host[self.active] += 1
         for slot, (rid, p, target) in list(self._slot_req.items()):
-            if self.active[slot] and pos[slot] + 1 >= target:
+            if self.active[slot] and self._pos_host[slot] + 1 >= target:
                 self._finish(slot)
 
     def _finish(self, slot: int) -> None:

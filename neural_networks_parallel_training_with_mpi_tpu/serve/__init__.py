@@ -1,0 +1,34 @@
+"""Production serving subsystem: paged KV cache + continuous batching.
+
+``models/serve.py`` is the library-shaped slot server: every slot
+reserves a dense ``max_len`` KV allocation and admission is "return None
+when full".  This package is the service-shaped runtime above it:
+
+* :mod:`serve.paged_kv` — a block-allocated KV pool with per-stream
+  block tables and static-shape gathered attention, so heterogeneous
+  stream lengths share device memory instead of each padding to max.
+* :mod:`serve.scheduler` — a continuous-batching scheduler: bounded
+  wait queue, per-tick admit/retire, chunked prefill interleaved with
+  decode, admission control gated on free blocks + token budget, and
+  SLO-aware eviction/requeue under block exhaustion.  Serving metrics
+  ride the PR 2 telemetry records + heartbeat, so the PR 1 supervisor
+  can babysit a serving fleet unchanged.
+* :mod:`serve.loadgen` — a closed-loop load generator measuring
+  tokens/s and TTFT/ITL percentiles vs. offered load
+  (``bench.py --serve`` -> BENCH_SERVE.json).
+"""
+
+from .paged_kv import (
+    BlockAllocator,
+    BlockExhausted,
+    PagedDecodeServer,
+    init_paged_kv,
+)
+from .scheduler import Request, Scheduler, ServeConfig
+from .loadgen import run_closed_loop, sweep_loads
+
+__all__ = [
+    "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
+    "init_paged_kv", "Request", "Scheduler", "ServeConfig",
+    "run_closed_loop", "sweep_loads",
+]

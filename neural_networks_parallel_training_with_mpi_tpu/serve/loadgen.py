@@ -1,0 +1,110 @@
+"""Closed-loop load generator + latency-percentile measurement.
+
+Closed-loop means each simulated client holds at most ONE outstanding
+request and submits its next the moment the previous completes — offered
+load is the number of concurrent clients, and the system can never be
+driven past saturation into a meaningless unbounded backlog (the
+standard serving-bench discipline; open-loop arrival processes measure
+queueing theory, closed-loop measures the server).
+
+Per request we record TTFT (submit -> first output token, queue wait
+included — that is what a client experiences) and mean ITL (decode span
+/ (new_tokens - 1)); the sweep reports p50/p99 of each across requests,
+plus aggregate generated tokens/s.  ``bench.py --serve`` drives
+:func:`sweep_loads` at >= 3 offered loads into ``BENCH_SERVE.json``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def run_closed_loop(scheduler, clients: int, requests_per_client: int,
+                    *, vocab_size: int, prompt_lens=(4, 24),
+                    max_new=(8, 32), seed: int = 0,
+                    slo_ms: Optional[float] = None,
+                    max_ticks: int = 200_000) -> Dict[str, Any]:
+    """Drive ``scheduler`` with ``clients`` closed-loop clients until
+    each has completed ``requests_per_client`` requests; returns the
+    measured row (tokens/s, TTFT/ITL percentiles, counters).
+
+    Prompt lengths and output budgets are drawn uniformly from the
+    given inclusive ranges with a seeded RNG, so a sweep's load points
+    serve the same request mix."""
+    rng = np.random.default_rng(seed)
+    remaining = [int(requests_per_client)] * int(clients)
+    outstanding: List[Optional[int]] = [None] * int(clients)
+    finished: List[int] = []
+    submit_retries = 0
+    t0 = time.perf_counter()
+    for _ in range(max_ticks):
+        for ci in range(clients):
+            if outstanding[ci] is not None or remaining[ci] <= 0:
+                continue
+            p = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+            n = int(rng.integers(max_new[0], max_new[1] + 1))
+            prompt = rng.integers(0, vocab_size, (p,)).tolist()
+            rid = scheduler.submit(prompt, n, slo_ms=slo_ms)
+            if rid is None:           # bounded queue full: retry next tick
+                submit_retries += 1
+                continue
+            outstanding[ci] = rid
+            remaining[ci] -= 1
+        for rid in scheduler.tick():
+            ci = outstanding.index(rid)
+            outstanding[ci] = None
+            finished.append(rid)
+            scheduler.result(rid)     # consume tokens; timings stay
+        if not any(r > 0 for r in remaining) and \
+                all(o is None for o in outstanding):
+            break
+    else:
+        raise RuntimeError(f"load run not drained in {max_ticks} ticks")
+    wall = time.perf_counter() - t0
+    stats = [scheduler.stats(rid) for rid in finished]
+    ttft = [s.ttft_ms for s in stats if s.ttft_ms is not None]
+    itl = [s.itl_ms for s in stats if s.itl_ms is not None]
+    return {
+        "clients": int(clients),
+        "requests": len(finished),
+        "wall_s": round(wall, 3),
+        "tokens_out": scheduler.tokens_out,
+        "tokens_per_sec": round(scheduler.tokens_out / wall, 1),
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "itl_ms_p50": _pct(itl, 50), "itl_ms_p99": _pct(itl, 99),
+        "ticks": scheduler.tick_no,
+        "admitted": scheduler.admitted,
+        "rejected": scheduler.rejected,
+        "evicted": scheduler.evicted,
+        "submit_retries": submit_retries,
+        "deadline_missed": sum(1 for s in stats if s.deadline_missed),
+    }
+
+
+def sweep_loads(make_scheduler, loads: List[int],
+                requests_per_client: int, *, vocab_size: int,
+                prompt_lens=(4, 24), max_new=(8, 32), seed: int = 0,
+                slo_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+    """One :func:`run_closed_loop` row per offered load (client count),
+    a FRESH scheduler each (``make_scheduler()`` factory) so load points
+    don't share warm state beyond compiled programs."""
+    rows = []
+    for c in loads:
+        sched = make_scheduler()
+        try:
+            rows.append(run_closed_loop(
+                sched, c, requests_per_client, vocab_size=vocab_size,
+                prompt_lens=prompt_lens, max_new=max_new, seed=seed,
+                slo_ms=slo_ms))
+        finally:
+            sched.close()
+    return rows

@@ -1,0 +1,574 @@
+"""Paged KV cache: block-allocated pools + static-shape gathered attention.
+
+The dense slot server (``models/serve.py``) reserves ``max_len`` cache
+positions per slot the moment a stream is admitted, so device memory is
+spent on the WORST-case length of every stream simultaneously — the
+classic serving waste paged attention removes (vLLM, Kwon et al. 2023;
+the TPU angle is that everything must stay static-shape so one compiled
+step serves any mix of lengths).  Here the cache is a pool of fixed-size
+blocks:
+
+* **Pools**: per layer, ``k``/``v`` of shape ``(num_blocks, block_size,
+  kv_heads, head_dim)`` (plus f32 scale pools under ``kv_quant`` — the
+  same int8 scheme as :func:`models.generate.init_kv_cache`, quantized
+  per (position, head) so block boundaries never change the numbers).
+* **Block tables**: per slot, ``(max_blocks,)`` int32 indices into the
+  pool, host-owned (a tiny traced argument each step — never a
+  recompile).  Unallocated entries point at the reserved **sink block
+  0**, which is never handed to a stream: pad/frozen writes land there
+  harmlessly and are never attended.
+* **Gathered attention**: a step gathers each row's blocks
+  ``pool[table] -> (T_cap, kv_heads, head_dim)`` (``T_cap = max_blocks *
+  block_size``) and attends under the causal mask ``t <= pos`` — the
+  same reduction, over the same values in the same order, as the dense
+  cache path, which is why greedy paged decode is token-identical to
+  ``DecodeServer`` / ``models.generate.generate`` (pinned by
+  tests/test_serve_paged.py).  The gather materializes the attended
+  window transiently (what dense attention reads anyway); the win is the
+  PERSISTENT allocation, which now tracks actual tokens in flight
+  instead of slots x max_len.
+* **Writes** are scatters at ``(table[pos // block_size], pos %
+  block_size)`` — one position per row at decode, a chunk of positions
+  at prefill (chunks may straddle block boundaries; each position
+  resolves its own block).
+
+Invariant the step relies on (mirrors the dense server's "dead lanes
+cost FLOPs, not recompiles" contract): every slot flows through the
+batched step every tick, but live blocks are written ONLY by prefill
+chunks and ACTIVE decode lanes.  ``step()`` masks every non-active
+slot's table row to the sink (free, finished, and mid-prefill slots
+alike), so a dead lane's unconditional write lands in the sink and its
+gathered read is discarded garbage — parity never rests on a frozen
+lane recomputing bitwise-identical K/V, and a finished/evicted slot's
+table is additionally zeroed BEFORE its blocks are freed so nothing can
+touch a block someone else just allocated.
+
+Completion is detected from HOST-tracked position counters (positions
+advance deterministically, one per active slot per step), so the decode
+loop performs zero per-token device syncs — the discipline the trainer's
+monitor uses, taken to its limit (see the satellite fix in
+``models/serve.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.generate import _quantize_kv, _sample
+from ..models.transformer import Transformer, split_qkv
+
+Pytree = Any
+
+# block 0 is reserved: pad positions and frozen slots write (and gather)
+# here, so a scatter never needs dynamic masking to be allocation-safe
+SINK_BLOCK = 0
+
+
+class BlockExhausted(RuntimeError):
+    """The pool cannot supply the next block for one or more streams;
+    carries the starving request ids so a scheduler can pick a victim."""
+
+    def __init__(self, rids: List[int]):
+        super().__init__(f"KV block pool exhausted; streams needing a "
+                         f"block: {rids}")
+        self.rids = list(rids)
+
+
+class BlockAllocator:
+    """Free-list allocator over block ids ``1..num_blocks-1`` (0 is the
+    sink).  Leak-proof by construction: every id is either in the free
+    list or in ``in_use``, ``free()`` of a foreign/double-freed id raises,
+    and :meth:`assert_drained` pins the balance at zero after a drain
+    (the fuzz test's invariant)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks {num_blocks} < 2: block 0 is "
+                             "the reserved sink, so a usable pool needs "
+                             "at least one more")
+        self.num_blocks = int(num_blocks)
+        # pop from the tail -> ascending ids hand out first (stable tests)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._in_use: set = set()
+
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (the sink is not allocatable)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._in_use)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None when the pool cannot satisfy the
+        request (all-or-nothing: no partial grants to roll back)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._in_use.update(out)
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._in_use:
+                raise ValueError(f"free of block {b} not in use (double "
+                                 "free or foreign id)")
+            self._in_use.remove(b)
+            self._free.append(b)
+
+    def assert_drained(self) -> None:
+        if self._in_use:
+            raise AssertionError(f"block leak: {sorted(self._in_use)} "
+                                 "still in use after drain")
+        if len(self._free) != self.capacity:
+            raise AssertionError(
+                f"free-list balance {len(self._free)} != capacity "
+                f"{self.capacity}")
+
+
+def init_paged_kv(model: Transformer, num_blocks: int, block_size: int,
+                  quant: bool = False):
+    """Per-layer paged pools ``(num_blocks, block_size, kv_heads,
+    head_dim)`` — :func:`models.generate.init_kv_cache` with the length
+    axis split into (block, offset).  ``quant=True`` stores int8 codes
+    plus one f32 scale per (block, offset, head), the identical scheme
+    the dense cache uses (scales are per position, so paging cannot
+    change the numbers)."""
+    c = model.cfg
+    shape = (num_blocks, block_size, c.kv_heads, c.head_dim)
+    if quant:
+        zeros = lambda: jnp.zeros(shape, jnp.int8)          # noqa: E731
+        ones = lambda: jnp.ones(shape[:-1], jnp.float32)    # noqa: E731
+        return [{"k": zeros(), "v": zeros(),
+                 "k_scale": ones(), "v_scale": ones()}
+                for _ in range(c.n_layers)]
+    zeros = lambda: jnp.zeros(shape, c.compute_dtype)       # noqa: E731
+    return [{"k": zeros(), "v": zeros()} for _ in range(c.n_layers)]
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
+                    temperature: float, top_k: int, top_p: float,
+                    kv_quant: bool = False):
+    """The two jitted programs of a paged server: chunk prefill (one per
+    power-of-two chunk bucket, via jit's shape cache) and the batched
+    decode step.  Cached per (model, geometry, sampling) so several
+    servers compile once."""
+    bs, mb = int(block_size), int(max_blocks)
+    t_cap = bs * mb
+    c = model.cfg
+
+    def block_fwd(layer_params, pool, tables, starts, x, valid):
+        """One transformer block over a chunk ``x`` (B, W, D) whose rows
+        sit at per-row start positions, K/V scattered into the paged
+        pool and attention gathered back through the block tables.
+        Mirrors ``models.generate._block_chunk`` (the pinned dense
+        math) with the cache axis split into (block, offset).  ``valid``
+        (W,) masks pad columns of a bucketed prefill chunk: their writes
+        divert to the sink block."""
+        mods = model._block_modules()
+        h = mods["ln1"].apply(layer_params["ln1"], x)
+        qkv = mods["qkv"].apply(layer_params["qkv"], h)
+        b, w, _ = qkv.shape
+        q, k, v = split_qkv(c, qkv)   # q: (B,W,H,hd); k/v: (B,W,KV,hd)
+        positions = starts[:, None] + jnp.arange(w)[None, :]    # (B, W)
+        if c.pos_encoding == "rope":
+            from ..ops.rope import rope_rotate
+
+            q = rope_rotate(q, positions, c.rope_theta)
+            k = rope_rotate(k, positions, c.rope_theta)
+        # scatter coordinates: each position resolves its own block via
+        # the row's table (chunks straddle block boundaries freely); pad
+        # columns land in the sink
+        blk = jnp.take_along_axis(tables, positions // bs, axis=1)
+        blk = jnp.where(valid[None, :], blk, SINK_BLOCK)
+        off = jnp.where(valid[None, :], positions % bs, 0)
+        quant = "k_scale" in pool
+        if quant:
+            k, ks = _quantize_kv(k)
+            v, vs = _quantize_kv(v)
+            new_ksp = pool["k_scale"].at[blk, off].set(ks)
+            new_vsp = pool["v_scale"].at[blk, off].set(vs)
+        new_kp = pool["k"].at[blk, off].set(k.astype(pool["k"].dtype))
+        new_vp = pool["v"].at[blk, off].set(v.astype(pool["v"].dtype))
+        # gather each row's attended window: (B, MB, bs, kv, hd) ->
+        # (B, T_cap, kv, hd), positions in ascending order — the same
+        # values, same order, as the dense cache's (B, T, kv, hd) slab
+        gk = new_kp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
+        gv = new_vp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
+        scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
+        mask = (jnp.arange(t_cap)[None, None, :]
+                <= positions[:, :, None])               # (B, W, T_cap)
+        if quant:
+            gks = new_ksp[tables].reshape(b, t_cap, c.kv_heads)
+            gvs = new_vsp[tables].reshape(b, t_cap, c.kv_heads)
+        if c.kv_heads == c.n_heads:
+            logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                q.astype(jnp.float32),
+                                gk.astype(jnp.float32)) * scale
+            if quant:
+                logits = logits * gks.transpose(0, 2, 1)[:, :, None, :]
+            logits = jnp.where(mask[:, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if quant:
+                probs = probs * gvs.transpose(0, 2, 1)[:, :, None, :]
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                             gv.astype(jnp.float32)).astype(x.dtype)
+        else:
+            g = c.n_heads // c.kv_heads
+            q5 = q.reshape(b, w, c.kv_heads, g, c.head_dim)
+            logits = jnp.einsum("bqcgd,bkcd->bcgqk",
+                                q5.astype(jnp.float32),
+                                gk.astype(jnp.float32)) * scale
+            if quant:
+                logits = logits * gks.transpose(0, 2, 1)[:, :, None,
+                                                         None, :]
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            if quant:
+                probs = probs * gvs.transpose(0, 2, 1)[:, :, None,
+                                                       None, :]
+            out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
+                             gv.astype(jnp.float32)).astype(x.dtype)
+            out = out.reshape(b, w, c.n_heads, c.head_dim)
+        out = out.reshape(b, w, c.d_model)
+        x = x + mods["attn_out"].apply(layer_params["attn_out"], out)
+        h = mods["ln2"].apply(layer_params["ln2"], x)
+        if c.moe_experts > 0:
+            ff, _ = mods["moe"].apply(layer_params["moe"], h)
+        else:
+            ff = model._ffn(mods, layer_params, h)
+        new_pool = {"k": new_kp, "v": new_vp}
+        if quant:
+            new_pool.update(k_scale=new_ksp, v_scale=new_vsp)
+        return x + ff.astype(x.dtype), new_pool
+
+    def forward(params, pools, tables, starts, ids, valid):
+        # clamp pad columns' embedding positions into range (their
+        # outputs are discarded; learned positional tables have no row
+        # past max_seq_len)
+        w = ids.shape[1]
+        emb_pos = jnp.minimum(starts[:, None] + jnp.arange(w)[None, :],
+                              c.max_seq_len - 1)
+        x = model.embed(params, ids, emb_pos)
+        new_pools = []
+        for layer_params, pool in zip(params["blocks"], pools):
+            x, pool = block_fwd(layer_params, pool, tables, starts, x,
+                                valid)
+            new_pools.append(pool)
+        return model.head_logits(params, x), new_pools
+
+    def prefill(params, pools, table, start, chunk, true_w):
+        # chunk (1, W_bucket) int32; logits for ALL columns return and
+        # the caller indexes the true last position (same contract as
+        # the dense server's bucketed prefill)
+        valid = jnp.arange(chunk.shape[1]) < true_w
+        return forward(params, pools, table, start, chunk, valid)
+
+    def step(params, pools, tokens, tables, pos, active, key):
+        s = tokens.shape[0]
+        cap = tokens.shape[1] - 1
+        ids = jnp.take_along_axis(tokens, pos[:, None], axis=1)  # (S, 1)
+        logits, new_pools = forward(params, pools, tables, pos, ids,
+                                    jnp.ones((1,), bool))
+        nxt, key = _sample(logits[:, 0], temperature, key, top_k, top_p)
+        # frozen slots re-write the token already there (idempotent) and
+        # hold position — the dense server's exact bookkeeping
+        nxt = jnp.where(active, nxt, jnp.take_along_axis(
+            tokens, jnp.minimum(pos + 1, cap)[:, None], axis=1)[:, 0])
+        write_at = jnp.minimum(pos + 1, cap)
+        tokens = tokens.at[jnp.arange(s), write_at].set(nxt)
+        pos = jnp.where(active, jnp.minimum(pos + 1, cap), pos)
+        return new_pools, tokens, pos, key
+
+    return (jax.jit(prefill, donate_argnums=(1,)),
+            jax.jit(step, donate_argnums=(1, 2, 4)))
+
+
+@dataclass
+class _Stream:
+    """Host bookkeeping for one in-flight request."""
+    rid: int
+    prompt: List[int]
+    max_new: int
+    target: int                       # prompt_len + max_new
+    blocks: List[int] = field(default_factory=list)
+    prefilled: int = 0                # prompt tokens written so far
+
+
+class PagedDecodeServer:
+    """Slot server over a paged KV pool: same host contract as the dense
+    ``DecodeServer`` (submit/step/done/result), plus the paged-runtime
+    surface a scheduler drives — partial (chunked) prefill, on-demand
+    block growth, eviction, and free-block/slot introspection."""
+
+    def __init__(self, model: Transformer, params: Pytree, *,
+                 slots: int = 8, num_blocks: int = 64,
+                 block_size: int = 16, max_len: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: int = 0,
+                 kv_quant: bool = False):
+        c = model.cfg
+        self.model, self.params = model, params
+        self.slots = int(slots)
+        self.block_size = int(block_size)
+        self.max_len = int(max_len or c.max_seq_len)
+        if self.max_len > c.max_seq_len:
+            raise ValueError(f"max_len {self.max_len} exceeds model "
+                             f"max_seq_len {c.max_seq_len}")
+        self.max_blocks = -(-self.max_len // self.block_size)   # ceil
+        self.t_cap = self.max_blocks * self.block_size
+        self.num_blocks = int(num_blocks)
+        self.allocator = BlockAllocator(self.num_blocks)
+        self._sampling = (float(temperature), int(top_k), float(top_p))
+        self.kv_quant = bool(kv_quant)
+        self._prefill_fn, self._step_fn = _paged_programs(
+            model, self.block_size, self.max_blocks, *self._sampling,
+            self.kv_quant)
+        self.pools = init_paged_kv(model, self.num_blocks,
+                                   self.block_size, quant=self.kv_quant)
+        self.tokens = jnp.zeros((self.slots, self.t_cap), jnp.int32)
+        self.pos = jnp.zeros((self.slots,), jnp.int32)
+        self.tables = np.zeros((self.slots, self.max_blocks), np.int32)
+        self.active = np.zeros((self.slots,), bool)     # decoding slots
+        self._pos_host = np.zeros((self.slots,), np.int64)
+        self.key = jax.random.PRNGKey(seed)
+        self._rid = 0
+        self._streams: Dict[int, _Stream] = {}
+        self._slot_of: Dict[int, int] = {}
+        self._results: Dict[int, List[int]] = {}
+        if c.scan_layers:
+            params = dict(params)
+            stacked = params["blocks"]
+            params["blocks"] = [
+                jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+                for i in range(c.n_layers)]
+            self.params = params
+
+    # ---- geometry ------------------------------------------------------
+    def blocks_for(self, length: int) -> int:
+        """Blocks needed to hold ``length`` cache positions."""
+        return -(-int(length) // self.block_size)
+
+    def free_slots(self) -> int:
+        return self.slots - len(self._slot_of)
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def block_utilization(self) -> float:
+        cap = self.allocator.capacity
+        return self.allocator.used_blocks / cap if cap else 0.0
+
+    # ---- admission -----------------------------------------------------
+    def try_admit(self, prompt_ids, max_new_tokens: int) -> Optional[int]:
+        """Reserve a slot + the blocks covering the prompt and the first
+        generated token; no model compute happens here (the scheduler
+        interleaves the prefill chunks).  Returns a request id, or None
+        when a slot or the initial blocks are unavailable.  Raises for a
+        request this server could NEVER hold (over max_len, or more
+        total blocks than the pool owns) — returning None there would
+        make a retry loop spin forever."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt: a request needs at least one "
+                             "token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens {max_new_tokens} < 1")
+        if p + max_new_tokens > self.max_len:
+            raise ValueError(f"prompt {p} + {max_new_tokens} exceeds "
+                             f"server max_len {self.max_len}")
+        total_need = self.blocks_for(p + max_new_tokens)
+        if total_need > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {total_need} blocks but the pool only "
+                f"has {self.allocator.capacity}: unservable at any load")
+        if not self.free_slots():
+            return None
+        blocks = self.allocator.alloc(self.blocks_for(p + 1))
+        if blocks is None:
+            return None
+        slot = next(s for s in range(self.slots)
+                    if s not in self._slot_of.values())
+        rid = self._rid
+        self._rid += 1
+        st = _Stream(rid=rid, prompt=prompt_ids,
+                     max_new=int(max_new_tokens),
+                     target=p + int(max_new_tokens), blocks=blocks)
+        self._streams[rid] = st
+        self._slot_of[rid] = slot
+        # reset the slot BEFORE any prefill chunk: the batched step's
+        # frozen-lane write for this slot is then the position-0 write
+        # prefill itself performs (idempotent — see module docstring)
+        self.tables[slot, :] = SINK_BLOCK
+        self.tables[slot, :len(blocks)] = blocks
+        row = np.zeros((self.t_cap,), np.int32)
+        row[:p] = prompt_ids
+        self.tokens = self.tokens.at[slot].set(jnp.asarray(row))
+        self.pos = self.pos.at[slot].set(0)
+        self._pos_host[slot] = 0
+        self.active[slot] = False
+        return rid
+
+    def prefill_remaining(self, rid: int) -> int:
+        """Prompt tokens not yet prefilled (0 = stream is decoding)."""
+        st = self._streams[rid]
+        return len(st.prompt) - st.prefilled
+
+    def prefill_step(self, rid: int, width: int) -> bool:
+        """Advance ``rid``'s prefill by up to ``width`` prompt tokens
+        (one chunk, padded to a power-of-two bucket so compiled prefill
+        programs stay O(log max_len)).  On the final chunk, samples the
+        first output token and activates the stream.  Returns True when
+        prefill is complete."""
+        st = self._streams[rid]
+        slot = self._slot_of[rid]
+        p = len(st.prompt)
+        remaining = p - st.prefilled
+        if remaining <= 0:
+            return True
+        w = min(int(width), remaining)
+        if w < 1:
+            raise ValueError(f"prefill width {width} < 1")
+        bucket = 8
+        while bucket < w:
+            bucket *= 2
+        chunk = st.prompt[st.prefilled:st.prefilled + w] + [0] * (bucket - w)
+        logits, self.pools = self._prefill_fn(
+            self.params, self.pools,
+            jnp.asarray(self.tables[slot:slot + 1]),
+            jnp.asarray([st.prefilled], jnp.int32),
+            jnp.asarray([chunk], jnp.int32),
+            jnp.asarray(w, jnp.int32))
+        st.prefilled += w
+        if st.prefilled < p:
+            return False
+        t, tk, tp = self._sampling
+        first_row, self.key = _sample(logits[:, w - 1], t, self.key, tk, tp)
+        self.tokens = self.tokens.at[slot, p].set(first_row[0])
+        self.pos = self.pos.at[slot].set(p)
+        self._pos_host[slot] = p
+        self.active[slot] = st.max_new > 1
+        if st.max_new <= 1:
+            self._finish(rid)
+        return True
+
+    # ---- block growth / eviction --------------------------------------
+    def needs_block(self) -> List[int]:
+        """Rids of active streams whose NEXT decode write crosses into an
+        unallocated block."""
+        out = []
+        for rid, slot in self._slot_of.items():
+            if not self.active[slot]:
+                continue
+            nxt = int(self._pos_host[slot]) + 1
+            if nxt < self.t_cap and \
+                    nxt // self.block_size >= len(self._streams[rid].blocks):
+                out.append(rid)
+        return out
+
+    def ensure_blocks(self) -> List[int]:
+        """Grow every stream that needs its next block; returns the rids
+        the pool could NOT satisfy (the scheduler's eviction trigger)."""
+        short = []
+        for rid in self.needs_block():
+            got = self.allocator.alloc(1)
+            if got is None:
+                short.append(rid)
+                continue
+            st = self._streams[rid]
+            slot = self._slot_of[rid]
+            self.tables[slot, len(st.blocks)] = got[0]
+            st.blocks.extend(got)
+        return short
+
+    def evict(self, rid: int):
+        """Preempt ``rid``: free its blocks (table zeroed to the sink
+        first, so the frozen lane cannot touch live blocks) and forget
+        the stream.  Returns ``(prompt_ids, max_new_tokens)`` for the
+        caller to requeue; generated tokens are discarded and recomputed
+        on re-admission (greedy re-runs reproduce them exactly)."""
+        st = self._streams.pop(rid)
+        slot = self._slot_of.pop(rid)
+        self.tables[slot, :] = SINK_BLOCK
+        self.allocator.free(st.blocks)
+        self.active[slot] = False
+        return list(st.prompt), st.max_new
+
+    # ---- decode --------------------------------------------------------
+    def step(self) -> List[int]:
+        """One batched decode step across all slots; returns the rids
+        that finished this step.  Completion comes from host-side
+        position counters — no device fetch.  Raises
+        :class:`BlockExhausted` when a stream's next write has no block
+        (call :meth:`ensure_blocks` / evict first)."""
+        if not self.active.any():
+            return []
+        short = self.ensure_blocks()
+        if short:
+            raise BlockExhausted(short)
+        # non-active lanes (free, finished, MID-PREFILL) see an all-sink
+        # table: their writes land in the sink and their reads gather
+        # garbage that is discarded — so live blocks are written ONLY by
+        # prefill chunks and active decode lanes, and parity never rests
+        # on a frozen lane recomputing bitwise-identical K/V under a
+        # different batch shape
+        masked = np.where(self.active[:, None], self.tables, SINK_BLOCK)
+        self.pools, self.tokens, self.pos, self.key = self._step_fn(
+            self.params, self.pools, self.tokens,
+            jnp.asarray(masked), self.pos,
+            jnp.asarray(self.active), self.key)
+        finished = []
+        for rid, slot in list(self._slot_of.items()):
+            if not self.active[slot]:
+                continue
+            self._pos_host[slot] += 1
+            if self._pos_host[slot] + 1 >= self._streams[rid].target:
+                self._finish(rid)
+                finished.append(rid)
+        return finished
+
+    def _finish(self, rid: int) -> None:
+        st = self._streams.pop(rid)
+        slot = self._slot_of.pop(rid)
+        # zero the table BEFORE freeing: the next step's frozen-lane
+        # write must go to the sink, never into a block someone else
+        # just allocated
+        self.tables[slot, :] = SINK_BLOCK
+        self.allocator.free(st.blocks)
+        self.active[slot] = False
+        row = np.asarray(jax.device_get(self.tokens[slot]))
+        self._results[rid] = [int(t) for t in row[:st.target]]
+
+    # ---- results -------------------------------------------------------
+    def done(self, rid: int) -> bool:
+        if rid in self._results:
+            return True
+        if rid in self._streams:
+            return False
+        raise KeyError(f"request {rid}: unknown or already consumed")
+
+    def result(self, rid: int) -> List[int]:
+        """Prompt + generated ids for a finished request (pops it)."""
+        return self._results.pop(rid)
+
+    def live(self) -> int:
+        return len(self._streams)
+
+    def any_active(self) -> bool:
+        return bool(self.active.any())

@@ -1,0 +1,452 @@
+"""Continuous-batching scheduler: the service loop over the paged server.
+
+``PagedDecodeServer`` is mechanism (slots, blocks, one compiled step);
+this module is policy — the part a production decode service needs on
+top of the library loop the repo had before this subsystem:
+
+* **Bounded wait queue**: ``submit()`` enqueues (FIFO) up to
+  ``queue_depth``; beyond that requests are REJECTED (counted, and the
+  caller told), because an unbounded queue just converts overload into
+  unbounded latency.
+* **Per-tick admit/retire**: every :meth:`Scheduler.tick` retires
+  finished streams, admits from the queue head while a slot + the
+  prompt's blocks + the token budget allow, runs at most one chunked
+  prefill chunk, and advances all decoding streams one batched step —
+  requests join and leave mid-flight, never stalling the batch.
+  Admission is head-of-line (no skip-ahead): simple, and what makes the
+  no-starvation property provable — the queue head cannot be bypassed
+  forever by luckier requests.
+* **Chunked prefill interleaved with decode**: a long prompt is written
+  ``prefill_chunk`` positions per tick, so admission of a 10k-token
+  prompt costs in-flight streams bounded added latency per tick instead
+  of one giant stall (the continuous-batching contract).
+* **SLO-aware eviction**: every request carries a deadline
+  (``t_submit + slo_ms``; no SLO = +inf).  When the pool cannot supply a
+  stream's next block, the LATEST-deadline stream is evicted — its
+  blocks freed, the request requeued at the FRONT of the queue (original
+  arrival time and deadline kept).  The earliest-deadline stream is
+  never evicted while others exist, so the oldest obligation always
+  makes progress: under any closed arrival sequence the system drains
+  (the fuzz test's no-starvation/no-leak invariant).
+* **Serving telemetry**: ``kind="serve"`` tick records and
+  ``kind="serve_req"`` per-request completion records (TTFT/ITL) go into
+  the same ``metrics.jsonl`` stream PR 2's trainer writes, and the
+  heartbeat file is the same atomic ``heartbeat.json`` —
+  ``train.resilience.supervise(heartbeat_path=...)`` and
+  ``tools/metrics_summary.py`` work on a serving process unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Deque, Dict, List, Optional
+
+from ..models.transformer import Transformer
+from ..train.telemetry import Heartbeat
+from ..utils.logging import log
+from .paged_kv import PagedDecodeServer
+
+Pytree = Any
+
+
+@dataclass
+class ServeConfig:
+    """Geometry + policy knobs of the serving runtime."""
+    slots: int = 8                 # concurrent streams in the batched step
+    num_blocks: int = 128          # KV pool blocks (block 0 is the sink)
+    block_size: int = 16           # cache positions per block
+    max_len: Optional[int] = None  # per-stream cap (default model max)
+    queue_depth: int = 64          # bounded wait queue; beyond = rejected
+    prefill_chunk: int = 32        # prompt positions prefilled per tick
+    token_budget: int = 0          # max committed (prompt+max_new) tokens
+    #                                in flight; 0 disables the gate
+    default_slo_ms: Optional[float] = None  # deadline for SLO-less submits
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    kv_quant: bool = False
+    telemetry_dir: Optional[str] = None
+    metrics_every: int = 25        # ticks between kind="serve" records
+    completed_history: int = 1024  # completed Requests kept for stats();
+    #                                older ones (and their unconsumed
+    #                                results) are pruned so a long-lived
+    #                                serving process cannot grow without
+    #                                bound
+
+
+@dataclass
+class Request:
+    """One request's lifecycle; the scheduler keeps it (with timings)
+    after completion so load generators can read TTFT/ITL off it."""
+    rid: int
+    prompt: List[int]
+    max_new: int
+    t_submit: float
+    deadline: float                       # t_submit + slo_ms, or +inf
+    slo_ms: Optional[float] = None
+    t_first: Optional[float] = None       # first output token sampled
+    t_done: Optional[float] = None
+    evictions: int = 0
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.t_first is None:
+            return None
+        return (self.t_first - self.t_submit) * 1e3
+
+    @property
+    def itl_ms(self) -> Optional[float]:
+        """Mean inter-token latency over the decode phase."""
+        if self.t_done is None or self.t_first is None:
+            return None
+        return ((self.t_done - self.t_first)
+                / max(1, self.max_new - 1)) * 1e3
+
+    @property
+    def deadline_missed(self) -> Optional[bool]:
+        if self.t_done is None:
+            return None
+        return bool(math.isfinite(self.deadline)
+                    and self.t_done > self.deadline)
+
+
+class _ServeTelemetry:
+    """Serving metrics through the PR 2 channel: kind="serve" /
+    "serve_req" records into metrics.jsonl + the standard heartbeat.
+    No-op when ``telemetry_dir`` is unset."""
+
+    def __init__(self, dirpath: Optional[str], metrics_every: int):
+        self.enabled = bool(dirpath)
+        self.metrics_every = max(1, int(metrics_every))
+        self._jsonl = None
+        self.heartbeat = Heartbeat(None)
+        if not self.enabled:
+            return
+        os.makedirs(dirpath, exist_ok=True)
+        self.metrics_path = os.path.join(dirpath, "metrics.jsonl")
+        self._jsonl = open(self.metrics_path, "a")
+        self.heartbeat = Heartbeat(os.path.join(dirpath, "heartbeat.json"))
+        self._t0 = time.perf_counter()
+        self._last_tokens = 0
+        self._last_t = self._t0
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
+    def on_tick(self, tick: int, snap: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        if tick % self.metrics_every:
+            # the heartbeat still refreshes (throttled internally): the
+            # supervisor's staleness monitor watches mtime, not records
+            self.heartbeat.beat(tick, None)
+            return
+        now = time.perf_counter()
+        rec = {"kind": "serve", "step": int(tick),
+               "t": round(now - self._t0, 6), **snap}
+        dt = now - self._last_t
+        if dt > 0:
+            rec["tokens_per_sec"] = round(
+                (snap["tokens_out"] - self._last_tokens) / dt, 2)
+        self._last_tokens = snap["tokens_out"]
+        self._last_t = now
+        self._write(rec)
+        self.heartbeat.beat(tick, rec)
+
+    def on_request_done(self, req: Request, n_generated: int) -> None:
+        if not self.enabled:
+            return
+        self._write({
+            "kind": "serve_req", "rid": req.rid,
+            "t": round(time.perf_counter() - self._t0, 6),
+            "prompt_tokens": len(req.prompt),
+            "new_tokens": int(n_generated),
+            "ttft_ms": round(req.ttft_ms, 3),
+            "itl_ms": round(req.itl_ms, 3),
+            "total_ms": round((req.t_done - req.t_submit) * 1e3, 3),
+            "evictions": req.evictions,
+            "deadline_missed": req.deadline_missed,
+        })
+
+    def close(self, tick: int, snap: Optional[Dict[str, Any]] = None
+              ) -> None:
+        if not self.enabled:
+            return
+        final_rec = None
+        if snap is not None:
+            # the drain can end off the metrics_every cadence; the final
+            # record must carry the terminal counters regardless
+            final_rec = {"kind": "serve", "step": int(tick),
+                         "t": round(time.perf_counter() - self._t0, 6),
+                         "final": True, **snap}
+            self._write(final_rec)
+        self.heartbeat.beat(tick, final_rec, force=True, final=True)
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+class Scheduler:
+    """The continuous-batching service loop (see module docstring).
+
+    ``now_fn`` injects the clock: tests and the fuzz harness drive a
+    virtual clock so deadline policy is deterministic; production uses
+    ``time.monotonic``."""
+
+    def __init__(self, model: Transformer, params: Pytree,
+                 cfg: Optional[ServeConfig] = None, now_fn=time.monotonic):
+        # fresh default per instance: ServeConfig is a plain mutable
+        # dataclass, and a shared default instance would leak one
+        # caller's tweaks into every later default-constructed Scheduler
+        self.cfg = cfg = ServeConfig() if cfg is None else cfg
+        self.now = now_fn
+        self.server = PagedDecodeServer(
+            model, params, slots=cfg.slots, num_blocks=cfg.num_blocks,
+            block_size=cfg.block_size, max_len=cfg.max_len,
+            temperature=cfg.temperature, top_k=cfg.top_k,
+            top_p=cfg.top_p, seed=cfg.seed, kv_quant=cfg.kv_quant)
+        self.queue: Deque[Request] = collections.deque()
+        self.reqs: Dict[int, Request] = {}      # every request ever seen
+        self._srv_rid: Dict[int, int] = {}      # scheduler rid -> server
+        self._sched_rid: Dict[int, int] = {}    # server rid -> scheduler
+        self._prefilling: Deque[int] = collections.deque()
+        self._results: Dict[int, List[int]] = {}
+        self._done_order: Deque[int] = collections.deque()
+        self._next_rid = 0
+        self.tick_no = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.evicted = 0
+        self.completed = 0
+        self.tokens_out = 0
+        self.telemetry = _ServeTelemetry(cfg.telemetry_dir,
+                                         cfg.metrics_every)
+
+    # ---- client surface ------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               slo_ms: Optional[float] = None) -> Optional[int]:
+        """Enqueue a request; returns its id, or None when the bounded
+        queue is full (the request is REJECTED — overload sheds load
+        instead of growing latency without bound).  Raises for requests
+        the server could never hold (over ``max_len`` / pool capacity),
+        mirroring ``PagedDecodeServer.try_admit``'s loud refusal."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens {max_new_tokens} < 1")
+        if p + max_new_tokens > self.server.max_len:
+            raise ValueError(f"prompt {p} + {max_new_tokens} exceeds "
+                             f"max_len {self.server.max_len}")
+        if (self.server.blocks_for(p + max_new_tokens)
+                > self.server.allocator.capacity):
+            raise ValueError("request needs more KV blocks than the pool "
+                             "owns: unservable at any load")
+        if len(self.queue) >= self.cfg.queue_depth:
+            self.rejected += 1
+            return None
+        slo = self.cfg.default_slo_ms if slo_ms is None else slo_ms
+        now = self.now()
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid, prompt=prompt_ids,
+                      max_new=int(max_new_tokens), t_submit=now,
+                      deadline=(now + slo / 1e3 if slo is not None
+                                else math.inf),
+                      slo_ms=slo)
+        self.reqs[rid] = req
+        self.queue.append(req)
+        return rid
+
+    def done(self, rid: int) -> bool:
+        if rid in self._results:
+            return True
+        if rid in self._srv_rid or any(r.rid == rid for r in self.queue):
+            return False
+        raise KeyError(f"request {rid}: unknown or already consumed")
+
+    def result(self, rid: int) -> List[int]:
+        """Prompt + generated ids (pops the tokens; timings stay
+        readable via :meth:`stats`)."""
+        return self._results.pop(rid)
+
+    def stats(self, rid: int) -> Request:
+        return self.reqs[rid]
+
+    def in_flight(self) -> int:
+        return len(self._srv_rid)
+
+    def pending(self) -> int:
+        return len(self.queue)
+
+    # ---- the service loop ----------------------------------------------
+    def tick(self) -> List[int]:
+        """One scheduler tick: retire/admit/prefill/decode.  Returns the
+        rids completed during this tick."""
+        self.tick_no += 1
+        done_now: List[int] = []
+        self._admit()
+        done_now += self._prefill_tick()
+        if self.server.any_active():
+            self._grow_or_evict()
+            for srv_rid in self.server.step():
+                done_now.append(self._retire(srv_rid))
+        self.telemetry.on_tick(self.tick_no, self._snapshot())
+        return done_now
+
+    def run_until_drained(self, max_ticks: int = 100_000) -> List[int]:
+        """Tick until queue + in-flight are empty; returns completion
+        order.  ``max_ticks`` is a hard stop so a policy bug shows up as
+        a loud failure, not a hang."""
+        order: List[int] = []
+        for _ in range(max_ticks):
+            if not (self.queue or self._srv_rid):
+                return order
+            order += self.tick()
+        raise RuntimeError(
+            f"not drained after {max_ticks} ticks: queue="
+            f"{len(self.queue)} in_flight={len(self._srv_rid)}")
+
+    def close(self) -> None:
+        self.telemetry.close(self.tick_no, self._snapshot())
+
+    # ---- internals -----------------------------------------------------
+    def _committed_tokens(self) -> int:
+        return sum(len(r.prompt) + r.max_new
+                   for rid, r in self.reqs.items()
+                   if rid in self._srv_rid)
+
+    def _admit(self) -> None:
+        while self.queue:
+            req = self.queue[0]
+            p = len(req.prompt)
+            if self.server.free_slots() == 0:
+                return
+            # normal admission overcommits (blocks for the prompt + first
+            # token only — growth is on demand; that overcommit IS the
+            # capacity win).  A request that already got evicted proved
+            # overcommit fails for it right now: hold it at the head
+            # until the pool can cover its FULL need, else it would
+            # thrash admit->grow->evict while the same streams hold the
+            # pool.
+            need = (self.server.blocks_for(p + req.max_new)
+                    if req.evictions else self.server.blocks_for(p + 1))
+            if self.server.free_blocks < need:
+                return
+            if (self.cfg.token_budget > 0
+                    and self._committed_tokens() + p + req.max_new
+                    > self.cfg.token_budget):
+                return
+            srv_rid = self.server.try_admit(req.prompt, req.max_new)
+            if srv_rid is None:
+                return
+            self.queue.popleft()
+            self._srv_rid[req.rid] = srv_rid
+            self._sched_rid[srv_rid] = req.rid
+            self._prefilling.append(req.rid)
+            self.admitted += 1
+
+    def _prefill_tick(self) -> List[int]:
+        """At most one prefill chunk per tick (interleaving: decoding
+        streams advance every tick regardless of admission work)."""
+        done_now: List[int] = []
+        if not self._prefilling:
+            return done_now
+        rid = self._prefilling[0]
+        srv_rid = self._srv_rid[rid]
+        if self.server.prefill_step(srv_rid, self.cfg.prefill_chunk):
+            self._prefilling.popleft()
+            self.reqs[rid].t_first = self.now()
+            if self.server.done(srv_rid):   # single-token request
+                done_now.append(self._retire(srv_rid))
+        return done_now
+
+    def _grow_or_evict(self) -> None:
+        """Supply every decoding stream's next block, evicting
+        latest-deadline streams under exhaustion.  The earliest-deadline
+        stream is never evicted while another in-flight stream exists —
+        the oldest obligation always progresses."""
+        while self.server.ensure_blocks():
+            victim = self._pick_victim()
+            if victim is None:
+                # unreachable when submit()'s capacity guard holds: a
+                # sole stream owns every non-free block, and the pool
+                # covers any single stream end to end
+                raise RuntimeError("block exhaustion with no evictable "
+                                   "stream (capacity guard violated)")
+            self._evict(victim)
+
+    def _pick_victim(self) -> Optional[int]:
+        inflight = [self.reqs[rid] for rid in self._srv_rid]
+        if len(inflight) <= 1:
+            return None
+        key = lambda r: (r.deadline, r.t_submit, r.rid)   # noqa: E731
+        protected = min(inflight, key=key)
+        victim = max(inflight, key=key)
+        if victim.rid == protected.rid:
+            return None
+        return victim.rid
+
+    def _evict(self, rid: int) -> None:
+        srv_rid = self._srv_rid.pop(rid)
+        self._sched_rid.pop(srv_rid)
+        self.server.evict(srv_rid)
+        if rid in self._prefilling:
+            self._prefilling.remove(rid)
+        req = self.reqs[rid]
+        req.evictions += 1
+        req.t_first = None          # TTFT restarts: tokens are recomputed
+        self.queue.appendleft(req)  # front: original arrival order kept
+        self.evicted += 1
+        log(f"[serve] evicted rid={rid} (deadline "
+            f"{'inf' if math.isinf(req.deadline) else round(req.deadline, 3)}"
+            f"); requeued at front")
+
+    def _retire(self, srv_rid: int) -> int:
+        rid = self._sched_rid.pop(srv_rid)
+        self._srv_rid.pop(rid)
+        req = self.reqs[rid]
+        req.t_done = self.now()
+        if req.t_first is None:
+            req.t_first = req.t_done
+        toks = self.server.result(srv_rid)
+        self._results[rid] = toks
+        n_gen = len(toks) - len(req.prompt)
+        self.completed += 1
+        self.tokens_out += n_gen
+        self.telemetry.on_request_done(req, n_gen)
+        # bounded retention: stats()/result() stay readable for the last
+        # completed_history completions (plenty for a load generator's
+        # post-completion read), then both the Request and any
+        # never-consumed result are pruned — a service that runs for
+        # days must not grow per-request state without bound
+        self._done_order.append(rid)
+        while len(self._done_order) > max(1, self.cfg.completed_history):
+            old = self._done_order.popleft()
+            self.reqs.pop(old, None)
+            self._results.pop(old, None)
+        return rid
+
+    def _snapshot(self) -> Dict[str, Any]:
+        return {
+            "queue_depth": len(self.queue),
+            "live": len(self._srv_rid),
+            "prefilling": len(self._prefilling),
+            "free_blocks": self.server.free_blocks,
+            "block_utilization": round(self.server.block_utilization(), 4),
+            "committed_tokens": self._committed_tokens(),
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "evicted": self.evicted,
+            "completed": self.completed,
+            "tokens_out": self.tokens_out,
+        }

@@ -55,6 +55,13 @@ Layout under ``--telemetry_dir``::
     heartbeat.json    freshest run-health snapshot (atomic replace)
     postmortem.json   flight-recorder dump, written on abnormal events
 
+The stream is SHARED with the serving runtime: serve/scheduler.py writes
+``kind="serve"`` tick records and ``kind="serve_req"`` per-request
+completions into the same metrics.jsonl schema and refreshes the same
+heartbeat file (through :class:`Heartbeat`), so the supervisor's
+stale-heartbeat monitor and tools/metrics_summary.py treat a serving
+process exactly like a training run.
+
 Everything is zero-cost when ``telemetry_dir`` is unset, and file writes
 are leader-only (multi-host safe).
 """

@@ -289,3 +289,18 @@ def test_example_19_multi_step_dispatch_completes():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "trajectory identical" in out.stdout
+
+
+def test_example_20_paged_serving_completes():
+    """The serve/ subsystem end to end on CPU: ragged prompts with SLOs
+    through the continuous-batching scheduler over the paged KV pool;
+    the script itself asserts token parity with generate() and a fully
+    drained block allocator, and prints per-request TTFT/ITL."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "20_paged_serving.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "block pool fully drained" in out.stdout
+    assert "TTFT" in out.stdout

@@ -102,6 +102,38 @@ def summarize(records: List[Dict[str, Any]],
             total += int(v - prev)
         prev = v
     out["skipped_updates"] = total
+    # serving records (serve/scheduler.py): kind="serve_req" carries one
+    # completed request's latency pair — percentiles across requests are
+    # THE serving health numbers — and kind="serve" ticks carry the
+    # queue/pool state + cumulative admission counters
+    serve_reqs = [r for r in records if r.get("kind") == "serve_req"]
+    if serve_reqs:
+        serving: Dict[str, Any] = {"requests": len(serve_reqs)}
+        for key in ("ttft_ms", "itl_ms", "total_ms"):
+            vals = sorted(_series(serve_reqs, key))
+            if vals:
+                serving[key] = {"p50": _percentile(vals, 0.50),
+                                "p99": _percentile(vals, 0.99),
+                                "max": vals[-1]}
+        serving["evictions"] = int(sum(_series(serve_reqs, "evictions")))
+        serving["deadline_missed"] = sum(
+            1 for r in serve_reqs if r.get("deadline_missed"))
+        out["serving"] = serving
+    serve_ticks = [r for r in records if r.get("kind") == "serve"]
+    if serve_ticks:
+        tick_stats: Dict[str, Any] = {}
+        for key in ("queue_depth", "block_utilization", "tokens_per_sec"):
+            vals = sorted(_series(serve_ticks, key))
+            if vals:
+                tick_stats[key] = {"p50": _percentile(vals, 0.50),
+                                   "p95": _percentile(vals, 0.95),
+                                   "max": vals[-1]}
+        last = serve_ticks[-1]
+        for key in ("admitted", "rejected", "evicted", "completed",
+                    "tokens_out"):
+            if key in last:
+                tick_stats[key] = last[key]
+        out["serving_ticks"] = tick_stats
     # elastic topology-change events (kind=topology, train.telemetry):
     # the moments the run resumed on a different world than the one that
     # saved its checkpoint — effective batch/accumulation may change there
@@ -154,6 +186,35 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
             f"devices (dp {t.get('from_dp')} -> {t.get('to_dp')}) at step "
             f"{t.get('step')}, policy {t.get('policy')}"
             + (f" ({', '.join(detail)})" if detail else ""))
+    if "serving" in summary:
+        sv = summary["serving"]
+        lines.append(f"serving: {sv['requests']} requests")
+        for key, label in (("ttft_ms", "ttft"), ("itl_ms", "itl"),
+                           ("total_ms", "total")):
+            if key in sv:
+                lines.append(
+                    f"  {label:<14} p50 {sv[key]['p50']:.6g}   "
+                    f"p99 {sv[key]['p99']:.6g}   max {sv[key]['max']:.6g}"
+                    " ms")
+        if sv.get("evictions"):
+            lines.append(f"  evictions: {sv['evictions']}")
+        if sv.get("deadline_missed"):
+            lines.append(f"  DEADLINES MISSED: {sv['deadline_missed']}")
+    if "serving_ticks" in summary:
+        st = summary["serving_ticks"]
+        counters = "/".join(str(st.get(k, "?")) for k in
+                            ("admitted", "rejected", "evicted",
+                             "completed"))
+        lines.append(f"serving ticks: adm/rej/evict/done {counters}, "
+                     f"{st.get('tokens_out', 0)} tokens out")
+        for key, unit in (("queue_depth", ""),
+                          ("block_utilization", ""),
+                          ("tokens_per_sec", "tok/s")):
+            if key in st:
+                lines.append(
+                    f"  {key:<18} p50 {st[key]['p50']:.6g}   "
+                    f"p95 {st[key]['p95']:.6g}   max {st[key]['max']:.6g}"
+                    + (f" {unit}" if unit else ""))
     if heartbeat is not None:
         age = ("?" if heartbeat_age is None
                else f"{heartbeat_age:.1f}s ago")
