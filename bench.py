@@ -1198,7 +1198,8 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
         if isinstance(doc, dict):
             return (doc.get("attention_artifact")
                     or doc.get("decode_artifact")
-                    or doc.get("serve_artifact"))
+                    or doc.get("serve_artifact")
+                    or doc.get("update_sharding_artifact"))
     return None
 
 
@@ -1542,6 +1543,206 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
     with open(out_path, "w") as f:
         json.dump(results, f, indent=2)
     log(f"decode comparison -> {out_path}: {results}")
+    return out_path
+
+
+def bench_update_sharding(out_path: str = "BENCH_UPDATE_SHARDING.json",
+                          reps: int = 3, chain: int = 2) -> str:
+    """Interleaved A/B of the replicated vs automatic-sharded weight
+    update (ROADMAP item 2; parallel.update_sharding) at the CPU-bench
+    transformer scale (DESIGN §7's 4L/d256/T128 — the _LM config at
+    seq 128), on the full virtual-device DP mesh.  Three arms:
+
+      replicated            the baseline full-psum update
+      sharded               per-leaf reduce-scatter -> 1/N update ->
+                            all-gather (update_sharding='sharded')
+      sharded_bf16_master   the same plus bf16 param storage with f32
+                            master weights in the sharded opt state
+                            (--param_dtype bfloat16 --master_weights)
+
+    Methodology: interleaved pairs (DESIGN §7 — grouping all A reps
+    before all B reps on the single shared core lets one load spike
+    masquerade as a delta); per-arm best-of-k and median step_ms.  The
+    SPEED claim on this host is only "no worse" — XLA:CPU serializes
+    every virtual device on one core, so the reduce-scatter's bandwidth
+    win cannot show as wall time; the win is claimed in (a) the
+    analytic per-device optimizer-state bytes (~1/N, exact) and (b) the
+    compiled-HLO overlap evidence (per-leaf reduce-scatters interleaved
+    with backward matmuls — ``collective_report``), plus the donation
+    audit (every state leaf aliased in/out).
+    """
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+        update_sharding as us,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.train.telemetry import (
+        telemetry_peak_flops, train_step_flops,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+    from neural_networks_parallel_training_with_mpi_tpu.utils.profiling import (
+        donation_report,
+    )
+
+    c = _LM
+    seq, batch_size = 128, 32
+    devices = jax.devices()
+    n = len(devices)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n), devices=devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    compute_dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    base_cfg = TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=seq, n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=compute_dtype)
+    rng = np.random.default_rng(0)
+    raw = {
+        "x": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "y": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "mask": np.ones((batch_size,), np.float32),
+    }
+    batch = shd.shard_batch(mesh, raw)
+    sync = _chain_sync_every()
+
+    def tree_bytes(tree, per_device=False):
+        total = 0
+        for l in jax.tree_util.tree_leaves(tree):
+            shape = (l.addressable_shards[0].data.shape if per_device
+                     else l.shape)
+            total += int(np.prod(shape) or 1) * l.dtype.itemsize
+        return total
+
+    def build(mode):
+        m_cfg = base_cfg
+        opt = optim.sgd(lr=1e-4, momentum=0.9)
+        if mode == "sharded_bf16_master":
+            m_cfg = _dc.replace(base_cfg, param_dtype=jnp.bfloat16)
+            opt = optim.with_master_weights(opt)
+        model = Transformer(m_cfg)
+        if mode == "replicated":
+            state = dp.replicate_state(
+                TrainState.create(model, opt, prng.init_key(0)), mesh)
+            step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                      "global_mean")
+        else:
+            params = model.init(prng.init_key(0))
+            plan = us.plan_updates(params, n)
+            host = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=us.init_opt_state(opt, params, plan))
+            state = us.place_state(host, mesh, opt, plan)
+            step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                      "global_mean",
+                                      update_sharding="sharded",
+                                      update_plan=plan)
+        compiled = step.lower(state, batch).compile()
+        hlo_text = compiled.as_text()  # rendered once, tens of MB
+        arm = {
+            "model": model,
+            "comp": compiled,
+            "state": state,
+            "param_bytes": tree_bytes(state.params),
+            "opt_bytes_total": tree_bytes(state.opt_state),
+            "opt_bytes_per_device": tree_bytes(state.opt_state,
+                                               per_device=True),
+            "hlo": us.collective_report(hlo_text),
+            "donation": {
+                k: v for k, v in donation_report(
+                    compiled, hlo_text=hlo_text).items()
+                if k != "aliased"},
+            "n_state_leaves": len(jax.tree_util.tree_leaves(state)),
+        }
+        try:
+            ma = compiled.memory_analysis()
+            arm["xla_temp_bytes"] = int(
+                getattr(ma, "temp_size_in_bytes", 0)) or None
+        except Exception:  # noqa: BLE001 — analysis is best-effort
+            arm["xla_temp_bytes"] = None
+        return arm
+
+    arms = {name: build(name)
+            for name in ("replicated", "sharded", "sharded_bf16_master")}
+    # warmup every arm once, then INTERLEAVED pairs (DESIGN §7)
+    for a in arms.values():
+        _, a["state"], _ = timed_chain(a["comp"], a["state"], batch, 1, sync)
+    times = {name: [] for name in arms}
+    loss_vals = {}
+    for _rep in range(reps):
+        for name, a in arms.items():
+            dt, a["state"], loss_vals[name] = timed_chain(
+                a["comp"], a["state"], batch, chain, sync)
+            times[name].append(dt / chain)
+    flops = train_step_flops(arms["replicated"]["model"], raw["x"].shape)
+    peak = telemetry_peak_flops(devices[0].device_kind,
+                                devices[0].platform) * n
+    rec = {
+        "metric": "update_sharding_ab",
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n,
+        "batch": batch_size,
+        "model": {"n_layers": c["n_layers"], "d_model": c["d_model"],
+                  "d_ff": c["d_ff"], "seq": seq, "vocab": c["vocab"]},
+        "reps": reps, "chain_steps": chain,
+        "mfu_denominator": ("chip_peak" if on_tpu
+                            else "nominal_cpu_peak (NNPT_PEAK_FLOPS)"),
+        "arms": {},
+    }
+    base_opt = arms["replicated"]["opt_bytes_per_device"]
+    base_best = min(times["replicated"])
+    for name, a in arms.items():
+        best = min(times[name])
+        med = float(np.median(times[name]))
+        # per-PAIR ratios (each rep's arms ran adjacent in time, so the
+        # ratio within a rep cancels slow host-load drift the way the
+        # best-of-k comparison cannot)
+        pair_ratios = [t / b for t, b in zip(times[name],
+                                             times["replicated"])]
+        assert np.isfinite(loss_vals[name]), (name, loss_vals[name])
+        rec["arms"][name] = {
+            "step_ms_best": round(best * 1e3, 2),
+            "step_ms_median": round(med * 1e3, 2),
+            "step_vs_replicated_best": round(best / base_best, 4),
+            "pair_ratio_median": round(float(np.median(pair_ratios)), 4),
+            "final_loss": round(float(loss_vals[name]), 5),
+            "param_bytes": a["param_bytes"],
+            "opt_bytes_total": a["opt_bytes_total"],
+            "opt_bytes_per_device": a["opt_bytes_per_device"],
+            "opt_per_device_vs_replicated": round(
+                a["opt_bytes_per_device"] / base_opt, 4),
+            "xla_temp_bytes": a["xla_temp_bytes"],
+            "hlo": a["hlo"],
+            "donation": a["donation"],
+            "n_state_leaves": a["n_state_leaves"],
+            "mfu": round(flops / best / peak, 4),
+        }
+        log(f"[update-sharding {name}] best {best * 1e3:.1f} ms/step "
+            f"(median {med * 1e3:.1f}), opt state "
+            f"{a['opt_bytes_per_device'] / 2**20:.1f} MiB/device "
+            f"({a['opt_bytes_per_device'] / base_opt:.2f}x replicated), "
+            f"HLO {a['hlo']['counts']}")
+    rec["note"] = (
+        "interleaved A/B pairs on the shared-core CPU host: wall-time "
+        "parity is the claim here (XLA:CPU serializes the virtual "
+        "devices, so the reduce-scatter bandwidth win cannot show); the "
+        "win is opt_bytes_per_device ~1/n_devices (analytic, exact) + "
+        "the HLO overlap evidence (per-leaf reduce-scatters interleaved "
+        "with backward dots) + bf16 param storage halving param bytes "
+        "with f32 masters costing 1/n_devices")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    log(f"update-sharding A/B -> {out_path}")
     return out_path
 
 
@@ -1905,6 +2106,17 @@ def main() -> int:
                          "BENCH_SERVE.json")
     ap.add_argument("--serve-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--update-sharding-ab", action="store_true",
+                    help="interleaved A/B of replicated vs automatic-"
+                         "sharded weight update (update_sharding="
+                         "'sharded', parallel.update_sharding) at the "
+                         "CPU-bench transformer scale: step_ms, per-"
+                         "device opt-state bytes (~1/N), compiled-HLO "
+                         "overlap evidence, donation audit, bf16 "
+                         "master-weight arm; write "
+                         "BENCH_UPDATE_SHARDING.json")
+    ap.add_argument("--update-sharding-ab-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     ap.add_argument("--grad-reduction", choices=["global_mean", "local"],
@@ -1944,8 +2156,12 @@ def main() -> int:
     if args.serve_inproc:
         print(json.dumps({"serve_artifact": bench_serve()}))
         return 0
+    if args.update_sharding_ab_inproc:
+        print(json.dumps({"update_sharding_artifact":
+                          bench_update_sharding()}))
+        return 0
 
-    if args.attention or args.decode or args.serve:
+    if args.attention or args.decode or args.serve or args.update_sharding_ab:
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -1971,6 +2187,13 @@ def main() -> int:
             else:
                 path = bench_serve()
             print(json.dumps({"serve_artifact": path}))
+        if args.update_sharding_ab:
+            if choice == "cpu":
+                # the A/B needs a real data axis: 8 virtual devices
+                path = _run_flag_cpu_child("--update-sharding-ab-inproc", 8)
+            else:
+                path = bench_update_sharding()
+            print(json.dumps({"update_sharding_artifact": path}))
         return 0
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]

@@ -48,6 +48,23 @@ def _pin_platform(args) -> int:
     return 0
 
 
+def _reinterpret_void_leaves(params, model):
+    """npz stores extension dtypes (ml_dtypes bfloat16 — the
+    --param_dtype bfloat16 training path) as raw void bytes; a
+    template-less decode restore gets them back as ``|V2`` arrays.
+    Reinterpret against the model's param dtype via the same helper the
+    templated restore path uses (utils.checkpoint.reinterpret_void)."""
+    import jax
+    import numpy as np
+
+    from .utils.checkpoint import reinterpret_void
+
+    dt = np.dtype(getattr(getattr(model, "cfg", None), "param_dtype", None)
+                  or np.float32)
+    return jax.tree_util.tree_map(
+        lambda x: reinterpret_void(x, dt), params)
+
+
 def _dense_decode_params(params, model, meta):
     """Normalize a restored checkpoint into the dense per-layer layout the
     KV-cache decoder expects.  Checkpoints from the explicit-TP layouts
@@ -141,7 +158,7 @@ def _generate(args) -> int:
         # could return a different generation's qkv_tp and silently
         # garble the decode weights
         params = _dense_decode_params(
-            restored.params, model,
+            _reinterpret_void_leaves(restored.params, model), model,
             ckpt.read_meta(cfg.checkpoint_dir,
                            step=int(jax.device_get(restored.step))))
         log(f"restored step {int(jax.device_get(restored.step))} from "

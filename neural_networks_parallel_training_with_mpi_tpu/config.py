@@ -225,10 +225,30 @@ class TrainConfig:
     #                    semantics (:188-197); equals global_mean when shards
     #                    are even
     grad_reduction: str = "global_mean"
-    # 'zero1' shards the weight update + optimizer state across the data
-    # axes (reduce-scatter grads, update 1/N slice, all-gather params) —
-    # cross-replica weight-update sharding; pure-DP shard_map path only
-    update_sharding: str = "replicated"  # replicated | zero1
+    # cross-replica weight-update sharding (arXiv 2004.13336):
+    #   zero1   - flat-buffer form: ravel the whole tree into one padded
+    #             f32 buffer sharded over the data axes (shard_map DP /
+    #             DP x seq paths)
+    #   sharded - automatic PER-LEAF form (parallel.update_sharding):
+    #             each leaf's update scatters along its largest dim (tiny
+    #             leaves stay replicated) — reduce-scatter grads, update
+    #             the 1/N slice with 1/N optimizer state, all-gather
+    #             params; one reduce-scatter per leaf, schedulable
+    #             against the backward (comm/compute overlap).  Works on
+    #             the shard_map DP / DP x seq paths AND the GSPMD path
+    #             (expressed there as opt-state NamedShardings).
+    update_sharding: str = "replicated"  # replicated | zero1 | sharded
+    # param storage dtype override for the training job ("" = the model
+    # config's --dtype): bfloat16 halves param HBM and the sharded
+    # update's all-gather bytes; pair with master_weights for f32 update
+    # math
+    param_dtype: str = ""  # "" | float32 | bfloat16 | float16
+    # mixed-precision master weights (ops.optim.with_master_weights):
+    # keep an f32 master copy of the params INSIDE the sharded optimizer
+    # state (1/N per replica — the arXiv 2004.13336 memory trick) and
+    # re-cast into param_dtype each step, so bf16 storage never
+    # accumulates rounding drift.  Requires update_sharding='sharded'.
+    master_weights: bool = False
     # Megatron vocab parallelism on the seq x tensor path: embedding table
     # and LM head sharded on the vocab dim, cross-entropy computed over the
     # sharded logits (never materialized full) — parallel.megatron
@@ -462,10 +482,28 @@ def build_argparser() -> argparse.ArgumentParser:
                    default="global_mean")
     p.add_argument("--seed", type=int, default=0)
     _add_bool_flag(p, "shuffle", True, "shuffle batches each epoch")
-    p.add_argument("--update_sharding", choices=["replicated", "zero1"],
+    p.add_argument("--update_sharding",
+                   choices=["replicated", "zero1", "sharded"],
                    default="replicated",
-                   help="zero1 = shard optimizer state + weight update "
-                        "across the data axes (reduce-scatter/all-gather)")
+                   help="shard optimizer state + weight update across the "
+                        "data axes (reduce-scatter/all-gather): zero1 = "
+                        "flat-buffer form (shard_map DP/DP x seq); "
+                        "sharded = automatic per-leaf form, largest-dim "
+                        "scatter with replicated fallback for tiny "
+                        "leaves, wired on DP, DP x seq AND the GSPMD "
+                        "(tp/fsdp) path — opt-state memory ~1/dp, "
+                        "per-leaf reduce-scatters overlap the backward")
+    p.add_argument("--param_dtype",
+                   choices=["float32", "bfloat16", "float16"], default="",
+                   help="param storage dtype for the training job "
+                        "(default: --dtype); bfloat16 halves param HBM "
+                        "and the sharded update's all-gather bytes — "
+                        "pair with --master_weights for f32 update math")
+    _add_bool_flag(p, "master-weights", False,
+                   "keep an f32 master copy of the params inside the "
+                   "SHARDED optimizer state (1/dp per replica) and "
+                   "re-cast to --param_dtype each step; requires "
+                   "--update_sharding sharded")
     p.add_argument("--vocab_parallel", action="store_true",
                    help="shard the embedding table + LM head on the vocab "
                         "dim with sharded-softmax cross-entropy (seq x "
@@ -724,6 +762,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         loss=args.loss, label_smoothing=args.label_smoothing,
         grad_reduction=args.grad_reduction,
         update_sharding=args.update_sharding,
+        param_dtype=args.param_dtype,
+        master_weights=args.master_weights,
         vocab_parallel=args.vocab_parallel,
         seed=args.seed,
         shuffle=args.shuffle,
@@ -762,8 +802,12 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                           seq_len=args.seq_len, vocab_size=args.vocab_size,
                           text_file=args.text_file,
                           backend=args.data_backend)
+    # --param_dtype overrides the model's param storage dtype HERE (not
+    # only in the Trainer) so every CLI consumer — training, --generate
+    # decode, template-building — derives the same model dtype; the
+    # compute dtype still defaults from --dtype alone
     cfg.model = ModelConfig(arch=args.arch, in_features=args.n_features,
-                            dtype=args.dtype,
+                            dtype=args.param_dtype or args.dtype,
                             compute_dtype=args.compute_dtype or args.dtype,
                             remat=args.remat,
                             remat_policy=args.remat_policy,

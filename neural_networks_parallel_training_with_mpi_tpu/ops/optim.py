@@ -371,14 +371,17 @@ def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
     the guard wraps — clipping would mask the anomaly the threshold is
     there to catch).
 
-    Correctness requires the gradients this wrapper sees to be identical
-    on every shard that holds a given parameter — true wherever the update
-    runs on fully-reduced (post-psum) or global-view gradients: the
-    shard_map DP / DP x SP paths and the GSPMD path.  Layouts that call
-    ``optimizer.update`` on axis-sharded gradient *slices* (zero1's
-    scattered flat shard, pipeline stages, expert/tensor slicing) would
-    make the norm — and hence the skip decision — shard-local and
-    divergent; the Trainer refuses the guard there.
+    Correctness requires the skip PREDICATE to be identical on every
+    shard that holds a given parameter.  That holds wherever the update
+    runs on fully-reduced (post-psum) or global-view gradients — the
+    shard_map DP / DP x SP paths and the GSPMD path — and on the
+    sharded-update layouts (zero1's scattered flat shard, the per-leaf
+    ``update_sharding='sharded'`` path), which compute the GLOBAL norm
+    from psum'd shard squares inside the step and hand it in via
+    ``update_with_norm``.  Layouts whose update consumes axis-sharded
+    slices without that psum'd norm (pipeline stages, expert/tensor
+    slicing) would make the decision shard-local and divergent; the
+    Trainer refuses the guard there.
 
     Semantics on a skipped step: ``TrainState.step`` still advances (it
     counts attempted steps and drives the data order), while the inner
@@ -429,6 +432,52 @@ def with_skip_guard(opt: Optimizer, skip_threshold: float = 0.0) -> Optimizer:
                      f"guard(thr={skip_threshold}):{opt.name}",
                      state_specs=state_specs,
                      update_with_norm=update_with_norm)
+
+
+class MasterState(NamedTuple):
+    """Opt state of :func:`with_master_weights`: the f32 master copy of
+    the parameters plus the wrapped optimizer's state (itself built over
+    the master copy, so every slot is f32)."""
+
+    master: Pytree
+    inner: Pytree
+
+
+def with_master_weights(opt: Optimizer) -> Optimizer:
+    """Mixed-precision master weights (arXiv 2004.13336 / 2204.06514):
+    the visible parameters may live in a storage dtype (bf16), while the
+    optimizer updates an f32 MASTER copy kept in its own state; each step
+    re-casts the updated master into the storage dtype.  The bf16 params
+    never accumulate rounding drift across steps — precision loss is one
+    f32->bf16 cast per step, from a master that never loses bits.
+
+    Intended for ``update_sharding='sharded'`` layouts, where the opt
+    state (master included) is scattered 1/N per replica — a REPLICATED
+    master would duplicate param memory and forfeit the point; the
+    Trainer enforces that pairing.  The wrapped update consumes the
+    incoming ``params`` only for the output storage dtype.
+    """
+
+    def init(params: Pytree) -> MasterState:
+        master = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), params)
+        return MasterState(master, opt.init(master))
+
+    def update(grads: Pytree, state: MasterState, params: Pytree):
+        g32 = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        new_master, new_inner = opt.update(g32, state.inner, state.master)
+        new_params = jax.tree_util.tree_map(
+            lambda m, p: m.astype(p.dtype), new_master, params)
+        return new_params, MasterState(new_master, new_inner)
+
+    def state_specs(ps, params=None):
+        if opt.state_specs is None:
+            raise ValueError(f"{opt.name} lacks state_specs")
+        return MasterState(ps, opt.state_specs(ps, params))
+
+    return Optimizer(init, update, f"master:{opt.name}",
+                     state_specs=state_specs)
 
 
 def with_clipping(opt: Optimizer, max_norm: float) -> Optimizer:

@@ -102,13 +102,23 @@ def zero1_opt_state(optimizer: Optimizer, params: Pytree, mesh: Mesh,
 def zero1_shard_update(optimizer: Optimizer, state: TrainState,
                        s, c, grads, mesh: Mesh,
                        grad_clip: float = 0.0,
-                       extra_reduce_axes: Tuple[str, ...] = ()):
+                       extra_reduce_axes: Tuple[str, ...] = (),
+                       with_metrics: bool = False):
     """The zero1 weight update, shared by the DP and DP x SP shard_map paths
     (call inside ``shard_map``): reduce-scatter the flat gradient over the
     data axes, clip by the *global* norm (psum of squared shard norms —
     shard-local clipping would desynchronize replicas), update the local
     1/N parameter slice with the local 1/N optimizer state, all-gather the
     updated slices.
+
+    The psum'd global norm also feeds ``Optimizer.update_with_norm`` when
+    the optimizer carries one (the skip guard — its predicate is then
+    identical on every replica despite the scattered update) and the
+    telemetry metrics vector when ``with_metrics`` (grad norm from the
+    scattered shard via that one psum; param/update norms from the
+    gathered flat buffer, local math).  The update expressions are
+    unchanged by ``with_metrics``, so params stay bitwise-equal with
+    metrics on vs off.
 
     ``extra_reduce_axes`` lists additional mesh axes that shard loss terms
     (e.g. ``('seq',)`` under sequence parallelism): counts/losses reduce
@@ -140,20 +150,36 @@ def zero1_shard_update(optimizer: Optimizer, state: TrainState,
     if extra_reduce_axes:
         g_shard = lax.psum(g_shard, tuple(extra_reduce_axes))
     g_shard = g_shard / total
-    if grad_clip > 0:
-        # padding lanes are zero, so they contribute nothing to the norm
+    gnorm = None
+    if (grad_clip > 0 or with_metrics
+            or optimizer.update_with_norm is not None):
+        # padding lanes are zero, so they contribute nothing to the norm;
+        # measured PRE-clip, matching the replicated path's guard
         gsq = lax.psum(jnp.sum(jnp.square(g_shard)), DATA_AXES)
-        scale = jnp.minimum(1.0,
-                            grad_clip / jnp.maximum(jnp.sqrt(gsq), 1e-12))
+        gnorm = jnp.sqrt(gsq)
+    if grad_clip > 0:
+        scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12))
         g_shard = g_shard * scale
     idx = lax.axis_index(DATA_AXES)
     p_shard = lax.dynamic_slice(
         jnp.pad(flat_params, (0, pad)), (idx * shard_len,), (shard_len,))
-    new_p_shard, new_opt = optimizer.update(g_shard, state.opt_state,
-                                            p_shard)
+    if optimizer.update_with_norm is not None:
+        new_p_shard, new_opt = optimizer.update_with_norm(
+            g_shard, state.opt_state, p_shard, gnorm)
+    else:
+        new_p_shard, new_opt = optimizer.update(g_shard, state.opt_state,
+                                                p_shard)
     flat_new = lax.all_gather(new_p_shard, DATA_AXES, axis=0,
                               tiled=True)[:flat_params.shape[0]]
-    return TrainState(state.step + 1, unravel(flat_new), new_opt), loss
+    new_state = TrainState(state.step + 1, unravel(flat_new), new_opt)
+    if not with_metrics:
+        return new_state, loss
+    from ..train import telemetry
+
+    # param/update norms on the flat buffer (== the whole-tree norms);
+    # both sides are full gathered vectors, so the math is local
+    return new_state, telemetry.metrics_vector(
+        loss, gnorm, flat_new, flat_params, new_opt)
 
 
 def zero1_state_spec(optimizer: Optimizer) -> TrainState:
@@ -172,7 +198,8 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
                     accum_steps: int = 1,
                     update_sharding: str = "replicated",
                     grad_clip: float = 0.0,
-                    with_metrics: bool = False
+                    with_metrics: bool = False,
+                    update_plan: Optional[Pytree] = None
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: (state, batch) -> (state, loss).
@@ -198,8 +225,18 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     ``grad_reduction='global_mean'`` and opt state built by
     :func:`zero1_opt_state`.
 
-    ``grad_clip`` applies *global*-norm clipping on the zero1 path (norm
-    from a psum of squared shard norms — see :func:`zero1_shard_update`).
+    ``update_sharding='sharded'`` is the automatic PER-LEAF generalization
+    (``parallel.update_sharding``): each leaf's update scatters along its
+    largest dimension (tiny leaves stay replicated), one reduce-scatter
+    per leaf schedulable against the remaining backward compute, and
+    mixed-precision master weights ride the same seam
+    (``optim.with_master_weights``).  Requires ``update_plan`` (the
+    :func:`~..parallel.update_sharding.plan_updates` tree) and opt state
+    built by ``update_sharding.init_opt_state``.
+
+    ``grad_clip`` applies *global*-norm clipping on the zero1/sharded
+    paths (norm from a psum of squared shard norms — see
+    :func:`zero1_shard_update` / ``update_sharding.sharded_update``).
     On the replicated path pass ``grad_clip=0`` and wrap the optimizer with
     ``optim.with_clipping`` instead (there the full mean gradient is local,
     so the wrapper's norm is already global).
@@ -207,33 +244,32 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     ``with_metrics=True`` returns ``(state, metrics)`` instead of
     ``(state, loss)``: the on-device telemetry vector
     (``train.telemetry.METRIC_KEYS`` — loss, global grad norm, param norm,
-    update/param ratio, cumulative skip-guard rejections), computed on the reduced
-    gradients so it is identical on every replica, with the update math
-    untouched (params stay bitwise-equal to the metrics-off step).
-    Replicated-update path only: zero1 updates a scattered gradient SHARD,
-    where these whole-tree norms would be shard-local.
+    update/param ratio, cumulative skip-guard rejections), identical on
+    every replica, with the update math untouched (params stay
+    bitwise-equal to the metrics-off step) — on the replicated path from
+    the reduced gradients, on the zero1/sharded paths from the scattered
+    shards via one extra scalar psum.
     """
     if grad_reduction not in ("global_mean", "per_shard_mean", "local"):
         raise ValueError(f"unknown grad_reduction {grad_reduction!r}")
-    if with_metrics and update_sharding == "zero1":
-        raise ValueError("with_metrics needs the replicated update (zero1 "
-                         "consumes a scattered gradient shard — whole-tree "
-                         "norms would be shard-local)")
     if with_metrics and grad_reduction == "local":
         raise ValueError("with_metrics is meaningless under the 'local' "
                          "measurement ablation (replicas diverge)")
-    if update_sharding not in ("replicated", "zero1"):
+    if update_sharding not in ("replicated", "zero1", "sharded"):
         raise ValueError(f"unknown update_sharding {update_sharding!r}")
-    if update_sharding == "zero1" and grad_reduction != "global_mean":
-        raise ValueError("update_sharding='zero1' implies the exact "
-                         "global-mean gradient; per_shard_mean is a "
+    if update_sharding != "replicated" and grad_reduction != "global_mean":
+        raise ValueError(f"update_sharding={update_sharding!r} implies the "
+                         "exact global-mean gradient; per_shard_mean is a "
                          "replicated-path-only compatibility mode")
-    if grad_clip > 0 and update_sharding != "zero1":
+    if update_sharding == "sharded" and update_plan is None:
+        raise ValueError("update_sharding='sharded' needs update_plan "
+                         "(parallel.update_sharding.plan_updates)")
+    if grad_clip > 0 and update_sharding == "replicated":
         raise ValueError(
-            "grad_clip is only applied inside the zero1 update (its "
-            "gradient is shard-scattered there); on the replicated path "
-            "the full mean gradient is local — wrap the optimizer with "
-            "optim.with_clipping instead of silently not clipping")
+            "grad_clip is only applied inside the zero1/sharded update "
+            "(the gradient is shard-scattered there); on the replicated "
+            "path the full mean gradient is local — wrap the optimizer "
+            "with optim.with_clipping instead of silently not clipping")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     loss_fn = make_loss_fn(model, loss_name)
@@ -243,7 +279,14 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
             loss_fn, state.params, batch, accum_steps)
         if update_sharding == "zero1":
             return zero1_shard_update(optimizer, state, s, c, grads, mesh,
-                                      grad_clip=grad_clip)
+                                      grad_clip=grad_clip,
+                                      with_metrics=with_metrics)
+        if update_sharding == "sharded":
+            from . import update_sharding as us
+
+            return us.sharded_update(optimizer, state, s, c, grads, mesh,
+                                     update_plan, grad_clip=grad_clip,
+                                     with_metrics=with_metrics)
         if grad_reduction == "global_mean":
             total = lax.psum(c, DATA_AXES)
             grads = jax.tree_util.tree_map(
@@ -277,8 +320,14 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         return TrainState(state.step + 1, new_params, new_opt), loss
 
     batch_spec = P(DATA_AXES)
-    state_spec = (zero1_state_spec(optimizer) if update_sharding == "zero1"
-                  else P())
+    if update_sharding == "zero1":
+        state_spec = zero1_state_spec(optimizer)
+    elif update_sharding == "sharded":
+        from . import update_sharding as us
+
+        state_spec = us.state_spec(optimizer, update_plan)
+    else:
+        state_spec = P()
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_spec, batch_spec),

@@ -39,14 +39,35 @@ def _named(mesh: Mesh, spec_tree: Pytree) -> Pytree:
 
 
 def state_specs(model, params: Pytree, optimizer: Optimizer,
-                mesh: Mesh) -> TrainState:
+                mesh: Mesh, update_sharding: str = "replicated"
+                ) -> TrainState:
     """PartitionSpec tree for a TrainState: params per TP/FSDP rules,
-    optimizer slots mirroring their params, scalar step replicated."""
+    optimizer slots mirroring their params, scalar step replicated.
+
+    ``update_sharding='sharded'`` additionally scatters the optimizer
+    state (master weights included) over the 'data' axis on each leaf's
+    largest still-unsharded divisible dimension
+    (``parallel.update_sharding.gspmd_opt_specs``): the params keep
+    their TP/FSDP layout, and XLA — seeing data-sharded opt state fed by
+    data-replicated gradients — materializes the reduce-scatter/
+    all-gather pair itself and schedules it against the backward pass
+    (the arXiv 2204.06514 formulation of arXiv 2004.13336's
+    cross-replica update sharding)."""
     ps = tp.param_specs(model, params, mesh)
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
+    opt_ps = ps
+    if update_sharding == "sharded":
+        from . import update_sharding as us
+
+        opt_ps = us.gspmd_opt_specs(ps, params, mesh)
+    elif update_sharding != "replicated":
+        raise ValueError(
+            f"update_sharding={update_sharding!r} on the GSPMD path "
+            "(choices: replicated, sharded — zero1's flat buffer is a "
+            "shard_map-path layout)")
     return TrainState(step=P(), params=ps,
-                      opt_state=optimizer.state_specs(ps, params))
+                      opt_state=optimizer.state_specs(opt_ps, params))
 
 
 def batch_specs(batch: Batch) -> Pytree:
@@ -59,7 +80,8 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                           example_batch: Optional[Batch] = None,
                           donate: bool = True,
                           accum_steps: int = 1,
-                          with_metrics: bool = False):
+                          with_metrics: bool = False,
+                          update_sharding: str = "replicated"):
     """(state, batch) -> (state, loss), global semantics, sharded by
     annotation.  The loss is the exact masked global-batch mean.
 
@@ -142,7 +164,8 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
         return TrainState(state.step + 1, new_params, new_opt), loss
 
     dummy_params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
-    sspec = state_specs(model, dummy_params, optimizer, mesh)
+    sspec = state_specs(model, dummy_params, optimizer, mesh,
+                        update_sharding=update_sharding)
     bspec = batch_specs(example_batch)
     return jax.jit(
         step_fn,
@@ -182,9 +205,11 @@ def make_gspmd_eval_step(model, mesh: Mesh,
 
 
 def shard_state(model, state: TrainState, optimizer: Optimizer,
-                mesh: Mesh) -> TrainState:
-    """Place a host TrainState per the TP/FSDP specs."""
-    sspec = state_specs(model, state.params, optimizer, mesh)
+                mesh: Mesh, update_sharding: str = "replicated"
+                ) -> TrainState:
+    """Place a host TrainState per the TP/FSDP (+ sharded-update) specs."""
+    sspec = state_specs(model, state.params, optimizer, mesh,
+                        update_sharding=update_sharding)
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, sspec)
 

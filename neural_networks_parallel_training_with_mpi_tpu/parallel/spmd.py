@@ -56,7 +56,8 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                          accum_steps: int = 1,
                          update_sharding: str = "replicated",
                          grad_clip: float = 0.0,
-                         with_metrics: bool = False):
+                         with_metrics: bool = False,
+                         update_plan: Optional[Pytree] = None):
     """(state, batch) -> (state, loss) jitted over data x seq axes.
 
     ``seq_axis`` should be set iff the model's attention is ring/ulysses and
@@ -69,24 +70,27 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     update — the same math as the unsplit step in exact arithmetic, with
     ulp-level f32 differences from the reassociated summation order.
 
-    ``update_sharding='zero1'`` shards the weight update + optimizer state
-    over the *data* axes exactly as in ``data_parallel.make_train_step``
-    (the state stays replicated over 'seq'; the scattered gradient shard is
-    additionally psum'd over 'seq').  ``grad_clip`` is the zero1 global-norm
-    clip; on the replicated path wrap the optimizer in ``optim.with_clipping``
-    instead.
+    ``update_sharding='zero1'`` / ``'sharded'`` shard the weight update +
+    optimizer state over the *data* axes exactly as in
+    ``data_parallel.make_train_step`` (the state stays replicated over
+    'seq'; the scattered gradient shards are additionally psum'd over
+    'seq'); ``'sharded'`` needs ``update_plan``
+    (``parallel.update_sharding.plan_updates``).  ``grad_clip`` is the
+    in-step global-norm clip on those paths; on the replicated path wrap
+    the optimizer in ``optim.with_clipping`` instead.  ``with_metrics``
+    rides every path (the sharded ones pay one extra scalar psum for the
+    global grad norm).
     """
-    if update_sharding not in ("replicated", "zero1"):
+    if update_sharding not in ("replicated", "zero1", "sharded"):
         raise ValueError(f"unknown update_sharding {update_sharding!r}")
-    if grad_clip > 0 and update_sharding != "zero1":
+    if grad_clip > 0 and update_sharding == "replicated":
         raise ValueError(
-            "grad_clip is only applied inside the zero1 update; on the "
-            "replicated path wrap the optimizer with optim.with_clipping "
+            "grad_clip is only applied inside the zero1/sharded update; on "
+            "the replicated path wrap the optimizer with optim.with_clipping "
             "instead of silently not clipping")
-    if with_metrics and update_sharding == "zero1":
-        raise ValueError("with_metrics needs the replicated update (zero1 "
-                         "consumes a scattered gradient shard — whole-tree "
-                         "norms would be shard-local)")
+    if update_sharding == "sharded" and update_plan is None:
+        raise ValueError("update_sharding='sharded' needs update_plan "
+                         "(parallel.update_sharding.plan_updates)")
     use_seq = seq_axis is not None and mesh.shape.get(seq_axis, 1) > 1
     extra = (seq_axis,) if use_seq else ()
     reduce_axes = DATA_AXES + extra
@@ -106,7 +110,15 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
         if update_sharding == "zero1":
             return zero1_shard_update(optimizer, state, s, c, grads, mesh,
                                       grad_clip=grad_clip,
-                                      extra_reduce_axes=extra)
+                                      extra_reduce_axes=extra,
+                                      with_metrics=with_metrics)
+        if update_sharding == "sharded":
+            from . import update_sharding as us
+
+            return us.sharded_update(optimizer, state, s, c, grads, mesh,
+                                     update_plan, grad_clip=grad_clip,
+                                     extra_reduce_axes=extra,
+                                     with_metrics=with_metrics)
         total = lax.psum(c, reduce_axes)
         grads = jax.tree_util.tree_map(
             lambda g: lax.psum(g, reduce_axes) / total, grads)
@@ -125,8 +137,14 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     if example_batch is None:
         raise ValueError("example_batch required to derive per-leaf specs")
     specs = batch_specs(example_batch, seq_axis if use_seq else None)
-    state_spec = (zero1_state_spec(optimizer)
-                  if update_sharding == "zero1" else P())
+    if update_sharding == "zero1":
+        state_spec = zero1_state_spec(optimizer)
+    elif update_sharding == "sharded":
+        from . import update_sharding as us
+
+        state_spec = us.state_spec(optimizer, update_plan)
+    else:
+        state_spec = P()
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_spec, specs),

@@ -177,10 +177,24 @@ def update_with_metrics(optimizer: Optimizer, grads: Pytree,
             grads, opt_state, params, gnorm)
     else:
         new_params, new_opt = optimizer.update(grads, opt_state, params)
+    return new_params, new_opt, metrics_vector(loss, gnorm, new_params,
+                                               params, new_opt)
+
+
+def metrics_vector(loss: jax.Array, grad_norm: jax.Array,
+                   new_params: Pytree, old_params: Pytree,
+                   new_opt: Pytree) -> Dict[str, jax.Array]:
+    """Assemble the ``METRIC_KEYS`` dict from an already-applied update —
+    the single construction point shared by :func:`update_with_metrics`
+    (replicated/GSPMD paths, whole-tree grad norm) and the
+    sharded-update paths (``parallel.update_sharding``/zero1, grad norm
+    from psum'd scattered-shard squares).  ``new_params``/``old_params``
+    must be the FULL (gathered) trees so the param/update norms are
+    local math, identical on every replica."""
     pnorm = global_norm(new_params)
     unorm = global_norm(jax.tree_util.tree_map(
         lambda n, o: n.astype(jnp.float32) - o.astype(jnp.float32),
-        new_params, params))
+        new_params, old_params))
     if isinstance(new_opt, GuardedState):
         # CUMULATIVE rejections, not a per-step delta: the host samples
         # the stream (metrics_every, and k>1 dispatches report only their
@@ -189,14 +203,13 @@ def update_with_metrics(optimizer: Optimizer, grads: Pytree,
         skipped = new_opt.skipped.astype(jnp.float32)
     else:
         skipped = jnp.zeros((), jnp.float32)
-    metrics = {
+    return {
         "loss": loss.astype(jnp.float32),
-        "grad_norm": gnorm,
+        "grad_norm": grad_norm,
         "param_norm": pnorm,
         "update_ratio": unorm / jnp.maximum(pnorm, 1e-12),
         "skipped": skipped,
     }
-    return new_params, new_opt, metrics
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,21 @@ from .state import TrainState
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None, data=None):
+        # --param_dtype: the training-job spelling of the model's param
+        # storage dtype (bf16 params halve HBM + the sharded-update
+        # all-gather bytes; pair with --master_weights for f32 update
+        # math).  Applied to the model config HERE so every downstream
+        # consumer — model init, checkpoint templates, FLOPs accounting —
+        # sees one consistent dtype.
+        if cfg.param_dtype:
+            import dataclasses as _dc
+
+            if cfg.param_dtype not in ("float32", "bfloat16", "float16"):
+                raise ValueError(
+                    f"unknown --param_dtype {cfg.param_dtype!r} "
+                    "(choices: float32, bfloat16, float16)")
+            cfg = _dc.replace(cfg, model=_dc.replace(
+                cfg.model, dtype=cfg.param_dtype))
         self.cfg = cfg
         world_setup()
         # capacity floor (DESIGN.md §10): a world below --min_devices must
@@ -173,7 +188,8 @@ class Trainer:
                     f"arch={cfg.model.arch!r} loss={cfg.loss!r} — drop it")
         if (cfg.optimizer == "adafactor"
                 and (self.pipeline or self.sp_tp or self.expert
-                     or self.ep_tp or cfg.update_sharding == "zero1")):
+                     or self.ep_tp
+                     or cfg.update_sharding in ("zero1", "sharded"))):
             raise ValueError(
                 "adafactor's stats are exact only where every leaf sees its "
                 "full matrix: DP/SP shard_map layouts and GSPMD global-view. "
@@ -183,7 +199,9 @@ class Trainer:
                 "update-RMS clip / parameter-scale RMS(p) (whole-leaf "
                 "means) and the (E, f) bias column factor become "
                 "EP-degree-dependent; zero1's flat state cannot carry "
-                "factored stats at all. Use adam/adamw/lion/sgd there")
+                "factored stats at all, and the per-leaf sharded update "
+                "scatters inside matrices the same way. Use "
+                "adam/adamw/lion/sgd there")
         from ..parallel.sequence import SEQ_SHARDED_IMPLS
 
         if (cfg.model.arch == "transformer"
@@ -194,15 +212,28 @@ class Trainer:
                 "axis > 1 (--sp); use dense or flash on an unsharded "
                 "sequence")
         self.zero1 = cfg.update_sharding == "zero1"
+        self.sharded = cfg.update_sharding == "sharded"
         if self.zero1 and (self.gspmd or self.pipeline or self.expert
                            or self.sp_tp or self.ep_tp):
             raise NotImplementedError(
-                "update_sharding='zero1' is wired into the shard_map DP "
-                "and DP x seq paths (fsdp/tensor axes already shard state "
-                "on the GSPMD path)")
-        if self.zero1 and cfg.grad_reduction != "global_mean":
-            raise ValueError("update_sharding='zero1' implies global_mean "
-                             "gradient semantics")
+                "update_sharding='zero1' is the flat-buffer shard_map DP "
+                "and DP x seq layout; the automatic per-leaf form "
+                "(update_sharding='sharded') covers the GSPMD path too")
+        if self.sharded and (self.pipeline or self.expert or self.sp_tp
+                             or self.ep_tp):
+            raise NotImplementedError(
+                "update_sharding='sharded' is wired into the shard_map DP "
+                "/ DP x seq and GSPMD (tensor/fsdp) layouts; the "
+                "pipe/expert/seq-x-tensor layouts own their slicing")
+        if (self.zero1 or self.sharded) and cfg.grad_reduction != "global_mean":
+            raise ValueError(f"update_sharding={cfg.update_sharding!r} "
+                             "implies global_mean gradient semantics")
+        if cfg.master_weights and not self.sharded:
+            raise ValueError(
+                "--master_weights keeps the f32 master copy in the SHARDED "
+                "optimizer state (1/N per replica); it requires "
+                "update_sharding='sharded' — a replicated master would "
+                "duplicate param memory instead of saving it")
         if cfg.pp_interleave > 1 and not self.pipeline:
             raise ValueError("--pp_interleave needs the pipeline layout "
                              "(--pp > 1); it schedules virtual stage-slices "
@@ -304,41 +335,61 @@ class Trainer:
         train_loss = (f"{cfg.loss}@{cfg.label_smoothing}"
                       if cfg.label_smoothing else cfg.loss)
         step_clips = (self.pipeline or self.expert or self.zero1
-                      or self.sp_tp or self.ep_tp)
+                      or self.sp_tp or self.ep_tp
+                      or (self.sharded and not self.gspmd))
         self.optimizer = optim_lib.make(
             cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
             grad_clip=0.0 if step_clips else cfg.grad_clip)
+        # mixed-precision master weights (ops.optim.with_master_weights):
+        # wrapped INSIDE the guard so a skipped step is a no-op on the
+        # master too; the f32 master lands in the sharded opt state, 1/N
+        # per replica (validated sharded-only above)
+        if cfg.master_weights:
+            self.optimizer = optim_lib.with_master_weights(self.optimizer)
         # guarded update (train.resilience / DESIGN.md §6): reject
         # non-finite or over-threshold steps inside the jitted step.  Wired
-        # where optimizer.update consumes fully-reduced or global-view
-        # gradients, so the skip predicate is identical on every replica:
-        # plain DP, DP x SP, and GSPMD.  Layouts whose update runs on
-        # axis-sharded gradient SLICES (zero1's scattered flat shard,
-        # pipeline stages, expert/tensor slicing) would make the norm —
-        # and hence the skip decision — shard-local and divergent.
+        # wherever the skip predicate is identical on every replica: the
+        # plain DP, DP x SP, and GSPMD layouts (fully-reduced or
+        # global-view gradients) AND the sharded-update layouts
+        # (zero1/'sharded'), which psum the shard squares into the global
+        # norm inside the step and hand it to the guard via
+        # Optimizer.update_with_norm.  The remaining sliced layouts
+        # (pipeline stages, expert/tensor slicing) have no such norm seam
+        # and stay refused.
         self.guarded = cfg.skip_nonfinite or cfg.skip_threshold > 0
         if self.guarded:
-            if (self.pipeline or self.expert or self.sp_tp or self.ep_tp
-                    or self.zero1):
+            if self.pipeline or self.expert or self.sp_tp or self.ep_tp:
                 raise NotImplementedError(
                     "--skip-nonfinite/--skip_threshold (the guarded "
-                    "update) is wired into the plain DP, DP x seq, and "
-                    "GSPMD layouts, whose updates see the full reduced "
-                    "gradient; pipe/expert/seq-x-tensor/zero1 updates run "
-                    "on gradient slices where a shard-local norm would "
-                    "desynchronize the skip decision")
+                    "update) is wired into the plain DP, DP x seq, GSPMD "
+                    "and sharded-update (zero1/'sharded') layouts; "
+                    "pipe/expert/seq-x-tensor updates run on gradient "
+                    "slices where a shard-local norm would desynchronize "
+                    "the skip decision")
             self.optimizer = optim_lib.with_skip_guard(
                 self.optimizer, cfg.skip_threshold)
         # on-device telemetry metrics (train.telemetry, DESIGN.md §7):
-        # wired exactly where the skip guard is wired — the update consumes
-        # fully-reduced (DP / DP x SP shard_map) or global-view (GSPMD)
-        # gradients, so the whole-tree norms are identical on every
-        # replica.  Sliced-update layouts (pipe/expert/seq-x-tensor/zero1)
+        # wired exactly where the skip guard is wired — fully-reduced
+        # (DP / DP x SP shard_map), global-view (GSPMD), or sharded-update
+        # (zero1/'sharded', one extra scalar psum for the global grad
+        # norm).  The remaining sliced layouts (pipe/expert/seq-x-tensor)
         # fall back to the loss-only telemetry stream.
         self.telemetry_metrics = bool(
-            cfg.telemetry_dir and cfg.metrics_every > 0 and not self.zero1
+            cfg.telemetry_dir and cfg.metrics_every > 0
             and not (self.pipeline or self.expert or self.sp_tp
                      or self.ep_tp))
+        # per-leaf update-sharding plan (parallel.update_sharding): shape-
+        # only, derived once from the model's abstract init — the shard_map
+        # step builders need it for their opt-state specs (the GSPMD path
+        # derives its own NamedShardings from the param specs instead)
+        self.update_plan = None
+        if self.sharded and not self.gspmd:
+            from ..parallel import update_sharding as us_lib
+
+            dummy = jax.eval_shape(
+                lambda: self.model.init(prng.init_key(cfg.seed)))
+            self.update_plan = us_lib.plan_updates(
+                dummy, dp.data_axis_size(self.mesh))
         if self.pipeline:
             from ..parallel import pipeline as pp
 
@@ -420,8 +471,9 @@ class Trainer:
                 seq_axis="seq", example_batch=example,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,
-                grad_clip=cfg.grad_clip if self.zero1 else 0.0,
-                with_metrics=self.telemetry_metrics)
+                grad_clip=cfg.grad_clip if step_clips else 0.0,
+                with_metrics=self.telemetry_metrics,
+                update_plan=self.update_plan)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -433,7 +485,8 @@ class Trainer:
             self.train_step = gspmd.make_gspmd_train_step(
                 self.model, self.optimizer, self.mesh, loss_name=train_loss,
                 example_batch=example, accum_steps=cfg.accum_steps,
-                with_metrics=self.telemetry_metrics)
+                with_metrics=self.telemetry_metrics,
+                update_sharding=cfg.update_sharding)
             self.eval_step = gspmd.make_gspmd_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"),
@@ -444,8 +497,9 @@ class Trainer:
                 grad_reduction=cfg.grad_reduction,
                 accum_steps=cfg.accum_steps,
                 update_sharding=cfg.update_sharding,
-                grad_clip=cfg.grad_clip if self.zero1 else 0.0,
-                with_metrics=self.telemetry_metrics)
+                grad_clip=cfg.grad_clip if step_clips else 0.0,
+                with_metrics=self.telemetry_metrics,
+                update_plan=self.update_plan)
             self.eval_step = dp.make_eval_step(
                 self.model, self.mesh, loss_name=cfg.loss,
                 with_accuracy=(cfg.loss == "cross_entropy"))
@@ -461,11 +515,11 @@ class Trainer:
         det = self.fault_plan.det_desync() if self.fault_plan else None
         if det is not None:
             if (self.pipeline or self.expert or self.sp_tp or self.ep_tp
-                    or self.gspmd or self.zero1):
+                    or self.gspmd or self.zero1 or self.sharded):
                 raise NotImplementedError(
                     "desync?det perturbs the fully-replicated train state "
                     "inside the step; it is wired on the plain DP and "
-                    "DP x seq layouts")
+                    "DP x seq layouts (replicated update)")
             from ..utils.faults import wrap_step_with_desync
 
             self.train_step = wrap_step_with_desync(
@@ -541,6 +595,20 @@ class Trainer:
             self.state = dp.place_zero1_state(host, self.mesh,
                                               self.optimizer)
             return self.state
+        if self.sharded and not self.gspmd:
+            import jax.numpy as jnp
+
+            from ..parallel import update_sharding as us_lib
+
+            params = self.model.init(prng.init_key(self.cfg.seed))
+            host = TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=us_lib.init_opt_state(self.optimizer, params,
+                                                self.update_plan))
+            self.state = us_lib.place_state(host, self.mesh,
+                                            self.optimizer,
+                                            self.update_plan)
+            return self.state
         if self.sp_tp:
             from ..parallel import spmd
 
@@ -570,8 +638,9 @@ class Trainer:
         elif self.gspmd:
             from ..parallel import gspmd
 
-            self.state = gspmd.shard_state(self.model, state, self.optimizer,
-                                           self.mesh)
+            self.state = gspmd.shard_state(
+                self.model, state, self.optimizer, self.mesh,
+                update_sharding=self.cfg.update_sharding)
         else:
             self.state = dp.replicate_state(state, self.mesh)
         return self.state
@@ -734,11 +803,18 @@ class Trainer:
         elif self.gspmd:
             from ..parallel import gspmd
 
-            self.state = gspmd.shard_state(self.model, restored,
-                                           self.optimizer, self.mesh)
+            self.state = gspmd.shard_state(
+                self.model, restored, self.optimizer, self.mesh,
+                update_sharding=self.cfg.update_sharding)
         elif self.zero1:
             self.state = dp.place_zero1_state(restored, self.mesh,
                                               self.optimizer)
+        elif self.sharded:
+            from ..parallel import update_sharding as us_lib
+
+            self.state = us_lib.place_state(restored, self.mesh,
+                                            self.optimizer,
+                                            self.update_plan)
         else:
             self.state = dp.replicate_state(restored, self.mesh)
 
@@ -1066,9 +1142,17 @@ class Trainer:
         if self._topology_change is not None:
             self.telemetry.on_topology(
                 int(start_step), dict(self._topology_change))
+        update_note = ""
+        if cfg.update_sharding != "replicated":
+            update_note = (f" | update: {cfg.update_sharding}"
+                           + (" + master weights" if cfg.master_weights
+                              else "")
+                           + (f" ({cfg.model.dtype} params)"
+                              if cfg.model.dtype != "float32" else ""))
         log(f"mesh: {describe(self.mesh)} | model: {cfg.model.arch} "
             f"({self.model.n_params():,} params) | "
-            f"{self.loader.n} samples, {self.loader.steps_per_epoch} steps/epoch")
+            f"{self.loader.n} samples, "
+            f"{self.loader.steps_per_epoch} steps/epoch{update_note}")
         profiler = profiling.trace(cfg.profile_dir)
         thr = Throughput()
         timer = profiling.StepTimer()

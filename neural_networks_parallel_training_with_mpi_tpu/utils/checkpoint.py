@@ -212,8 +212,15 @@ def _write_npz(d: Path, step: int, host_state: Any, keep: int,
         _sweep_tmp(d)
         tmp.mkdir(parents=True)
         leaves, treedef = jax.tree_util.tree_flatten(host_state)
-        np.savez(tmp / "state.npz", **{f"leaf_{i}": np.asarray(l)
-                                       for i, l in enumerate(leaves)})
+        # __leaf_dtypes__: the TRUE dtypes, recorded because npz
+        # round-trips extension dtypes (ml_dtypes bfloat16) as anonymous
+        # void bytes — restore must know whether |V2 means bfloat16 or
+        # float16 rather than guess from the caller's config
+        np.savez(tmp / "state.npz",
+                 __leaf_dtypes__=np.array(
+                     [str(np.asarray(l).dtype) for l in leaves]),
+                 **{f"leaf_{i}": np.asarray(l)
+                    for i, l in enumerate(leaves)})
         (tmp / "treedef.pkl").write_bytes(pickle.dumps(treedef))
         (tmp / "meta.json").write_text(json.dumps(
             {"step": step, "format": "npz", **(extra_meta or {})}))
@@ -403,11 +410,14 @@ def restore(directory: str, template: Optional[TrainState] = None,
     ``elastic`` (DESIGN.md §10) arms the cross-world reshard path: a
     snapshot whose ``saved_world`` differs from the current topology is
     loaded anyway — replicated state is world-shape-independent (the host
-    pytree re-places under any mesh), zero1's flat per-dp-padded buffers
-    are re-padded for the new data-axis size (strictly zero padding moves;
-    a nonzero tail raises instead of dropping state), and orbax snapshots
-    reshard through the template's target shardings.  Without ``elastic``
-    a shape mismatch stays the loud error it always was."""
+    pytree re-places under any mesh), sharded-update opt state (zero1's
+    flat per-dp-padded buffer, the per-leaf ``'sharded'`` layout's padded
+    dims) is re-padded for the new data-axis size — which also converts
+    sharded<->replicated layouts of the same optimizer (strictly zero
+    padding moves; a nonzero tail raises instead of dropping state) —
+    and orbax snapshots reshard through the template's target shardings.
+    Without ``elastic`` a shape mismatch stays the loud error it always
+    was."""
     _join_pending()  # never race an in-flight writer's pruning
     d = Path(directory)
     if jax.process_index() == 0:
@@ -489,43 +499,71 @@ def _load_snapshot(path: Path, template: Optional[TrainState],
             # an N-device snapshot natively (the orbax half of the
             # elastic reshard path)
             return ckptr.restore(path.absolute() / "orbax", template)
-    return _restore_npz(path, template, elastic=elastic, meta=meta)
+    return _restore_npz(path, template, elastic=elastic)
 
 
-def _repad_flat(saved: np.ndarray, new_len: int, leaf_idx: int
+def reinterpret_void(arr: np.ndarray, dtype) -> np.ndarray:
+    """Recover an extension-dtype array (ml_dtypes bfloat16) that numpy's
+    npz round-tripped as raw void bytes: ``|V2`` in, ``bfloat16`` out —
+    the bytes ARE the payload.  Identity for anything that is not a
+    matching-width void array.  Shared by the templated restore loop
+    (below) and the template-less decode restore (cli._generate)."""
+    a = np.asarray(arr)
+    dt = np.dtype(dtype)
+    if a.dtype.kind == "V" and a.dtype.itemsize == dt.itemsize:
+        return a.view(dt)
+    return arr
+
+
+def _repad_axis(saved: np.ndarray, want_shape: tuple, leaf_idx: int
                 ) -> np.ndarray:
-    """Re-pad a zero1 flat state buffer for a new data-axis size: the
-    saved length is ``ceil(P/N)*N`` (P true entries + zero padding), the
-    target ``ceil(P/M)*M`` — only zeros may move.  A nonzero tail means
-    the buffer is NOT padding (wrong leaf, or a layout this path does not
-    understand) and truncating it would silently drop optimizer state —
-    raise instead."""
+    """Re-pad a sharded-update optimizer-state leaf whose padded
+    dimension was sized for a different data-axis width: zero1's flat
+    buffer is ``ceil(P/N)*N`` long (P true entries + zero padding), the
+    per-leaf ``update_sharding='sharded'`` layout pads each leaf's
+    largest dimension the same way, and a replicated snapshot is the
+    padding-free special case — so N->M reshard, sharded->replicated and
+    replicated->sharded conversion are all the same move: grow or shrink
+    the ONE differing dimension, where only zeros may move.  A nonzero
+    tail means the slab is NOT padding (wrong leaf, or a layout this
+    path does not understand) and truncating it would silently drop
+    optimizer state — raise instead."""
     cur = np.asarray(saved)
-    if new_len < cur.shape[0]:
-        tail = cur[new_len:]
+    diff = [d for d in range(cur.ndim)
+            if cur.shape[d] != want_shape[d]]
+    assert len(diff) == 1, (cur.shape, want_shape)  # caller-checked
+    axis = diff[0]
+    new_len = want_shape[axis]
+    if new_len < cur.shape[axis]:
+        tail = np.take(cur, range(new_len, cur.shape[axis]), axis=axis)
         if np.any(tail != 0):
             raise ValueError(
                 f"cannot reshard checkpoint leaf {leaf_idx}: truncating "
-                f"{cur.shape[0]} -> {new_len} would drop "
+                f"dim {axis} {cur.shape[axis]} -> {new_len} would drop "
                 f"{int(np.count_nonzero(tail))} nonzero entries — not "
-                "zero1 padding; wrong model/optimizer config?")
-        return np.ascontiguousarray(cur[:new_len])
-    out = np.zeros((new_len,), cur.dtype)
-    out[:cur.shape[0]] = cur
-    return out
+                "update-sharding padding; wrong model/optimizer config?")
+        return np.ascontiguousarray(
+            np.take(cur, range(new_len), axis=axis))
+    widths = [(0, 0)] * cur.ndim
+    widths[axis] = (0, new_len - cur.shape[axis])
+    return np.pad(cur, widths)
 
 
 def _restore_npz(path: Path, template: Optional[TrainState],
-                 elastic: bool = False,
-                 meta: Optional[dict] = None) -> TrainState:
+                 elastic: bool = False) -> TrainState:
     data = np.load(path / "state.npz")
-    leaves = [data[f"leaf_{i}"] for i in range(len(data.files))]
+    n_leaves = sum(1 for k in data.files if k.startswith("leaf_"))
+    leaves = [data[f"leaf_{i}"] for i in range(n_leaves)]
+    # the recorded TRUE dtypes (None for pre-round-7 snapshots): void
+    # leaves reinterpret to what was SAVED, never to what the caller's
+    # config wishes — a bf16 snapshot restored with a f16 template must
+    # raise the dtype mismatch, not silently view garbage
+    recorded = ([str(s) for s in data["__leaf_dtypes__"]]
+                if "__leaf_dtypes__" in data.files else None)
+    if recorded is not None:
+        leaves = [reinterpret_void(l, np.dtype(d))
+                  for l, d in zip(leaves, recorded)]
     treedef = pickle.loads((path / "treedef.pkl").read_bytes())
-    # zero1's flat opt-state buffers are padded to a multiple of the
-    # SAVING world's data-axis size; under elastic restore a pure-padding
-    # length mismatch on a 1-D leaf is resharded, not rejected
-    zero1 = ((meta or {}).get("saved_world") or {}).get(
-        "update_sharding") == "zero1"
     if template is not None:
         t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
         if t_treedef != treedef:
@@ -534,13 +572,20 @@ def _restore_npz(path: Path, template: Optional[TrainState],
                 f"expected {t_treedef} — wrong model/optimizer config, or a "
                 "checkpoint written by an older framework version (e.g. "
                 "SGDState gained a 'count' field)?")
-        # only OPT-STATE leaves are zero1 flat buffers: a 1-D model
-        # param (bias, norm scale) whose length changed is a config
-        # mismatch that must refuse, not be silently zero-extended.
-        # TrainState flattens field-ordered (step, params, opt_state),
-        # so opt-state leaves are exactly the trailing ones.
+        # sharded-update opt-state leaves (zero1's flat buffer, the
+        # per-leaf 'sharded' layout) are padded to a multiple of the
+        # SAVING world's data-axis size; under elastic restore a
+        # pure-padding single-dimension mismatch on an OPT-STATE leaf is
+        # resharded, not rejected — which also converts
+        # sharded<->replicated layouts of the same optimizer (the
+        # replicated shapes are the padding-free case).  Only OPT-STATE
+        # leaves: a model param (bias, norm scale) whose length changed
+        # is a config mismatch that must refuse, not be silently
+        # zero-extended.  TrainState flattens field-ordered (step,
+        # params, opt_state), so opt-state leaves are exactly the
+        # trailing ones.
         opt_start = len(t_leaves)
-        if zero1 and hasattr(template, "opt_state"):
+        if hasattr(template, "opt_state"):
             opt_start -= len(jax.tree_util.tree_leaves(
                 template.opt_state))
         resharded = []
@@ -548,27 +593,35 @@ def _restore_npz(path: Path, template: Optional[TrainState],
             w_shape = tuple(np.shape(want))
             w_dtype = np.dtype(getattr(want, "dtype",
                                        np.asarray(want).dtype))
+            if recorded is None and np.dtype(saved.dtype).kind == "V":
+                # pre-round-7 snapshot without __leaf_dtypes__: the only
+                # available reading is the template's width-matching
+                # dtype (the legacy best effort)
+                saved = leaves[i] = reinterpret_void(saved, w_dtype)
             if tuple(saved.shape) != w_shape:
-                if (elastic and zero1 and i >= opt_start
-                        and saved.ndim == 1
-                        and len(w_shape) == 1
+                if (elastic and i >= opt_start
+                        and saved.ndim == len(w_shape)
+                        and sum(saved.shape[d] != w_shape[d]
+                                for d in range(saved.ndim)) == 1
                         and np.dtype(saved.dtype) == w_dtype):
-                    leaves[i] = _repad_flat(saved, w_shape[0], i)
+                    leaves[i] = _repad_axis(saved, w_shape, i)
                     resharded.append(i)
                     continue
                 raise ValueError(
                     f"checkpoint leaf {i} shape {tuple(saved.shape)} != "
                     f"expected {w_shape} — wrong model config?"
                     + ("" if elastic else
-                       " (a zero1 snapshot from a different world size "
-                       "needs the elastic reshard path: --elastic)"))
+                       " (a sharded-update snapshot from a different "
+                       "world size — or a sharded<->replicated layout "
+                       "change — needs the elastic reshard path: "
+                       "--elastic)"))
             if np.dtype(saved.dtype) != w_dtype:
                 raise ValueError(
                     f"checkpoint leaf {i} dtype {np.dtype(saved.dtype)} != "
                     f"expected {w_dtype} — wrong precision/optimizer "
                     "config?")
         if resharded:
-            log(f"checkpoint: resharded {len(resharded)} zero1 flat "
-                f"leaf/leaves for the new data-axis size (leaf "
+            log(f"checkpoint: resharded {len(resharded)} sharded-update "
+                f"opt-state leaf/leaves for the new data-axis size (leaf "
                 f"{resharded[:4]}{'...' if len(resharded) > 4 else ''})")
     return jax.tree_util.tree_unflatten(treedef, leaves)

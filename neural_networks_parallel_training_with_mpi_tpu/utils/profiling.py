@@ -52,6 +52,48 @@ def device_memory_stats() -> Dict[str, Dict[str, int]]:
     return out
 
 
+def donation_report(compiled, hlo_text: Optional[str] = None
+                    ) -> Dict[str, Any]:
+    """Inspect a compiled executable's buffer-donation result (ROADMAP
+    item 2's donation audit): parse the ``input_output_alias`` (donations
+    the compiler ACCEPTED — each aliased output reuses its input buffer,
+    no copy) and ``buffer_donor`` (donations offered but NOT aliased to
+    any output — the donated buffer is freed, but the matching output is
+    a fresh allocation, i.e. an unexpected copy) annotations from the
+    optimized HLO's module header.
+
+    ``compiled`` is the object returned by ``jitted.lower(...).compile()``.
+    Returns ``{"aliased": [(output_index, param_number), ...],
+    "n_aliased": ..., "unaliased_donors": n}``.  A step that donates its
+    TrainState should alias every donatable state leaf; a refactor that
+    silently breaks donation (e.g. a dtype change on one side of the
+    in/out pair) shows up as leaves migrating from ``aliased`` to
+    ``unaliased_donors`` — the regression tests pin the counts.
+
+    ``hlo_text``: pass the module text if the caller already rendered it
+    (``compiled.as_text()`` re-stringifies the WHOLE optimized module —
+    tens of MB at transformer scale — just to read its header line)."""
+    import re
+
+    if hlo_text is None:
+        hlo_text = compiled.as_text()
+    header = hlo_text.split("\n", 1)[0]
+    # entries look like `{1}: (3, {}, may-alias)` inside
+    # input_output_alias={...}: output tuple-index {1} aliases param 3
+    aliased = [(tuple(int(x) for x in out_idx.split(",") if x.strip()),
+                int(param))
+               for out_idx, param in re.findall(
+                   r"\{([0-9, ]*)\}:\s*\((\d+),", header)]
+    donors = 0
+    md = re.search(r"buffer_donor=\{(.*?)\}\s*,\s*entry_computation", header)
+    if md is None:
+        md = re.search(r"buffer_donor=\{(.*?)\}\s*$", header)
+    if md:
+        donors = len(re.findall(r"\(\d+,", md.group(1)))
+    return {"aliased": aliased, "n_aliased": len(aliased),
+            "unaliased_donors": donors}
+
+
 class StepTimer:
     """Wall-clock per-step statistics.
 

@@ -556,18 +556,28 @@ def test_zero1_repad_restricted_to_opt_state(tmp_path):
         ckpt.restore(str(tmp_path), grown_param, elastic=True)
 
 
-def test_repad_flat_never_drops_state():
+def test_repad_axis_never_drops_state():
     from neural_networks_parallel_training_with_mpi_tpu.utils.checkpoint import (  # noqa: E501
-        _repad_flat,
+        _repad_axis,
     )
 
     buf = np.array([1., 2., 3., 0., 0., 0.], np.float32)
-    np.testing.assert_array_equal(_repad_flat(buf, 4, 0),
+    np.testing.assert_array_equal(_repad_axis(buf, (4,), 0),
                                   [1., 2., 3., 0.])
-    np.testing.assert_array_equal(_repad_flat(buf, 8, 0),
+    np.testing.assert_array_equal(_repad_axis(buf, (8,), 0),
                                   [1., 2., 3., 0., 0., 0., 0., 0.])
     with pytest.raises(ValueError, match="nonzero"):
-        _repad_flat(np.array([1., 2., 3., 4.], np.float32), 3, 0)
+        _repad_axis(np.array([1., 2., 3., 4.], np.float32), (3,), 0)
+    # per-leaf ('sharded') layouts pad an interior dim of an n-D leaf:
+    # the one differing dim is re-padded, zeros only
+    m = np.zeros((4, 3), np.float32)
+    m[:2] = 1.0
+    np.testing.assert_array_equal(_repad_axis(m, (2, 3), 0),
+                                  np.ones((2, 3), np.float32))
+    grown = _repad_axis(m, (6, 3), 0)
+    assert grown.shape == (6, 3) and np.all(grown[4:] == 0)
+    with pytest.raises(ValueError, match="nonzero"):
+        _repad_axis(np.ones((4, 3), np.float32), (2, 3), 0)
 
 
 # ------------------------------------------------- topology lineage

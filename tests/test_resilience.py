@@ -238,12 +238,17 @@ def test_guarded_step_gspmd_bitwise_noop(mesh4x2):
     assert int(jax.device_get(state1.opt_state.skipped)) == 1
 
 
-def test_guard_refused_on_sliced_update_layouts(mesh8):
-    """zero1's update consumes a scattered gradient SHARD — a shard-local
-    norm would desynchronize the skip decision, so the Trainer refuses."""
-    with pytest.raises(NotImplementedError, match="guarded"):
-        Trainer(_cfg(skip_nonfinite=True, update_sharding="zero1"),
+def test_guard_composes_with_zero1(mesh8):
+    """zero1's update consumes a scattered gradient SHARD, but the step
+    psums the shard squares into the GLOBAL norm and hands it to the
+    guard via Optimizer.update_with_norm — the skip predicate is
+    identical on every replica, so the guard composes (it used to be
+    refused here; tests/test_update_sharding.py pins the skip firing)."""
+    t = Trainer(_cfg(skip_nonfinite=True, update_sharding="zero1"),
                 mesh=mesh8)
+    assert t.guarded and t.zero1
+    r = t.fit()
+    assert r["skipped_updates"] == 0 and np.isfinite(r["final_loss"])
 
 
 @pytest.mark.parametrize("mesh_cfg", [MeshConfig(data=8),

@@ -170,17 +170,21 @@ def test_sparse_metrics_cannot_lose_skip_fires(tmp_path, mesh8):
                for r in t.telemetry.recorder.records)
 
 
-def test_sliced_update_layouts_fall_back_to_loss_only(tmp_path, mesh8):
-    """zero1's update consumes a scattered gradient shard: the trainer
-    keeps telemetry ON but drops to the loss-only stream instead of
-    refusing the layout."""
+def test_zero1_rides_the_full_metrics_stream(tmp_path, mesh8):
+    """zero1 used to fall back to the loss-only stream (its update
+    consumes a scattered gradient shard); since the update-sharding
+    layer it computes the GLOBAL grad norm from the shards via one
+    extra psum, so the full metrics vector rides along — and params
+    stay bitwise-identical with metrics on vs off
+    (tests/test_update_sharding.py pins that half)."""
     d = str(tmp_path / "telem")
     t = Trainer(_cfg(update_sharding="zero1", optimizer="adam",
                      telemetry_dir=d), mesh=mesh8)
-    assert not t.telemetry_metrics and t.telemetry.enabled
+    assert t.telemetry_metrics and t.telemetry.enabled
     t.fit()
     recs = [r for r in _records(d) if r.get("kind") == "step"]
-    assert recs and all("loss" in r and "grad_norm" not in r for r in recs)
+    assert recs and all("loss" in r and "grad_norm" in r
+                        and "update_ratio" in r for r in recs)
 
 
 def test_heartbeat_only_mode_final_step(tmp_path, mesh8):
