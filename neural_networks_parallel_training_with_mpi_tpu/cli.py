@@ -262,7 +262,15 @@ def main(argv=None) -> int:
 
     cfg = config_from_args(args)
     try:
-        trainer = Trainer(cfg)
+        if cfg.workload == "rl":
+            # Anakin actor-learner RL (rl/, DESIGN.md §13) — same
+            # exception->exit-code contract, so the supervisor and the
+            # elastic policy treat an RL child like any training child
+            from .rl.runner import RLRunner
+
+            trainer = RLRunner(cfg)
+        else:
+            trainer = Trainer(cfg)
         result = trainer.fit()
     except AnomalyAbort as e:
         # deterministic divergence: the last good checkpoint is preserved
@@ -306,8 +314,9 @@ def main(argv=None) -> int:
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(EXIT_PEER)
+    unit = ("env frames/sec" if cfg.workload == "rl" else "samples/sec")
     log(f"done: final loss {result['final_loss']:.6f}, "
-        f"{result['samples_per_sec']:.1f} samples/sec")
+        f"{result['samples_per_sec']:.1f} {unit}")
     val = {k: v for k, v in result.items() if k.startswith("val_")}
     if val:
         log("validation: " + ", ".join(f"{k[4:]} {v:.6f}"

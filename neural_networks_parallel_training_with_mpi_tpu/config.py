@@ -174,9 +174,39 @@ class ModelConfig:
 
 
 @dataclass
+class RLConfig:
+    """Anakin actor–learner RL knobs (``--workload rl``; rl/ package,
+    DESIGN.md §13).  Environments are dim-0-sharded over the data axes
+    and the whole rollout+GAE+PPO cycle is ONE jitted step on the mesh
+    (arXiv 2104.06272); the shared training knobs — optimizer, lr
+    schedule, grad clip, skip guard, checkpointing, telemetry,
+    supervisor — come from the enclosing TrainConfig unchanged."""
+
+    env: str = "gridworld"      # gridworld | cartpole (rl.envs)
+    n_envs: int = 64            # GLOBAL env count (must divide by dp)
+    rollout_steps: int = 32     # T: env steps per Anakin step
+    total_updates: int = 200    # Anakin steps (rollout + PPO update cycles)
+    gamma: float = 0.99         # discount
+    gae_lambda: float = 0.95    # GAE lambda (arXiv 1506.02438)
+    clip_eps: float = 0.2       # PPO clipped-surrogate epsilon
+    entropy_coef: float = 0.01  # entropy bonus weight
+    value_coef: float = 0.5     # value-loss weight
+    # full-batch clipped-surrogate passes per rollout (each one optimizer
+    # update; the lr schedule's domain is total_updates * ppo_epochs)
+    ppo_epochs: int = 4
+    # policy/value MLP torso widths (head: n_actions + 1 outputs)
+    hidden: Tuple[int, ...] = (64, 64)
+
+
+@dataclass
 class TrainConfig:
     """Full job config.  The four reference knobs keep their reference
     defaults (dataParallelTraining_NN_MPI.py:245-252)."""
+
+    # which learner the CLI runs: "train" = the supervised Trainer,
+    # "rl" = the Anakin actor–learner (rl.runner.RLRunner); both share
+    # the optimizer/checkpoint/telemetry/resilience knobs below
+    workload: str = "train"
 
     lr: float = 1e-3
     momentum: float = 0.9
@@ -259,6 +289,7 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
+    rl: RLConfig = field(default_factory=RLConfig)
     # checkpointing (extension beyond reference parity, SURVEY.md §5.4)
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 0  # steps; 0 = only at end
@@ -387,7 +418,8 @@ class TrainConfig:
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "TrainConfig":
         d = dict(d)
-        for key, cls in (("mesh", MeshConfig), ("data", DataConfig), ("model", ModelConfig)):
+        for key, cls in (("mesh", MeshConfig), ("data", DataConfig),
+                         ("model", ModelConfig), ("rl", RLConfig)):
             if key in d and isinstance(d[key], dict):
                 sub = dict(d[key])
                 for f in dataclasses.fields(cls):
@@ -448,6 +480,38 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(interleaved schedule: bubble / v at constant "
                         "microbatch count; needs n_layers %% (v*pp) == 0)")
     p.add_argument("--loss", choices=["mse", "cross_entropy"], default="mse")
+    # ---- RL workload (rl/ package, DESIGN.md §13) ----------------------
+    p.add_argument("--workload", choices=["train", "rl"], default="train",
+                   help="rl = Anakin actor-learner PPO on the data mesh "
+                        "(envs sharded over dp, rollout + GAE + update "
+                        "in one jitted step); optimizer/checkpoint/"
+                        "telemetry/supervisor flags apply unchanged")
+    p.add_argument("--rl_env", choices=["gridworld", "cartpole"],
+                   default="gridworld",
+                   help="pure-JAX vectorized environment (rl.envs)")
+    p.add_argument("--rl_envs", type=int, default=64,
+                   help="GLOBAL env count, dim-0-sharded over the data "
+                        "axes (must divide by the dp size)")
+    p.add_argument("--rollout_steps", type=int, default=32,
+                   help="T: env steps per Anakin step (frames per update "
+                        "= T * rl_envs)")
+    p.add_argument("--rl_updates", type=int, default=200,
+                   help="Anakin steps to run (the RL analogue of epochs)")
+    p.add_argument("--gamma", type=float, default=0.99,
+                   help="RL discount factor")
+    p.add_argument("--gae_lambda", type=float, default=0.95,
+                   help="GAE lambda (arXiv 1506.02438)")
+    p.add_argument("--clip_eps", type=float, default=0.2,
+                   help="PPO clipped-surrogate epsilon")
+    p.add_argument("--entropy_coef", type=float, default=0.01,
+                   help="PPO entropy-bonus weight")
+    p.add_argument("--value_coef", type=float, default=0.5,
+                   help="PPO value-loss weight")
+    p.add_argument("--ppo_epochs", type=int, default=4,
+                   help="full-batch clipped-surrogate passes per rollout "
+                        "(each is one optimizer update)")
+    p.add_argument("--rl_hidden", type=str, default="64,64",
+                   help="policy/value MLP hidden widths, comma-separated")
     p.add_argument("--label_smoothing", type=float, default=0.0,
                    help="CE target smoothing s: (1-s)*onehot + s/C "
                         "(train loss only)")
@@ -745,6 +809,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
     full_batch = (args.full_batch if args.full_batch is not None
                   else args.batch_size is None)
     cfg = TrainConfig(
+        workload=getattr(args, "workload", "train"),
         lr=args.lr,
         momentum=args.momentum,
         batch_size=args.batch_size if args.batch_size is not None else 4,
@@ -856,6 +921,23 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                 f"--attention {args.attention} needs a sequence-sharded "
                 "mesh; pass --sp > 1 (or use dense/flash)")
         cfg.model.attention = args.attention
+    if cfg.workload == "rl":
+        try:
+            hidden = tuple(int(h) for h in args.rl_hidden.split(",") if h)
+        except ValueError:
+            raise SystemExit(f"--rl_hidden expects comma-separated ints, "
+                             f"got {args.rl_hidden!r}")
+        if not hidden:
+            raise SystemExit("--rl_hidden needs at least one width")
+        cfg.rl = RLConfig(env=args.rl_env, n_envs=args.rl_envs,
+                          rollout_steps=args.rollout_steps,
+                          total_updates=args.rl_updates,
+                          gamma=args.gamma, gae_lambda=args.gae_lambda,
+                          clip_eps=args.clip_eps,
+                          entropy_coef=args.entropy_coef,
+                          value_coef=args.value_coef,
+                          ppo_epochs=args.ppo_epochs,
+                          hidden=hidden)
     if args.moe_experts:
         cfg.model.moe_experts = args.moe_experts
     if args.moe_capacity_factor is not None:

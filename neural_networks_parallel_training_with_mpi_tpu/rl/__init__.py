@@ -1,0 +1,32 @@
+"""Reinforcement learning on the training mesh (ROADMAP item 5).
+
+Podracer/Anakin-style co-located actor–learner training ("Podracer
+architectures for scalable Reinforcement Learning", arXiv 2104.06272):
+environment transitions, the rollout loop, advantage estimation and the
+PPO update are ALL jitted into one shard_mapped program on the same data
+mesh the LM steps use — environments sharded along the data axes, params
+replicated, gradients psum'd, exactly like the DP train step.  DrJAX
+(arXiv 2403.07128) names the mechanism: the actor fan-out is a mapped
+primitive (``vmap`` over envs inside ``shard_map`` over devices), not a
+fleet of actor processes.
+
+Modules:
+
+* :mod:`.envs` — stateless pure-JAX vectorized environments (gridworld,
+  CartPole) with auto-reset transitions.
+* :mod:`.gae` — Generalized Advantage Estimation via ``lax.scan``.
+* :mod:`.anakin` — the fused rollout + GAE + PPO step and its
+  :class:`~.anakin.RLState`.
+* :mod:`.runner` — the learner loop riding the existing ``train/``
+  machinery (telemetry, manifest checkpoints, supervisor, faults).
+"""
+
+from .envs import CartPole, GridWorld, make_env  # noqa: F401
+from .gae import gae_advantages  # noqa: F401
+from .anakin import (  # noqa: F401
+    RLState,
+    anakin_step_flops,
+    init_rl_state,
+    make_anakin_step,
+    place_rl_state,
+)

@@ -1,0 +1,285 @@
+"""The Anakin actor–learner step: rollout + GAE + PPO in ONE program.
+
+Podracer's Anakin layout (arXiv 2104.06272 §3) co-locates acting and
+learning on the same devices: a T-step environment rollout under the
+CURRENT policy (``lax.scan`` over time, ``vmap`` over the per-device env
+batch — the DrJAX-style mapped fan-out, arXiv 2403.07128), Generalized
+Advantage Estimation, and the PPO clipped-surrogate update are all
+compiled into one ``shard_map``-mapped, jitted step on the data mesh:
+
+* envs (state, obs, running returns, per-env PRNG keys) are dim-0-sharded
+  over the DATA axes — each device owns ``n_envs / dp`` environments;
+* params / optimizer state / step counter are replicated;
+* gradients are psum'd over the data axes exactly like the DP LM step
+  (``parallel.data_parallel``), so the update — and hence the skip guard
+  predicate and the telemetry metrics vector — is identical on every
+  replica.
+
+One Anakin step = one rollout of ``T * n_envs`` env frames + ``ppo_epochs``
+full-batch clipped-surrogate optimizer updates on them.  There is no host
+round-trip anywhere inside: the policy the envs step under is the one
+being updated, on the same chips, which is the entire point of the
+architecture.
+
+Determinism/resume contract: the step is a pure function of
+:class:`RLState`; all randomness derives from the carried per-env base
+keys via ``fold_in(key_i, 1 + step*T + t)``, so checkpointing RLState
+(step, params, opt state, env state, obs, running returns, env keys) and
+restoring it reproduces the uninterrupted run bitwise
+(tests/test_rl.py pins this).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.optim import Optimizer
+from ..parallel.data_parallel import DATA_AXES, data_axis_size
+from ..utils import prng
+from .gae import gae_advantages
+
+Pytree = Any
+
+
+class RLState(NamedTuple):
+    """The Anakin analogue of ``train.state.TrainState`` — everything a
+    trajectory-exact resume needs, in one checkpointable pytree.  The
+    first three fields mirror TrainState; the trailing four are the
+    per-env actor state, dim-0-sharded over the data axes.
+    ``utils.checkpoint``'s elastic reshard derives the opt-state leaf
+    range from the NamedTuple field order, so the env leaves here are
+    never mistaken for repaddable optimizer padding — a resume with a
+    different ``--rl_envs`` refuses loudly instead of silently
+    zero-extending env state (tests/test_rl.py pins it)."""
+
+    step: jax.Array       # int32 scalar — Anakin steps (rollout+update)
+    params: Pytree        # policy/value net, replicated
+    opt_state: Pytree     # replicated (GuardedState-wrapped when guarded)
+    env_state: Pytree     # per-env environment state, (n_envs, ...)
+    obs: jax.Array        # (n_envs, obs_dim) current observations
+    ep_return: jax.Array  # (n_envs,) running (uncompleted) episode returns
+    env_keys: jax.Array   # (n_envs, 2) per-env PRNG base keys
+
+
+def rl_state_spec() -> RLState:
+    """shard_map in/out spec-prefix tree: params replicated, envs sharded."""
+    return RLState(step=P(), params=P(), opt_state=P(),
+                   env_state=P(DATA_AXES), obs=P(DATA_AXES),
+                   ep_return=P(DATA_AXES), env_keys=P(DATA_AXES))
+
+
+def policy_heads(model, params: Pytree, obs: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """(logits, value) from the shared-torso net: the registry MLP with
+    ``out_features = n_actions + 1`` — columns [:-1] are action logits,
+    column [-1] the state value.  One matmul stack serves both heads."""
+    out = model.apply(params, obs)
+    return out[..., :-1], out[..., -1]
+
+
+def init_rl_state(env, model, optimizer: Optimizer, n_envs: int,
+                  seed: int) -> RLState:
+    """Deterministic host-side init (every process derives the identical
+    state from the job seed, like ``TrainState.create``): policy params
+    from the INIT stream, per-env base keys from the ENV stream, each
+    env reset with ``fold_in(key_i, 0)`` (step keys use ``1 + ...``, so
+    the reset draw can never collide with a rollout draw)."""
+    params = model.init(prng.init_key(seed))
+    base = prng.stream(seed, prng.ENV)
+    env_keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+        jnp.arange(n_envs))
+    reset_keys = jax.vmap(lambda k: jax.random.fold_in(k, 0))(env_keys)
+    env_state, obs = jax.vmap(env.reset)(reset_keys)
+    return RLState(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=optimizer.init(params),
+                   env_state=env_state, obs=obs,
+                   ep_return=jnp.zeros((n_envs,), jnp.float32),
+                   env_keys=env_keys)
+
+
+def place_rl_state(state: RLState, mesh: Mesh) -> RLState:
+    """Place an RLState on the mesh: params/opt replicated, env leaves
+    dim-0-sharded over the data axes (used at init AND on restore)."""
+    rep = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(DATA_AXES))
+    put = lambda tree, s: jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, s), tree)
+    return RLState(step=jax.device_put(state.step, rep),
+                   params=put(state.params, rep),
+                   opt_state=put(state.opt_state, rep),
+                   env_state=put(state.env_state, shard),
+                   obs=jax.device_put(state.obs, shard),
+                   ep_return=jax.device_put(state.ep_return, shard),
+                   env_keys=jax.device_put(state.env_keys, shard))
+
+
+def anakin_step_flops(model, obs_dim: int, rollout_steps: int,
+                      ppo_epochs: int) -> Optional[float]:
+    """Analytic matmul FLOPs of one Anakin step PER ENV FRAME — the honest
+    accounting the MFU stream divides by (``train.telemetry``): every
+    frame pays 1 actor forward, the bootstrap value adds 1/T of a
+    forward, and the learner pays ``ppo_epochs`` fwd+bwd passes (the
+    standard 3x-forward convention) over the full rollout batch.  None
+    for unaccounted architectures."""
+    fwd = model.fwd_flops((1, obs_dim))
+    if fwd is None:
+        return None
+    return float(fwd) * (1.0 + 1.0 / max(1, rollout_steps)
+                         + 3.0 * max(1, ppo_epochs))
+
+
+def make_anakin_step(env, model, optimizer: Optimizer, mesh: Mesh, *,
+                     rollout_steps: int, gamma: float = 0.99,
+                     gae_lambda: float = 0.95, clip_eps: float = 0.2,
+                     entropy_coef: float = 0.01, value_coef: float = 0.5,
+                     ppo_epochs: int = 4, normalize_advantages: bool = True,
+                     with_metrics: bool = False, donate: bool = True):
+    """Build the jitted Anakin step: ``state -> (state, out)``.
+
+    ``out`` is the scalar PPO loss, or with ``with_metrics`` the
+    on-device telemetry dict — ``telemetry.METRIC_KEYS`` assembled by the
+    same ``telemetry.update_with_metrics`` seam the DP LM step uses (so
+    a guarded optimizer pays ONE norm reduction, and the update math is
+    byte-identical to the metrics-off step: params stay bitwise-equal
+    with telemetry on vs off) — extended with the RL scalars
+    ``return_mean`` (completed episodes this rollout; NaN when none
+    completed), ``episodes`` (completed count), ``entropy``,
+    ``approx_kl`` and ``value_loss`` from the final PPO epoch.
+
+    The PPO update is ``ppo_epochs`` FULL-batch clipped-surrogate steps
+    on the rollout (advantages frozen after GAE; no minibatch shuffling
+    — at Anakin scale the rollout IS the minibatch), each an ordinary
+    ``Optimizer.update`` on psum'd global-mean gradients, so
+    ``with_skip_guard``/``with_clipping`` wrappers apply unchanged.
+    """
+    if rollout_steps < 1:
+        raise ValueError(f"rollout_steps must be >= 1, got {rollout_steps}")
+    if ppo_epochs < 1:
+        raise ValueError(f"ppo_epochs must be >= 1, got {ppo_epochs}")
+    T = int(rollout_steps)
+
+    def shard_step(state: RLState):
+        n_local = state.obs.shape[0]
+
+        # ---- actor: T-step rollout under the current policy ----------
+        def rollout_body(carry, t):
+            env_state, obs, ep_ret = carry
+            # one fresh key per (env, t), derived from the carried base
+            # keys — nothing about the draw depends on how the rollout
+            # is batched or sharded
+            keys = jax.vmap(
+                lambda k: jax.random.fold_in(k, 1 + state.step * T + t)
+            )(state.env_keys)
+            akeys = jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys)
+            ekeys = jax.vmap(lambda k: jax.random.fold_in(k, 1))(keys)
+            logits, value = policy_heads(model, state.params, obs)
+            action = jax.vmap(jax.random.categorical)(akeys, logits)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits), action[:, None], axis=1)[:, 0]
+            env_state, next_obs, reward, done = jax.vmap(env.step)(
+                env_state, action, ekeys)
+            ep_ret = ep_ret + reward
+            completed_sum = jnp.sum(ep_ret * done)
+            completed_n = jnp.sum(done)
+            ep_ret = ep_ret * (1.0 - done)
+            traj = (obs, action, logp, value, reward, done)
+            return ((env_state, next_obs, ep_ret),
+                    (traj, completed_sum, completed_n))
+
+        carry0 = (state.env_state, state.obs, state.ep_return)
+        (env_state, final_obs, ep_return), (traj, csum, cnum) = lax.scan(
+            rollout_body, carry0, jnp.arange(T))
+        obs_t, action_t, logp_t, value_t, reward_t, done_t = traj
+
+        # completed-episode return, GLOBAL mean over the data axes (NaN
+        # when no episode completed this rollout — the host stream skips
+        # non-finite points)
+        total_completed = lax.psum(jnp.sum(cnum), DATA_AXES)
+        return_mean = jnp.where(
+            total_completed > 0,
+            lax.psum(jnp.sum(csum), DATA_AXES)
+            / jnp.maximum(total_completed, 1.0),
+            jnp.float32(jnp.nan))
+
+        # ---- advantages (GAE) ----------------------------------------
+        _, last_value = policy_heads(model, state.params, final_obs)
+        adv_t, ret_t = gae_advantages(reward_t, value_t, done_t,
+                                      last_value, gamma, gae_lambda)
+        n_total = jnp.float32(T * n_local) * data_axis_size(mesh)
+        if normalize_advantages:
+            # global-batch normalization: psum'd moments, so every
+            # replica standardizes by the identical statistics
+            mean = lax.psum(jnp.sum(adv_t), DATA_AXES) / n_total
+            var = lax.psum(jnp.sum(jnp.square(adv_t - mean)),
+                           DATA_AXES) / n_total
+            adv_t = (adv_t - mean) / jnp.sqrt(var + 1e-8)
+
+        flat = lambda x: x.reshape((T * n_local,) + x.shape[2:])
+        b_obs, b_act = flat(obs_t), flat(action_t)
+        b_logp, b_adv, b_ret = flat(logp_t), flat(adv_t), flat(ret_t)
+
+        # ---- learner: PPO clipped surrogate, global-mean gradients ----
+        def loss_sums(params):
+            logits, value = policy_heads(model, params, b_obs)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(logp_all, b_act[:, None],
+                                       axis=1)[:, 0]
+            ratio = jnp.exp(logp - b_logp)
+            clipped = jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps)
+            pg_sum = -jnp.sum(jnp.minimum(ratio * b_adv, clipped * b_adv))
+            v_sum = 0.5 * jnp.sum(jnp.square(value - b_ret))
+            ent_sum = -jnp.sum(jnp.sum(jnp.exp(logp_all) * logp_all,
+                                       axis=-1))
+            kl_sum = jnp.sum(b_logp - logp)
+            total = pg_sum + value_coef * v_sum - entropy_coef * ent_sum
+            return total, (v_sum, ent_sum, kl_sum)
+
+        params, opt_state = state.params, state.opt_state
+        loss = v_loss = entropy = approx_kl = jnp.float32(0.0)
+        metrics = None
+        for e in range(ppo_epochs):
+            (total, (v_sum, ent_sum, kl_sum)), grads = jax.value_and_grad(
+                loss_sums, has_aux=True)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, DATA_AXES) / n_total, grads)
+            loss = lax.psum(total, DATA_AXES) / n_total
+            v_loss = lax.psum(v_sum, DATA_AXES) / n_total
+            entropy = lax.psum(ent_sum, DATA_AXES) / n_total
+            approx_kl = lax.psum(kl_sum, DATA_AXES) / n_total
+            if with_metrics and e == ppo_epochs - 1:
+                from ..train import telemetry
+
+                params, opt_state, metrics = telemetry.update_with_metrics(
+                    optimizer, grads, opt_state, params, loss)
+            else:
+                params, opt_state = optimizer.update(grads, opt_state,
+                                                     params)
+
+        new_state = RLState(step=state.step + 1, params=params,
+                            opt_state=opt_state, env_state=env_state,
+                            obs=final_obs, ep_return=ep_return,
+                            env_keys=state.env_keys)
+        # the RL scalars are byproducts of work the step does anyway, so
+        # both modes carry them; with_metrics ADDS the telemetry vector
+        # (grad/param norms etc.) — the only change to the program — and
+        # the update math stays byte-identical either way
+        out = dict(metrics) if with_metrics else {"loss": loss}
+        out.update(return_mean=return_mean,
+                   episodes=total_completed,
+                   entropy=entropy, approx_kl=approx_kl,
+                   value_loss=v_loss)
+        return new_state, out
+
+    spec = rl_state_spec()
+    mapped = jax.shard_map(
+        shard_step, mesh=mesh,
+        in_specs=(spec,),
+        out_specs=(spec, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,171 @@
+"""Pure-JAX vectorized environments.
+
+Each environment is a frozen dataclass of STATIC configuration whose
+``reset``/``step`` methods are pure functions over explicit state::
+
+    state, obs          = env.reset(key)
+    state, obs, r, done = env.step(state, action, key)
+
+so thousands of envs batch with one ``vmap`` and run entirely on device —
+zero host round-trips per transition, which is the whole point of the
+Anakin layout (arXiv 2104.06272 §2: "the environment itself is compiled
+into the TPU program").  ``step`` AUTO-RESETS: when the transition ends
+the episode (``done``), the returned state/obs already belong to a fresh
+episode (seeded from the same per-step key), so a fixed-length
+``lax.scan`` rollout never stalls on episode boundaries.  ``done`` flags
+the boundary for GAE masking; the reward returned is the terminal
+transition's.
+
+Time-limit truncation is treated as termination (``done=1``, no
+bootstrap) — the standard small-scale simplification; DESIGN.md §13
+discusses the bias.
+
+Determinism: every method consumes an explicit PRNG key and carries no
+hidden state, so a rollout is a pure function of (params, env state,
+keys) — the property the trajectory-exact checkpoint resume contract
+rests on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+EnvState = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class GridWorld:
+    """N x N gridworld: start anywhere, walk to the fixed goal at the
+    bottom-right corner.  Actions: 0=up 1=right 2=down 3=left (moves off
+    the edge are no-ops).  Reward: ``goal_reward`` on reaching the goal,
+    ``step_penalty`` per non-terminal step.  Episodes end at the goal or
+    after ``max_steps`` transitions.  Observation: one-hot row ++ one-hot
+    col (``2 * size`` floats) — small enough that the policy MLP is a few
+    thousand params, rich enough that the optimal policy is non-trivial
+    from every start cell."""
+
+    size: int = 5
+    max_steps: int = 30
+    goal_reward: float = 1.0
+    step_penalty: float = 0.01
+
+    @property
+    def obs_dim(self) -> int:
+        return 2 * self.size
+
+    @property
+    def n_actions(self) -> int:
+        return 4
+
+    def _obs(self, state: EnvState) -> jax.Array:
+        r = jax.nn.one_hot(state["pos"][0], self.size, dtype=jnp.float32)
+        c = jax.nn.one_hot(state["pos"][1], self.size, dtype=jnp.float32)
+        return jnp.concatenate([r, c])
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        # uniform over all cells EXCEPT the goal (a spawn on the goal
+        # would be a zero-length episode)
+        cell = jax.random.randint(key, (), 0, self.size * self.size - 1)
+        state = {"pos": jnp.stack([cell // self.size, cell % self.size]
+                                  ).astype(jnp.int32),
+                 "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(state)
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array
+             ) -> Tuple[EnvState, jax.Array, jax.Array, jax.Array]:
+        moves = jnp.asarray([[-1, 0], [0, 1], [1, 0], [0, -1]], jnp.int32)
+        pos = jnp.clip(state["pos"] + moves[action], 0, self.size - 1)
+        t = state["t"] + 1
+        at_goal = jnp.all(pos == self.size - 1)
+        done = (at_goal | (t >= self.max_steps)).astype(jnp.float32)
+        reward = jnp.where(at_goal, jnp.float32(self.goal_reward),
+                           jnp.float32(-self.step_penalty))
+        nxt = {"pos": pos, "t": t}
+        reset_state, reset_obs = self.reset(key)
+        # auto-reset: where done, the carried state/obs are already the
+        # next episode's (done itself still marks THIS transition)
+        boolean = done > 0
+        state_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(boolean, a, b), reset_state, nxt)
+        obs_out = jnp.where(boolean, reset_obs, self._obs(nxt))
+        return state_out, obs_out, reward, done
+
+
+@dataclass(frozen=True)
+class CartPole:
+    """Classic CartPole-v1 dynamics (Barto-Sutton-Anderson), the control
+    benchmark Anakin's paper itself uses for the toy scale: Euler
+    integration at ``tau``, +1 reward per transition, episode ends when
+    the pole falls (|theta| > ~12 deg), the cart leaves the track
+    (|x| > 2.4), or after ``max_steps`` transitions."""
+
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5          # half the pole length
+    force_mag: float = 10.0
+    tau: float = 0.02
+    theta_threshold: float = 12 * 2 * jnp.pi / 360
+    x_threshold: float = 2.4
+    max_steps: int = 200
+
+    @property
+    def obs_dim(self) -> int:
+        return 4
+
+    @property
+    def n_actions(self) -> int:
+        return 2
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        x = jax.random.uniform(key, (4,), jnp.float32, -0.05, 0.05)
+        state = {"x": x, "t": jnp.zeros((), jnp.int32)}
+        return state, x
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array
+             ) -> Tuple[EnvState, jax.Array, jax.Array, jax.Array]:
+        x, x_dot, theta, theta_dot = (state["x"][0], state["x"][1],
+                                      state["x"][2], state["x"][3])
+        force = jnp.where(action == 1, self.force_mag, -self.force_mag)
+        total_mass = self.masscart + self.masspole
+        polemass_length = self.masspole * self.length
+        cos, sin = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + polemass_length * theta_dot**2 * sin) / total_mass
+        theta_acc = ((self.gravity * sin - cos * temp)
+                     / (self.length * (4.0 / 3.0
+                                       - self.masspole * cos**2
+                                       / total_mass)))
+        x_acc = temp - polemass_length * theta_acc * cos / total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * x_acc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * theta_acc
+        vec = jnp.stack([x, x_dot, theta, theta_dot])
+        t = state["t"] + 1
+        fell = ((jnp.abs(x) > self.x_threshold)
+                | (jnp.abs(theta) > self.theta_threshold))
+        done = (fell | (t >= self.max_steps)).astype(jnp.float32)
+        reward = jnp.ones((), jnp.float32)
+        nxt = {"x": vec, "t": t}
+        reset_state, reset_obs = self.reset(key)
+        boolean = done > 0
+        state_out = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(boolean, a, b), reset_state, nxt)
+        obs_out = jnp.where(boolean, reset_obs, vec)
+        return state_out, obs_out, reward, done
+
+
+ENVS = {"gridworld": GridWorld, "cartpole": CartPole}
+
+
+def make_env(name: str):
+    """Build an environment from its config name (``config.RLConfig.env``)."""
+    if name not in ENVS:
+        raise ValueError(f"unknown env {name!r} (choices: "
+                         f"{', '.join(sorted(ENVS))})")
+    return ENVS[name]()

@@ -1,0 +1,48 @@
+"""Generalized Advantage Estimation (Schulman et al., arXiv 1506.02438).
+
+One ``lax.scan`` backward over the rollout::
+
+    delta_t = r_t + gamma * V_{t+1} * (1 - done_t) - V_t
+    A_t     = delta_t + gamma * lam * (1 - done_t) * A_{t+1}
+
+``done_t`` masks BOTH the bootstrap and the recursion: an episode that
+terminates mid-rollout contributes no value (or advantage) leakage from
+the auto-reset successor state — the boundary every hand-rolled GAE gets
+wrong, pinned against a plain-numpy reference in tests/test_rl.py.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gae_advantages(rewards: jax.Array, values: jax.Array,
+                   dones: jax.Array, last_value: jax.Array,
+                   gamma: float, lam: float
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """(advantages, returns), each shaped like ``rewards``.
+
+    ``rewards``/``values``/``dones`` are time-major ``(T, ...)`` —
+    ``values[t] = V(s_t)`` for the state the t-th action was taken in,
+    ``dones[t]`` flags that transition t ended its episode —
+    and ``last_value`` is ``V(s_T)`` of the post-rollout state (the
+    bootstrap for episodes still running at the boundary).
+    ``returns = advantages + values`` are the value-function regression
+    targets (the lambda-returns)."""
+    values_next = jnp.concatenate([values[1:], last_value[None]], axis=0)
+    not_done = 1.0 - dones
+
+    def body(carry, xs):
+        r, v, v_next, nd = xs
+        delta = r + gamma * v_next * nd - v
+        adv = delta + gamma * lam * nd * carry
+        return adv, adv
+
+    _, advantages = lax.scan(body, jnp.zeros_like(last_value),
+                             (rewards, values, values_next, not_done),
+                             reverse=True)
+    return advantages, advantages + values

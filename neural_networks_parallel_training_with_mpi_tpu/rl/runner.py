@@ -1,0 +1,321 @@
+"""The RL learner loop: Anakin steps riding the existing train/ machinery.
+
+Deliberately NOT a fork of ``train.trainer.Trainer``: everything around
+the step — optimizer construction (``ops.optim.make`` + the
+``with_skip_guard`` guarded update), telemetry (metrics.jsonl, heartbeat,
+flight recorder, MFU accounting), manifest-committed checkpoints with
+verified restore, deterministic fault injection, the hang watchdog,
+graceful SIGTERM preemption, and the crash-restart supervisor — is the
+same machinery, consumed through the same seams.  The point of ROADMAP
+item 5 is precisely that the reliability stack needs NO RL-specific code:
+an injected crash mid-RL-run relaunches, restores the newest verified
+snapshot, and continues trajectory-exact (tests/test_rl.py pins it).
+
+What IS different from supervised training: there is no data loader (the
+environments generate the data on device), one "dispatch" is one Anakin
+step (T * n_envs env frames + ppo_epochs PPO updates), and the
+checkpoint state is :class:`~.anakin.RLState` — params + optimizer state
+PLUS env state, observations, running returns and the per-env PRNG keys,
+so a resume reproduces the uninterrupted run bitwise.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..config import ModelConfig, TrainConfig
+from ..models.registry import build_model
+from ..ops import optim as optim_lib
+from ..ops import schedules
+from ..parallel import data_parallel as dp
+from ..parallel.mesh import describe, make_mesh, world_setup
+from ..train import telemetry as telemetry_lib
+from ..utils.logging import MetricsLogger, Throughput, log
+from . import anakin
+from .envs import make_env
+
+
+def params_digest(params: Any) -> str:
+    """sha256 over the host copy of every param leaf, in tree order — the
+    cross-process bitwise-trajectory witness examples/21 diffs."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(jax.device_get(params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+class RLRunner:
+    """Drives :func:`rl.anakin.make_anakin_step` under the full
+    reliability stack.  Mirrors the Trainer's surface where it matters
+    (``fit() -> result dict`` with ``final_loss``/``samples_per_sec``,
+    the same abort exceptions propagating to the CLI's exit-code
+    mapping) so ``cli.main`` treats both workloads identically."""
+
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        world_setup()
+        if cfg.min_devices and jax.device_count() < cfg.min_devices:
+            from ..train.resilience import CapacityAbort
+
+            raise CapacityAbort(
+                f"{jax.device_count()} healthy device(s) < --min_devices "
+                f"{cfg.min_devices}: refusing to train below the capacity "
+                "floor (exit 46; raise capacity or lower --min_devices)")
+        if cfg.collective_timeout > 0:
+            from ..parallel import distributed
+
+            distributed.set_collective_timeout(cfg.collective_timeout)
+        self.mesh = mesh if mesh is not None else make_mesh(cfg.mesh)
+        for axis in ("tensor", "pipe", "seq", "expert"):
+            if self.mesh.shape.get(axis, 1) > 1:
+                raise NotImplementedError(
+                    f"--workload rl shards ENVIRONMENTS over the data "
+                    f"axes; the {axis} axis has no meaning for the "
+                    "policy MLP — use --dp/--fsdp only")
+        if cfg.update_sharding != "replicated" or cfg.master_weights:
+            raise NotImplementedError(
+                "--workload rl runs the replicated weight update (the "
+                "policy net is a few thousand params; sharding its "
+                "update would be pure overhead) — drop "
+                "--update_sharding/--master_weights")
+        self.dp_size = dp.data_axis_size(self.mesh)
+        rl = cfg.rl
+        if rl.n_envs < 1 or rl.n_envs % self.dp_size != 0:
+            raise ValueError(
+                f"--rl_envs {rl.n_envs} must be a positive multiple of "
+                f"the data-axis size {self.dp_size} (each device owns "
+                "n_envs/dp environments)")
+        self.env = make_env(rl.env)
+        # the policy/value net comes from models/registry like every
+        # other workload's model: an MLP torso with n_actions+1 outputs
+        # (logits ++ value — rl.anakin.policy_heads splits them)
+        self.model = build_model(ModelConfig(
+            arch="mlp", in_features=self.env.obs_dim,
+            hidden=tuple(rl.hidden),
+            out_features=self.env.n_actions + 1,
+            dtype=cfg.param_dtype or cfg.model.dtype,
+            compute_dtype=cfg.model.compute_dtype))
+        # lr schedule domain = optimizer steps = updates * ppo_epochs
+        lr = schedules.make(
+            cfg.lr_schedule, cfg.lr,
+            total_steps=max(1, rl.total_updates * rl.ppo_epochs),
+            warmup_steps=cfg.warmup_steps, min_lr=cfg.min_lr)
+        # replicated path: with_clipping's whole-tree norm is already the
+        # global norm (gradients are psum'd before the update) — same
+        # seam as the DP trainer
+        self.optimizer = optim_lib.make(
+            cfg.optimizer, lr, cfg.momentum, cfg.weight_decay,
+            grad_clip=cfg.grad_clip)
+        self.guarded = cfg.skip_nonfinite or cfg.skip_threshold > 0
+        if self.guarded:
+            self.optimizer = optim_lib.with_skip_guard(
+                self.optimizer, cfg.skip_threshold)
+        from ..utils.faults import FaultPlan
+
+        self.fault_plan = FaultPlan.from_config(cfg.faults)
+        if self.fault_plan and self.fault_plan.det_desync() is not None:
+            raise NotImplementedError(
+                "desync?det wraps the supervised train step's TrainState; "
+                "the RL step is not wired for it (bitflip/desync without "
+                "det target RLState.params/opt_state and work unchanged)")
+        if self.fault_plan and any(f.kind == "nan"
+                                   for f in self.fault_plan.faults):
+            # reject rather than vacuously pass: nan poisons a HOST-FED
+            # batch, and the RL step's frames are generated on device —
+            # a chaos run asking for it would inject nothing and exit 0
+            raise NotImplementedError(
+                "the 'nan' fault poisons the host-fed batch; RL frames "
+                "are generated on device, so there is nothing to poison "
+                "— exercise the skip guard with the state kinds "
+                "(bitflip/desync) instead")
+        self.telemetry_metrics = bool(cfg.telemetry_dir
+                                      and cfg.metrics_every > 0)
+        self.step_fn = anakin.make_anakin_step(
+            self.env, self.model, self.optimizer, self.mesh,
+            rollout_steps=rl.rollout_steps, gamma=rl.gamma,
+            gae_lambda=rl.gae_lambda, clip_eps=rl.clip_eps,
+            entropy_coef=rl.entropy_coef, value_coef=rl.value_coef,
+            ppo_epochs=rl.ppo_epochs,
+            with_metrics=self.telemetry_metrics)
+        self.frames_per_update = rl.rollout_steps * rl.n_envs
+        self.metrics = MetricsLogger(cfg.metrics_jsonl)
+        dev = self.mesh.devices.flat[0]
+        self.telemetry = telemetry_lib.Telemetry(
+            cfg, self.model, (self.env.obs_dim,),
+            n_devices=int(self.mesh.devices.size),
+            device_kind=dev.device_kind, platform=dev.platform,
+            kind="rl",
+            flops_per_row=anakin.anakin_step_flops(
+                self.model, self.env.obs_dim, rl.rollout_steps,
+                rl.ppo_epochs))
+        self.state: Optional[anakin.RLState] = None
+
+    # ---- state lifecycle -------------------------------------------------
+    def init_state(self) -> anakin.RLState:
+        host = anakin.init_rl_state(self.env, self.model, self.optimizer,
+                                    self.cfg.rl.n_envs, self.cfg.seed)
+        self.state = anakin.place_rl_state(host, self.mesh)
+        return self.state
+
+    def maybe_resume(self) -> int:
+        """Restore the newest VERIFIED snapshot (manifest-checked,
+        quarantine-and-fall-back — utils.checkpoint unchanged) and return
+        the Anakin step to resume from.  The snapshot carries env state,
+        observations, running returns and the per-env keys, so the
+        resumed trajectory is bitwise the uninterrupted one."""
+        if not (self.cfg.resume and self.cfg.checkpoint_dir):
+            return 0
+        from ..utils import checkpoint as ckpt
+
+        restored = ckpt.restore(self.cfg.checkpoint_dir, self.state,
+                                elastic=self.cfg.elastic)
+        if restored is None:
+            return 0
+        self.state = anakin.place_rl_state(restored, self.mesh)
+        return int(jax.device_get(self.state.step))
+
+    def save(self, final: bool = False) -> None:
+        if not self.cfg.checkpoint_dir:
+            return
+        from ..utils import checkpoint as ckpt
+
+        self.telemetry.alive()
+        extra = {"workload": "rl",
+                 "saved_world": {"dp": int(self.dp_size)}}
+        if self.cfg.async_checkpoint and not final:
+            ckpt.save_async(self.cfg.checkpoint_dir, self.state,
+                            keep=self.cfg.checkpoint_keep,
+                            extra_meta=extra)
+        else:
+            if final:
+                ckpt.wait_pending()
+            ckpt.save(self.cfg.checkpoint_dir, self.state,
+                      keep=self.cfg.checkpoint_keep, extra_meta=extra)
+
+    # ---- the loop --------------------------------------------------------
+    def fit(self) -> Dict[str, Any]:
+        cfg, rl = self.cfg, self.cfg.rl
+        if self.state is None:
+            self.init_state()
+        start = self.maybe_resume()
+        log(f"mesh: {describe(self.mesh)} | workload: rl ({rl.env}) | "
+            f"policy: mlp {self.env.obs_dim}->"
+            f"{'x'.join(str(h) for h in rl.hidden)}->"
+            f"{self.env.n_actions}+1 ({self.model.n_params():,} params) | "
+            f"{rl.n_envs} envs x T={rl.rollout_steps} "
+            f"({self.frames_per_update} frames/update), "
+            f"ppo_epochs={rl.ppo_epochs}"
+            + (f" | resumed at update {start}" if start else ""))
+        from ..utils.watchdog import HangWatchdog
+        from ..train.resilience import GracefulShutdown
+
+        watchdog = HangWatchdog(
+            cfg.hang_timeout or None,
+            on_timeout=lambda: telemetry_lib.emergency_dump("hang"))
+        shutdown = GracefulShutdown()
+        thr = Throughput()
+        first_return = None
+        ema_return = None
+        last_loss = float("nan")
+        last_fetched: Optional[dict] = None
+        prev: Optional[tuple] = None  # (update, out future)
+        step = start
+
+        def observe(update: int, out) -> None:
+            """Fetch one dispatch's out dict (the step always returns at
+            least loss + the RL scalars), fold the return stream into the
+            host-side trackers, and emit the log/metrics lines at the
+            log_every cadence."""
+            nonlocal first_return, ema_return, last_loss, last_fetched
+            fetched = last_fetched = jax.device_get(out)
+            last_loss = float(fetched["loss"])
+            ret = float(fetched.get("return_mean", float("nan")))
+            if np.isfinite(ret):
+                if first_return is None:
+                    first_return = ret
+                ema_return = (ret if ema_return is None
+                              else 0.9 * ema_return + 0.1 * ret)
+            if cfg.log_every and update % cfg.log_every == 0:
+                extra = (f", return {ret:.3f} (EMA {ema_return:.3f})"
+                         if np.isfinite(ret) and ema_return is not None
+                         else "")
+                log(f"update {update}: loss {last_loss:.6f}{extra}")
+                self.metrics.write({"step": update, "loss": last_loss,
+                                    **({"return_mean": ret}
+                                       if np.isfinite(ret) else {}),
+                                    "frames_per_sec":
+                                        thr.samples_per_sec})
+
+        try:
+            with watchdog, shutdown:
+                while step < rl.total_updates and not shutdown.requested:
+                    if self.fault_plan is not None:
+                        # crash/sigterm/ckpt-I/O kinds (no batch leaves
+                        # to poison — env frames are generated on device)
+                        self.fault_plan.apply(step, {},
+                                              ckpt_dir=cfg.checkpoint_dir)
+                        # SDC kinds corrupt RLState.params/opt_state
+                        # shards exactly like the trainer's state
+                        self.state = self.fault_plan.apply_state(
+                            step, self.state, what="rl state")
+                    self.state, out = self.step_fn(self.state)
+                    watchdog.pat()
+                    thr.add(self.frames_per_update)
+                    before, step = step, step + 1
+                    self.telemetry.on_dispatch(step, 0, before, out, 1,
+                                               self.frames_per_update)
+                    # lag-1 fetch: by now `out`'s successor is submitted,
+                    # so this device_get keeps one dispatch in flight —
+                    # and it is the blocking point the watchdog needs
+                    if prev is not None:
+                        observe(*prev)
+                    prev = (step, out)
+                    if (cfg.checkpoint_every
+                            and step % cfg.checkpoint_every == 0):
+                        with watchdog.suspended():
+                            self.save()
+        finally:
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                self.telemetry.on_abnormal_exit(exc)
+                self.metrics.close()
+                self.telemetry.close()
+        if prev is not None:
+            observe(*prev)
+        self.telemetry.flush(step=step)
+        if shutdown.requested:
+            self.telemetry.on_preempted(shutdown.signum, step)
+        self.save(final=True)
+        digest = params_digest(self.state.params)
+        final_return = (ema_return if ema_return is not None
+                        else float("nan"))
+        log(f"rl: return {first_return if first_return is not None else float('nan'):.3f}"
+            f" -> EMA {final_return:.3f} over {step - start} update(s); "
+            f"params sha256 {digest}")
+        result = {"final_loss": last_loss,
+                  "steps": step,
+                  "updates": step - start,
+                  "samples_per_sec": thr.samples_per_sec,
+                  "env_frames_per_sec": thr.samples_per_sec,
+                  "first_return": first_return,
+                  "final_return": final_return,
+                  "params_sha256": digest}
+        if shutdown.requested:
+            log(f"preempted (signal {shutdown.signum}): final checkpoint "
+                f"at update {step}, exiting 0")
+            result["preempted"] = True
+        if self.guarded:
+            result["skipped_updates"] = int(
+                jax.device_get(self.state.opt_state.skipped))
+        if last_fetched is not None:
+            for k in ("entropy", "approx_kl", "value_loss"):
+                if k in last_fetched:
+                    result[k] = float(last_fetched[k])
+        self.metrics.close()
+        self.telemetry.close()
+        return result

@@ -381,11 +381,21 @@ class Telemetry:
     when ``telemetry_dir`` is unset."""
 
     def __init__(self, cfg, model, feature_shape: Tuple[int, ...],
-                 n_devices: int, device_kind: str, platform: str):
+                 n_devices: int, device_kind: str, platform: str,
+                 kind: str = "step",
+                 flops_per_row: Optional[float] = None):
+        """``kind`` stamps every metrics record (``"step"`` for the LM
+        trainer, ``"rl"`` for the Anakin learner — tools/metrics_summary
+        renders each kind's view); ``flops_per_row`` overrides the
+        per-row MFU numerator for workloads whose step is not one
+        fwd+bwd per row (the RL step's T actor forwards + ppo_epochs
+        fwd/bwd live in ``rl.anakin.anakin_step_flops``)."""
         global _ACTIVE
 
         self.enabled = bool(cfg.telemetry_dir)
         self.dir = cfg.telemetry_dir
+        self.kind = kind
+        self._flops_override = flops_per_row
         self.metrics_every = max(0, int(cfg.metrics_every))
         self._queue: List[tuple] = []  # (step, epoch, out, n_steps, rows, t)
         self._last_t: Optional[float] = None
@@ -409,9 +419,12 @@ class Telemetry:
                        if is_leader() else None)
         self._t0 = time.perf_counter()
         # per-ROW step FLOPs (every accounted model is linear in batch),
-        # so per-dispatch FLOPs = rows * this
-        self.flops_per_row = train_step_flops(model, (1,) + tuple(
-            feature_shape))
+        # so per-dispatch FLOPs = rows * this; workload-specific callers
+        # (the RL learner) hand in their own honest accounting instead
+        self.flops_per_row = (self._flops_override
+                              if self._flops_override is not None
+                              else train_step_flops(model, (1,) + tuple(
+                                  feature_shape)))
         self.peak_total = (telemetry_peak_flops(device_kind, platform)
                            * max(1, n_devices))
         _ACTIVE = self
@@ -453,7 +466,8 @@ class Telemetry:
         else:
             rec = {"loss": float(fetched)}
         rec.update(step=int(step), epoch=int(epoch),
-                   kind="step", t=round(time.perf_counter() - self._t0, 6))
+                   kind=self.kind,
+                   t=round(time.perf_counter() - self._t0, 6))
         if t_prev is not None and t_disp > t_prev:
             dt = (t_disp - t_prev) / max(1, n_steps)  # dispatch-to-dispatch
             rec["step_time_ms"] = round(dt * 1e3, 4)

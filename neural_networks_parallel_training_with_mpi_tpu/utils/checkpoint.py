@@ -581,13 +581,24 @@ def _restore_npz(path: Path, template: Optional[TrainState],
         # replicated shapes are the padding-free case).  Only OPT-STATE
         # leaves: a model param (bias, norm scale) whose length changed
         # is a config mismatch that must refuse, not be silently
-        # zero-extended.  TrainState flattens field-ordered (step,
-        # params, opt_state), so opt-state leaves are exactly the
-        # trailing ones.
-        opt_start = len(t_leaves)
+        # zero-extended.  The opt-state leaf RANGE is derived from the
+        # template's field order (NamedTuple states flatten
+        # field-ordered), NOT by assuming the opt-state leaves are the
+        # trailing ones: TrainState happens to end with opt_state, but
+        # rl.anakin.RLState carries env state AFTER it — and an env leaf
+        # mistaken for opt state would be silently zero-extended on an
+        # elastic resume with a different --rl_envs instead of refusing.
+        opt_start = opt_end = len(t_leaves)
         if hasattr(template, "opt_state"):
-            opt_start -= len(jax.tree_util.tree_leaves(
-                template.opt_state))
+            n_opt = len(jax.tree_util.tree_leaves(template.opt_state))
+            if hasattr(template, "_fields"):
+                fields = list(template._fields)
+                opt_start = sum(
+                    len(jax.tree_util.tree_leaves(getattr(template, f)))
+                    for f in fields[:fields.index("opt_state")])
+            else:  # non-NamedTuple fallback: the historical trailing rule
+                opt_start = len(t_leaves) - n_opt
+            opt_end = opt_start + n_opt
         resharded = []
         for i, (saved, want) in enumerate(zip(leaves, t_leaves)):
             w_shape = tuple(np.shape(want))
@@ -599,7 +610,7 @@ def _restore_npz(path: Path, template: Optional[TrainState],
                 # dtype (the legacy best effort)
                 saved = leaves[i] = reinterpret_void(saved, w_dtype)
             if tuple(saved.shape) != w_shape:
-                if (elastic and i >= opt_start
+                if (elastic and opt_start <= i < opt_end
                         and saved.ndim == len(w_shape)
                         and sum(saved.shape[d] != w_shape[d]
                                 for d in range(saved.ndim)) == 1

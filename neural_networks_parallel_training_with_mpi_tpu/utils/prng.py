@@ -16,6 +16,7 @@ INIT = 0
 DATA = 1
 DROPOUT = 2
 HOST = 3
+ENV = 4   # per-env RL base keys (rl.anakin — action sampling + resets)
 
 
 def job_key(seed: int) -> jax.Array:

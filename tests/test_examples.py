@@ -291,6 +291,21 @@ def test_example_19_multi_step_dispatch_completes():
     assert "trajectory identical" in out.stdout
 
 
+def test_example_21_anakin_rl_completes():
+    """Gridworld PPO through the CLI end to end (rl/): the script itself
+    asserts the trained return EMA beats the measured random-policy
+    (lr=0) baseline AND that a checkpoint-resumed run lands on the
+    bitwise-identical params of the uninterrupted trajectory."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "21_anakin_rl.sh")],
+        capture_output=True, text=True, timeout=560, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "return improved over the random-policy baseline" in out.stdout
+    assert "resume trajectory-exact" in out.stdout
+
+
 def test_example_20_paged_serving_completes():
     """The serve/ subsystem end to end on CPU: ragged prompts with SLOs
     through the continuous-batching scheduler over the paged KV pool;

@@ -102,6 +102,38 @@ def summarize(records: List[Dict[str, Any]],
             total += int(v - prev)
         prev = v
     out["skipped_updates"] = total
+    # RL records (rl/runner.py writes kind="rl" through the shared
+    # telemetry stream): the health numbers are the return trend (is the
+    # policy learning?), the PPO diagnostics (entropy should anneal,
+    # approx_kl should stay small), and env frames/s (the Anakin
+    # throughput headline)
+    rl_recs = [r for r in records if r.get("kind") == "rl"]
+    if rl_recs:
+        rl_out: Dict[str, Any] = {"updates": len(rl_recs)}
+        rets = _series(rl_recs, "return_mean")
+        if rets:
+            ema = rets[0]
+            for v in rets:
+                ema = 0.9 * ema + 0.1 * v
+            rl_out["return_first"] = rets[0]
+            rl_out["return_last"] = rets[-1]
+            rl_out["return_max"] = max(rets)
+            rl_out["return_ema"] = ema
+        for key, label in (("samples_per_sec", "env_frames_per_sec"),
+                           ("entropy", "entropy"),
+                           ("approx_kl", "approx_kl"),
+                           ("value_loss", "value_loss")):
+            vals = sorted(_series(rl_recs, key))
+            if vals:
+                rl_out[label] = {"p50": _percentile(vals, 0.50),
+                                 "p95": _percentile(vals, 0.95),
+                                 "max": vals[-1]}
+        times = _series(rl_recs, "step_time_ms")
+        if times:
+            rl_out["updates_per_sec"] = {
+                "p50": 1e3 / _percentile(sorted(times), 0.50),
+                "max": 1e3 / min(times)}
+        out["rl"] = rl_out
     # serving records (serve/scheduler.py): kind="serve_req" carries one
     # completed request's latency pair — percentiles across requests are
     # THE serving health numbers — and kind="serve" ticks carry the
@@ -186,6 +218,27 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
             f"devices (dp {t.get('from_dp')} -> {t.get('to_dp')}) at step "
             f"{t.get('step')}, policy {t.get('policy')}"
             + (f" ({', '.join(detail)})" if detail else ""))
+    if "rl" in summary:
+        rl = summary["rl"]
+        rl_recs = [r for r in records if r.get("kind") == "rl"]
+        lines.append(f"rl: {rl['updates']} updates")
+        if "return_last" in rl:
+            lines.append(
+                f"  return         {rl['return_first']:.6g} -> "
+                f"{rl['return_last']:.6g} (EMA {rl['return_ema']:.6g}, "
+                f"max {rl['return_max']:.6g})")
+        for key, label, unit in (
+                ("samples_per_sec", "env_frames/s", "frames/s"),
+                ("entropy", "entropy", ""),
+                ("approx_kl", "approx_kl", ""),
+                ("value_loss", "value_loss", "")):
+            row = _stat_row(label, _series(rl_recs, key), unit)
+            if row:
+                lines.append(row)
+        if "updates_per_sec" in rl:
+            lines.append(
+                f"  updates/s      p50 {rl['updates_per_sec']['p50']:.6g}"
+                f"   max {rl['updates_per_sec']['max']:.6g}")
     if "serving" in summary:
         sv = summary["serving"]
         lines.append(f"serving: {sv['requests']} requests")
