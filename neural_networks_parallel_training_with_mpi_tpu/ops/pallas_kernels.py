@@ -480,6 +480,231 @@ flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
 
 
 # ==========================================================================
+# Paged attention (serving: decode + chunked prefill over a block pool)
+# ==========================================================================
+
+def _paged_attn_kernel(tables_ref, lens_ref, starts_ref, q_ref, k_hbm,
+                       v_hbm, *rest, block_size: int, kv_heads: int,
+                       groups: int, width: int, scale: float,
+                       quant: bool):
+    """Grid: (streams,).  Each program walks ITS stream's allocated
+    block-table entries — ``ceil(len/block_size)`` of them, a dynamic
+    ``fori_loop`` bound — double-buffering pool blocks HBM→VMEM with
+    ``make_async_copy`` (block ``j+1``'s DMA is in flight while ``j``
+    computes) and carrying the online-softmax (max, denom, acc) in the
+    loop.  KV heads are unrolled in-program: one block fetch serves every
+    head (a (stream, kv_head) grid would DMA each block ``kv_heads``
+    times).
+
+    Refs: ``tables (S, MB)`` / ``lens (S,)`` / ``starts (S,)`` ride
+    scalar prefetch (SMEM) — runtime VALUES, not compile-time constants,
+    so table churn and length growth re-run the same compiled kernel.
+    ``q (1, KV, W·G, hd)`` in VMEM; ``k``/``v`` pools (and int8 scale
+    pools when ``quant``) stay UNBLOCKED in ANY/HBM — only the blocks a
+    stream actually owns ever cross into VMEM, which is the bandwidth
+    half of the win (the FLOPs half is the loop bound).  Scratch: 2-slot
+    VMEM landing buffers per pool operand + a (2, n_operands) DMA
+    semaphore array.
+
+    Blocks past a stream's true length (and every block of an inactive
+    ``len=0`` lane, whose loop never runs) contribute NOTHING.  Within
+    the last live block the tail positions ``>= len`` are masked, so the
+    sink block's frozen garbage is never attended.  int8 pools
+    dequantize ON LOAD (``k·k_scale`` per (position, head) — the same
+    per-position scheme the gathered path applies to its logits/probs,
+    reassociated).  A ``len=0`` lane exits with output 0, the flash
+    kernels' "no contribution" convention."""
+    if quant:
+        (ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sem) = rest
+    else:
+        o_ref, k_buf, v_buf, sem = rest
+    s = pl.program_id(0)
+    ln = lens_ref[s]
+    nb = lax.div(ln + block_size - 1, block_size)
+    rows = width * groups
+
+    def _copies(j):
+        slot = lax.rem(j, 2)
+        blk = tables_ref[s, j]
+        ops = [
+            pltpu.make_async_copy(k_hbm.at[blk], k_buf.at[slot],
+                                  sem.at[slot, 0]),
+            pltpu.make_async_copy(v_hbm.at[blk], v_buf.at[slot],
+                                  sem.at[slot, 1]),
+        ]
+        if quant:
+            ops += [
+                pltpu.make_async_copy(ks_hbm.at[blk], ks_buf.at[slot],
+                                      sem.at[slot, 2]),
+                pltpu.make_async_copy(vs_hbm.at[blk], vs_buf.at[slot],
+                                      sem.at[slot, 3]),
+            ]
+        return ops
+
+    # rows are (W, G) flattened: row r is query column r // groups
+    k_off = lax.broadcasted_iota(jnp.int32, (rows, block_size), 1)
+    q_pos = starts_ref[s] + lax.broadcasted_iota(
+        jnp.int32, (rows, block_size), 0) // groups
+
+    def body(j, carry):
+        acc, m, l = carry
+
+        @pl.when(j + 1 < nb)
+        def _prefetch():
+            for c in _copies(j + 1):
+                c.start()
+
+        for c in _copies(j):
+            c.wait()
+        slot = lax.rem(j, 2)
+        k = k_buf[slot].astype(jnp.float32)          # (bs, KV, hd)
+        v = v_buf[slot].astype(jnp.float32)
+        if quant:
+            k = k * ks_buf[slot].astype(jnp.float32)[..., None]
+            v = v * vs_buf[slot].astype(jnp.float32)[..., None]
+        k_pos = j * block_size + k_off
+        keep = (k_pos < ln) & (k_pos <= q_pos)       # (rows, bs)
+        for h in range(kv_heads):
+            q = q_ref[0, h].astype(jnp.float32) * scale    # (rows, hd)
+            sc = jax.lax.dot_general(
+                q, k[:, h, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # (rows, bs)
+            sc = jnp.where(keep, sc, NEG_INF)
+            m_new = jnp.maximum(m[h], sc.max(axis=-1, keepdims=True))
+            # a row with no attendable key in THIS block keeps its prior
+            # max; every live row sees position 0 in block 0, so m is
+            # finite before the running exp() can ever see exp(0) garbage
+            p = jnp.exp(sc - m_new)
+            corr = jnp.exp(m[h] - m_new)
+            l = l.at[h].set(corr * l[h] + p.sum(axis=-1, keepdims=True))
+            acc = acc.at[h].set(corr * acc[h] + jax.lax.dot_general(
+                p, v[:, h, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+            m = m.at[h].set(m_new)
+        return acc, m, l
+
+    hd = q_ref.shape[-1]
+    acc0 = jnp.zeros((kv_heads, rows, hd), jnp.float32)
+    m0 = jnp.full((kv_heads, rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kv_heads, rows, 1), jnp.float32)
+
+    @pl.when(nb == 0)
+    def _inactive():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    @pl.when(nb > 0)
+    def _walk():
+        for c in _copies(0):
+            c.start()
+        acc, m, l = lax.fori_loop(0, nb, body, (acc0, m0, l0))
+        empty = m < (NEG_INF * 0.5)
+        l_safe = jnp.where(empty, 1.0, l)
+        o_ref[0] = jnp.where(empty, 0.0, acc / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    tables: jax.Array, lengths: jax.Array,
+                    starts: jax.Array, *,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused paged attention: reads K/V straight from the serving block
+    pool through per-stream block tables and reduces over each stream's
+    TRUE length instead of the table capacity ``max_blocks·block_size``
+    (serve/paged_kv.py's gathered path; ROADMAP 1(b)'s FLOPs win).
+
+    One kernel covers the family: ``width == 1`` is the batched decode
+    step (each stream's single query at position ``lengths-1``),
+    ``width > 1`` is a chunked-prefill bucket (rows at absolute positions
+    ``starts .. starts+width-1``, flash-style causal within the chunk).
+
+    * ``q``: (streams, width, n_heads, head_dim) — GQA folds in-kernel
+      (``n_heads`` must be a multiple of the pool's ``kv_heads``).
+    * ``k_pool``/``v_pool``: (num_blocks, block_size, kv_heads, head_dim)
+      — f32/bf16, or int8 with ``k_scale``/``v_scale``
+      (num_blocks, block_size, kv_heads) f32 dequantized on load.
+    * ``tables``: (streams, max_blocks) int32 pool indices; unallocated
+      entries point at the sink block and are NEVER walked (the block
+      loop stops at ``ceil(length/block_size)``).
+    * ``lengths``: (streams,) int32 attendable keys per stream (0 = an
+      inactive lane: zero blocks walked, zero blocks fetched, output 0).
+    * ``starts``: (streams,) int32 absolute position of each stream's
+      first query row (decode passes ``lengths - 1``).
+
+    Tables/lengths/starts are traced scalar-prefetch operands: block-table
+    churn (admission, growth, eviction) re-runs the SAME compiled kernel
+    — pinned by tests/test_paged_attn.py's compile-count test."""
+    s_n, width, n_heads, hd = q.shape
+    nb, bs, kv_heads, hd_k = k_pool.shape
+    if hd_k != hd:
+        raise ValueError(f"head_dim mismatch: q {hd} vs pool {hd_k}")
+    if n_heads % kv_heads:
+        raise ValueError(f"n_heads {n_heads} not a multiple of kv_heads "
+                         f"{kv_heads}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 pools need BOTH k_scale and v_scale")
+    quant = k_scale is not None
+    groups = n_heads // kv_heads
+    scale = 1.0 / (hd ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    if not _HAS_PLTPU:  # pragma: no cover - exercised only on odd builds
+        # unlike the flash kernels (plain grids, no DMA), the paged
+        # kernel's scalar-prefetch spec, HBM refs and async copies live
+        # in pallas.tpu even in interpret mode — no pl-only fallback
+        raise RuntimeError("paged_attention needs jax.experimental."
+                           "pallas.tpu (scalar prefetch + async DMA)")
+
+    # (S, W, H, hd) -> (S, KV, W·G, hd): per-kv-head query rows contiguous
+    qk = q.reshape(s_n, width, kv_heads, groups, hd)
+    qk = qk.transpose(0, 2, 1, 3, 4).reshape(s_n, kv_heads,
+                                             width * groups, hd)
+
+    row_map = lambda s, tbl, lns, sts: (s, 0, 0, 0)      # noqa: E731
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)      # stays in HBM
+    in_specs = [
+        pl.BlockSpec((1, kv_heads, width * groups, hd), row_map),
+        any_spec, any_spec,
+    ]
+    operands = [qk, k_pool, v_pool]
+    n_dma = 2
+    scratch = [
+        pltpu.VMEM((2, bs, kv_heads, hd), k_pool.dtype),
+        pltpu.VMEM((2, bs, kv_heads, hd), v_pool.dtype),
+    ]
+    if quant:
+        in_specs += [any_spec, any_spec]
+        operands += [k_scale, v_scale]
+        scratch += [pltpu.VMEM((2, bs, kv_heads), k_scale.dtype),
+                    pltpu.VMEM((2, bs, kv_heads), v_scale.dtype)]
+        n_dma = 4
+    scratch.append(pltpu.SemaphoreType.DMA((2, n_dma)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s_n,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, kv_heads, width * groups, hd), row_map),
+        scratch_shapes=scratch,
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_attn_kernel, block_size=bs, kv_heads=kv_heads,
+            groups=groups, width=width, scale=scale, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (s_n, kv_heads, width * groups, hd), q.dtype),
+        interpret=interpret,
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      starts.astype(jnp.int32), *operands)
+    # (S, KV, W·G, hd) -> (S, W, H, hd)
+    out = out.reshape(s_n, kv_heads, width, groups, hd)
+    return out.transpose(0, 2, 1, 3, 4).reshape(s_n, width, n_heads, hd)
+
+
+# ==========================================================================
 # Fused LayerNorm
 # ==========================================================================
 

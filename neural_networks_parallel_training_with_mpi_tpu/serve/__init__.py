@@ -5,8 +5,13 @@ reserves a dense ``max_len`` KV allocation and admission is "return None
 when full".  This package is the service-shaped runtime above it:
 
 * :mod:`serve.paged_kv` — a block-allocated KV pool with per-stream
-  block tables and static-shape gathered attention, so heterogeneous
-  stream lengths share device memory instead of each padding to max.
+  block tables, so heterogeneous stream lengths share device memory
+  instead of each padding to max.  Attention is dispatched behind the
+  ``attn_impl`` seam: ``'gathered'`` (static-shape ``pool[table]``
+  materialization, the parity reference) or ``'fused'`` (the Pallas
+  paged-attention kernel, ``ops.pallas_kernels.paged_attention``, which
+  reads K/V straight from the pool and stops at each stream's true
+  length — the FLOPs win on top of the memory win).
 * :mod:`serve.scheduler` — a continuous-batching scheduler: bounded
   wait queue, per-tick admit/retire, chunked prefill interleaved with
   decode, admission control gated on free blocks + token budget, and
@@ -24,11 +29,12 @@ from .paged_kv import (
     PagedDecodeServer,
     init_paged_kv,
 )
+from .paged_kv import ATTN_IMPLS
 from .scheduler import Request, Scheduler, ServeConfig
-from .loadgen import run_closed_loop, sweep_loads
+from .loadgen import prewarm, run_closed_loop, sweep_loads
 
 __all__ = [
-    "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
+    "ATTN_IMPLS", "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
     "init_paged_kv", "Request", "Scheduler", "ServeConfig",
-    "run_closed_loop", "sweep_loads",
+    "prewarm", "run_closed_loop", "sweep_loads",
 ]

@@ -28,6 +28,43 @@ def _pct(vals: List[float], q: float) -> Optional[float]:
     return float(np.percentile(np.asarray(vals, np.float64), q))
 
 
+def prewarm(make_scheduler, *, prompt_lens=(4, 24)) -> None:
+    """Pay every compile a load run can draw BEFORE any latency is
+    measured: each power-of-two prefill bucket the prompt range can
+    produce under the scheduler's ``prefill_chunk``, plus the batched
+    decode program — which, under ``attn_impl='fused'``, is where the
+    Pallas paged-attention kernel compiles.  Without this, the first
+    request to hit a cold bucket (or the cold decode kernel) books XLA /
+    Mosaic compile time as a fake TTFT outlier in the p99.
+
+    The bucket set is derived THROUGH ``paged_kv.prefill_bucket`` — the
+    same function ``prefill_step`` compiles against — over every chunk
+    width the sweep can draw (``w <= min(prefill_chunk, prompt_len)``),
+    so the warmed set cannot drift from the compiled set if the bucket
+    rule ever changes.  Uses a throwaway scheduler from the same
+    factory; the jitted programs are cached per (model, geometry,
+    sampling, attn_impl), so the warmth carries to every load point."""
+    from .paged_kv import prefill_bucket
+
+    sched = make_scheduler()
+    try:
+        chunk = max(1, int(sched.cfg.prefill_chunk))
+        hi = min(int(prompt_lens[1]), sched.server.max_len - 2)
+        w_max = max(1, min(chunk, hi))
+        targets = {prefill_bucket(w) for w in range(1, w_max + 1)}
+        # a prompt of min(bucket, w_max) tokens prefills in one chunk
+        # drawing exactly that bucket (the top bucket via the partial
+        # width w_max)
+        lens = sorted(min(b, w_max) for b in targets)
+        rids = [sched.submit(list(range(1, p + 1)), 2) for p in lens]
+        assert all(r is not None for r in rids), "prewarm rejected"
+        sched.run_until_drained()
+        for r in rids:
+            sched.result(r)
+    finally:
+        sched.close()
+
+
 def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                     *, vocab_size: int, prompt_lens=(4, 24),
                     max_new=(8, 32), seed: int = 0,
@@ -93,11 +130,16 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
 def sweep_loads(make_scheduler, loads: List[int],
                 requests_per_client: int, *, vocab_size: int,
                 prompt_lens=(4, 24), max_new=(8, 32), seed: int = 0,
-                slo_ms: Optional[float] = None) -> List[Dict[str, Any]]:
+                slo_ms: Optional[float] = None,
+                warm: bool = True) -> List[Dict[str, Any]]:
     """One :func:`run_closed_loop` row per offered load (client count),
     a FRESH scheduler each (``make_scheduler()`` factory) so load points
-    don't share warm state beyond compiled programs."""
+    don't share warm state beyond compiled programs — which
+    :func:`prewarm` populates up front (``warm=False`` opts out for
+    callers measuring cold-start itself)."""
     rows = []
+    if warm and loads:
+        prewarm(make_scheduler, prompt_lens=prompt_lens)
     for c in loads:
         sched = make_scheduler()
         try:

@@ -17,16 +17,21 @@ blocks:
   recompile).  Unallocated entries point at the reserved **sink block
   0**, which is never handed to a stream: pad/frozen writes land there
   harmlessly and are never attended.
-* **Gathered attention**: a step gathers each row's blocks
-  ``pool[table] -> (T_cap, kv_heads, head_dim)`` (``T_cap = max_blocks *
-  block_size``) and attends under the causal mask ``t <= pos`` — the
-  same reduction, over the same values in the same order, as the dense
-  cache path, which is why greedy paged decode is token-identical to
-  ``DecodeServer`` / ``models.generate.generate`` (pinned by
-  tests/test_serve_paged.py).  The gather materializes the attended
-  window transiently (what dense attention reads anyway); the win is the
-  PERSISTENT allocation, which now tracks actual tokens in flight
-  instead of slots x max_len.
+* **Attention dispatch** (``attn_impl``): the default **gathered** path
+  gathers each row's blocks ``pool[table] -> (T_cap, kv_heads,
+  head_dim)`` (``T_cap = max_blocks * block_size``) and attends under
+  the causal mask ``t <= pos`` — the same reduction, over the same
+  values in the same order, as the dense cache path, which is why
+  greedy paged decode is token-identical to ``DecodeServer`` /
+  ``models.generate.generate`` (pinned by tests/test_serve_paged.py).
+  The gather materializes the attended window transiently (what dense
+  attention reads anyway); the win is the PERSISTENT allocation, which
+  now tracks actual tokens in flight instead of slots x max_len.  The
+  **fused** path (``ops.pallas_kernels.paged_attention``) adds the
+  FLOPs/bandwidth win on top: the Pallas kernel reads K/V straight from
+  the pool through the tables and walks only ``ceil(len/block_size)``
+  blocks per stream — token-identical to gathered, pinned by
+  tests/test_paged_attn.py.
 * **Writes** are scatters at ``(table[pos // block_size], pos %
   block_size)`` — one position per row at decode, a chunk of positions
   at prefill (chunks may straddle block boundaries; each position
@@ -62,12 +67,31 @@ import numpy as np
 
 from ..models.generate import _quantize_kv, _sample
 from ..models.transformer import Transformer, split_qkv
+from ..ops.pallas_kernels import paged_attention
 
 Pytree = Any
+
+# attention dispatch seam: 'gathered' materializes pool[table] and reduces
+# over all max_blocks*block_size key positions per stream (the parity
+# reference); 'fused' reads K/V straight from the block pool via the
+# Pallas paged-attention kernel and stops at each stream's true length
+# (ops.pallas_kernels.paged_attention — token-identical, pinned)
+ATTN_IMPLS = ("gathered", "fused")
 
 # block 0 is reserved: pad positions and frozen slots write (and gather)
 # here, so a scatter never needs dynamic masking to be allocation-safe
 SINK_BLOCK = 0
+
+
+def prefill_bucket(width: int) -> int:
+    """The pow2 bucket a prefill chunk of ``width`` tokens pads to
+    (minimum 8) — the rule :meth:`PagedDecodeServer.prefill_step`
+    compiles against, shared with ``serve.loadgen.prewarm`` so the
+    warmed bucket set can never drift from the compiled set."""
+    b = 8
+    while b < width:
+        b *= 2
+    return b
 
 
 class BlockExhausted(RuntimeError):
@@ -162,23 +186,33 @@ def init_paged_kv(model: Transformer, num_blocks: int, block_size: int,
 @functools.lru_cache(maxsize=8)
 def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
                     temperature: float, top_k: int, top_p: float,
-                    kv_quant: bool = False):
+                    kv_quant: bool = False, attn_impl: str = "gathered"):
     """The two jitted programs of a paged server: chunk prefill (one per
     power-of-two chunk bucket, via jit's shape cache) and the batched
-    decode step.  Cached per (model, geometry, sampling) so several
-    servers compile once."""
+    decode step.  Cached per (model, geometry, sampling, attn_impl) so
+    several servers compile once.  ``attn_impl='fused'`` swaps the
+    gathered attention for the Pallas paged kernel; everything else
+    (scatter coordinates, sampling, bookkeeping) is shared, which is what
+    makes gathered-vs-fused an attention-only A/B."""
     bs, mb = int(block_size), int(max_blocks)
     t_cap = bs * mb
     c = model.cfg
+    if attn_impl not in ATTN_IMPLS:
+        raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, "
+                         f"got {attn_impl!r}")
 
-    def block_fwd(layer_params, pool, tables, starts, x, valid):
+    def block_fwd(layer_params, pool, tables, starts, x, valid, lengths):
         """One transformer block over a chunk ``x`` (B, W, D) whose rows
         sit at per-row start positions, K/V scattered into the paged
-        pool and attention gathered back through the block tables.
+        pool and attention read back through the block tables — gathered
+        (``pool[table]`` then a full-width masked reduction) or fused
+        (the paged kernel walks only ``ceil(lengths/bs)`` live blocks).
         Mirrors ``models.generate._block_chunk`` (the pinned dense
         math) with the cache axis split into (block, offset).  ``valid``
         (W,) masks pad columns of a bucketed prefill chunk: their writes
-        divert to the sink block."""
+        divert to the sink block.  ``lengths`` (B,) is each row's
+        attendable-key count (0 = inactive lane), traced like the
+        tables so length churn never recompiles."""
         mods = model._block_modules()
         h = mods["ln1"].apply(layer_params["ln1"], x)
         qkv = mods["qkv"].apply(layer_params["qkv"], h)
@@ -204,46 +238,57 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
             new_vsp = pool["v_scale"].at[blk, off].set(vs)
         new_kp = pool["k"].at[blk, off].set(k.astype(pool["k"].dtype))
         new_vp = pool["v"].at[blk, off].set(v.astype(pool["v"].dtype))
-        # gather each row's attended window: (B, MB, bs, kv, hd) ->
-        # (B, T_cap, kv, hd), positions in ascending order — the same
-        # values, same order, as the dense cache's (B, T, kv, hd) slab
-        gk = new_kp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
-        gv = new_vp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
-        scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
-        mask = (jnp.arange(t_cap)[None, None, :]
-                <= positions[:, :, None])               # (B, W, T_cap)
-        if quant:
-            gks = new_ksp[tables].reshape(b, t_cap, c.kv_heads)
-            gvs = new_vsp[tables].reshape(b, t_cap, c.kv_heads)
-        if c.kv_heads == c.n_heads:
-            logits = jnp.einsum("bqhd,bkhd->bhqk",
-                                q.astype(jnp.float32),
-                                gk.astype(jnp.float32)) * scale
-            if quant:
-                logits = logits * gks.transpose(0, 2, 1)[:, :, None, :]
-            logits = jnp.where(mask[:, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            if quant:
-                probs = probs * gvs.transpose(0, 2, 1)[:, :, None, :]
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                             gv.astype(jnp.float32)).astype(x.dtype)
+        if attn_impl == "fused":
+            # the Pallas kernel reads K/V straight from the pool through
+            # the tables and reduces over each row's TRUE length — no
+            # pool[table] materialization, no max_blocks*bs reduction.
+            # int8 scale pools ride in and dequantize on load.
+            out = paged_attention(
+                q, new_kp, new_vp, tables, lengths, starts,
+                k_scale=new_ksp if quant else None,
+                v_scale=new_vsp if quant else None).astype(x.dtype)
         else:
-            g = c.n_heads // c.kv_heads
-            q5 = q.reshape(b, w, c.kv_heads, g, c.head_dim)
-            logits = jnp.einsum("bqcgd,bkcd->bcgqk",
-                                q5.astype(jnp.float32),
-                                gk.astype(jnp.float32)) * scale
+            # gather each row's attended window: (B, MB, bs, kv, hd) ->
+            # (B, T_cap, kv, hd), positions in ascending order — the
+            # same values, same order, as the dense cache's
+            # (B, T, kv, hd) slab
+            gk = new_kp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
+            gv = new_vp[tables].reshape(b, t_cap, c.kv_heads, c.head_dim)
+            scale = 1.0 / jnp.sqrt(jnp.asarray(c.head_dim, jnp.float32))
+            mask = (jnp.arange(t_cap)[None, None, :]
+                    <= positions[:, :, None])           # (B, W, T_cap)
             if quant:
-                logits = logits * gks.transpose(0, 2, 1)[:, :, None,
-                                                         None, :]
-            logits = jnp.where(mask[:, None, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1)
-            if quant:
-                probs = probs * gvs.transpose(0, 2, 1)[:, :, None,
-                                                       None, :]
-            out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
-                             gv.astype(jnp.float32)).astype(x.dtype)
-            out = out.reshape(b, w, c.n_heads, c.head_dim)
+                gks = new_ksp[tables].reshape(b, t_cap, c.kv_heads)
+                gvs = new_vsp[tables].reshape(b, t_cap, c.kv_heads)
+            if c.kv_heads == c.n_heads:
+                logits = jnp.einsum("bqhd,bkhd->bhqk",
+                                    q.astype(jnp.float32),
+                                    gk.astype(jnp.float32)) * scale
+                if quant:
+                    logits = logits * gks.transpose(0, 2, 1)[:, :, None, :]
+                logits = jnp.where(mask[:, None], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                if quant:
+                    probs = probs * gvs.transpose(0, 2, 1)[:, :, None, :]
+                out = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                                 gv.astype(jnp.float32)).astype(x.dtype)
+            else:
+                g = c.n_heads // c.kv_heads
+                q5 = q.reshape(b, w, c.kv_heads, g, c.head_dim)
+                logits = jnp.einsum("bqcgd,bkcd->bcgqk",
+                                    q5.astype(jnp.float32),
+                                    gk.astype(jnp.float32)) * scale
+                if quant:
+                    logits = logits * gks.transpose(0, 2, 1)[:, :, None,
+                                                             None, :]
+                logits = jnp.where(mask[:, None, None], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1)
+                if quant:
+                    probs = probs * gvs.transpose(0, 2, 1)[:, :, None,
+                                                           None, :]
+                out = jnp.einsum("bcgqk,bkcd->bqcgd", probs,
+                                 gv.astype(jnp.float32)).astype(x.dtype)
+                out = out.reshape(b, w, c.n_heads, c.head_dim)
         out = out.reshape(b, w, c.d_model)
         x = x + mods["attn_out"].apply(layer_params["attn_out"], out)
         h = mods["ln2"].apply(layer_params["ln2"], x)
@@ -256,7 +301,7 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
             new_pool.update(k_scale=new_ksp, v_scale=new_vsp)
         return x + ff.astype(x.dtype), new_pool
 
-    def forward(params, pools, tables, starts, ids, valid):
+    def forward(params, pools, tables, starts, ids, valid, lengths):
         # clamp pad columns' embedding positions into range (their
         # outputs are discarded; learned positional tables have no row
         # past max_seq_len)
@@ -267,23 +312,30 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
         new_pools = []
         for layer_params, pool in zip(params["blocks"], pools):
             x, pool = block_fwd(layer_params, pool, tables, starts, x,
-                                valid)
+                                valid, lengths)
             new_pools.append(pool)
         return model.head_logits(params, x), new_pools
 
     def prefill(params, pools, table, start, chunk, true_w):
         # chunk (1, W_bucket) int32; logits for ALL columns return and
         # the caller indexes the true last position (same contract as
-        # the dense server's bucketed prefill)
+        # the dense server's bucketed prefill).  attendable keys after
+        # this chunk's writes: everything up to start + true_w (pad
+        # columns wrote to the sink, which is past every length)
         valid = jnp.arange(chunk.shape[1]) < true_w
-        return forward(params, pools, table, start, chunk, valid)
+        return forward(params, pools, table, start, chunk, valid,
+                       start + true_w)
 
     def step(params, pools, tokens, tables, pos, active, key):
         s = tokens.shape[0]
         cap = tokens.shape[1] - 1
         ids = jnp.take_along_axis(tokens, pos[:, None], axis=1)  # (S, 1)
+        # a decode row attends its own fresh write too: pos + 1 keys;
+        # inactive lanes carry length 0, so the fused kernel walks ZERO
+        # of their blocks (the gathered path computes-and-discards them)
+        lengths = jnp.where(active, pos + 1, 0)
         logits, new_pools = forward(params, pools, tables, pos, ids,
-                                    jnp.ones((1,), bool))
+                                    jnp.ones((1,), bool), lengths)
         nxt, key = _sample(logits[:, 0], temperature, key, top_k, top_p)
         # frozen slots re-write the token already there (idempotent) and
         # hold position — the dense server's exact bookkeeping
@@ -320,7 +372,7 @@ class PagedDecodeServer:
                  block_size: int = 16, max_len: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 kv_quant: bool = False):
+                 kv_quant: bool = False, attn_impl: str = "gathered"):
         c = model.cfg
         self.model, self.params = model, params
         self.slots = int(slots)
@@ -335,9 +387,13 @@ class PagedDecodeServer:
         self.allocator = BlockAllocator(self.num_blocks)
         self._sampling = (float(temperature), int(top_k), float(top_p))
         self.kv_quant = bool(kv_quant)
+        if attn_impl not in ATTN_IMPLS:
+            raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, "
+                             f"got {attn_impl!r}")
+        self.attn_impl = attn_impl
         self._prefill_fn, self._step_fn = _paged_programs(
             model, self.block_size, self.max_blocks, *self._sampling,
-            self.kv_quant)
+            self.kv_quant, self.attn_impl)
         self.pools = init_paged_kv(model, self.num_blocks,
                                    self.block_size, quant=self.kv_quant)
         self.tokens = jnp.zeros((self.slots, self.t_cap), jnp.int32)
@@ -373,6 +429,27 @@ class PagedDecodeServer:
     def block_utilization(self) -> float:
         cap = self.allocator.capacity
         return self.allocator.used_blocks / cap if cap else 0.0
+
+    def keys_accounting(self) -> Dict[str, int]:
+        """Key-position accounting for the NEXT decode step, from host
+        state (no device traffic): ``attended_keys`` is what the math
+        needs (sum of pos+1 over active lanes), ``kernel_keys`` is what
+        the fused kernel touches (whole blocks: ceil((pos+1)/bs)·bs per
+        lane), ``padded_keys`` is what the gathered path reduces over
+        (t_cap per active lane).  attended/padded is the measurable
+        skipped-work ratio the telemetry and BENCH_PAGED_ATTN report."""
+        att = kern = n_active = 0
+        for rid, slot in self._slot_of.items():
+            if not self.active[slot]:
+                continue
+            ln = int(self._pos_host[slot]) + 1
+            att += ln
+            kern += -(-ln // self.block_size) * self.block_size
+            n_active += 1
+        return {"attended_keys": att,
+                "kernel_keys": kern,
+                "padded_keys": n_active * self.t_cap,
+                "active_streams": n_active}
 
     # ---- admission -----------------------------------------------------
     def try_admit(self, prompt_ids, max_new_tokens: int) -> Optional[int]:
@@ -445,9 +522,7 @@ class PagedDecodeServer:
         w = min(int(width), remaining)
         if w < 1:
             raise ValueError(f"prefill width {width} < 1")
-        bucket = 8
-        while bucket < w:
-            bucket *= 2
+        bucket = prefill_bucket(w)
         chunk = st.prompt[st.prefilled:st.prefilled + w] + [0] * (bucket - w)
         logits, self.pools = self._prefill_fn(
             self.params, self.pools,

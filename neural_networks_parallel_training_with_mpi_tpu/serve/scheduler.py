@@ -28,7 +28,9 @@ top of the library loop the repo had before this subsystem:
   never evicted while others exist, so the oldest obligation always
   makes progress: under any closed arrival sequence the system drains
   (the fuzz test's no-starvation/no-leak invariant).
-* **Serving telemetry**: ``kind="serve"`` tick records and
+* **Serving telemetry**: ``kind="serve"`` tick records (queue/pool
+  state plus attended/padded/kernel key counters — the decode work the
+  fused paged-attention kernel skips, measurable per tick) and
   ``kind="serve_req"`` per-request completion records (TTFT/ITL) go into
   the same ``metrics.jsonl`` stream PR 2's trainer writes, and the
   heartbeat file is the same atomic ``heartbeat.json`` —
@@ -71,6 +73,10 @@ class ServeConfig:
     top_p: float = 1.0
     seed: int = 0
     kv_quant: bool = False
+    attn_impl: str = "gathered"    # 'gathered' (parity reference) or
+    #                                'fused' (Pallas paged-attention
+    #                                kernel: walks only allocated blocks,
+    #                                stops at each stream's true length)
     telemetry_dir: Optional[str] = None
     metrics_every: int = 25        # ticks between kind="serve" records
     completed_history: int = 1024  # completed Requests kept for stats();
@@ -212,7 +218,8 @@ class Scheduler:
             model, params, slots=cfg.slots, num_blocks=cfg.num_blocks,
             block_size=cfg.block_size, max_len=cfg.max_len,
             temperature=cfg.temperature, top_k=cfg.top_k,
-            top_p=cfg.top_p, seed=cfg.seed, kv_quant=cfg.kv_quant)
+            top_p=cfg.top_p, seed=cfg.seed, kv_quant=cfg.kv_quant,
+            attn_impl=cfg.attn_impl)
         self.queue: Deque[Request] = collections.deque()
         self.reqs: Dict[int, Request] = {}      # every request ever seen
         self._srv_rid: Dict[int, int] = {}      # scheduler rid -> server
@@ -227,6 +234,13 @@ class Scheduler:
         self.evicted = 0
         self.completed = 0
         self.tokens_out = 0
+        # decode-step key accounting (host arithmetic, zero device
+        # traffic): attended = what the math needs, padded = what the
+        # gathered path reduces over, kernel = whole blocks the fused
+        # kernel walks — attended/padded is the measured skipped work
+        self.attended_keys = 0
+        self.padded_keys = 0
+        self.kernel_keys = 0
         self.telemetry = _ServeTelemetry(cfg.telemetry_dir,
                                          cfg.metrics_every)
 
@@ -298,6 +312,10 @@ class Scheduler:
         done_now += self._prefill_tick()
         if self.server.any_active():
             self._grow_or_evict()
+            acct = self.server.keys_accounting()
+            self.attended_keys += acct["attended_keys"]
+            self.padded_keys += acct["padded_keys"]
+            self.kernel_keys += acct["kernel_keys"]
             for srv_rid in self.server.step():
                 done_now.append(self._retire(srv_rid))
         self.telemetry.on_tick(self.tick_no, self._snapshot())
@@ -449,4 +467,10 @@ class Scheduler:
             "evicted": self.evicted,
             "completed": self.completed,
             "tokens_out": self.tokens_out,
+            "attended_keys": self.attended_keys,
+            "padded_keys": self.padded_keys,
+            "kernel_keys": self.kernel_keys,
+            "attended_ratio": (
+                round(self.attended_keys / self.padded_keys, 4)
+                if self.padded_keys else None),
         }

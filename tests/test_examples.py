@@ -310,7 +310,9 @@ def test_example_20_paged_serving_completes():
     """The serve/ subsystem end to end on CPU: ragged prompts with SLOs
     through the continuous-batching scheduler over the paged KV pool;
     the script itself asserts token parity with generate() and a fully
-    drained block allocator, and prints per-request TTFT/ITL."""
+    drained block allocator (for BOTH attention impls — the fused
+    Pallas kernel must be client-invisible), and prints per-request
+    TTFT/ITL plus the attended-keys ratio the kernel skips."""
     out = subprocess.run(
         ["bash", str(REPO / "examples" / "20_paged_serving.sh")],
         capture_output=True, text=True, timeout=420, env=_clean_env(),
@@ -319,3 +321,6 @@ def test_example_20_paged_serving_completes():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "block pool fully drained" in out.stdout
     assert "TTFT" in out.stdout
+    assert ("attn_impl=fused == attn_impl=gathered: token-identical "
+            "end to end") in out.stdout
+    assert "the skipped FLOPs" in out.stdout

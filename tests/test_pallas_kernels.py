@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.pallas
+
 from neural_networks_parallel_training_with_mpi_tpu.ops import (
     pallas_kernels as pk,
 )

@@ -20,7 +20,7 @@ from pathlib import Path
 import pytest
 
 # needs the real chip (and burns its probe timeout when the tunnel is wedged)
-pytestmark = [pytest.mark.slow, pytest.mark.tpu]
+pytestmark = [pytest.mark.slow, pytest.mark.tpu, pytest.mark.pallas]
 
 CHILD = Path(__file__).with_name("tpu_pallas_child.py")
 TIMEOUT_S = float(os.environ.get("TPU_SMOKE_TIMEOUT", "240"))

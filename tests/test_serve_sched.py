@@ -171,7 +171,8 @@ def test_slo_eviction_prefers_latest_deadline():
     sched.server.allocator.assert_drained()
 
 
-def _fuzz_once(seed: int, model, params, random_geometry: bool):
+def _fuzz_once(seed: int, model, params, random_geometry: bool,
+               attn_impl: str = "gathered"):
     """One fuzz round: random arrivals, prompt/output lengths, SLOs and
     (in the serve lane) pool geometry; asserts the no-leak /
     no-starvation / max_len / exact-tokens invariants after drain.  The
@@ -193,7 +194,7 @@ def _fuzz_once(seed: int, model, params, random_geometry: bool):
     sched = Scheduler(model, params, ServeConfig(
         slots=slots, num_blocks=num_blocks, block_size=block_size,
         max_len=max_len, prefill_chunk=int(rng.choice([4, 8, 32])),
-        queue_depth=64), now_fn=clock)
+        queue_depth=64, attn_impl=attn_impl), now_fn=clock)
     want = {}
     n_reqs = 10
     arrivals = sorted(int(t) for t in rng.integers(0, 30, n_reqs))
@@ -244,6 +245,67 @@ def test_scheduler_fuzz_property_more_seeds(seed):
     model = _model()
     params = model.init(prng.init_key(0))
     _fuzz_once(seed, model, params, random_geometry=True)
+
+
+@pytest.mark.serve
+@pytest.mark.slow
+@pytest.mark.pallas
+@pytest.mark.parametrize("seed", [5, 6, 7])
+def test_scheduler_fuzz_fused_kernel(seed):
+    """The same no-leak / no-starvation / exact-tokens invariants with
+    the Pallas paged-attention kernel active (attn_impl='fused') under
+    random pool geometry — eviction, re-admission and block growth all
+    hitting the kernel's table/length plumbing."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    _fuzz_once(seed, model, params, random_geometry=True,
+               attn_impl="fused")
+
+
+def test_attended_keys_accounting_and_records(tmp_path):
+    """The serving-telemetry satellite: kind="serve" records carry
+    attended/padded/kernel key counters whose values match the
+    scheduler's block accounting exactly (single deterministic stream:
+    closed-form sums), the final snapshot carries the ratio, and
+    metrics_summary renders it."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    tdir = str(tmp_path / "t")
+    p, n, bs = 5, 6, 8
+    sched = Scheduler(model, params, ServeConfig(
+        slots=2, num_blocks=20, block_size=bs, max_len=64,
+        telemetry_dir=tdir, metrics_every=1, attn_impl="fused"))
+    rid = sched.submit(list(range(1, p + 1)), n)
+    sched.run_until_drained()
+    sched.result(rid)
+    sched.close()
+    t_cap = sched.server.t_cap
+    # one stream, prefill emits token 1, then n-1 decode steps at
+    # positions p .. p+n-2, each attending pos+1 keys
+    want_attended = sum(range(p + 1, p + n))
+    want_padded = (n - 1) * t_cap
+    want_kernel = sum(-(-(k) // bs) * bs for k in range(p + 1, p + n))
+    assert sched.attended_keys == want_attended
+    assert sched.padded_keys == want_padded
+    assert sched.kernel_keys == want_kernel
+    records = [json.loads(line) for line in
+               open(os.path.join(tdir, "metrics.jsonl"))]
+    finals = [r for r in records if r.get("kind") == "serve"
+              and r.get("final")]
+    assert finals and finals[-1]["attended_keys"] == want_attended
+    assert finals[-1]["padded_keys"] == want_padded
+    assert finals[-1]["attended_ratio"] == round(
+        want_attended / want_padded, 4)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "metrics_summary", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "metrics_summary.py"))
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+    summary = ms.summarize(records)
+    assert "attended_ratio" in summary["serving_ticks"]
+    text = ms.render_text(summary, records, None, None, None)
+    assert "attended keys" in text
 
 
 def test_telemetry_serve_records_and_heartbeat(tmp_path):

@@ -161,8 +161,12 @@ def summarize(records: List[Dict[str, Any]],
                                    "p95": _percentile(vals, 0.95),
                                    "max": vals[-1]}
         last = serve_ticks[-1]
+        # attended/padded are CUMULATIVE counters (their running ratio
+        # converges, so percentiles would be distribution theater): the
+        # run's honest summary is the final ratio
         for key in ("admitted", "rejected", "evicted", "completed",
-                    "tokens_out"):
+                    "tokens_out", "attended_keys", "padded_keys",
+                    "attended_ratio"):
             if key in last:
                 tick_stats[key] = last[key]
         out["serving_ticks"] = tick_stats
@@ -260,6 +264,12 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
                              "completed"))
         lines.append(f"serving ticks: adm/rej/evict/done {counters}, "
                      f"{st.get('tokens_out', 0)} tokens out")
+        if st.get("attended_ratio") is not None:
+            lines.append(
+                f"  attended keys: {st.get('attended_keys')} / "
+                f"{st.get('padded_keys')} padded "
+                f"({st['attended_ratio']:.3f} "
+                "— the fused kernel's skipped work)")
         for key, unit in (("queue_depth", ""),
                           ("block_utilization", ""),
                           ("tokens_per_sec", "tok/s")):
