@@ -1202,7 +1202,8 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
                     or doc.get("serve_artifact")
                     or doc.get("paged_attn_artifact")
                     or doc.get("rl_artifact")
-                    or doc.get("update_sharding_artifact"))
+                    or doc.get("update_sharding_artifact")
+                    or doc.get("trace_artifact"))
     return None
 
 
@@ -1747,6 +1748,165 @@ def bench_update_sharding(out_path: str = "BENCH_UPDATE_SHARDING.json",
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=2)
     log(f"update-sharding A/B -> {out_path}")
+    return out_path
+
+
+def bench_trace_overhead(out_path: str = "BENCH_TRACE.json",
+                         reps: int = 5, chain: int = 2) -> str:
+    """Interleaved A/B of tracing OFF vs ON (span tracer + compile
+    ledger, train/trace.py + utils/compile_ledger.py) at the CPU-bench
+    transformer scale — the DESIGN §7 methodology: per-rep adjacent
+    pairs so shared-core load drift cancels in the ratio, because a
+    non-interleaved A/B on this host fabricates +10-18% from drift
+    alone.  The ON arm pays everything the instrumented trainer pays
+    per dispatch: a span write (json + flush), the ledger's signature
+    check, and dispatch through the AOT-compiled executable.  Both arms
+    start from the same init and the final param digests are compared —
+    the bitwise trace-on-vs-off pin, embedded as evidence (and pinned
+    independently by tests/test_trace.py)."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        trace as trace_lib,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        compile_ledger as ledger_lib,
+        prng,
+    )
+
+    c = _LM
+    seq, batch_size = 128, 32
+    devices = jax.devices()
+    n = len(devices)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n), devices=devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=seq, n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32))
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    rng = np.random.default_rng(0)
+    raw = {
+        "x": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "y": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "mask": np.ones((batch_size,), np.float32),
+    }
+    batch = shd.shard_batch(mesh, raw)
+    step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                              "global_mean")
+    instrumented = ledger_lib.instrument(step, "bench_step[dp]")
+    sync = _chain_sync_every()
+
+    def fresh_state():
+        return dp.replicate_state(
+            TrainState.create(model, opt, prng.init_key(0)), mesh)
+
+    def digest(state):
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    def run_chain(state, k, traced):
+        t0 = time.perf_counter()
+        loss = None
+        for i in range(k):
+            if traced:
+                with trace_lib.span("dispatch", step=i):
+                    state, loss = instrumented(state, batch)
+            else:
+                state, loss = step(state, batch)
+            if sync and (i + 1) % sync == 0:
+                jax.block_until_ready(loss)
+        val = float(jax.device_get(loss))
+        return time.perf_counter() - t0, state, val
+
+    trace_tmp = tempfile.mkdtemp(prefix="bench_trace_")
+    tracer = trace_lib.start_run(trace_tmp)
+    try:
+        states = {"off": fresh_state(), "on": fresh_state()}
+        # warmup both arms (off: jit compile; on: ledger AOT compile)
+        for name in states:
+            _, states[name], _ = run_chain(states[name], 1, name == "on")
+        times = {"off": [], "on": []}
+        loss_vals = {}
+        for _rep in range(reps):
+            for name in ("off", "on"):
+                dt, states[name], loss_vals[name] = run_chain(
+                    states[name], chain, name == "on")
+                times[name].append(dt / chain)
+        dig = {name: digest(s) for name, s in states.items()}
+        ledger = ledger_lib.active()
+        n_compiles = len(ledger.events) if ledger else 0
+        compile_s = ledger.compile_seconds() if ledger else 0.0
+        n_spans = trace_lib.active().events if trace_lib.active() else 0
+    finally:
+        trace_lib.stop_run(tracer)
+        shutil.rmtree(trace_tmp, ignore_errors=True)
+    assert np.isfinite(loss_vals["off"]) and np.isfinite(loss_vals["on"])
+    pair_ratios = [a / b for a, b in zip(times["on"], times["off"])]
+    best_off, best_on = min(times["off"]), min(times["on"])
+    rec = {
+        "metric": "trace_overhead_ab",
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n,
+        "batch": batch_size,
+        "model": {"n_layers": c["n_layers"], "d_model": c["d_model"],
+                  "d_ff": c["d_ff"], "seq": seq, "vocab": c["vocab"]},
+        "reps": reps, "chain_steps": chain,
+        "arms": {
+            "trace_off": {"step_ms_best": round(best_off * 1e3, 2),
+                          "step_ms_median": round(
+                              float(np.median(times["off"])) * 1e3, 2)},
+            "trace_on": {"step_ms_best": round(best_on * 1e3, 2),
+                         "step_ms_median": round(
+                             float(np.median(times["on"])) * 1e3, 2)},
+        },
+        "overhead_best_pct": round((best_on / best_off - 1.0) * 100, 2),
+        "overhead_pair_median_pct": round(
+            (float(np.median(pair_ratios)) - 1.0) * 100, 2),
+        "params_bitwise_identical": dig["off"] == dig["on"],
+        "params_sha256": dig["off"],
+        "trace_spans_written": int(n_spans),
+        "ledger_compiles": int(n_compiles),
+        "ledger_compile_s": round(compile_s, 3),
+        "note": ("interleaved ON/OFF pairs (DESIGN §7): the ON arm pays "
+                 "one span write + one ledger signature check per "
+                 "dispatch and executes through the ledger's AOT-"
+                 "compiled executable; params bitwise-identical either "
+                 "way (also pinned by tests/test_trace.py)"),
+    }
+    out_path = _divert_cpu_overwrite(out_path, on_tpu)
+    log(f"[trace-overhead] off {best_off * 1e3:.1f} ms/step, on "
+        f"{best_on * 1e3:.1f} ms/step (pair-median "
+        f"{rec['overhead_pair_median_pct']:+.1f}%), "
+        f"{n_compiles} ledger compile(s), params bitwise "
+        f"{'equal' if rec['params_bitwise_identical'] else 'DIFFERENT'}")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    log(f"trace-overhead A/B -> {out_path}")
+    # raise AFTER writing: a failing run must leave an artifact that
+    # records params_bitwise_identical: false, not vanish
+    if dig["off"] != dig["on"]:
+        raise AssertionError(
+            f"trace on/off param digests differ: {dig}")
     return out_path
 
 
@@ -2475,6 +2635,13 @@ def main() -> int:
                          "BENCH_UPDATE_SHARDING.json")
     ap.add_argument("--update-sharding-ab-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="interleaved A/B of span tracing + compile "
+                         "ledger OFF vs ON (train/trace.py) at the "
+                         "CPU-bench transformer scale, with the params "
+                         "bitwise pin embedded; write BENCH_TRACE.json")
+    ap.add_argument("--trace-overhead-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     ap.add_argument("--grad-reduction", choices=["global_mean", "local"],
@@ -2525,9 +2692,13 @@ def main() -> int:
         print(json.dumps({"update_sharding_artifact":
                           bench_update_sharding()}))
         return 0
+    if args.trace_overhead_inproc:
+        print(json.dumps({"trace_artifact": bench_trace_overhead()}))
+        return 0
 
     if (args.attention or args.decode or args.serve or args.rl
-            or args.paged_attn or args.update_sharding_ab):
+            or args.paged_attn or args.update_sharding_ab
+            or args.trace_overhead):
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -2575,6 +2746,14 @@ def main() -> int:
             else:
                 path = bench_update_sharding()
             print(json.dumps({"update_sharding_artifact": path}))
+        if args.trace_overhead:
+            if choice == "cpu":
+                # same 8-virtual-device DP mesh as the telemetry/update-
+                # sharding overhead measurements
+                path = _run_flag_cpu_child("--trace-overhead-inproc", 8)
+            else:
+                path = bench_trace_overhead()
+            print(json.dumps({"trace_artifact": path}))
         return 0
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]

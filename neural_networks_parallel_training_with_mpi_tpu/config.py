@@ -318,6 +318,21 @@ class TrainConfig:
     # flight-recorder ring size (last N step records + events kept for the
     # postmortem dump); 0 disables the recorder
     flight_recorder: int = 64
+    # ---- distributed tracing + compile ledger (train/trace.py,
+    # utils/compile_ledger.py; off by default, zero cost when off) ----
+    # host-side span timeline (load/dispatch/fetch/eval/ckpt/rollback and
+    # the serving tick phases) + compile-event ledger, written per
+    # process as trace-p{P}-i{I}.jsonl / compiles-p{P}-i{I}.jsonl and
+    # merged by tools/trace_report.py into one Perfetto trace.json.
+    # trace=True rides --telemetry_dir (a trace/ subdir); trace_dir
+    # names an explicit directory (and implies trace on).
+    trace: bool = False
+    trace_dir: Optional[str] = None
+    # leader-gated jax.profiler capture (utils.profiling.trace): the
+    # DEVICE-side complement to the host spans — per-op XLA timelines
+    # for TensorBoard/XProf.  Alias of the legacy profile_dir knob with
+    # the documented two-trace relationship (README "Observability").
+    xla_trace_dir: Optional[str] = None
     # evaluate on the validation split every N epochs (0 = only after
     # training); needs data.val_fraction > 0
     eval_every: int = 0
@@ -695,6 +710,23 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="flight-recorder ring size: last N step records/"
                         "events dumped to postmortem.json on abnormal "
                         "exit (0 = off)")
+    _add_bool_flag(p, "trace", False,
+                   "host-side span tracing + compile-event ledger "
+                   "(train/trace.py): per-process trace-p{P}-i{I}.jsonl "
+                   "/ compiles-p{P}-i{I}.jsonl under --telemetry_dir's "
+                   "trace/ subdir (or --trace_dir), merged by "
+                   "tools/trace_report.py into one Perfetto trace.json "
+                   "across processes AND supervisor relaunches")
+    p.add_argument("--trace_dir", type=str, default=None,
+                   help="explicit directory for the span trace + compile "
+                        "ledger (implies --trace); share one dir across "
+                        "the processes of a world — files are per-"
+                        "(process, incarnation)")
+    p.add_argument("--xla_trace_dir", type=str, default=None,
+                   help="leader-gated jax.profiler capture "
+                        "(TensorBoard/XProf device timeline) — the "
+                        "DEVICE complement to --trace's host spans; "
+                        "equivalent to the legacy --profile_dir")
     p.add_argument("--check_replicas_every", type=int, default=0,
                    help="verify replicated state is bit-identical across "
                         "device shards every N steps (0 = off); detect-"
@@ -842,6 +874,9 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         telemetry_dir=args.telemetry_dir,
         metrics_every=args.metrics_every,
         flight_recorder=args.flight_recorder,
+        trace=args.trace or args.trace_dir is not None,
+        trace_dir=args.trace_dir,
+        xla_trace_dir=args.xla_trace_dir,
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
         sdc_check_every=args.sdc_check_every,

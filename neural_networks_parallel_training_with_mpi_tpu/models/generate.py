@@ -351,7 +351,13 @@ def _sharded_decode_program(model: Transformer, mesh, max_new_tokens: int,
                         key=key, prompt_lens=lens, pad_id=pad_id,
                         kv_quant=kv_quant)
 
-    return jax.jit(run, out_shardings=rows), rows
+    # compile-ledger seam (utils/compile_ledger): decode-path compiles
+    # land in compiles.jsonl whenever a ledger is installed
+    from ..utils import compile_ledger as ledger_lib
+
+    return ledger_lib.instrument(
+        jax.jit(run, out_shardings=rows),
+        f"generate_sharded[n={max_new_tokens}]"), rows
 
 
 def generate_sharded(model: Transformer, params, prompt, mesh,

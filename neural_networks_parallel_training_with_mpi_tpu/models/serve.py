@@ -123,8 +123,17 @@ def _programs(model: Transformer, max_len: int, temperature: float,
         pos = jnp.where(active, jnp.minimum(pos + 1, max_len - 1), pos)
         return caches, tokens, pos, key
 
-    return (jax.jit(prefill), jax.jit(insert, donate_argnums=(0,)),
-            jax.jit(step, donate_argnums=(1, 2, 3)))
+    # compile-ledger seam (utils/compile_ledger): the dense server's
+    # programs report their compiles like the paged server's
+    from ..utils import compile_ledger as ledger_lib
+
+    tag = f"T{max_len}" + ("/int8" if kv_quant else "")
+    return (ledger_lib.instrument(jax.jit(prefill),
+                                  f"dense_prefill[{tag}]"),
+            ledger_lib.instrument(jax.jit(insert, donate_argnums=(0,)),
+                                  f"dense_insert[{tag}]"),
+            ledger_lib.instrument(jax.jit(step, donate_argnums=(1, 2, 3)),
+                                  f"dense_decode[{tag}]"))
 
 
 class DecodeServer:

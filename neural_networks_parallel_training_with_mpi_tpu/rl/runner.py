@@ -35,6 +35,8 @@ from ..ops import schedules
 from ..parallel import data_parallel as dp
 from ..parallel.mesh import describe, make_mesh, world_setup
 from ..train import telemetry as telemetry_lib
+from ..train import trace as trace_lib
+from ..utils import compile_ledger as ledger_lib
 from ..utils.logging import MetricsLogger, Throughput, log
 from . import anakin
 from .envs import make_env
@@ -135,13 +137,21 @@ class RLRunner:
                 "(bitflip/desync) instead")
         self.telemetry_metrics = bool(cfg.telemetry_dir
                                       and cfg.metrics_every > 0)
-        self.step_fn = anakin.make_anakin_step(
-            self.env, self.model, self.optimizer, self.mesh,
-            rollout_steps=rl.rollout_steps, gamma=rl.gamma,
-            gae_lambda=rl.gae_lambda, clip_eps=rl.clip_eps,
-            entropy_coef=rl.entropy_coef, value_coef=rl.value_coef,
-            ppo_epochs=rl.ppo_epochs,
-            with_metrics=self.telemetry_metrics)
+        # compile-ledger seam + span tracer: same observability channel
+        # as the supervised Trainer (train/trace.py, DESIGN.md §7)
+        self.step_fn = ledger_lib.instrument(
+            anakin.make_anakin_step(
+                self.env, self.model, self.optimizer, self.mesh,
+                rollout_steps=rl.rollout_steps, gamma=rl.gamma,
+                gae_lambda=rl.gae_lambda, clip_eps=rl.clip_eps,
+                entropy_coef=rl.entropy_coef, value_coef=rl.value_coef,
+                ppo_epochs=rl.ppo_epochs,
+                with_metrics=self.telemetry_metrics),
+            "rl_anakin_step")
+        self.tracer = None
+        trace_dir = trace_lib.dir_from_config(cfg)
+        if trace_dir:
+            self.tracer = trace_lib.start_run(trace_dir)
         self.frames_per_update = rl.rollout_steps * rl.n_envs
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
         dev = self.mesh.devices.flat[0]
@@ -185,17 +195,26 @@ class RLRunner:
         from ..utils import checkpoint as ckpt
 
         self.telemetry.alive()
+        step_now = int(jax.device_get(self.state.step))
+        # a run ending exactly on a checkpoint boundary already committed
+        # this step (same guard as Trainer.save: the orbax layout refuses
+        # to rewrite an existing generation)
+        if final and getattr(self, "_last_saved_step", None) == step_now:
+            ckpt.wait_pending()
+            return
+        self._last_saved_step = step_now
         extra = {"workload": "rl",
                  "saved_world": {"dp": int(self.dp_size)}}
-        if self.cfg.async_checkpoint and not final:
-            ckpt.save_async(self.cfg.checkpoint_dir, self.state,
-                            keep=self.cfg.checkpoint_keep,
-                            extra_meta=extra)
-        else:
-            if final:
-                ckpt.wait_pending()
-            ckpt.save(self.cfg.checkpoint_dir, self.state,
-                      keep=self.cfg.checkpoint_keep, extra_meta=extra)
+        with trace_lib.span("ckpt", final=final):
+            if self.cfg.async_checkpoint and not final:
+                ckpt.save_async(self.cfg.checkpoint_dir, self.state,
+                                keep=self.cfg.checkpoint_keep,
+                                extra_meta=extra)
+            else:
+                if final:
+                    ckpt.wait_pending()
+                ckpt.save(self.cfg.checkpoint_dir, self.state,
+                          keep=self.cfg.checkpoint_keep, extra_meta=extra)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -232,7 +251,8 @@ class RLRunner:
             host-side trackers, and emit the log/metrics lines at the
             log_every cadence."""
             nonlocal first_return, ema_return, last_loss, last_fetched
-            fetched = last_fetched = jax.device_get(out)
+            with trace_lib.span("fetch", step=update):
+                fetched = last_fetched = jax.device_get(out)
             last_loss = float(fetched["loss"])
             ret = float(fetched.get("return_mean", float("nan")))
             if np.isfinite(ret):
@@ -263,7 +283,8 @@ class RLRunner:
                         # shards exactly like the trainer's state
                         self.state = self.fault_plan.apply_state(
                             step, self.state, what="rl state")
-                    self.state, out = self.step_fn(self.state)
+                    with trace_lib.span("dispatch", step=step):
+                        self.state, out = self.step_fn(self.state)
                     watchdog.pat()
                     thr.add(self.frames_per_update)
                     before, step = step, step + 1
@@ -285,6 +306,8 @@ class RLRunner:
                 self.telemetry.on_abnormal_exit(exc)
                 self.metrics.close()
                 self.telemetry.close()
+                if self.tracer is not None:
+                    trace_lib.stop_run(self.tracer)
         if prev is not None:
             observe(*prev)
         self.telemetry.flush(step=step)
@@ -318,4 +341,6 @@ class RLRunner:
                     result[k] = float(last_fetched[k])
         self.metrics.close()
         self.telemetry.close()
+        if self.tracer is not None:
+            trace_lib.stop_run(self.tracer)
         return result

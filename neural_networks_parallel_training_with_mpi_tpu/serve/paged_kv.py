@@ -346,8 +346,20 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
         pos = jnp.where(active, jnp.minimum(pos + 1, cap), pos)
         return new_pools, tokens, pos, key
 
-    return (jax.jit(prefill, donate_argnums=(1,)),
-            jax.jit(step, donate_argnums=(1, 2, 4)))
+    # compile-ledger seam (utils/compile_ledger): while a ledger is
+    # installed every distinct compile of the serve programs is recorded
+    # — which is how the "block-table churn never recompiles" invariant
+    # becomes a production assertion instead of a test-only cache count
+    # (tables/lengths are traced args; only a NEW prefill bucket width
+    # may legitimately add an entry)
+    from ..utils import compile_ledger as ledger_lib
+
+    tag = (f"bs{bs}x{mb}" + ("/int8" if kv_quant else "")
+           + f"/{attn_impl}")
+    return (ledger_lib.instrument(jax.jit(prefill, donate_argnums=(1,)),
+                                  f"serve_prefill[{tag}]"),
+            ledger_lib.instrument(jax.jit(step, donate_argnums=(1, 2, 4)),
+                                  f"serve_decode[{tag}]"))
 
 
 @dataclass

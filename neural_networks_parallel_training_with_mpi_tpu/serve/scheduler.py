@@ -49,6 +49,7 @@ from dataclasses import dataclass
 from typing import Any, Deque, Dict, List, Optional
 
 from ..models.transformer import Transformer
+from ..train import trace as trace_lib
 from ..train.telemetry import Heartbeat
 from ..utils.logging import log
 from .paged_kv import PagedDecodeServer
@@ -79,6 +80,11 @@ class ServeConfig:
     #                                stops at each stream's true length)
     telemetry_dir: Optional[str] = None
     metrics_every: int = 25        # ticks between kind="serve" records
+    # span tracing + compile ledger (train/trace.py): per-tick
+    # admit/prefill/decode/retire spans and the serve programs' compile
+    # events under this dir; None = ride any tracer the enclosing
+    # process already installed (or off)
+    trace_dir: Optional[str] = None
     completed_history: int = 1024  # completed Requests kept for stats();
     #                                older ones (and their unconsumed
     #                                results) are pruned so a long-lived
@@ -214,6 +220,12 @@ class Scheduler:
         # caller's tweaks into every later default-constructed Scheduler
         self.cfg = cfg = ServeConfig() if cfg is None else cfg
         self.now = now_fn
+        # install the span tracer + compile ledger BEFORE the server
+        # builds its programs, so their compiles land in the ledger; an
+        # already-active tracer (an enclosing run) is never displaced
+        self._tracer = None
+        if cfg.trace_dir and trace_lib.active() is None:
+            self._tracer = trace_lib.start_run(cfg.trace_dir)
         self.server = PagedDecodeServer(
             model, params, slots=cfg.slots, num_blocks=cfg.num_blocks,
             block_size=cfg.block_size, max_len=cfg.max_len,
@@ -308,16 +320,21 @@ class Scheduler:
         rids completed during this tick."""
         self.tick_no += 1
         done_now: List[int] = []
-        self._admit()
-        done_now += self._prefill_tick()
+        with trace_lib.span("admit", tick=self.tick_no):
+            self._admit()
+        with trace_lib.span("prefill", tick=self.tick_no):
+            done_now += self._prefill_tick()
         if self.server.any_active():
-            self._grow_or_evict()
-            acct = self.server.keys_accounting()
-            self.attended_keys += acct["attended_keys"]
-            self.padded_keys += acct["padded_keys"]
-            self.kernel_keys += acct["kernel_keys"]
-            for srv_rid in self.server.step():
-                done_now.append(self._retire(srv_rid))
+            with trace_lib.span("decode", tick=self.tick_no):
+                self._grow_or_evict()
+                acct = self.server.keys_accounting()
+                self.attended_keys += acct["attended_keys"]
+                self.padded_keys += acct["padded_keys"]
+                self.kernel_keys += acct["kernel_keys"]
+                finished = self.server.step()
+            with trace_lib.span("retire", tick=self.tick_no):
+                for srv_rid in finished:
+                    done_now.append(self._retire(srv_rid))
         self.telemetry.on_tick(self.tick_no, self._snapshot())
         return done_now
 
@@ -336,6 +353,9 @@ class Scheduler:
 
     def close(self) -> None:
         self.telemetry.close(self.tick_no, self._snapshot())
+        if self._tracer is not None:
+            trace_lib.stop_run(self._tracer)
+            self._tracer = None
 
     # ---- internals -----------------------------------------------------
     def _committed_tokens(self) -> int:

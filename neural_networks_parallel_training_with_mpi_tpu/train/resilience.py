@@ -322,6 +322,13 @@ _COORD_ENV_KEYS = ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS")
 _NUM_PROCESSES_ENV = "NNPT_NUM_PROCESSES"
 _PROCESS_ID_ENV = "NNPT_PROCESS_ID"
 DEGRADED_ENV = "NNPT_ELASTIC_DEGRADED"  # marks a shrunken-world child
+# trace correlation channel (train/trace.py; duplicated as strings so
+# this module stays importable on jax-less ops hosts): the supervisor
+# stamps every child with ONE job-stable run id and its attempt number,
+# so tools/trace_report.py can merge the per-incarnation trace files of
+# a crashed-and-relaunched run onto one timeline
+RUN_ID_ENV = "NNPT_RUN_ID"
+INCARNATION_ENV = "NNPT_INCARNATION"
 
 
 def degrade_env(env: dict, probe: dict) -> dict:
@@ -588,17 +595,28 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     attempt = 0
     peer_streak = 0
     child_env = dict(env) if env is not None else None
+    # run identity for the trace channel: one run_id for the whole
+    # supervised job (inherited when the operator set it — e.g. shared
+    # across a multi-host world like COORDINATOR_ADDRESS — else
+    # generated once here), plus the attempt number as the incarnation
+    import os as _os
+
+    _base = env if env is not None else _os.environ
+    run_id = _base.get(RUN_ID_ENV) or (
+        f"run-{int(time.time())}-{_os.getpid()}")
     # original world configuration, for grow-back: a degraded relaunch
     # rewrites child_env, and a LATER probe that finds the full world
     # healthy again must restore these keys — otherwise the child keeps
     # forming the small world while the log reports the full topology
-    import os as _os
-
     _world_keys = _COORD_ENV_KEYS + (_NUM_PROCESSES_ENV, _PROCESS_ID_ENV)
     orig_world = {k: (env if env is not None else _os.environ).get(k)
                   for k in _world_keys}
     while True:
         attempt += 1
+        if child_env is None:
+            child_env = dict(_os.environ)
+        child_env[RUN_ID_ENV] = run_id
+        child_env[INCARNATION_ENV] = str(attempt - 1)
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
         launched = time.time()
         rc = _run_child(cmd, child_env, heartbeat_path, heartbeat_timeout,
@@ -607,8 +625,6 @@ def supervise(cmd: Sequence[str], max_restarts: int,
         # whose dump is the flagship black-box case — gets the pointer
         if rc != EXIT_OK and postmortem_path:
             try:
-                import os as _os
-
                 if _os.stat(postmortem_path).st_mtime >= launched - 1.0:
                     log(f"[supervise] child left a postmortem: "
                         f"{postmortem_path}")

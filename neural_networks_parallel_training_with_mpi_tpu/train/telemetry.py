@@ -261,6 +261,11 @@ class FlightRecorder:
             "n_records": len(self.records),
             "records": list(self.records),
         }
+        # per-device memory AT DEATH: the number an OOM/hang postmortem
+        # is usually missing (best-effort — the runtime may be gone)
+        mem = device_memory_summary(full=True)
+        if mem:
+            doc["device_memory"] = mem
         _atomic_write_json(self.path, doc)
         self.dumps += 1
         log(f"[telemetry] postmortem ({reason}) -> {self.path}")
@@ -270,6 +275,27 @@ class FlightRecorder:
 # ---------------------------------------------------------------------------
 # Pillar 4: heartbeat
 # ---------------------------------------------------------------------------
+
+def device_memory_summary(full: bool = False) -> Optional[Dict[str, Any]]:
+    """Per-device memory snapshot for the heartbeat (compact: live +
+    peak bytes) and the flight-recorder postmortem (``full=True``:
+    everything the backend reports) — so an OOM/hang postmortem shows
+    per-device memory at death.  None where the backend reports nothing
+    (XLA:CPU) or the runtime is already too broken to answer."""
+    try:
+        from ..utils.profiling import device_memory_stats
+
+        stats = device_memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    if full:
+        return stats
+    return {dev: {k: v for k, v in s.items()
+                  if k in ("bytes_in_use", "peak_bytes_in_use")}
+            for dev, s in stats.items()}
+
 
 def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -334,6 +360,11 @@ class Heartbeat:
             "last_metrics": last_metrics,
             **extra,
         }
+        # per-device live/peak memory where the backend reports it —
+        # writes are already throttled, so this stays off the hot path
+        mem = device_memory_summary()
+        if mem:
+            doc["device_memory"] = mem
         if final:
             doc["final"] = True
         _atomic_write_json(self.path, doc)
@@ -459,8 +490,11 @@ class Telemetry:
                             skipped_total=self.skipped_total)
 
     def _fetch(self, entry) -> None:
+        from . import trace as trace_lib
+
         step, epoch, out, n_steps, rows, t_prev, t_disp = entry
-        fetched = jax.device_get(out)
+        with trace_lib.span("fetch", what="metrics", step=int(step)):
+            fetched = jax.device_get(out)
         if isinstance(fetched, dict):
             rec = {k: float(v) for k, v in fetched.items()}
         else:

@@ -25,9 +25,11 @@ from ..ops import optim as optim_lib
 from ..ops import schedules
 from ..parallel import data_parallel as dp
 from ..parallel.mesh import describe, make_mesh, world_setup
+from ..utils import compile_ledger as ledger_lib
 from ..utils import profiling, prng
 from ..utils.logging import MetricsLogger, Throughput, is_leader, log
 from . import telemetry as telemetry_lib
+from . import trace as trace_lib
 from .state import TrainState
 
 
@@ -284,6 +286,9 @@ class Trainer:
         # _resume_plan keeps the (epoch, in-epoch step) the offset maps to
         self._step_offset = 0
         self._resume_plan = None
+        # newest step this process has committed a snapshot for (gates
+        # the redundant final re-save of an end-of-run boundary step)
+        self._last_saved_step = None
         cfg = self.cfg = self._elastic_preflight(cfg)
         # striped attention: tokens reorder round-robin over the seq shards
         # (balanced causal blocks — parallel.sequence.striped_permutation);
@@ -414,10 +419,14 @@ class Trainer:
             from ..parallel import expert as ep_lib
 
             moe_seq = "seq" if self.seq_parallel else None
-            moe_step = ep_lib.make_moe_tp_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=train_loss,
-                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps,
-                seq_axis=moe_seq)
+            # the ledger seam wraps the INNER jitted program (the outer
+            # train_step is a plain closure the seam cannot lower)
+            moe_step = ledger_lib.instrument(
+                ep_lib.make_moe_tp_train_step(
+                    self.model, self.optimizer, self.mesh,
+                    loss_name=train_loss, grad_clip=cfg.grad_clip,
+                    accum_steps=cfg.accum_steps, seq_axis=moe_seq),
+                "train_step[ep_tp]")
 
             def train_step(state, batch):
                 state, metrics = moe_step(state, batch)
@@ -432,10 +441,12 @@ class Trainer:
             from ..parallel import expert as ep_lib
 
             moe_seq = "seq" if self.sp_ep else None
-            moe_step = ep_lib.make_moe_train_step(
-                self.model, self.optimizer, self.mesh, loss_name=train_loss,
-                grad_clip=cfg.grad_clip, accum_steps=cfg.accum_steps,
-                seq_axis=moe_seq)
+            moe_step = ledger_lib.instrument(
+                ep_lib.make_moe_train_step(
+                    self.model, self.optimizer, self.mesh,
+                    loss_name=train_loss, grad_clip=cfg.grad_clip,
+                    accum_steps=cfg.accum_steps, seq_axis=moe_seq),
+                "train_step[expert]")
 
             def train_step(state, batch):
                 state, metrics = moe_step(state, batch)
@@ -524,6 +535,27 @@ class Trainer:
 
             self.train_step = wrap_step_with_desync(
                 self.train_step, self.mesh, det.start, det.eps)
+        # compile-event ledger seam (utils/compile_ledger, DESIGN.md §7):
+        # every layout's train/eval program goes through ONE
+        # instrumentation point — while a ledger is installed
+        # (--trace/--trace_dir) each new arg-shape/dtype signature is
+        # compiled exactly once with wall time, HLO fingerprint, cost
+        # analysis and recompile attribution recorded; with no ledger
+        # the wrappers are pass-throughs.  The expert/ep_tp branches
+        # instrumented their inner jitted program above.
+        self.layout_tag = ("pipe" if self.pipeline else
+                           "ep_tp" if self.ep_tp else
+                           "expert" if self.expert else
+                           "sp_tp" if self.sp_tp else
+                           "sp" if self.seq_parallel else
+                           "gspmd" if self.gspmd else "dp")
+        if cfg.update_sharding != "replicated":
+            self.layout_tag += f"+{cfg.update_sharding}"
+        if not (self.expert or self.ep_tp):
+            self.train_step = ledger_lib.instrument(
+                self.train_step, f"train_step[{self.layout_tag}]")
+        self.eval_step = ledger_lib.instrument(
+            self.eval_step, f"eval_step[{self.layout_tag}]")
         # silent-data-corruption defense (utils.consistency, DESIGN.md
         # §9): --sdc_check_every fingerprints the replicated state at
         # this cadence and heals transient divergence; the legacy
@@ -558,7 +590,16 @@ class Trainer:
 
             # donate the carried state: the caller always discards the old
             # one, and k>1 exists to cut overhead, not add copies
-            self.multi_step = jax.jit(multi, donate_argnums=0)
+            self.multi_step = ledger_lib.instrument(
+                jax.jit(multi, donate_argnums=0),
+                f"multi_step[{self.layout_tag},k={self.k_dispatch}]")
+        # distributed tracing (train/trace.py): install the span tracer
+        # + compile ledger for this process.  Validates the flag combo
+        # (--trace needs --telemetry_dir or --trace_dir) eagerly.
+        self.tracer = None
+        trace_dir = trace_lib.dir_from_config(cfg)
+        if trace_dir:
+            self.tracer = trace_lib.start_run(trace_dir)
         self.metrics = MetricsLogger(cfg.metrics_jsonl)
         dev = self.mesh.devices.flat[0]
         self.telemetry = telemetry_lib.Telemetry(
@@ -847,6 +888,10 @@ class Trainer:
                 ckpt.read_meta(self.cfg.checkpoint_dir, step=step) or {},
                 step)
         self.loader.order_salt += 1
+        # the retrained window will revisit step numbers already saved —
+        # with DIFFERENT state (re-drawn order); the final-save skip
+        # must never treat those as already-committed
+        self._last_saved_step = None
         return int(jax.device_get(self.state.step))
 
     # ---- silent-data-corruption defense (DESIGN.md §9) -------------------
@@ -1103,6 +1148,17 @@ class Trainer:
             # progress coordinate an elastic resume with a different
             # batch size maps through (DESIGN.md §10).
             step_now = int(jax.device_get(self.state.step))
+            # when the run ENDS exactly on a checkpoint boundary, the
+            # loop's periodic save already committed this step and the
+            # state has not changed since — the final save would rewrite
+            # the same generation, which the orbax (multi-process) layout
+            # refuses ("Destination already exists") and the npz layout
+            # pays as a redundant full write.  Drain the async writer and
+            # return: the committed generation IS the final snapshot.
+            if final and self._last_saved_step == step_now:
+                ckpt.wait_pending()
+                return
+            self._last_saved_step = step_now
             extra = {"qkv_tp": (int(self.mesh.shape.get("tensor", 1))
                                 if (self.pipeline or self.sp_tp
                                     or self.ep_tp) else 1),
@@ -1118,15 +1174,20 @@ class Trainer:
                              step_now + self._step_offset)}
             if self._restored_world:
                 extra["restored_world"] = self._restored_world
-            if self.cfg.async_checkpoint and not final:
-                ckpt.save_async(self.cfg.checkpoint_dir, self.state,
-                                keep=self.cfg.checkpoint_keep,
-                                extra_meta=extra)
-            else:
-                if final:  # drain in-flight writes before the last snapshot
-                    ckpt.wait_pending()
-                ckpt.save(self.cfg.checkpoint_dir, self.state,
-                          keep=self.cfg.checkpoint_keep, extra_meta=extra)
+            # span "ckpt" = this call's host-side cost (the async path's
+            # staging device_get); the writer thread's disk time shows
+            # separately as "ckpt_write" (utils/checkpoint)
+            with trace_lib.span("ckpt", step=step_now, final=final):
+                if self.cfg.async_checkpoint and not final:
+                    ckpt.save_async(self.cfg.checkpoint_dir, self.state,
+                                    keep=self.cfg.checkpoint_keep,
+                                    extra_meta=extra)
+                else:
+                    if final:  # drain in-flight writes before the last
+                        ckpt.wait_pending()
+                    ckpt.save(self.cfg.checkpoint_dir, self.state,
+                              keep=self.cfg.checkpoint_keep,
+                              extra_meta=extra)
 
     # ---- the loop --------------------------------------------------------
     def fit(self) -> Dict[str, Any]:
@@ -1153,7 +1214,10 @@ class Trainer:
             f"({self.model.n_params():,} params) | "
             f"{self.loader.n} samples, "
             f"{self.loader.steps_per_epoch} steps/epoch{update_note}")
-        profiler = profiling.trace(cfg.profile_dir)
+        # --xla_trace_dir: the leader-gated jax.profiler DEVICE capture
+        # (utils.profiling.trace) next to the host spans — same knob as
+        # the legacy --profile_dir
+        profiler = profiling.trace(cfg.profile_dir or cfg.xla_trace_dir)
         thr = Throughput()
         timer = profiling.StepTimer()
         last_loss = float("nan")
@@ -1219,7 +1283,7 @@ class Trainer:
             BOTH lag queues — their futures belong to the abandoned
             timeline.  The caller breaks out of the dispatch loop."""
             nonlocal step, prev, rolled_back
-            with watchdog.suspended():
+            with trace_lib.span("rollback"), watchdog.suspended():
                 step = self._rollback()
             log(f"{why} — restored step {step}, re-drew the data order")
             # postmortem now + a straddling re-dump after the first
@@ -1274,6 +1338,9 @@ class Trainer:
                             (b, 1, self.loader.batch_rows(epoch_start_step + i))
                             for i, b in enumerate(self.loader.epoch(
                                 epoch, start_step=epoch_start_step)))
+                    # each next() is a "load" span (host batch assembly);
+                    # pass-through when tracing is off
+                    dispatches = trace_lib.traced_iter("load", dispatches)
                     for batch, n_steps, rows in dispatches:
                         if shutdown.requested:
                             break
@@ -1285,8 +1352,10 @@ class Trainer:
                             # pipeline keeps overlapping host batch prep
                             # with device compute even when log_every > 1
                             m_step, m_loss = monitor_q.pop(0)
-                            action = monitor.observe(
-                                float(jax.device_get(m_loss)))
+                            with trace_lib.span("fetch", what="monitor",
+                                                step=m_step):
+                                m_val = float(jax.device_get(m_loss))
+                            action = monitor.observe(m_val)
                             if action == "abort":
                                 raise AnomalyAbort(
                                     f"training diverged at step {m_step}: "
@@ -1305,7 +1374,9 @@ class Trainer:
                         # step count before that dispatch)
                         if prev is not None and cfg.log_every and \
                                 prev[0] // cfg.log_every > prev[3] // cfg.log_every:
-                            last_loss = float(jax.device_get(prev[2]))
+                            with trace_lib.span("fetch", what="log",
+                                                step=prev[0]):
+                                last_loss = float(jax.device_get(prev[2]))
                             self.metrics.write({
                                 "step": prev[0], "epoch": prev[1],
                                 "loss": last_loss,
@@ -1324,19 +1395,23 @@ class Trainer:
                             # not donated; holding one dispatch's worth
                             # of rows is the entire cost)
                             self._sdc_batch = batch
-                        if self.k_dispatch > 1:
-                            self.state, outs = self.multi_step(self.state,
-                                                               batch)
-                            # each dispatch reports its LAST step (the
-                            # intermediate outputs live inside the scan;
-                            # the 'skipped' metric is the guard's
-                            # CUMULATIVE counter exactly so this slice
-                            # cannot lose mid-dispatch fires)
-                            out = jax.tree_util.tree_map(lambda x: x[-1],
-                                                         outs)
-                        else:
-                            self.state, out = self.train_step(self.state,
-                                                              batch)
+                        # "dispatch" measures the HOST-side submission
+                        # cost (async — the device runs behind it)
+                        with trace_lib.span("dispatch", step=step):
+                            if self.k_dispatch > 1:
+                                self.state, outs = self.multi_step(
+                                    self.state, batch)
+                                # each dispatch reports its LAST step
+                                # (the intermediate outputs live inside
+                                # the scan; the 'skipped' metric is the
+                                # guard's CUMULATIVE counter exactly so
+                                # this slice cannot lose mid-dispatch
+                                # fires)
+                                out = jax.tree_util.tree_map(
+                                    lambda x: x[-1], outs)
+                            else:
+                                self.state, out = self.train_step(
+                                    self.state, batch)
                         # telemetry layouts return the on-device metrics
                         # dict; everything downstream keys off the loss
                         loss = out["loss"] if isinstance(out, dict) else out
@@ -1419,7 +1494,8 @@ class Trainer:
                     # periodic held-out eval (the reference's :213-220 intent)
                     if (self.val_data is not None and cfg.eval_every
                             and (epoch + 1) % cfg.eval_every == 0):
-                        with watchdog.suspended():
+                        with trace_lib.span("eval", epoch=epoch), \
+                                watchdog.suspended():
                             ev = self.evaluate(self.val_data)
                         last_eval = (step, ev)
                         log("validation: " + ", ".join(
@@ -1451,6 +1527,10 @@ class Trainer:
                 self.telemetry.on_abnormal_exit(exc)
                 self.metrics.close()
                 self.telemetry.close()
+                if self.tracer is not None:
+                    # flush the span timeline too: the trace must
+                    # survive the crash for the postmortem merge
+                    trace_lib.stop_run(self.tracer)
         if prev is not None and cfg.log_every and \
                 prev[0] // cfg.log_every > prev[3] // cfg.log_every:
             self.metrics.write({"step": prev[0], "epoch": prev[1],
@@ -1511,12 +1591,15 @@ class Trainer:
             if last_eval is not None and last_eval[0] == step:
                 ev = last_eval[1]
             else:
-                ev = self.evaluate(self.val_data)
+                with trace_lib.span("eval", final=True):
+                    ev = self.evaluate(self.val_data)
                 self.metrics.write({"step": step, "final": True,
                                     **{f"val_{k}": v for k, v in ev.items()}})
             result.update({f"val_{k}": v for k, v in ev.items()})
         self.metrics.close()
         self.telemetry.close()
+        if self.tracer is not None:
+            trace_lib.stop_run(self.tracer)
         return result
 
     def _eval_params(self):

@@ -293,7 +293,23 @@ def save_async(directory: str, state: TrainState, keep: int = 3,
 
     def work():
         try:
-            _write_npz(Path(directory), step, host_state, keep, extra_meta)
+            # span "ckpt_write" (train/trace.py, lazy so jax-free tools
+            # importing this module by path never pull train/): the
+            # writer thread's actual disk time, visible on the timeline
+            # next to the hot loop it overlaps
+            try:
+                from ..train import trace as trace_lib
+
+                span = trace_lib.span("ckpt_write", step=step)
+            except Exception:
+                span = None
+            if span is not None:
+                with span:
+                    _write_npz(Path(directory), step, host_state, keep,
+                               extra_meta)
+            else:
+                _write_npz(Path(directory), step, host_state, keep,
+                           extra_meta)
         except BaseException as e:  # surfaced on the next save/wait call
             with _err_lock:
                 _async_errors.append(e)

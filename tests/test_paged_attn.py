@@ -280,6 +280,32 @@ def test_block_table_churn_never_recompiles():
     assert srv._prefill_fn._cache_size() == n_prefill
 
 
+def test_donation_audit_fused_decode_program():
+    """The donation audit extended to the fused serving decode program:
+    it donates the KV pools, the token slab and the position vector
+    (donate_argnums=(1, 2, 4)) — every donated leaf must alias in/out
+    (an unaliased pool leaf would copy the whole block pool per decoded
+    token)."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.utils.profiling import (
+        donation_report,
+    )
+
+    model = _model()
+    params = model.init(prng.init_key(0))
+    srv = PagedDecodeServer(model, params, slots=4, num_blocks=24,
+                            block_size=8, attn_impl="fused")
+    masked = np.where(srv.active[:, None], srv.tables, 0)
+    comp = srv._step_fn.lower(
+        srv.params, srv.pools, srv.tokens, jnp.asarray(masked), srv.pos,
+        jnp.asarray(srv.active), srv.key).compile()
+    rep = donation_report(comp)
+    donated = len(jax.tree_util.tree_leaves(srv.pools)) + 2  # + tokens, pos
+    assert rep["n_aliased"] == donated, rep
+    assert rep["unaliased_donors"] == 0, rep
+
+
 # ---------------------------------------------------------------------------
 # model-variant parity (full lane: each variant is a fresh compile)
 # ---------------------------------------------------------------------------

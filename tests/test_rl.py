@@ -362,6 +362,28 @@ def test_anakin_step_flops_accounting():
     assert anakin.anakin_step_flops(model, env.obs_dim, 32, 1) < per_frame
 
 
+def test_donation_audit_anakin_step_all_leaves_aliased():
+    """The donation audit (utils.profiling.donation_report) extended to
+    the RL step: the fused rollout+GAE+PPO program donates its RLState
+    (params, opt state, env state, obs, returns, per-env keys) and the
+    compiler must alias EVERY leaf in/out — an RLState leaf migrating to
+    unaliased_donors means a silent per-update copy of the env buffers."""
+    from neural_networks_parallel_training_with_mpi_tpu.utils.profiling import (
+        donation_report,
+    )
+
+    env = make_env("gridworld")
+    mesh = _mesh()
+    model = _policy(env)
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    state = anakin.place_rl_state(
+        anakin.init_rl_state(env, model, opt, 16, 0), mesh)
+    step = anakin.make_anakin_step(env, model, opt, mesh, rollout_steps=4)
+    rep = donation_report(step.lower(state).compile())
+    assert rep["n_aliased"] == len(jax.tree_util.tree_leaves(state)), rep
+    assert rep["unaliased_donors"] == 0, rep
+
+
 # ---------------------------------------------------------------------------
 # CLI / supervisor e2e (subprocess — full lane)
 # ---------------------------------------------------------------------------

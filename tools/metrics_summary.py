@@ -297,6 +297,38 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
     return "\n".join(lines)
 
 
+def _trace_report_mod():
+    """tools/trace_report.py loaded by file path (works as a script, as
+    a module, and under ``python -S``) — the trace view reuses its
+    loader/summary instead of duplicating the merge semantics."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_report.py")
+    spec = importlib.util.spec_from_file_location("_nnpt_trace_report",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def trace_view(path: str) -> Optional[Dict[str, Any]]:
+    """The --trace summary for a run dir: looks for the span/ledger
+    files in ``path/trace`` (the --telemetry_dir layout) falling back to
+    ``path`` itself (an explicit --trace_dir).  Returns the
+    trace_report summary dict, or None when no trace exists."""
+    tr = _trace_report_mod()
+    for cand in (os.path.join(path, "trace"), path):
+        if os.path.isdir(cand):
+            data = tr.load_dir(cand)
+            if data["spans"] or data["compiles"]:
+                summary = tr.summarize(data)
+                summary["trace_dir"] = cand
+                summary["_render"] = tr.render_text(summary)
+                return summary
+    return None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="a --telemetry_dir or a metrics JSONL file")
@@ -304,6 +336,11 @@ def main(argv=None) -> int:
                     help="summarize only the last N records")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as one JSON object")
+    ap.add_argument("--trace", action="store_true",
+                    help="also summarize the run's span trace + compile "
+                         "ledger (trace/ subdir or an explicit trace "
+                         "dir): per-phase time share and compile "
+                         "count/seconds per incarnation")
     args = ap.parse_args(argv)
 
     heartbeat = postmortem = None
@@ -329,17 +366,30 @@ def main(argv=None) -> int:
     try:
         records = load_records(metrics_path, last=args.last)
     except OSError as e:
-        print(f"ERROR: cannot read {metrics_path}: {e}", file=sys.stderr)
-        return 2
+        if not args.trace:
+            print(f"ERROR: cannot read {metrics_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        records = []  # trace-only view of a dir with no metrics stream
     summary = summarize(records, windowed=args.last > 0)
+    trace = trace_view(args.path) if args.trace else None
     if args.json:
         summary["heartbeat"] = heartbeat
         summary["heartbeat_age_s"] = heartbeat_age
         summary["postmortem_reason"] = (postmortem or {}).get("reason")
+        if trace is not None:
+            trace.pop("_render", None)
+            summary["trace"] = trace
         print(json.dumps(summary, indent=2))
     else:
         print(render_text(summary, records, heartbeat, heartbeat_age,
                           postmortem))
+        if args.trace:
+            if trace is None:
+                print("trace: no span/ledger files found")
+            else:
+                print(f"trace ({trace['trace_dir']}):")
+                print(trace["_render"])
     return 0
 
 

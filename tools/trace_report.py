@@ -1,0 +1,290 @@
+"""Merge per-process span traces into one Chrome/Perfetto timeline.
+
+Reads a ``--trace_dir`` (train/trace.py: ``trace-p{P}-i{I}.jsonl`` span
+files plus ``compiles-p{P}-i{I}.jsonl`` compile-ledger files, one pair
+per process × incarnation) and writes:
+
+* ``trace.json`` — Chrome trace format (load it in Perfetto's
+  https://ui.perfetto.dev or chrome://tracing): every (process,
+  incarnation) becomes its own named process row on ONE shared
+  wall-clock axis, so a supervised multi-process run that crashed and
+  relaunched shows both incarnations of every rank with the relaunch
+  gap visible between them;
+* a text summary — per-phase time share per (process, incarnation)
+  and the compile ledger rollup (compiles, recompiles, total compile
+  seconds, what changed).
+
+Zero dependencies beyond the stdlib (proven under ``python -S`` like
+``ckpt_fsck``) — usable on a host with no JAX to triage a trace dir
+copied off a pod::
+
+    python tools/trace_report.py TRACE_DIR                 # summary
+    python tools/trace_report.py TRACE_DIR --out trace.json
+    python tools/trace_report.py TRACE_DIR --json          # machine form
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+Key = Tuple[str, int, int]  # (run_id, process_id, incarnation)
+
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of a live run
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def load_dir(dirpath: str) -> Dict[str, List[Dict[str, Any]]]:
+    """All span + compile records under a trace dir, keyed by kind."""
+    spans: List[Dict[str, Any]] = []
+    compiles: List[Dict[str, Any]] = []
+    metas: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(dirpath, "trace-*.jsonl"))):
+        for rec in _load_jsonl(path):
+            kind = rec.get("kind")
+            if kind == "span":
+                spans.append(rec)
+            elif kind == "meta":
+                metas.append(rec)
+            elif kind == "instant":
+                spans.append(rec)
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "compiles-*.jsonl"))):
+        compiles.extend(r for r in _load_jsonl(path)
+                        if r.get("kind") == "compile")
+    return {"spans": spans, "compiles": compiles, "metas": metas}
+
+
+def _key(rec: Dict[str, Any]) -> Key:
+    return (str(rec.get("run", "")), int(rec.get("p", 0)),
+            int(rec.get("inc", 0)))
+
+
+def _groups(records: List[Dict[str, Any]]
+            ) -> Dict[Key, List[Dict[str, Any]]]:
+    out: Dict[Key, List[Dict[str, Any]]] = {}
+    for r in records:
+        out.setdefault(_key(r), []).append(r)
+    return out
+
+
+_META_KEYS = ("kind", "name", "t", "dur", "p", "run", "inc", "thread")
+
+
+def to_chrome(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Chrome trace-event JSON: one Chrome 'process' per (run, process,
+    incarnation) group, named so Perfetto's track labels carry the
+    correlation triple; ts normalized to the earliest record so the
+    numbers stay readable (relative microseconds on one shared axis)."""
+    spans = data["spans"]
+    if not spans:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(float(r["t"]) for r in spans if "t" in r)
+    events: List[Dict[str, Any]] = []
+    tids: Dict[Tuple[Key, str], int] = {}
+    for cpid, (key, recs) in enumerate(sorted(_groups(spans).items())):
+        run, p, inc = key
+        events.append({"ph": "M", "name": "process_name", "pid": cpid,
+                       "tid": 0,
+                       "args": {"name": f"proc {p} / incarnation {inc}"
+                                        f" [{run}]"}})
+        for r in recs:
+            thread = r.get("thread", "main")
+            tkey = (key, thread)
+            if tkey not in tids:
+                tids[tkey] = sum(1 for (k, _t) in tids if k == key)
+            tid = tids[tkey]
+            args = {k: v for k, v in r.items() if k not in _META_KEYS}
+            ev = {"name": r.get("name", "?"), "pid": cpid, "tid": tid,
+                  "ts": round((float(r.get("t", t0)) - t0) * 1e6, 1)}
+            if r.get("kind") == "instant":
+                ev.update(ph="i", s="p")
+            else:
+                ev.update(ph="X",
+                          dur=round(float(r.get("dur", 0.0)) * 1e6, 1))
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        for (key2, thread), tid in sorted(tids.items()):
+            if key2 == key and thread != "main":
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": cpid, "tid": tid,
+                               "args": {"name": thread}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summarize(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
+    """Machine-readable rollup: per-(process, incarnation) phase time
+    share + span counts, run ids seen, relaunch gaps, and the compile
+    ledger totals per incarnation."""
+    spans = [r for r in data["spans"] if r.get("kind") == "span"]
+    out: Dict[str, Any] = {"runs": sorted({_key(r)[0] for r in spans}),
+                           "groups": [], "compiles": []}
+    groups = _groups(spans)
+    for key in sorted(groups):
+        run, p, inc = key
+        recs = groups[key]
+        starts = [float(r["t"]) for r in recs]
+        ends = [float(r["t"]) + float(r.get("dur", 0.0)) for r in recs]
+        wall = max(ends) - min(starts) if recs else 0.0
+        phases: Dict[str, Dict[str, float]] = {}
+        for r in recs:
+            ph = phases.setdefault(str(r.get("name", "?")),
+                                   {"count": 0, "total_s": 0.0})
+            ph["count"] += 1
+            ph["total_s"] += float(r.get("dur", 0.0))
+        for ph in phases.values():
+            ph["total_s"] = round(ph["total_s"], 6)
+            ph["share"] = (round(min(1.0, ph["total_s"] / wall), 4)
+                           if wall else None)
+        out["groups"].append({
+            "run": run, "process": p, "incarnation": inc,
+            "n_spans": len(recs),
+            "t_first": round(min(starts), 6) if starts else None,
+            "t_last": round(max(ends), 6) if ends else None,
+            "wall_s": round(wall, 6),
+            "phases": phases,
+        })
+    # relaunch gaps: for each (run, process), the quiet time between one
+    # incarnation's last span and the next incarnation's first
+    by_proc: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
+    for g in out["groups"]:
+        by_proc.setdefault((g["run"], g["process"]), []).append(g)
+    gaps = []
+    for (run, p), gs in sorted(by_proc.items()):
+        gs = sorted(gs, key=lambda g: g["incarnation"])
+        for a, b in zip(gs, gs[1:]):
+            if a["t_last"] is not None and b["t_first"] is not None:
+                gaps.append({"run": run, "process": p,
+                             "from_incarnation": a["incarnation"],
+                             "to_incarnation": b["incarnation"],
+                             "gap_s": round(b["t_first"] - a["t_last"],
+                                            6)})
+    out["relaunch_gaps"] = gaps
+    for key, recs in sorted(_groups(data["compiles"]).items()):
+        run, p, inc = key
+        recompiles = [r for r in recs
+                      if r.get("changed") or r.get("added")
+                      or r.get("removed")]
+        out["compiles"].append({
+            "run": run, "process": p, "incarnation": inc,
+            "n_compiles": len(recs),
+            "compile_s": round(sum((r.get("compile_ms") or 0.0)
+                                   for r in recs) / 1e3, 3),
+            "lower_s": round(sum((r.get("lower_ms") or 0.0)
+                                 for r in recs) / 1e3, 3),
+            "by_name": {
+                name: len([r for r in recs if r.get("name") == name])
+                for name in sorted({str(r.get("name")) for r in recs})},
+            "recompiles": [
+                {"name": r.get("name"), "n_compile": r.get("n_compile"),
+                 **{k: r[k] for k in ("changed", "added", "removed")
+                    if r.get(k)}}
+                for r in recompiles],
+        })
+    return out
+
+
+def render_text(summary: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    runs = summary.get("runs", [])
+    lines.append(f"runs: {', '.join(runs) if runs else '(none)'}")
+    for g in summary["groups"]:
+        lines.append(f"proc {g['process']} / incarnation "
+                     f"{g['incarnation']}: {g['n_spans']} spans over "
+                     f"{g['wall_s']:.3f}s wall")
+        phases = sorted(g["phases"].items(),
+                        key=lambda kv: -kv[1]["total_s"])
+        for name, ph in phases:
+            share = ("" if ph["share"] is None
+                     else f"  {100 * ph['share']:5.1f}%")
+            lines.append(f"  {name:<16} {ph['count']:>6}x  "
+                         f"{ph['total_s']:>10.3f}s{share}")
+    for gap in summary.get("relaunch_gaps", []):
+        lines.append(f"relaunch gap: proc {gap['process']} incarnation "
+                     f"{gap['from_incarnation']} -> "
+                     f"{gap['to_incarnation']}: {gap['gap_s']:.3f}s quiet")
+    for c in summary.get("compiles", []):
+        lines.append(f"compiles: proc {c['process']} / incarnation "
+                     f"{c['incarnation']}: {c['n_compiles']} compile(s), "
+                     f"{c['compile_s']:.2f}s compiling "
+                     f"(+{c['lower_s']:.2f}s lowering)")
+        for name, n in c["by_name"].items():
+            lines.append(f"  {name:<40} x{n}")
+        for r in c["recompiles"]:
+            what = []
+            for k in ("changed", "added", "removed"):
+                if r.get(k):
+                    what.append(f"{k}: "
+                                + ", ".join(f"{p}"
+                                            + (f" {v['from']} -> {v['to']}"
+                                               if isinstance(v, dict)
+                                               else f" {v}")
+                                            for p, v in r[k].items()))
+            lines.append(f"  RECOMPILE {r['name']} (#{r['n_compile']}): "
+                         + ("; ".join(what) if what else "?"))
+    if not summary["groups"]:
+        lines.append("(no spans found)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir", help="a --trace_dir (or the trace/ "
+                                      "subdir of a --telemetry_dir)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the merged Chrome/Perfetto trace JSON "
+                         "here (default: <trace_dir>/trace.json)")
+    ap.add_argument("--no-chrome", action="store_true",
+                    help="summary only; skip writing trace.json")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.trace_dir):
+        print(f"ERROR: not a directory: {args.trace_dir}",
+              file=sys.stderr)
+        return 2
+    data = load_dir(args.trace_dir)
+    if not data["spans"] and not data["compiles"]:
+        print(f"ERROR: no trace-*.jsonl / compiles-*.jsonl records "
+              f"under {args.trace_dir}", file=sys.stderr)
+        return 2
+    summary = summarize(data)
+    if not args.no_chrome:
+        out = args.out or os.path.join(args.trace_dir, "trace.json")
+        with open(out, "w") as f:
+            json.dump(to_chrome(data), f)
+        summary["chrome_trace"] = out
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render_text(summary))
+        if "chrome_trace" in summary:
+            print(f"merged Perfetto trace -> {summary['chrome_trace']} "
+                  "(open in https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
