@@ -1203,7 +1203,8 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
                     or doc.get("paged_attn_artifact")
                     or doc.get("rl_artifact")
                     or doc.get("update_sharding_artifact")
-                    or doc.get("trace_artifact"))
+                    or doc.get("trace_artifact")
+                    or doc.get("prefix_cache_artifact"))
     return None
 
 
@@ -2283,6 +2284,176 @@ def bench_paged_attn(out_path: str = "BENCH_PAGED_ATTN.json") -> str:
     return out_path
 
 
+def bench_prefix_cache(out_path: str = "BENCH_PREFIX_CACHE.json") -> str:
+    """The prefix-cache bench (serve/paged_kv.py ``prefix_cache``): a
+    cache-OFF vs cache-ON A/B of the full continuous-batching service
+    loop at varying shared-prefix traffic ratios.  Both arms serve the
+    BYTE-IDENTICAL pre-generated request stream (serve.loadgen.
+    make_requests), and the row-level sha256 over every request's output
+    tokens pins greedy decode bitwise-equal cache on vs off — the
+    parity claim — while the deltas measure the two wins: cached-prefix
+    TTFT (admission skips the matched prefill chunks) and steady-state
+    blocks-in-use (shared blocks are resident once).  Interleaved
+    OFF/ON pairs per mix (DESIGN S7: grouping arms would let shared-host
+    load drift masquerade as a delta); the shared prefix length is NOT
+    block-aligned, so every shared-suffix admission also exercises the
+    copy-on-write fork path under measurement."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.models import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        Scheduler, ServeConfig, prewarm, run_closed_loop,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform not in ("cpu",)
+    c = (_LM if on_tpu else
+         dict(vocab=256, seq=128, d_model=64, n_layers=2, n_heads=4,
+              d_ff=128))
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=c["seq"], n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"]))
+    params = model.init(prng.init_key(0))
+
+    block_size = 16
+    slots = 8
+    max_len = c["seq"]
+    num_blocks = 1 + slots * (max_len // block_size)
+    # a small prefill chunk makes TTFT prefill-dominated (the quantity
+    # the cache attacks); the pool is non-starved so eviction policy
+    # stays out of the latency measurement
+    base = dict(slots=slots, num_blocks=num_blocks, block_size=block_size,
+                max_len=max_len, prefill_chunk=16)
+    # 72 = 4.5 blocks: a long system prompt ending MID-block, so a
+    # regenerated turn (0-token suffix — see loadgen.make_requests)
+    # full-hits and FORKS (CoW) under measurement, while distinct-suffix
+    # requests share the block-aligned 64 tokens
+    shared_len = 72
+    suffix_lens = (0, 12)
+    new_tokens = (8, 16)
+    clients, reqs_per_client, reps = 6, 3, 3
+    workload = dict(shared_prefix_len=shared_len,
+                    suffix_prompt_lens=list(suffix_lens),
+                    max_new=list(new_tokens), clients=clients,
+                    requests_per_client=reqs_per_client,
+                    interleaved_pairs=reps, seed=7)
+
+    def mk(on: bool):
+        return Scheduler(model, params,
+                         ServeConfig(**base, prefix_cache=on))
+
+    # pay every compile BEFORE measuring: prefill buckets + decode for
+    # both arms (same programs — prefix_cache is host-side), plus the
+    # CoW fork program, which only the ON arm can draw (two prompts
+    # sharing a non-aligned prefix force one fork)
+    prewarm(lambda: mk(False), prompt_lens=(4, shared_len + suffix_lens[1]))
+    warm = mk(True)
+    try:
+        a = warm.submit(list(range(1, shared_len + 3)), 2)
+        warm.run_until_drained()
+        b = warm.submit(list(range(1, shared_len + 3)) + [7], 2)
+        warm.run_until_drained()
+        warm.result(a), warm.result(b)
+        assert warm.server.cow_forks >= 1, "CoW prewarm drew no fork"
+    finally:
+        warm.close()
+
+    def med(vals):
+        return round(float(np.median(np.asarray(vals, np.float64))), 3)
+
+    mixes = []
+    for frac in (0.0, 0.5, 0.9):
+        pairs = []
+        for rep in range(reps):
+            pair = {}
+            for arm, on in (("off", False), ("on", True)):
+                sched = mk(on)
+                try:
+                    pair[arm] = run_closed_loop(
+                        sched, clients, reqs_per_client,
+                        vocab_size=c["vocab"], prompt_lens=suffix_lens,
+                        max_new=new_tokens, seed=workload["seed"],
+                        shared_prefix_len=shared_len,
+                        shared_fraction=frac)
+                finally:
+                    sched.server.allocator.assert_drained()
+                    sched.close()
+            pairs.append(pair)
+        ident = all(p["off"]["tokens_sha256"] == p["on"]["tokens_sha256"]
+                    for p in pairs)
+        ttft_key = ("ttft_ms_p50_shared" if frac > 0 else "ttft_ms_p50")
+        cold = [p["off"][ttft_key] for p in pairs]
+        cached = [p["on"][ttft_key] for p in pairs]
+        row = {
+            "shared_fraction": frac,
+            "tokens_identical": ident,
+            # the 0.0 mix has no shared class: its columns fall back to
+            # the all-requests TTFT (a no-sharing baseline, not the
+            # same population as the >0 mixes' shared-class numbers)
+            "ttft_population": ("shared_class" if frac > 0
+                                else "all_requests"),
+            "ttft_ms_p50_shared_cold": med(cold),
+            "ttft_ms_p50_shared_cached": med(cached),
+            "ttft_cached_over_cold": round(
+                med(cached) / max(1e-9, med(cold)), 4),
+            "tokens_per_sec_off": med(
+                [p["off"]["tokens_per_sec"] for p in pairs]),
+            "tokens_per_sec_on": med(
+                [p["on"]["tokens_per_sec"] for p in pairs]),
+            "blocks_in_use_mean_off": med(
+                [p["off"]["blocks_in_use_mean"] for p in pairs]),
+            "blocks_in_use_mean_on": med(
+                [p["on"]["blocks_in_use_mean"] for p in pairs]),
+            "blocks_in_use_peak_off": max(
+                p["off"]["blocks_in_use_peak"] for p in pairs),
+            "blocks_in_use_peak_on": max(
+                p["on"]["blocks_in_use_peak"] for p in pairs),
+            "ticks_off": med([p["off"]["ticks"] for p in pairs]),
+            "ticks_on": med([p["on"]["ticks"] for p in pairs]),
+            "prefix_cache_stats": pairs[-1]["on"].get("prefix_cache"),
+        }
+        mixes.append(row)
+        log(f"[prefix-cache] frac={frac}: TTFT "
+            f"{row['ttft_ms_p50_shared_cold']} -> "
+            f"{row['ttft_ms_p50_shared_cached']} ms, "
+            f"blocks {row['blocks_in_use_mean_off']} -> "
+            f"{row['blocks_in_use_mean_on']}, identical={ident}")
+
+    shared_mix = next(m for m in mixes if m["shared_fraction"] >= 0.5)
+    results = {
+        "model": {k: c[k] for k in ("vocab", "seq", "d_model", "n_layers")},
+        "serve_config": base,
+        "workload": workload,
+        "mixes": mixes,
+        "acceptance": {
+            "tokens_bitwise_identical_all_mixes": all(
+                m["tokens_identical"] for m in mixes),
+            "cached_ttft_below_cold_at_50pct_mix": (
+                shared_mix["ttft_ms_p50_shared_cached"]
+                < shared_mix["ttft_ms_p50_shared_cold"]),
+            "blocks_in_use_drop_at_50pct_mix": (
+                shared_mix["blocks_in_use_mean_on"]
+                < shared_mix["blocks_in_use_mean_off"]),
+        },
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": len(devices),
+        "note": ("interleaved OFF/ON pairs per mix; the parity evidence "
+                 "(tokens_identical) and the CURVES (cached vs cold "
+                 "TTFT, blocks-in-use vs shared fraction) are platform-"
+                 "independent; absolute tokens/s on the CPU fallback is "
+                 "a mechanism check at tiny shapes"),
+    }
+    out_path = _divert_cpu_overwrite(out_path, on_tpu)
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    log(f"prefix-cache bench -> {out_path}")
+    return out_path
+
+
 def bench_rl(out_path: str = "BENCH_RL.json") -> str:
     """The RL-workload bench (rl/): Anakin actor-learner throughput —
     env frames/s and updates/s of the fused rollout+GAE+PPO step at >= 2
@@ -2617,6 +2788,15 @@ def main() -> int:
                          "sweep; write BENCH_PAGED_ATTN.json")
     ap.add_argument("--paged-attn-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="prefix-cache bench (serve/ prefix_cache): "
+                         "interleaved cache-off/on A/B of the service "
+                         "loop at 0/50/90%% shared-prefix traffic — "
+                         "cached vs cold TTFT, blocks-in-use, tokens/s, "
+                         "bitwise token-identity pin; write "
+                         "BENCH_PREFIX_CACHE.json")
+    ap.add_argument("--prefix-cache-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--rl", action="store_true",
                     help="RL-workload bench (rl/): Anakin actor-learner "
                          "env frames/s + updates/s at >= 2 env counts, "
@@ -2685,6 +2865,9 @@ def main() -> int:
     if args.paged_attn_inproc:
         print(json.dumps({"paged_attn_artifact": bench_paged_attn()}))
         return 0
+    if args.prefix_cache_inproc:
+        print(json.dumps({"prefix_cache_artifact": bench_prefix_cache()}))
+        return 0
     if args.rl_inproc:
         print(json.dumps({"rl_artifact": bench_rl()}))
         return 0
@@ -2697,8 +2880,8 @@ def main() -> int:
         return 0
 
     if (args.attention or args.decode or args.serve or args.rl
-            or args.paged_attn or args.update_sharding_ab
-            or args.trace_overhead):
+            or args.paged_attn or args.prefix_cache
+            or args.update_sharding_ab or args.trace_overhead):
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -2732,6 +2915,13 @@ def main() -> int:
             else:
                 path = bench_paged_attn()
             print(json.dumps({"paged_attn_artifact": path}))
+        if args.prefix_cache:
+            if choice == "cpu":
+                # host-side sharing over one device, like --serve
+                path = _run_flag_cpu_child("--prefix-cache-inproc", 1)
+            else:
+                path = bench_prefix_cache()
+            print(json.dumps({"prefix_cache_artifact": path}))
         if args.rl:
             if choice == "cpu":
                 # env sharding needs a data axis: 8 virtual devices

@@ -11,7 +11,11 @@ when full".  This package is the service-shaped runtime above it:
   materialization, the parity reference) or ``'fused'`` (the Pallas
   paged-attention kernel, ``ops.pallas_kernels.paged_attention``, which
   reads K/V straight from the pool and stops at each stream's true
-  length — the FLOPs win on top of the memory win).
+  length — the FLOPs win on top of the memory win).  With
+  ``prefix_cache=True`` identical prompt prefixes share blocks across
+  streams (refcounts + a host-side prefix index + copy-on-write forks),
+  so a cached prefix admits without re-prefilling — near-zero TTFT for
+  shared system prompts.
 * :mod:`serve.scheduler` — a continuous-batching scheduler: bounded
   wait queue, per-tick admit/retire, chunked prefill interleaved with
   decode, admission control gated on free blocks + token budget, and
@@ -27,14 +31,15 @@ from .paged_kv import (
     BlockAllocator,
     BlockExhausted,
     PagedDecodeServer,
+    PrefixIndex,
     init_paged_kv,
 )
 from .paged_kv import ATTN_IMPLS
 from .scheduler import Request, Scheduler, ServeConfig
-from .loadgen import prewarm, run_closed_loop, sweep_loads
+from .loadgen import make_requests, prewarm, run_closed_loop, sweep_loads
 
 __all__ = [
     "ATTN_IMPLS", "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
-    "init_paged_kv", "Request", "Scheduler", "ServeConfig",
-    "prewarm", "run_closed_loop", "sweep_loads",
+    "PrefixIndex", "init_paged_kv", "Request", "Scheduler", "ServeConfig",
+    "make_requests", "prewarm", "run_closed_loop", "sweep_loads",
 ]

@@ -12,10 +12,24 @@ included — that is what a client experiences) and mean ITL (decode span
 / (new_tokens - 1)); the sweep reports p50/p99 of each across requests,
 plus aggregate generated tokens/s.  ``bench.py --serve`` drives
 :func:`sweep_loads` at >= 3 offered loads into ``BENCH_SERVE.json``.
+
+**Shared-prefix traffic mixes** (``shared_prefix_len`` /
+``shared_fraction``): real chat fleets share system prompts, so a
+seeded fraction of requests prepend one fixed shared prefix to their
+random suffix — the workload the prefix cache (``ServeConfig.
+prefix_cache``) exists for.  The request stream is pre-generated
+per seed (client-major, independent of queue dynamics), so a cache-off
+and a cache-on arm serve BYTE-IDENTICAL requests and the row's
+``tokens_sha256`` digest pins greedy output equality across the A/B
+(``bench.py --prefix-cache`` -> BENCH_PREFIX_CACHE.json).  TTFT
+percentiles split by class (shared-prefix vs unique) and per-tick
+blocks-in-use peak/mean expose the two wins: cached-prefix TTFT and
+pool residency.
 """
 
 from __future__ import annotations
 
+import hashlib
 import time
 from typing import Any, Dict, List, Optional
 
@@ -65,43 +79,102 @@ def prewarm(make_scheduler, *, prompt_lens=(4, 24)) -> None:
         sched.close()
 
 
+def make_requests(clients: int, requests_per_client: int, *,
+                  vocab_size: int, prompt_lens=(4, 24), max_new=(8, 32),
+                  seed: int = 0, shared_prefix_len: int = 0,
+                  shared_fraction: float = 0.0
+                  ) -> List[List[Dict[str, Any]]]:
+    """Pre-generate every client's request list (client-major, one RNG
+    pass) so the stream is a pure function of the arguments — queue
+    dynamics (rejections, completion order) cannot perturb which
+    requests get generated, which is what lets two scheduler arms serve
+    byte-identical traffic for an A/B.  With ``shared_prefix_len`` > 0,
+    a ``shared_fraction`` of requests prepend ONE fixed shared prefix
+    (drawn first from the same seed) to their random suffix."""
+    rng = np.random.default_rng(seed)
+    shared = (rng.integers(0, vocab_size, (shared_prefix_len,)).tolist()
+              if shared_prefix_len > 0 else [])
+    out: List[List[Dict[str, Any]]] = []
+    for _ in range(int(clients)):
+        reqs = []
+        for _ in range(int(requests_per_client)):
+            p = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+            n = int(rng.integers(max_new[0], max_new[1] + 1))
+            is_shared = bool(shared
+                             and rng.random() < float(shared_fraction))
+            if not is_shared:
+                p = max(1, p)     # a bare prompt needs >= 1 token; a
+                #                   0-suffix SHARED request is legal (a
+                #                   regenerated turn: the prompt IS the
+                #                   shared prefix — the full-hit + CoW
+                #                   path)
+            suffix = rng.integers(0, vocab_size, (p,)).tolist()
+            reqs.append({"prompt": shared + suffix if is_shared
+                         else suffix,
+                         "max_new": n, "shared": is_shared})
+        out.append(reqs)
+    return out
+
+
 def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                     *, vocab_size: int, prompt_lens=(4, 24),
                     max_new=(8, 32), seed: int = 0,
                     slo_ms: Optional[float] = None,
+                    shared_prefix_len: int = 0,
+                    shared_fraction: float = 0.0,
                     max_ticks: int = 200_000) -> Dict[str, Any]:
     """Drive ``scheduler`` with ``clients`` closed-loop clients until
     each has completed ``requests_per_client`` requests; returns the
-    measured row (tokens/s, TTFT/ITL percentiles, counters).
+    measured row (tokens/s, TTFT/ITL percentiles — split by shared/
+    unique class under a shared-prefix mix — per-tick blocks-in-use,
+    counters, and a sha256 of every request's output tokens in
+    submission order for cross-arm identity pins).
 
-    Prompt lengths and output budgets are drawn uniformly from the
-    given inclusive ranges with a seeded RNG, so a sweep's load points
-    serve the same request mix."""
-    rng = np.random.default_rng(seed)
-    remaining = [int(requests_per_client)] * int(clients)
+    The request stream comes from :func:`make_requests` — a pure
+    function of the arguments — so a sweep's load points (and an A/B's
+    arms) serve the same request mix."""
+    plan = make_requests(clients, requests_per_client,
+                         vocab_size=vocab_size, prompt_lens=prompt_lens,
+                         max_new=max_new, seed=seed,
+                         shared_prefix_len=shared_prefix_len,
+                         shared_fraction=shared_fraction)
+    next_idx = [0] * int(clients)
     outstanding: List[Optional[int]] = [None] * int(clients)
     finished: List[int] = []
+    shared_rids: set = set()
+    results: Dict[int, tuple] = {}    # rid -> (client, idx, tokens)
     submit_retries = 0
+    blocks_peak = 0
+    blocks_sum = 0
+    n_ticks = 0
     t0 = time.perf_counter()
     for _ in range(max_ticks):
         for ci in range(clients):
-            if outstanding[ci] is not None or remaining[ci] <= 0:
+            if outstanding[ci] is not None or \
+                    next_idx[ci] >= requests_per_client:
                 continue
-            p = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
-            n = int(rng.integers(max_new[0], max_new[1] + 1))
-            prompt = rng.integers(0, vocab_size, (p,)).tolist()
-            rid = scheduler.submit(prompt, n, slo_ms=slo_ms)
+            req = plan[ci][next_idx[ci]]
+            rid = scheduler.submit(req["prompt"], req["max_new"],
+                                   slo_ms=slo_ms)
             if rid is None:           # bounded queue full: retry next tick
                 submit_retries += 1
                 continue
+            if req["shared"]:
+                shared_rids.add(rid)
+            results[rid] = (ci, next_idx[ci], None)
             outstanding[ci] = rid
-            remaining[ci] -= 1
+            next_idx[ci] += 1
         for rid in scheduler.tick():
             ci = outstanding.index(rid)
             outstanding[ci] = None
             finished.append(rid)
-            scheduler.result(rid)     # consume tokens; timings stay
-        if not any(r > 0 for r in remaining) and \
+            c, i, _ = results[rid]
+            results[rid] = (c, i, scheduler.result(rid))
+        used = scheduler.server.allocator.used_blocks
+        blocks_peak = max(blocks_peak, used)
+        blocks_sum += used
+        n_ticks += 1
+        if all(i >= requests_per_client for i in next_idx) and \
                 all(o is None for o in outstanding):
             break
     else:
@@ -110,7 +183,13 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
     stats = [scheduler.stats(rid) for rid in finished]
     ttft = [s.ttft_ms for s in stats if s.ttft_ms is not None]
     itl = [s.itl_ms for s in stats if s.itl_ms is not None]
-    return {
+    # output-identity digest: every request's tokens in SUBMISSION order
+    # (client-major), so two arms serving the same plan hash equal iff
+    # every generated token matches
+    h = hashlib.sha256()
+    for ci, i, toks in sorted(results.values()):
+        h.update(repr((ci, i, toks)).encode())
+    row = {
         "clients": int(clients),
         "requests": len(finished),
         "wall_s": round(wall, 3),
@@ -124,13 +203,31 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
         "evicted": scheduler.evicted,
         "submit_retries": submit_retries,
         "deadline_missed": sum(1 for s in stats if s.deadline_missed),
+        "blocks_in_use_peak": blocks_peak,
+        "blocks_in_use_mean": round(blocks_sum / max(1, n_ticks), 2),
+        "tokens_sha256": h.hexdigest(),
     }
+    if shared_prefix_len > 0:
+        row["shared_prefix_len"] = int(shared_prefix_len)
+        row["shared_fraction"] = float(shared_fraction)
+        row["shared_requests"] = len(shared_rids)
+        for cls, rids in (("shared", shared_rids),
+                          ("unique", set(finished) - shared_rids)):
+            vals = [scheduler.stats(r).ttft_ms for r in rids
+                    if scheduler.stats(r).ttft_ms is not None]
+            row[f"ttft_ms_p50_{cls}"] = _pct(vals, 50)
+            row[f"ttft_ms_p99_{cls}"] = _pct(vals, 99)
+    if getattr(scheduler.cfg, "prefix_cache", False):
+        row["prefix_cache"] = scheduler.server.prefix_stats()
+    return row
 
 
 def sweep_loads(make_scheduler, loads: List[int],
                 requests_per_client: int, *, vocab_size: int,
                 prompt_lens=(4, 24), max_new=(8, 32), seed: int = 0,
                 slo_ms: Optional[float] = None,
+                shared_prefix_len: int = 0,
+                shared_fraction: float = 0.0,
                 warm: bool = True) -> List[Dict[str, Any]]:
     """One :func:`run_closed_loop` row per offered load (client count),
     a FRESH scheduler each (``make_scheduler()`` factory) so load points
@@ -146,7 +243,8 @@ def sweep_loads(make_scheduler, loads: List[int],
             rows.append(run_closed_loop(
                 sched, c, requests_per_client, vocab_size=vocab_size,
                 prompt_lens=prompt_lens, max_new=max_new, seed=seed,
-                slo_ms=slo_ms))
+                slo_ms=slo_ms, shared_prefix_len=shared_prefix_len,
+                shared_fraction=shared_fraction))
         finally:
             sched.close()
     return rows

@@ -53,13 +53,42 @@ advance deterministically, one per active slot per step), so the decode
 loop performs zero per-token device syncs — the discipline the trainer's
 monitor uses, taken to its limit (see the satellite fix in
 ``models/serve.py``).
+
+**Prefix caching + copy-on-write** (``prefix_cache=True``): real chat
+traffic shares system prompts, and the block-table indirection above is
+one refcount away from sharing the identical prefix K/V across streams
+(vLLM's insight applied at admission; SGLang's RadixAttention shows the
+hit rates a prefix-matched block store reaches on chat/agentic mixes).
+A host-side :class:`PrefixIndex` maps hash-chained token chunks at block
+granularity to resident blocks; ``try_admit`` longest-matches a new
+prompt against it and points the matched table entries at the EXISTING
+blocks instead of allocating and prefilling them — a fully cached prefix
+admits with only the last prompt token left to prefill (its logits seed
+the first sampled token), so TTFT collapses to the remaining-suffix
+prefill.  :class:`BlockAllocator` grows per-block refcounts: a matched
+in-use block is ``share()``d (refcount + 1), a matched cached-FREE block
+(refcount 0, content intact, sitting in the allocator's LRU side of the
+free list) is ``reuse_cached()``d, and fresh allocation under pressure
+evicts cached-free blocks LRU-first (invalidating their index entries).
+Sharing is read-only by construction: a stream may write ONLY blocks it
+owns, and when its matched prefix ends mid-block the first write past
+the shared boundary triggers **copy-on-write** — a fresh block (reserved
+at admission, so the fork can never fail mid-prefill) receives the
+shared block's contents via one on-device copy program (traced src/dst
+scalars: forks never recompile), the table is repointed, and the share
+is released.  This extends the block-0 sink invariant's discipline —
+"nothing writes a block another stream can read" — to shared blocks,
+asserted on every prefill chunk and decode step.  ``assert_drained``
+extends to "all refcounts zero": after a drain every block is either
+plain-free or cached-free, never referenced.
 """
 
 from __future__ import annotations
 
+import collections
 import functools
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -105,13 +134,19 @@ class BlockExhausted(RuntimeError):
 
 
 class BlockAllocator:
-    """Free-list allocator over block ids ``1..num_blocks-1`` (0 is the
-    sink).  Leak-proof by construction: every id is either in the free
-    list or in ``in_use``, ``free()`` of a foreign/double-freed id raises,
-    and :meth:`assert_drained` pins the balance at zero after a drain
-    (the fuzz test's invariant)."""
+    """Refcounted free-list allocator over block ids ``1..num_blocks-1``
+    (0 is the sink).  A block is in one of three states: **in use**
+    (refcount >= 1 — several streams may share one block), **cached-free**
+    (refcount 0 but still holding prefix-cache content: allocatable, kept
+    in LRU order and evicted under pressure via ``on_cache_evict``), or
+    **plain free**.  Leak-proof by construction: every id is in exactly
+    one state, :meth:`release` of a block with no references raises (the
+    double-free hard error — ALL frees route through this one path), and
+    :meth:`assert_drained` pins every refcount at zero with the free
+    balance equal to capacity after a drain (the fuzz invariant)."""
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, num_blocks: int,
+                 on_cache_evict: Optional[Callable[[int], None]] = None):
         if num_blocks < 2:
             raise ValueError(f"num_blocks {num_blocks} < 2: block 0 is "
                              "the reserved sink, so a usable pool needs "
@@ -119,7 +154,13 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # pop from the tail -> ascending ids hand out first (stable tests)
         self._free = list(range(self.num_blocks - 1, 0, -1))
-        self._in_use: set = set()
+        # cached-free: refcount 0, prefix content intact; insertion order
+        # = release order, so popitem(last=False) is LRU eviction
+        self._cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._cached_ids: set = set()   # blocks carrying a cache identity
+        self._ref: Dict[int, int] = {}  # in-use refcounts (>= 1)
+        self._on_cache_evict = on_cache_evict
 
     @property
     def capacity(self) -> int:
@@ -128,39 +169,152 @@ class BlockAllocator:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: plain free + cached-free (a cached block
+        costs nothing to keep — it is reclaimed LRU-first on demand)."""
+        return len(self._free) + len(self._cached)
 
     @property
     def used_blocks(self) -> int:
-        return len(self._in_use)
+        return len(self._ref)
+
+    @property
+    def cached_free_blocks(self) -> int:
+        return len(self._cached)
+
+    @property
+    def shared_extra(self) -> int:
+        """Extra references across all shared blocks — the number of
+        block allocations sharing is saving RIGHT NOW."""
+        return sum(r - 1 for r in self._ref.values() if r > 1)
+
+    def refcount(self, b: int) -> int:
+        return self._ref.get(b, 0)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` block ids, or None when the pool cannot satisfy the
-        request (all-or-nothing: no partial grants to roll back)."""
+        """``n`` fresh block ids at refcount 1, or None when the pool
+        cannot satisfy the request (all-or-nothing: nothing is evicted
+        or granted on refusal).  Plain-free blocks hand out first;
+        beyond them, cached-free blocks are reclaimed LRU-first, their
+        index entries invalidated via ``on_cache_evict``."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > self.free_blocks:
             return None
-        out = [self._free.pop() for _ in range(n)]
-        self._in_use.update(out)
+        out = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:
+                b, _ = self._cached.popitem(last=False)   # LRU victim
+                self._cached_ids.discard(b)
+                if self._on_cache_evict is not None:
+                    self._on_cache_evict(b)
+            self._ref[b] = 1
+            out.append(b)
         return out
 
-    def free(self, blocks: List[int]) -> None:
+    def share(self, b: int) -> None:
+        """One more reader of an in-use block (a cache-hit admission
+        mapping its table onto an existing block)."""
+        if b not in self._ref:
+            raise ValueError(f"share of block {b} not in use")
+        self._ref[b] += 1
+
+    def reuse_cached(self, b: int) -> None:
+        """Revive a specific cached-free block (refcount 0 -> 1) — a
+        cache hit on content whose last reader already finished."""
+        if b not in self._cached:
+            raise ValueError(f"reuse_cached of block {b} not cached-free")
+        del self._cached[b]
+        self._ref[b] = 1
+
+    def release(self, blocks: List[int]) -> None:
+        """THE single release path: drop one reference per listed block.
+        A block reaching refcount 0 returns to the free list — the
+        cached-free LRU side when it carries prefix content, plain
+        otherwise.  Releasing a block with no references is a hard error
+        (double free of a shared block, foreign id, or the sink)."""
         for b in blocks:
-            if b not in self._in_use:
-                raise ValueError(f"free of block {b} not in use (double "
-                                 "free or foreign id)")
-            self._in_use.remove(b)
-            self._free.append(b)
+            r = self._ref.get(b)
+            if r is None:
+                raise ValueError(f"release of block {b} not in use "
+                                 "(double free or foreign id)")
+            if r > 1:
+                self._ref[b] = r - 1
+            else:
+                del self._ref[b]
+                if b in self._cached_ids:
+                    self._cached[b] = None      # MRU end of the LRU queue
+                else:
+                    self._free.append(b)
+
+    def free(self, blocks: List[int]) -> None:
+        """Alias of :meth:`release` kept for callers predating refcounts
+        — every free routes through the one release path, so a double
+        free of a shared block raises instead of silently re-pooling a
+        block someone still reads."""
+        self.release(blocks)
+
+    def mark_cached(self, b: int) -> None:
+        """Tag a block as carrying prefix-cache content: when its last
+        reference drops it parks in the cached-free LRU instead of the
+        plain free list."""
+        self._cached_ids.add(b)
 
     def assert_drained(self) -> None:
-        if self._in_use:
-            raise AssertionError(f"block leak: {sorted(self._in_use)} "
-                                 "still in use after drain")
-        if len(self._free) != self.capacity:
+        if self._ref:
             raise AssertionError(
-                f"free-list balance {len(self._free)} != capacity "
-                f"{self.capacity}")
+                "block leak: refcounts not drained after quiesce: "
+                f"{dict(sorted(self._ref.items()))}")
+        if len(self._free) + len(self._cached) != self.capacity:
+            raise AssertionError(
+                f"free-list balance {len(self._free)} plain + "
+                f"{len(self._cached)} cached != capacity {self.capacity}")
+
+
+class PrefixIndex:
+    """Host-side prefix-cache index: hash-chained token chunks at block
+    granularity -> resident block id.  A key is ``(parent_key,
+    tokens_tuple)`` — the EXACT token ids, so a hit can never be a hash
+    collision, and nesting shares structure with the parent key (O(1)
+    extra per entry).  Full prompt blocks chain with ``tokens_tuple`` of
+    ``block_size`` ids; the final partial prompt block registers under
+    the same scheme with a shorter tuple.  One identity per block, at
+    most one block per key (first writer wins); entries are invalidated
+    when the allocator reclaims their block."""
+
+    def __init__(self):
+        self._map: Dict[Tuple, int] = {}
+        self._key_of: Dict[int, Tuple] = {}
+        # bumped on every mutation: lookup results are pure functions of
+        # (prompt, version), which is what lets the server memoize the
+        # admission lookup (admit_need + try_admit + a blocked queue
+        # head re-polling every tick would otherwise re-hash the whole
+        # prompt each time)
+        self.version = 0
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def get(self, key: Tuple) -> Optional[int]:
+        return self._map.get(key)
+
+    def insert(self, key: Tuple, block: int) -> bool:
+        """Register ``block`` under ``key``; False when the key is
+        already claimed (a concurrent identical prefill — first writer
+        wins) or the block already carries another identity."""
+        if key in self._map or block in self._key_of:
+            return False
+        self._map[key] = block
+        self._key_of[block] = key
+        self.version += 1
+        return True
+
+    def invalidate_block(self, block: int) -> None:
+        key = self._key_of.pop(block, None)
+        if key is not None and self._map.get(key) == block:
+            del self._map[key]
+            self.version += 1
 
 
 def init_paged_kv(model: Transformer, num_blocks: int, block_size: int,
@@ -187,13 +341,14 @@ def init_paged_kv(model: Transformer, num_blocks: int, block_size: int,
 def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
                     temperature: float, top_k: int, top_p: float,
                     kv_quant: bool = False, attn_impl: str = "gathered"):
-    """The two jitted programs of a paged server: chunk prefill (one per
-    power-of-two chunk bucket, via jit's shape cache) and the batched
-    decode step.  Cached per (model, geometry, sampling, attn_impl) so
-    several servers compile once.  ``attn_impl='fused'`` swaps the
-    gathered attention for the Pallas paged kernel; everything else
-    (scatter coordinates, sampling, bookkeeping) is shared, which is what
-    makes gathered-vs-fused an attention-only A/B."""
+    """The three jitted programs of a paged server: chunk prefill (one
+    per power-of-two chunk bucket, via jit's shape cache), the batched
+    decode step, and the copy-on-write block copy (``serve_cow``).
+    Cached per (model, geometry, sampling, attn_impl) so several
+    servers compile once.  ``attn_impl='fused'`` swaps the gathered
+    attention for the Pallas paged kernel; everything else (scatter
+    coordinates, sampling, bookkeeping) is shared, which is what makes
+    gathered-vs-fused an attention-only A/B."""
     bs, mb = int(block_size), int(max_blocks)
     t_cap = bs * mb
     c = model.cfg
@@ -346,12 +501,25 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
         pos = jnp.where(active, jnp.minimum(pos + 1, cap), pos)
         return new_pools, tokens, pos, key
 
+    def cow(pools, src, dst):
+        """Copy-on-write fork: duplicate block row ``src`` into the
+        stream-owned ``dst`` across every layer's pool tensors (K, V and
+        the int8 scale pools alike).  ``src``/``dst`` are TRACED scalars,
+        so fork churn reuses one compiled program — the same discipline
+        that keeps table churn recompile-free.  The whole block row
+        copies (positions past the shared prefix are overwritten by the
+        forking stream's own writes before they are ever attended)."""
+        return jax.tree_util.tree_map(
+            lambda p: p.at[dst].set(p[src]), pools)
+
     # compile-ledger seam (utils/compile_ledger): while a ledger is
     # installed every distinct compile of the serve programs is recorded
     # — which is how the "block-table churn never recompiles" invariant
     # becomes a production assertion instead of a test-only cache count
     # (tables/lengths are traced args; only a NEW prefill bucket width
-    # may legitimately add an entry)
+    # may legitimately add an entry).  Cache-hit admissions, CoW forks
+    # and shared-block evictions ride the same contract: src/dst/table
+    # values are runtime data, so the ledger stays flat.
     from ..utils import compile_ledger as ledger_lib
 
     tag = (f"bs{bs}x{mb}" + ("/int8" if kv_quant else "")
@@ -359,7 +527,9 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
     return (ledger_lib.instrument(jax.jit(prefill, donate_argnums=(1,)),
                                   f"serve_prefill[{tag}]"),
             ledger_lib.instrument(jax.jit(step, donate_argnums=(1, 2, 4)),
-                                  f"serve_decode[{tag}]"))
+                                  f"serve_decode[{tag}]"),
+            ledger_lib.instrument(jax.jit(cow, donate_argnums=(0,)),
+                                  f"serve_cow[{tag}]"))
 
 
 @dataclass
@@ -371,6 +541,17 @@ class _Stream:
     target: int                       # prompt_len + max_new
     blocks: List[int] = field(default_factory=list)
     prefilled: int = 0                # prompt tokens written so far
+    # prefix-cache state: the leading n_shared table entries are BORROWED
+    # (read-only — owned by the index/another stream); fork_pending is
+    # the block reserved at admission for the copy-on-write fork of a
+    # borrowed PARTIAL tail (None when the match ended on a block
+    # boundary); chain_key/registered_tokens track how far this stream's
+    # own prompt blocks have been registered into the prefix index
+    n_shared: int = 0
+    fork_pending: Optional[int] = None
+    chain_key: Any = None
+    registered_tokens: int = 0
+    shared_at_admit: int = 0          # matched prefix tokens (stats)
 
 
 class PagedDecodeServer:
@@ -384,7 +565,8 @@ class PagedDecodeServer:
                  block_size: int = 16, max_len: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, seed: int = 0,
-                 kv_quant: bool = False, attn_impl: str = "gathered"):
+                 kv_quant: bool = False, attn_impl: str = "gathered",
+                 prefix_cache: bool = False):
         c = model.cfg
         self.model, self.params = model, params
         self.slots = int(slots)
@@ -396,14 +578,29 @@ class PagedDecodeServer:
         self.max_blocks = -(-self.max_len // self.block_size)   # ceil
         self.t_cap = self.max_blocks * self.block_size
         self.num_blocks = int(num_blocks)
-        self.allocator = BlockAllocator(self.num_blocks)
+        self.prefix_cache = bool(prefix_cache)
+        self.prefix = PrefixIndex()
+        self.allocator = BlockAllocator(
+            self.num_blocks,
+            on_cache_evict=self._on_cache_evict if self.prefix_cache
+            else None)
+        # prefix-cache counters (host arithmetic; the scheduler folds
+        # them into kind="serve" telemetry records)
+        self.prefix_hits = 0          # admissions with matched_len > 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0    # prompt tokens served from cache
+        self.prompt_tokens_admitted = 0
+        self.cow_forks = 0            # copy-on-write block forks
+        self.cache_evictions = 0      # cached-free blocks reclaimed (LRU)
+        self.blocks_shared_total = 0  # cumulative matched blocks at admit
+        self._lookup_memo = None      # (prompt, index-version) -> walk
         self._sampling = (float(temperature), int(top_k), float(top_p))
         self.kv_quant = bool(kv_quant)
         if attn_impl not in ATTN_IMPLS:
             raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, "
                              f"got {attn_impl!r}")
         self.attn_impl = attn_impl
-        self._prefill_fn, self._step_fn = _paged_programs(
+        self._prefill_fn, self._step_fn, self._cow_fn = _paged_programs(
             model, self.block_size, self.max_blocks, *self._sampling,
             self.kv_quant, self.attn_impl)
         self.pools = init_paged_kv(model, self.num_blocks,
@@ -463,15 +660,133 @@ class PagedDecodeServer:
                 "padded_keys": n_active * self.t_cap,
                 "active_streams": n_active}
 
+    # ---- prefix cache --------------------------------------------------
+    def _on_cache_evict(self, block: int) -> None:
+        """Allocator callback: a cached-free block is being reclaimed
+        for fresh use — its prefix identity must die with it."""
+        self.prefix.invalidate_block(block)
+        self.cache_evictions += 1
+
+    def _prefix_lookup(self, prompt_ids: List[int]
+                       ) -> Tuple[List[Tuple[int, int]], Any, int]:
+        """Longest prefix match of ``prompt_ids`` against the index:
+        returns ``(entries, chain_key, matched_len)`` where ``entries``
+        is ``[(block, used_tokens), ...]`` (all full ``block_size``
+        chunks except possibly a final partial), ``chain_key`` is the
+        index key after the FULL matches (the new stream's registration
+        resumes there), and ``matched_len <= len(prompt) - 1`` — the
+        last prompt token is always left to prefill so its logits can
+        seed the first sampled token (the vLLM full-hit rule).
+
+        Memoized on ``(prompt, index version)``: the scheduler's
+        ``admit_need`` pre-check, the ``try_admit`` that follows it in
+        the same tick, and a queue head re-polled across ticks while
+        blocked all reuse one walk instead of re-hashing the prompt.
+        Refcount churn cannot stale the cache — it changes how a matched
+        block is PINNED (share vs reuse), which both callers read live,
+        never which blocks match."""
+        key = (tuple(prompt_ids), self.prefix.version)
+        if self._lookup_memo is not None and self._lookup_memo[0] == key:
+            return self._lookup_memo[1]
+        out = self._prefix_walk(prompt_ids)
+        self._lookup_memo = (key, out)
+        return out
+
+    def _prefix_walk(self, prompt_ids: List[int]
+                     ) -> Tuple[List[Tuple[int, int]], Any, int]:
+        p = len(prompt_ids)
+        cap = p - 1             # never match the final prompt token
+        bs = self.block_size
+        entries: List[Tuple[int, int]] = []
+        chain: Any = None
+        off = 0
+        while off + bs <= cap:
+            key = (chain, tuple(prompt_ids[off:off + bs]))
+            b = self.prefix.get(key)
+            if b is None:
+                break
+            entries.append((b, bs))
+            chain = key
+            off += bs
+        # partial tail: the longest registered chunk that prefixes the
+        # remaining prompt (a FULL block's entry also serves here when
+        # the cap truncates it — the overhang is recomputed after the
+        # CoW fork); usable tokens stop at the cap
+        for length in range(min(bs, p - off), 0, -1):
+            b = self.prefix.get((chain, tuple(prompt_ids[off:off + length])))
+            if b is not None:
+                usable = min(length, cap - off)
+                if usable > 0:
+                    entries.append((b, usable))
+                    off += usable
+                break
+        return entries, chain, off
+
+    def admit_need(self, prompt_ids, max_new_tokens: int,
+                   full_residency: bool = False) -> int:
+        """Free-list consumption :meth:`try_admit` would require right
+        now: the raw block count for prompt+1 (or the stream's FULL
+        residency when ``full_residency`` — the scheduler's anti-thrash
+        gate for previously evicted requests) minus the matched prefix
+        blocks that are currently IN USE (shared references consume no
+        free block; matched cached-FREE blocks still occupy a free-list
+        slot), plus the one reserved CoW fork block when the match ends
+        mid-block."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        p = len(prompt_ids)
+        base = self.blocks_for(p + max_new_tokens if full_residency
+                               else p + 1)
+        if not self.prefix_cache:
+            return base
+        entries, _, matched_len = self._prefix_lookup(prompt_ids)
+        n_in_use = sum(1 for b, _ in entries
+                       if self.allocator.refcount(b) > 0)
+        fork = 1 if matched_len % self.block_size else 0
+        return max(0, base - n_in_use + fork)
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Prefix-cache accounting (host arithmetic, no device traffic):
+        cumulative hit/fork/eviction counters plus the instantaneous
+        sharing state — ``shared_blocks`` is the number of allocations
+        sharing is saving right now (sum of refcount-1 over shared
+        blocks), ``cached_free_blocks`` the reusable content parked in
+        the allocator's LRU."""
+        return {
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prompt_tokens_admitted": self.prompt_tokens_admitted,
+            "cow_forks": self.cow_forks,
+            "cache_evictions": self.cache_evictions,
+            "blocks_saved": self.blocks_shared_total,
+            "shared_blocks": self.allocator.shared_extra,
+            "cached_free_blocks": self.allocator.cached_free_blocks,
+        }
+
+    def shared_token_discount(self) -> int:
+        """Upper-bound estimate of committed tokens double-counted by
+        refcount sharing (each extra reference of a shared block holds
+        at most ``block_size`` token positions once, not once per
+        stream) — the scheduler subtracts this from its token-budget
+        accounting so shared residency is not double-charged."""
+        return self.allocator.shared_extra * self.block_size
+
     # ---- admission -----------------------------------------------------
     def try_admit(self, prompt_ids, max_new_tokens: int) -> Optional[int]:
         """Reserve a slot + the blocks covering the prompt and the first
         generated token; no model compute happens here (the scheduler
-        interleaves the prefill chunks).  Returns a request id, or None
-        when a slot or the initial blocks are unavailable.  Raises for a
-        request this server could NEVER hold (over max_len, or more
-        total blocks than the pool owns) — returning None there would
-        make a retry loop spin forever."""
+        interleaves the prefill chunks).  Under ``prefix_cache``, the
+        longest indexed prefix of the prompt maps onto EXISTING blocks —
+        in-use blocks gain a reference, cached-free blocks revive — and
+        only the unmatched remainder allocates fresh (plus one reserved
+        fork block when the match ends mid-block, so the copy-on-write
+        fork can never fail mid-prefill); ``prefilled`` starts at the
+        matched length, so the scheduler skips those prefill chunks
+        entirely.  Returns a request id, or None when a slot or the
+        blocks are unavailable.  Raises for a request this server could
+        NEVER hold (over max_len, or more total blocks than the pool
+        owns) — returning None there would make a retry loop spin
+        forever."""
         prompt_ids = [int(t) for t in prompt_ids]
         p = len(prompt_ids)
         if p == 0:
@@ -489,16 +804,50 @@ class PagedDecodeServer:
                 f"has {self.allocator.capacity}: unservable at any load")
         if not self.free_slots():
             return None
-        blocks = self.allocator.alloc(self.blocks_for(p + 1))
-        if blocks is None:
+        entries: List[Tuple[int, int]] = []
+        chain: Any = None
+        matched_len = 0
+        if self.prefix_cache:
+            entries, chain, matched_len = self._prefix_lookup(prompt_ids)
+        partial = matched_len % self.block_size != 0
+        # fresh blocks: the prompt+1 span not covered by the match, plus
+        # the reserved CoW fork target for a mid-block match boundary
+        need_fresh = (self.blocks_for(p + 1) - len(entries)
+                      + (1 if partial else 0))
+        n_reuse = sum(1 for b, _ in entries
+                      if self.allocator.refcount(b) == 0)
+        if need_fresh + n_reuse > self.allocator.free_blocks:
             return None
+        # pin the matched blocks FIRST so the fresh allocation's LRU
+        # eviction can never reclaim one of them
+        for b, _ in entries:
+            if self.allocator.refcount(b) > 0:
+                self.allocator.share(b)
+            else:
+                self.allocator.reuse_cached(b)
+        fresh = self.allocator.alloc(need_fresh) if need_fresh else []
+        assert fresh is not None    # capacity checked above
+        fork_reserve = fresh.pop() if partial else None
+        if matched_len:
+            self.prefix_hits += 1
+            self.prefix_hit_tokens += matched_len
+            self.blocks_shared_total += len(entries)
+        elif self.prefix_cache:
+            self.prefix_misses += 1
+        self.prompt_tokens_admitted += p
+        blocks = [b for b, _ in entries] + fresh
+        n_full = len(entries) - (1 if partial else 0)
         slot = next(s for s in range(self.slots)
                     if s not in self._slot_of.values())
         rid = self._rid
         self._rid += 1
         st = _Stream(rid=rid, prompt=prompt_ids,
                      max_new=int(max_new_tokens),
-                     target=p + int(max_new_tokens), blocks=blocks)
+                     target=p + int(max_new_tokens), blocks=blocks,
+                     prefilled=matched_len, n_shared=len(entries),
+                     fork_pending=fork_reserve, chain_key=chain,
+                     registered_tokens=n_full * self.block_size,
+                     shared_at_admit=matched_len)
         self._streams[rid] = st
         self._slot_of[rid] = slot
         # reset the slot BEFORE any prefill chunk: the batched step's
@@ -528,12 +877,35 @@ class PagedDecodeServer:
         st = self._streams[rid]
         slot = self._slot_of[rid]
         p = len(st.prompt)
+        # late match: a stream that found nothing at ADMISSION retries
+        # the index once at its first prefill chunk — under burst
+        # arrivals several shared-prompt requests admit in one tick
+        # before any of them has registered a block, but streams prefill
+        # FIFO, so by the time this one runs its predecessors' blocks
+        # are indexed (the admission-time match alone would miss the
+        # whole burst)
+        if (self.prefix_cache and st.prefilled == 0
+                and st.n_shared == 0):
+            self._rematch_prefix(st, slot)
         remaining = p - st.prefilled
         if remaining <= 0:
             return True
         w = min(int(width), remaining)
         if w < 1:
             raise ValueError(f"prefill width {width} < 1")
+        # copy-on-write: the FIRST write past the shared boundary lands
+        # here when the matched prefix ended mid-block — fork the
+        # borrowed partial block (reserved target, one on-device copy,
+        # repoint, release the share) BEFORE the chunk writes into it
+        if (st.fork_pending is not None
+                and st.prefilled // self.block_size < st.n_shared):
+            self._cow_fork(st, slot)
+        # sink-invariant extension: every block this chunk writes must
+        # be OWNED by the stream — a shared block is read-only
+        assert st.prefilled // self.block_size >= st.n_shared, (
+            f"prefill would write shared block of rid={rid}: "
+            f"pos {st.prefilled} inside the first {st.n_shared} "
+            "borrowed table entries")
         bucket = prefill_bucket(w)
         chunk = st.prompt[st.prefilled:st.prefilled + w] + [0] * (bucket - w)
         logits, self.pools = self._prefill_fn(
@@ -543,6 +915,7 @@ class PagedDecodeServer:
             jnp.asarray([chunk], jnp.int32),
             jnp.asarray(w, jnp.int32))
         st.prefilled += w
+        self._register_prefix(st, final=st.prefilled >= p)
         if st.prefilled < p:
             return False
         t, tk, tp = self._sampling
@@ -554,6 +927,89 @@ class PagedDecodeServer:
         if st.max_new <= 1:
             self._finish(rid)
         return True
+
+    def _cow_fork(self, st: _Stream, slot: int) -> None:
+        """Fork the stream's borrowed partial tail block: copy the
+        shared block's contents into the reserved fresh block on-device
+        (traced src/dst — no recompile), repoint the table entry, drop
+        the share.  After this the stream owns every block it will ever
+        write; positions past the shared prefix inside the copy are
+        overwritten by the stream's own prefill/decode writes before
+        they are attended."""
+        idx = st.n_shared - 1
+        src, dst = st.blocks[idx], st.fork_pending
+        self.pools = self._cow_fn(self.pools,
+                                  jnp.asarray(src, jnp.int32),
+                                  jnp.asarray(dst, jnp.int32))
+        st.blocks[idx] = dst
+        # repoint BEFORE releasing the share: once the table stops
+        # naming src, this stream can never touch it again
+        self.tables[slot, idx] = dst
+        st.fork_pending = None
+        st.n_shared = idx
+        self.allocator.release([src])
+        self.cow_forks += 1
+
+    def _rematch_prefix(self, st: _Stream, slot: int) -> None:
+        """Retry the prefix lookup for a stream that matched nothing at
+        admission (see :meth:`prefill_step`): point its leading table
+        entries at the now-indexed blocks, release the fresh blocks they
+        displace (keeping one as the CoW fork reserve when the match
+        ends mid-block), and reclassify the admission as a hit."""
+        entries, chain, matched_len = self._prefix_lookup(st.prompt)
+        if not matched_len:
+            return
+        partial = matched_len % self.block_size != 0
+        n = len(entries)
+        # pin the matched blocks before releasing the displaced ones so
+        # the release cannot hand a matched cached-free block back out
+        for b, _ in entries:
+            if self.allocator.refcount(b) > 0:
+                self.allocator.share(b)
+            else:
+                self.allocator.reuse_cached(b)
+        displaced = st.blocks[:n]
+        st.blocks[:n] = [b for b, _ in entries]
+        st.fork_pending = displaced.pop() if partial else None
+        self.allocator.release(displaced)
+        self.tables[slot, :len(st.blocks)] = st.blocks
+        st.n_shared = n
+        st.chain_key = chain
+        st.prefilled = matched_len
+        st.registered_tokens = (n - (1 if partial else 0)) * self.block_size
+        st.shared_at_admit = matched_len
+        self.prefix_misses -= 1
+        self.prefix_hits += 1
+        self.prefix_hit_tokens += matched_len
+        self.blocks_shared_total += n
+
+    def _register_prefix(self, st: _Stream, final: bool) -> None:
+        """Publish this stream's OWNED, fully-written prompt blocks into
+        the prefix index (borrowed blocks are already there): every full
+        ``block_size`` chunk covered by ``prefilled``, plus — once the
+        prompt is complete — the partial tail.  The tail entry claims
+        only the prompt positions; decode writes land past them, so the
+        entry stays valid while the stream keeps generating."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        p = len(st.prompt)
+        while st.registered_tokens + bs <= st.prefilled:
+            off = st.registered_tokens
+            key = (st.chain_key, tuple(st.prompt[off:off + bs]))
+            if off // bs >= st.n_shared:
+                b = st.blocks[off // bs]
+                if self.prefix.insert(key, b):
+                    self.allocator.mark_cached(b)
+            st.chain_key = key
+            st.registered_tokens = off + bs
+        if final and st.registered_tokens < p:
+            off = st.registered_tokens
+            key = (st.chain_key, tuple(st.prompt[off:p]))
+            if off // bs >= st.n_shared:
+                b = st.blocks[off // bs]
+                if self.prefix.insert(key, b):
+                    self.allocator.mark_cached(b)
 
     # ---- block growth / eviction --------------------------------------
     def needs_block(self) -> List[int]:
@@ -584,17 +1040,36 @@ class PagedDecodeServer:
             st.blocks.extend(got)
         return short
 
+    def _release_stream(self, st: _Stream, slot: int) -> None:
+        """THE single stream-release path (_finish and evict both land
+        here): zero the table to the sink FIRST — the next step's
+        frozen-lane write must go to the sink, never into a block
+        someone else holds — then drop one reference per block through
+        :meth:`BlockAllocator.release`, including the unused CoW fork
+        reserve.  A shared block survives at refcount >= 1 for its other
+        readers; an owned cached block parks in the cached-free LRU; a
+        double release is a hard error by the allocator's contract."""
+        self.tables[slot, :] = SINK_BLOCK
+        rel = list(st.blocks)
+        if st.fork_pending is not None:
+            rel.append(st.fork_pending)
+            st.fork_pending = None
+        st.blocks = []
+        self.allocator.release(rel)
+        self.active[slot] = False
+
     def evict(self, rid: int):
-        """Preempt ``rid``: free its blocks (table zeroed to the sink
-        first, so the frozen lane cannot touch live blocks) and forget
-        the stream.  Returns ``(prompt_ids, max_new_tokens)`` for the
-        caller to requeue; generated tokens are discarded and recomputed
-        on re-admission (greedy re-runs reproduce them exactly)."""
+        """Preempt ``rid``: release its block references (table zeroed
+        to the sink first, so the frozen lane cannot touch live blocks)
+        and forget the stream.  Returns ``(prompt_ids,
+        max_new_tokens)`` for the caller to requeue; generated tokens
+        are discarded and recomputed on re-admission (greedy re-runs
+        reproduce them exactly — and under ``prefix_cache`` the re-run
+        usually re-matches the very blocks this eviction parked in the
+        cached-free LRU)."""
         st = self._streams.pop(rid)
         slot = self._slot_of.pop(rid)
-        self.tables[slot, :] = SINK_BLOCK
-        self.allocator.free(st.blocks)
-        self.active[slot] = False
+        self._release_stream(st, slot)
         return list(st.prompt), st.max_new
 
     # ---- decode --------------------------------------------------------
@@ -609,6 +1084,17 @@ class PagedDecodeServer:
         short = self.ensure_blocks()
         if short:
             raise BlockExhausted(short)
+        # sink-invariant extension for sharing: an active lane's decode
+        # write position must sit in a block the stream OWNS (decode
+        # positions start past the prompt, and the CoW fork ran during
+        # the suffix prefill — so this can only fire on a bookkeeping
+        # bug, which must not silently corrupt a shared block)
+        for rid, slot in self._slot_of.items():
+            if self.active[slot]:
+                st = self._streams[rid]
+                assert (int(self._pos_host[slot]) // self.block_size
+                        >= st.n_shared), (
+                    f"decode would write shared block of rid={rid}")
         # non-active lanes (free, finished, MID-PREFILL) see an all-sink
         # table: their writes land in the sink and their reads gather
         # garbage that is discarded — so live blocks are written ONLY by
@@ -633,14 +1119,9 @@ class PagedDecodeServer:
     def _finish(self, rid: int) -> None:
         st = self._streams.pop(rid)
         slot = self._slot_of.pop(rid)
-        # zero the table BEFORE freeing: the next step's frozen-lane
-        # write must go to the sink, never into a block someone else
-        # just allocated
-        self.tables[slot, :] = SINK_BLOCK
-        self.allocator.free(st.blocks)
-        self.active[slot] = False
         row = np.asarray(jax.device_get(self.tokens[slot]))
         self._results[rid] = [int(t) for t in row[:st.target]]
+        self._release_stream(st, slot)
 
     # ---- results -------------------------------------------------------
     def done(self, rid: int) -> bool:

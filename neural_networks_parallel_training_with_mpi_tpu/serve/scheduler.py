@@ -78,6 +78,11 @@ class ServeConfig:
     #                                'fused' (Pallas paged-attention
     #                                kernel: walks only allocated blocks,
     #                                stops at each stream's true length)
+    prefix_cache: bool = False     # share identical prompt-prefix blocks
+    #                                across streams (refcounts + copy-on-
+    #                                write; serve/paged_kv.py): a cached
+    #                                prefix admits without re-prefilling,
+    #                                so TTFT collapses to the suffix
     telemetry_dir: Optional[str] = None
     metrics_every: int = 25        # ticks between kind="serve" records
     # span tracing + compile ledger (train/trace.py): per-tick
@@ -231,7 +236,7 @@ class Scheduler:
             block_size=cfg.block_size, max_len=cfg.max_len,
             temperature=cfg.temperature, top_k=cfg.top_k,
             top_p=cfg.top_p, seed=cfg.seed, kv_quant=cfg.kv_quant,
-            attn_impl=cfg.attn_impl)
+            attn_impl=cfg.attn_impl, prefix_cache=cfg.prefix_cache)
         self.queue: Deque[Request] = collections.deque()
         self.reqs: Dict[int, Request] = {}      # every request ever seen
         self._srv_rid: Dict[int, int] = {}      # scheduler rid -> server
@@ -359,9 +364,16 @@ class Scheduler:
 
     # ---- internals -----------------------------------------------------
     def _committed_tokens(self) -> int:
-        return sum(len(r.prompt) + r.max_new
-                   for rid, r in self.reqs.items()
-                   if rid in self._srv_rid)
+        """In-flight committed (prompt + max_new) tokens, refcount-aware:
+        token positions resident in a SHARED block are physical once, so
+        each extra reference's worth is discounted (the server's
+        block-granular upper bound) instead of charged per stream —
+        otherwise a token budget would reject admissions whose residency
+        the cache already holds."""
+        raw = sum(len(r.prompt) + r.max_new
+                  for rid, r in self.reqs.items()
+                  if rid in self._srv_rid)
+        return max(0, raw - self.server.shared_token_discount())
 
     def _admit(self) -> None:
         while self.queue:
@@ -375,9 +387,13 @@ class Scheduler:
             # overcommit fails for it right now: hold it at the head
             # until the pool can cover its FULL need, else it would
             # thrash admit->grow->evict while the same streams hold the
-            # pool.
-            need = (self.server.blocks_for(p + req.max_new)
-                    if req.evictions else self.server.blocks_for(p + 1))
+            # pool.  Both needs are REFCOUNT-AWARE: a prefix match onto
+            # in-use blocks consumes no free block (admit_need subtracts
+            # them, and adds the reserved CoW fork block for a mid-block
+            # match boundary).
+            need = self.server.admit_need(req.prompt, req.max_new,
+                                          full_residency=bool(
+                                              req.evictions))
             if self.server.free_blocks < need:
                 return
             if (self.cfg.token_budget > 0
@@ -475,7 +491,19 @@ class Scheduler:
         return rid
 
     def _snapshot(self) -> Dict[str, Any]:
+        prefix: Dict[str, Any] = {}
+        if self.cfg.prefix_cache:
+            ps = self.server.prefix_stats()
+            prefix = dict(ps)
+            # hit rate over prompt TOKENS (not requests): the fraction
+            # of admitted prompt work served from resident blocks — the
+            # number RadixAttention-style stores are judged on
+            prefix["prefix_hit_rate"] = (
+                round(ps["prefix_hit_tokens"]
+                      / ps["prompt_tokens_admitted"], 4)
+                if ps["prompt_tokens_admitted"] else None)
         return {
+            **prefix,
             "queue_depth": len(self.queue),
             "live": len(self._srv_rid),
             "prefilling": len(self._prefilling),

@@ -324,3 +324,23 @@ def test_example_20_paged_serving_completes():
     assert ("attn_impl=fused == attn_impl=gathered: token-identical "
             "end to end") in out.stdout
     assert "the skipped FLOPs" in out.stdout
+
+
+def test_example_22_prefix_cached_serving_completes():
+    """The prefix cache end to end on CPU: a shared-system-prompt mix
+    with a regenerated turn (full hit + CoW fork) through cache-on and
+    cache-off schedulers; the script itself asserts token identity
+    against both the cache-off arm and generate(), refcount drain, a
+    faster cached drain, and prints the per-request cold-vs-cached
+    TTFTs plus the hit/fork counters."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "22_prefix_cached_serving.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert ("tokens: cache on == cache off == generate() for all "
+            "5 requests") in out.stdout
+    assert "CoW fork(s)" in out.stdout
+    assert "near-zero-TTFT admission verified" in out.stdout
+    assert "block pool fully drained" in out.stdout

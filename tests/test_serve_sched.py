@@ -172,12 +172,21 @@ def test_slo_eviction_prefers_latest_deadline():
 
 
 def _fuzz_once(seed: int, model, params, random_geometry: bool,
-               attn_impl: str = "gathered"):
+               attn_impl: str = "gathered", prefix_cache: bool = False):
     """One fuzz round: random arrivals, prompt/output lengths, SLOs and
     (in the serve lane) pool geometry; asserts the no-leak /
     no-starvation / max_len / exact-tokens invariants after drain.  The
     core-lane round pins the geometry the parity tests already compiled,
-    so it adds steps to the budgeted lane, not programs."""
+    so it adds steps to the budgeted lane, not programs.
+
+    With ``prefix_cache=True`` half the prompts extend one of two shared
+    system prefixes (and some are exact regenerations — the full-hit +
+    CoW path), so admit/decode/CoW/evict/readmit sequences run with
+    blocks genuinely shared: ``assert_drained`` then pins REFCOUNTS at
+    zero, token exactness pins that no stream ever read a block another
+    stream wrote after its fork, and evicted+readmitted shared requests
+    stay token-exact (tests/test_prefix_cache.py carries the dedicated
+    counter/LRU pins)."""
     rng = np.random.default_rng(seed)
     if random_geometry:
         block_size = int(rng.choice([4, 8, 16]))
@@ -194,7 +203,10 @@ def _fuzz_once(seed: int, model, params, random_geometry: bool,
     sched = Scheduler(model, params, ServeConfig(
         slots=slots, num_blocks=num_blocks, block_size=block_size,
         max_len=max_len, prefill_chunk=int(rng.choice([4, 8, 32])),
-        queue_depth=64, attn_impl=attn_impl), now_fn=clock)
+        queue_depth=64, attn_impl=attn_impl,
+        prefix_cache=prefix_cache), now_fn=clock)
+    shared_prefixes = [rng.integers(0, VOCAB, (int(ln),)).tolist()
+                       for ln in (9, 14)]
     want = {}
     n_reqs = 10
     arrivals = sorted(int(t) for t in rng.integers(0, 30, n_reqs))
@@ -202,9 +214,19 @@ def _fuzz_once(seed: int, model, params, random_geometry: bool,
     tick = 0
     while submitted < n_reqs or sched.pending() or sched.in_flight():
         while submitted < n_reqs and arrivals[submitted] <= tick:
-            p = int(rng.integers(1, 20))
+            draw = rng.random()
+            if prefix_cache and draw < 0.5:
+                base = shared_prefixes[int(rng.integers(0, 2))]
+                sfx = rng.integers(
+                    0, VOCAB, (int(rng.integers(0, 6)),)).tolist()
+                prompt = base + sfx
+            elif prefix_cache and draw < 0.65 and want:
+                prompt = list(next(iter(want.values()))[0])  # regen
+            else:
+                p = int(rng.integers(1, 20))
+                prompt = rng.integers(0, VOCAB, (p,)).tolist()
+            p = len(prompt)
             n = int(rng.integers(1, min(max_len - p, 24) + 1))
-            prompt = rng.integers(0, VOCAB, (p,)).tolist()
             slo = (None if rng.random() < 0.3
                    else float(rng.integers(1, 1000)))
             rid = sched.submit(prompt, n, slo_ms=slo)
@@ -215,7 +237,8 @@ def _fuzz_once(seed: int, model, params, random_geometry: bool,
         sched.tick()
         tick += 1
         assert tick < 5000, "starvation: not drained"
-    # no leak: every block returned
+    # no leak: every block reference returned (under prefix_cache this
+    # is the refcount-drain invariant — shared blocks count per reader)
     sched.server.allocator.assert_drained()
     # no starvation: every accepted request completed, with max_len and
     # length contracts intact (greedy => token-exact against the
@@ -245,6 +268,28 @@ def test_scheduler_fuzz_property_more_seeds(seed):
     model = _model()
     params = model.init(prng.init_key(0))
     _fuzz_once(seed, model, params, random_geometry=True)
+
+
+def test_scheduler_fuzz_prefix_cache_property():
+    """The shared-prefix fuzz in the core lane: admit/decode/CoW/evict/
+    readmit sequences with prefix_cache on — refcounts drain to zero at
+    quiesce, no stream ever reads a block another stream wrote after
+    its CoW fork (token exactness + the server's in-step write-safety
+    asserts), and evict/readmit under sharing keeps tokens exact."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    _fuzz_once(0, model, params, random_geometry=False,
+               prefix_cache=True)
+
+
+@pytest.mark.serve
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [8, 9, 10])
+def test_scheduler_fuzz_prefix_cache_more_seeds(seed):
+    model = _model()
+    params = model.init(prng.init_key(0))
+    _fuzz_once(seed, model, params, random_geometry=True,
+               prefix_cache=True)
 
 
 @pytest.mark.serve

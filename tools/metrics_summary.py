@@ -163,10 +163,14 @@ def summarize(records: List[Dict[str, Any]],
         last = serve_ticks[-1]
         # attended/padded are CUMULATIVE counters (their running ratio
         # converges, so percentiles would be distribution theater): the
-        # run's honest summary is the final ratio
+        # run's honest summary is the final ratio — same story for the
+        # prefix-cache hit/fork/eviction counters
         for key in ("admitted", "rejected", "evicted", "completed",
                     "tokens_out", "attended_keys", "padded_keys",
-                    "attended_ratio"):
+                    "attended_ratio", "prefix_hits", "prefix_misses",
+                    "prefix_hit_tokens", "prefix_hit_rate",
+                    "shared_blocks", "cow_forks", "cache_evictions",
+                    "blocks_saved", "cached_free_blocks"):
             if key in last:
                 tick_stats[key] = last[key]
         out["serving_ticks"] = tick_stats
@@ -184,6 +188,63 @@ def summarize(records: List[Dict[str, Any]],
          "accum_steps": r.get("accum_steps")}
         for r in records if r.get("kind") == "topology"]
     return out
+
+
+def serving_lines(summary: Dict[str, Any]) -> List[str]:
+    """The serving view: request-latency percentiles + tick/pool/prefix-
+    cache state — shared by the full render and ``--serve``."""
+    lines: List[str] = []
+    if "serving" in summary:
+        sv = summary["serving"]
+        lines.append(f"serving: {sv['requests']} requests")
+        for key, label in (("ttft_ms", "ttft"), ("itl_ms", "itl"),
+                           ("total_ms", "total")):
+            if key in sv:
+                lines.append(
+                    f"  {label:<14} p50 {sv[key]['p50']:.6g}   "
+                    f"p99 {sv[key]['p99']:.6g}   max {sv[key]['max']:.6g}"
+                    " ms")
+        if sv.get("evictions"):
+            lines.append(f"  evictions: {sv['evictions']}")
+        if sv.get("deadline_missed"):
+            lines.append(f"  DEADLINES MISSED: {sv['deadline_missed']}")
+    if "serving_ticks" in summary:
+        st = summary["serving_ticks"]
+        counters = "/".join(str(st.get(k, "?")) for k in
+                            ("admitted", "rejected", "evicted",
+                             "completed"))
+        lines.append(f"serving ticks: adm/rej/evict/done {counters}, "
+                     f"{st.get('tokens_out', 0)} tokens out")
+        if st.get("attended_ratio") is not None:
+            lines.append(
+                f"  attended keys: {st.get('attended_keys')} / "
+                f"{st.get('padded_keys')} padded "
+                f"({st['attended_ratio']:.3f} "
+                "— the fused kernel's skipped work)")
+        if "prefix_hits" in st:
+            rate = st.get("prefix_hit_rate")
+            lines.append(
+                f"  prefix cache: hit rate "
+                f"{'?' if rate is None else format(rate, '.3f')} "
+                f"({st.get('prefix_hits')} hits / "
+                f"{st.get('prefix_misses')} misses, "
+                f"{st.get('prefix_hit_tokens')} prompt tokens from "
+                "cache)")
+            lines.append(
+                f"  shared blocks {st.get('shared_blocks')} now / "
+                f"{st.get('blocks_saved')} saved total, "
+                f"CoW forks {st.get('cow_forks')}, "
+                f"cache evictions {st.get('cache_evictions')}, "
+                f"{st.get('cached_free_blocks')} cached-free")
+        for key, unit in (("queue_depth", ""),
+                          ("block_utilization", ""),
+                          ("tokens_per_sec", "tok/s")):
+            if key in st:
+                lines.append(
+                    f"  {key:<18} p50 {st[key]['p50']:.6g}   "
+                    f"p95 {st[key]['p95']:.6g}   max {st[key]['max']:.6g}"
+                    + (f" {unit}" if unit else ""))
+    return lines
 
 
 def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
@@ -243,41 +304,7 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
             lines.append(
                 f"  updates/s      p50 {rl['updates_per_sec']['p50']:.6g}"
                 f"   max {rl['updates_per_sec']['max']:.6g}")
-    if "serving" in summary:
-        sv = summary["serving"]
-        lines.append(f"serving: {sv['requests']} requests")
-        for key, label in (("ttft_ms", "ttft"), ("itl_ms", "itl"),
-                           ("total_ms", "total")):
-            if key in sv:
-                lines.append(
-                    f"  {label:<14} p50 {sv[key]['p50']:.6g}   "
-                    f"p99 {sv[key]['p99']:.6g}   max {sv[key]['max']:.6g}"
-                    " ms")
-        if sv.get("evictions"):
-            lines.append(f"  evictions: {sv['evictions']}")
-        if sv.get("deadline_missed"):
-            lines.append(f"  DEADLINES MISSED: {sv['deadline_missed']}")
-    if "serving_ticks" in summary:
-        st = summary["serving_ticks"]
-        counters = "/".join(str(st.get(k, "?")) for k in
-                            ("admitted", "rejected", "evicted",
-                             "completed"))
-        lines.append(f"serving ticks: adm/rej/evict/done {counters}, "
-                     f"{st.get('tokens_out', 0)} tokens out")
-        if st.get("attended_ratio") is not None:
-            lines.append(
-                f"  attended keys: {st.get('attended_keys')} / "
-                f"{st.get('padded_keys')} padded "
-                f"({st['attended_ratio']:.3f} "
-                "— the fused kernel's skipped work)")
-        for key, unit in (("queue_depth", ""),
-                          ("block_utilization", ""),
-                          ("tokens_per_sec", "tok/s")):
-            if key in st:
-                lines.append(
-                    f"  {key:<18} p50 {st[key]['p50']:.6g}   "
-                    f"p95 {st[key]['p95']:.6g}   max {st[key]['max']:.6g}"
-                    + (f" {unit}" if unit else ""))
+    lines += serving_lines(summary)
     if heartbeat is not None:
         age = ("?" if heartbeat_age is None
                else f"{heartbeat_age:.1f}s ago")
@@ -341,6 +368,12 @@ def main(argv=None) -> int:
                          "ledger (trace/ subdir or an explicit trace "
                          "dir): per-phase time share and compile "
                          "count/seconds per incarnation")
+    ap.add_argument("--serve", action="store_true",
+                    help="serving-only view: TTFT/ITL percentiles, tick "
+                         "counters, attended-keys ratio, and the prefix-"
+                         "cache columns (hit rate, shared blocks, CoW "
+                         "forks, blocks saved) — nothing from the "
+                         "training stream")
     args = ap.parse_args(argv)
 
     heartbeat = postmortem = None
@@ -374,6 +407,9 @@ def main(argv=None) -> int:
     summary = summarize(records, windowed=args.last > 0)
     trace = trace_view(args.path) if args.trace else None
     if args.json:
+        if args.serve:
+            summary = {k: v for k, v in summary.items()
+                       if k in ("n_records", "serving", "serving_ticks")}
         summary["heartbeat"] = heartbeat
         summary["heartbeat_age_s"] = heartbeat_age
         summary["postmortem_reason"] = (postmortem or {}).get("reason")
@@ -381,6 +417,10 @@ def main(argv=None) -> int:
             trace.pop("_render", None)
             summary["trace"] = trace
         print(json.dumps(summary, indent=2))
+    elif args.serve:
+        out = serving_lines(summary)
+        print("\n".join(out) if out
+              else "no serving records (kind=serve/serve_req) found")
     else:
         print(render_text(summary, records, heartbeat, heartbeat_age,
                           postmortem))
