@@ -166,6 +166,15 @@ def _generate(args) -> int:
     else:
         log("note: no --checkpoint_dir; generating from a fresh init")
         params = model.init(prng.init_key(cfg.seed))
+    if (getattr(args, "quantize", "none") == "int8"
+            and cfg.model.matmul_dtype == "fp8"):
+        # refuse loudly instead of silently falling through to the
+        # dequant path: Linear's fp8 branch requires float kernels, so
+        # over PTQ int8 weights the flag would do nothing (DESIGN §14)
+        log("ERROR: --matmul_dtype fp8 cannot run over --quantize int8 "
+            "PTQ kernels; use --matmul_dtype int8 (true int8 compute) "
+            "or bf16 (dequant) with PTQ weights")
+        return 2
     if getattr(args, "quantize", "none") == "int8":
         from .ops.quant import quantize_params, quantized_bytes
 
@@ -175,6 +184,12 @@ def _generate(args) -> int:
         log(f"int8 weights-only PTQ: param bytes {full_b/2**20:.1f} -> "
             f"{quantized_bytes(params)/2**20:.1f} MiB"
             + (f" (kept {','.join(skip)} full-precision)" if skip else ""))
+        if cfg.model.matmul_dtype == "int8":
+            # ops.qmm int8_serve_dot: the decode matmuls run int8 x int8
+            # -> int32 with dynamic per-token activation scales instead
+            # of dequantizing into the compute dtype (DESIGN.md §14)
+            log("int8 COMPUTE decode: true int8 activation x weight dot "
+                "(ops.qmm) over the PTQ kernels")
     prompt = jnp.asarray([ids], jnp.int32)
     out = generate(model, params, prompt, args.max_new_tokens,
                    temperature=args.temperature, top_k=args.top_k,

@@ -147,6 +147,19 @@ class ModelConfig:
     ffn_activation: str = "gelu"
     dtype: str = "float32"  # param dtype; activations may use bfloat16 on TPU
     compute_dtype: str = "float32"
+    # quantized-matmul seam (ops.qmm, DESIGN.md §14): run the dense
+    # projections in this format.  bf16 = the plain compute-dtype matmul
+    # (byte-identical no-op); int8 = dynamic int8 x int8 -> int32
+    # (training custom_vjp / serving against --quantize int8 PTQ
+    # weights); fp8 = e4m3 fwd / e5m2 bwd with delayed-scaling amax
+    # state in TrainState.qstate.  Transformer only; DP / DP x seq /
+    # GSPMD step builders (+ zero1/'sharded' update sharding).
+    matmul_dtype: str = "bf16"
+    # projection sites excluded from the quantized-compute seam (kept on
+    # the plain compute-dtype matmul): the CLI folds --quantize_skip in
+    # here so a layer kept full-precision in storage is never
+    # dynamically quantized in compute either
+    matmul_skip: Tuple[str, ...] = ()
     remat: bool = False  # jax.checkpoint the forward to trade FLOPs for HBM
     # what jax.checkpoint may SAVE under --remat (models.core.make_remat):
     #   full          save nothing, recompute everything (max HBM saving)
@@ -612,6 +625,17 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--compute_dtype", choices=["float32", "bfloat16", "float16"],
                    default=None,
                    help="matmul/activation dtype (default: same as --dtype)")
+    p.add_argument("--matmul_dtype", choices=["bf16", "int8", "fp8"],
+                   default="bf16",
+                   help="quantized-matmul seam (ops.qmm): run the dense "
+                        "projections in this format — int8 = dynamic "
+                        "int8 x int8 -> int32 (training AND the "
+                        "--quantize int8 decode path), fp8 = e4m3 fwd / "
+                        "e5m2 bwd with delayed-scaling amax state "
+                        "carried in the train state; bf16 = the plain "
+                        "compute-dtype matmul (exact no-op).  "
+                        "Transformer on the DP / DP x seq / GSPMD "
+                        "layouts")
     _add_bool_flag(p, "remat", False,
                    "rematerialize transformer blocks (jax.checkpoint)")
     p.add_argument("--remat_policy",
@@ -911,6 +935,13 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
                             compute_dtype=args.compute_dtype or args.dtype,
                             remat=args.remat,
                             remat_policy=args.remat_policy,
+                            matmul_dtype=args.matmul_dtype,
+                            # a site the user kept full-precision in
+                            # STORAGE (--quantize_skip) stays out of the
+                            # quantized COMPUTE seam too
+                            matmul_skip=tuple(
+                                s for s in (args.quantize_skip or ""
+                                            ).split(",") if s),
                             scan_layers=args.scan_layers,
                             n_layers=args.n_layers, d_model=args.d_model,
                             n_heads=args.n_heads,

@@ -99,6 +99,13 @@ class Linear(Module):
     use_bias: bool = True
     param_dtype: Any = jnp.float32
     compute_dtype: Optional[Any] = None
+    # quantized-matmul seam (ops.qmm): 'bf16' = the plain path below,
+    # byte-identical to the pre-seam layer; 'int8'/'fp8' run the
+    # contraction in the quantized domain (training: custom_vjp qdot;
+    # serving: a true int8 activation dot against ops.quant PTQ weights).
+    # q_role names this layer's fp8 amax-history slot (delayed scaling).
+    matmul_dtype: str = "bf16"
+    q_role: str = ""
 
     def init(self, key: jax.Array) -> Pytree:
         wkey, bkey = jax.random.split(key)
@@ -110,16 +117,51 @@ class Linear(Module):
                                    self.param_dtype)
         return params
 
-    def apply(self, params: Pytree, x: jax.Array, **kwargs) -> jax.Array:
+    def apply(self, params: Pytree, x: jax.Array,
+              qscales=None, qobserved=None, **kwargs) -> jax.Array:
         cdt = self.compute_dtype or x.dtype
-        y = jnp.matmul(x.astype(cdt), params["w"].astype(cdt))
-        if "w_scale" in params:
-            # weights-only int8 (ops.quant.quantize_params): w is int8,
-            # cast in-register for a bf16 MXU matmul, and the per-output-
-            # channel scale commutes through the contraction — one fused
-            # multiply on the output tile, half the HBM bytes per token
-            # on the bandwidth-bound decode path
-            y = y * params["w_scale"].astype(cdt)
+        fmt = self.matmul_dtype
+        if fmt == "int8" and "w_scale" in params:
+            # serving: ops.quant PTQ weights + the quantized-compute seam
+            # — dynamic per-token activation scales, int8 x int8 -> int32
+            # on the MXU, both scales folded on the output tile (the
+            # dequant-then-bf16-dot below was the bandwidth half only)
+            from ..ops import qmm
+
+            y = qmm.int8_serve_dot(x.astype(cdt), params["w"],
+                                   params["w_scale"]).astype(cdt)
+        elif fmt == "fp8" and "w_scale" in params:
+            # refuse at the dispatch site, not only in the CLI: fp8 qdot
+            # needs float kernels, and silently falling through to the
+            # dequant matmul would mislabel every non-CLI caller's run
+            raise ValueError(
+                "matmul_dtype='fp8' cannot run over int8 PTQ kernels "
+                "(params carry w_scale); use matmul_dtype='int8' for "
+                "true int8 compute or 'bf16' for the dequant path")
+        elif fmt in ("int8", "fp8"):
+            from ..ops import qmm
+
+            a_amax = None
+            if fmt == "fp8" and qscales is not None and self.q_role:
+                a_amax = qscales.get(self.q_role)
+            if fmt == "fp8" and qobserved is not None and self.q_role:
+                # calibration observation (stop-gradient amax); max-merged
+                # across layers sharing this role
+                prev = qobserved.get(self.q_role)
+                obs = qmm.tensor_amax(x)
+                qobserved[self.q_role] = (obs if prev is None
+                                          else jnp.maximum(prev, obs))
+            y = qmm.qdot(x.astype(cdt), params["w"],
+                         fmt=fmt, scales=a_amax).astype(cdt)
+        else:
+            y = jnp.matmul(x.astype(cdt), params["w"].astype(cdt))
+            if "w_scale" in params:
+                # weights-only int8 (ops.quant.quantize_params): w is int8,
+                # cast in-register for a bf16 MXU matmul, and the per-output-
+                # channel scale commutes through the contraction — one fused
+                # multiply on the output tile, half the HBM bytes per token
+                # on the bandwidth-bound decode path
+                y = y * params["w_scale"].astype(cdt)
         if self.use_bias:
             y = y + params["b"].astype(cdt)
         return y

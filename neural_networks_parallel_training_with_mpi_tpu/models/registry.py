@@ -40,6 +40,8 @@ def build_model(cfg: ModelConfig) -> Module:
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_top_k=cfg.moe_top_k,
             ce_chunk=cfg.ce_chunk,
+            matmul_dtype=cfg.matmul_dtype,
+            matmul_skip=tuple(cfg.matmul_skip),
             scan_layers=cfg.scan_layers)
         return Transformer(tc)
     raise ValueError(f"unknown arch {cfg.arch!r}")

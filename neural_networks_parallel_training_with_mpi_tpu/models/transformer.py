@@ -115,6 +115,21 @@ class TransformerConfig:
     moe_capacity: Optional[int] = None
     moe_expert_axis: Optional[str] = None
     moe_top_k: int = 1  # 1 = Switch; 2 = GShard-style top-2 routing
+    # Quantized-matmul seam (ops.qmm, DESIGN.md §14): run every dense
+    # projection (qkv/attn_out/ffn/head) in this format.  'bf16' = the
+    # plain compute_dtype matmul (byte-identical to the pre-seam model);
+    # 'int8' = dynamic symmetric int8 x int8 -> int32 (training via
+    # custom_vjp, serving against ops.quant PTQ weights); 'fp8' = e4m3
+    # fwd / e5m2 bwd with delayed-scaling activation amax histories
+    # carried in TrainState.qstate and threaded through apply(qscales=).
+    # Attention's score/value einsums stay in compute_dtype.
+    matmul_dtype: str = "bf16"
+    # Roles excluded from the quantized-compute seam (kept on the plain
+    # compute_dtype matmul): mirrors ops.quant's `skip` — a layer the
+    # user kept full-precision in STORAGE (--quantize_skip head) must
+    # not be dynamically quantized in COMPUTE either, and high-precision
+    # first/last layers are the standard low-precision-training recipe.
+    matmul_skip: Tuple[str, ...] = ()
     # Fused chunked cross-entropy (>0 enables): the LM head + CE are
     # evaluated over sequence blocks of this many tokens under
     # jax.checkpoint, so the full (B, T, vocab) f32 logits tensor — the
@@ -154,14 +169,24 @@ class Transformer(Module):
     cfg: TransformerConfig = dataclasses.field(default_factory=TransformerConfig)
 
     # ---- submodule builders (stateless; params live in the pytree) ----
+    def _mm(self, role: str) -> str:
+        """Effective matmul format for one projection site: the config
+        format, unless the role is in ``matmul_skip`` (kept full
+        precision — the compute analogue of ops.quant's ``skip``)."""
+        c = self.cfg
+        return "bf16" if role in c.matmul_skip else c.matmul_dtype
+
     def _block_modules(self):
         c = self.cfg
         mods = {
             "ln1": LayerNorm(c.d_model, param_dtype=c.param_dtype),
             "qkv": Linear(c.d_model, c.qkv_dim, param_dtype=c.param_dtype,
-                          compute_dtype=c.compute_dtype),
+                          compute_dtype=c.compute_dtype,
+                          matmul_dtype=self._mm("qkv"), q_role="qkv"),
             "attn_out": Linear(c.d_model, c.d_model, param_dtype=c.param_dtype,
-                               compute_dtype=c.compute_dtype),
+                               compute_dtype=c.compute_dtype,
+                               matmul_dtype=self._mm("attn_out"),
+                               q_role="attn_out"),
             "ln2": LayerNorm(c.d_model, param_dtype=c.param_dtype),
         }
         if c.moe_experts > 0:
@@ -177,7 +202,9 @@ class Transformer(Module):
         else:
             mods["ff_in"] = Linear(c.d_model, c.d_ff,
                                    param_dtype=c.param_dtype,
-                                   compute_dtype=c.compute_dtype)
+                                   compute_dtype=c.compute_dtype,
+                                   matmul_dtype=self._mm("ff_in"),
+                                   q_role="ff_in")
             if c.activation == "swiglu":
                 # gated FFN (Shazeer 2020): silu(x W_gate) * (x W_in),
                 # then W_out — the modern-LM FFN.  A third (d, ff)
@@ -185,25 +212,51 @@ class Transformer(Module):
                 # iso-parameter comparisons.
                 mods["ff_gate"] = Linear(c.d_model, c.d_ff,
                                          param_dtype=c.param_dtype,
-                                         compute_dtype=c.compute_dtype)
+                                         compute_dtype=c.compute_dtype,
+                                         matmul_dtype=self._mm("ff_gate"),
+                                         q_role="ff_gate")
             mods["ff_out"] = Linear(c.d_ff, c.d_model,
                                     param_dtype=c.param_dtype,
-                                    compute_dtype=c.compute_dtype)
+                                    compute_dtype=c.compute_dtype,
+                                    matmul_dtype=self._mm("ff_out"),
+                                    q_role="ff_out")
         return mods
 
-    def _ffn(self, mods, params, h: jax.Array) -> jax.Array:
+    def quant_roles(self):
+        """fp8 delayed-scaling roles (ops.qmm): one activation amax
+        history per logical matmul site, shared across layers (under
+        scan_layers the layers share one traced block anyway; for the
+        python-loop stack the cross-layer max is a conservative
+        per-tensor bound).  Skipped roles carry no history — their
+        Linears run the plain matmul.  MoE blocks apply no ffn Linears
+        (the expert einsums live outside the seam; the Trainer refuses
+        the combination, but a directly-built step must not seed
+        histories no forward will ever observe)."""
+        c = self.cfg
+        roles = ["qkv", "attn_out", "head"]
+        if c.moe_experts <= 0:
+            ffn = ["ff_in", "ff_out"]
+            if c.activation == "swiglu":
+                ffn.insert(1, "ff_gate")
+            roles[2:2] = ffn
+        return tuple(r for r in roles if r not in c.matmul_skip)
+
+    def _ffn(self, mods, params, h: jax.Array, **qkw) -> jax.Array:
         """Dense-FFN tail shared by the training block and the KV-cache
         decode chunk (anti-drift): classic act(W_in h) W_out, or SwiGLU
-        when activation == 'swiglu'."""
+        when activation == 'swiglu'.  ``qkw`` threads the fp8
+        delayed-scaling context (qscales/qobserved) to the Linears."""
         c = self.cfg
         if c.activation == "swiglu":
-            gate = jax.nn.silu(mods["ff_gate"].apply(params["ff_gate"], h))
+            gate = jax.nn.silu(mods["ff_gate"].apply(params["ff_gate"], h,
+                                                     **qkw))
             return mods["ff_out"].apply(
                 params["ff_out"],
-                gate * mods["ff_in"].apply(params["ff_in"], h))
-        h = mods["ff_in"].apply(params["ff_in"], h)
+                gate * mods["ff_in"].apply(params["ff_in"], h, **qkw),
+                **qkw)
+        h = mods["ff_in"].apply(params["ff_in"], h, **qkw)
         h = ACTIVATIONS[c.activation](h)
-        return mods["ff_out"].apply(params["ff_out"], h)
+        return mods["ff_out"].apply(params["ff_out"], h, **qkw)
 
     def init(self, key: jax.Array):
         c = self.cfg
@@ -230,13 +283,19 @@ class Transformer(Module):
             out["pos"] = pos.init(keys[-2])
         return out
 
-    def _block(self, params, x: jax.Array):
-        """One pre-LN block: (params, x) -> (x, aux); aux is the MoE
-        load-balance loss for this block (0.0 for a dense FFN)."""
+    def _block(self, params, x: jax.Array, qscales=None, collect=False):
+        """One pre-LN block: (params, x) -> (x, aux, qobs); aux is the MoE
+        load-balance loss for this block (0.0 for a dense FFN), qobs the
+        fp8 calibration observations ({role: amax} when ``collect``, {}
+        otherwise — ops.qmm delayed scaling; ``qscales`` is the delayed
+        amax each Linear reads)."""
         c = self.cfg
         mods = self._block_modules()
+        qobs = {} if collect else None
+        qkw = ({"qscales": qscales, "qobserved": qobs}
+               if c.matmul_dtype == "fp8" else {})
         h = mods["ln1"].apply(params["ln1"], x)
-        qkv = mods["qkv"].apply(params["qkv"], h)
+        qkv = mods["qkv"].apply(params["qkv"], h, **qkw)
         q, k, v = split_qkv(c, qkv)
         # GQA training path: repeat K/V to full query heads so every
         # attention impl (dense/flash/ring/...) sees plain MHA — same
@@ -250,14 +309,14 @@ class Transformer(Module):
             rope_theta=(c.rope_theta if c.pos_encoding == "rope"
                         else None))
         out = out.reshape(*out.shape[:2], c.d_model)
-        x = x + mods["attn_out"].apply(params["attn_out"], out)
+        x = x + mods["attn_out"].apply(params["attn_out"], out, **qkw)
         h = mods["ln2"].apply(params["ln2"], x)
         if c.moe_experts > 0:
             ff, aux = mods["moe"].apply(params["moe"], h)
         else:
-            ff = self._ffn(mods, params, h)
+            ff = self._ffn(mods, params, h, **qkw)
             aux = jnp.zeros((), jnp.float32)
-        return x + ff.astype(x.dtype), aux
+        return x + ff.astype(x.dtype), aux, (qobs or {})
 
     def add_pos(self, params, x_tokens: jax.Array,
                 positions: jax.Array) -> jax.Array:
@@ -293,14 +352,17 @@ class Transformer(Module):
         return LayerNorm(c.d_model, param_dtype=c.param_dtype).apply(
             params["ln_f"], x)
 
-    def head_logits(self, params, x: jax.Array) -> jax.Array:
+    def head_logits(self, params, x: jax.Array, qscales=None) -> jax.Array:
         """Final LayerNorm + untied head -> f32 logits (shared with
         models.generate, same drift argument as :meth:`embed`)."""
         c = self.cfg
         x = self.final_norm(params, x)
         logits = Linear(c.d_model, c.vocab_size, use_bias=False,
                         param_dtype=c.param_dtype,
-                        compute_dtype=c.compute_dtype).apply(params["head"], x)
+                        compute_dtype=c.compute_dtype,
+                        matmul_dtype=self._mm("head"),
+                        q_role="head").apply(params["head"], x,
+                                             qscales=qscales)
         return logits.astype(jnp.float32)
 
     def fwd_flops(self, x_shape):
@@ -322,49 +384,88 @@ class Transformer(Module):
         per_layer += ffn
         return float(c.n_layers * per_layer + 2.0 * b * t * d * v)
 
-    def backbone(self, params, ids: jax.Array
-                 ) -> Tuple[jax.Array, jax.Array]:
+    def backbone(self, params, ids: jax.Array, qscales=None,
+                 collect=False):
         """Embedding + all blocks -> ((B, T_local, d_model) pre-head
-        hidden states, MoE aux sum).  The shared trunk of :meth:`apply`
-        and the fused chunked-CE loss path (same drift argument as
-        :meth:`embed` / :meth:`head_logits`)."""
+        hidden states, MoE aux sum, fp8 amax observations).  The shared
+        trunk of :meth:`apply` and the fused chunked-CE loss path (same
+        drift argument as :meth:`embed` / :meth:`head_logits`).
+
+        ``qscales``/``collect`` are the fp8 delayed-scaling context
+        (ops.qmm): blocks read the per-role delayed amax and, under
+        ``collect``, report this step's observed amax — max-merged across
+        layers, riding the scan carry under ``scan_layers`` so the
+        observations escape the scan trace."""
         c = self.cfg
         from ..parallel.sequence import global_positions
 
         positions = global_positions(c.attention, c.seq_axis, ids.shape[1])
         x = self.embed(params, ids, positions)
-        block_fn = self._block
+        collect = collect and c.matmul_dtype == "fp8"
+        # qscales/collect are CLOSED OVER (not block_fn args): collect is
+        # a static python bool — as a positional arg, jax.checkpoint
+        # would trace it — and qscales is calibration state, constant
+        # w.r.t. the differentiated params
+        _qs, _collect = qscales, collect
+
+        def block_fn(layer_params, h):
+            return self._block(layer_params, h, _qs, _collect)
+
         if c.remat:
             from .core import make_remat
 
             block_fn = make_remat(c.remat_policy)(block_fn)
         aux_total = jnp.zeros((), jnp.float32)
+        # block-level roles only (head observes in apply/qloss callers)
+        block_roles = [r for r in (self.quant_roles() if collect else ())
+                       if r != "head"]
+        qobs_total = {r: jnp.zeros((), jnp.float32) for r in block_roles}
         if c.scan_layers:
             def body(carry, layer_params):
-                h, aux_sum = carry
-                h, aux = block_fn(layer_params, h)
-                return (h, aux_sum + aux), None
+                h, aux_sum, obs_acc = carry
+                h, aux, obs = block_fn(layer_params, h)
+                obs_acc = {r: jnp.maximum(obs_acc[r], obs[r])
+                           for r in obs_acc}
+                return (h, aux_sum + aux, obs_acc), None
 
-            (x, aux_total), _ = jax.lax.scan(body, (x, aux_total),
-                                             params["blocks"])
+            (x, aux_total, qobs_total), _ = jax.lax.scan(
+                body, (x, aux_total, qobs_total), params["blocks"])
         else:
             for layer_params in params["blocks"]:
-                x, aux = block_fn(layer_params, x)
+                x, aux, obs = block_fn(layer_params, x)
                 aux_total = aux_total + aux
-        return x, aux_total
+                qobs_total = {r: jnp.maximum(qobs_total[r], obs[r])
+                              for r in qobs_total}
+        return x, aux_total, qobs_total
 
     def apply(self, params, ids: jax.Array, return_aux: bool = False,
-              **kwargs):
+              qscales=None, return_qobs: bool = False, **kwargs):
         """ids: (B, T_local) int32 -> logits (B, T_local, vocab), or
         (logits, aux) with ``return_aux`` (aux = summed MoE load-balance
-        loss over blocks; 0.0 for dense FFNs).
+        loss over blocks; 0.0 for dense FFNs), or (logits, qobs) with
+        ``return_qobs`` (the fp8 delayed-scaling observations,
+        {role: amax} — the training step's calibration input).
+
+        ``qscales`` is the per-role delayed amax read from
+        TrainState.qstate (ops.qmm.delayed_amax); None = current scaling
+        (eval/decode, no calibration state to thread).
 
         Under sequence parallelism T_local = T / seq_axis_size and
         ``pos_offset`` (the shard's global starting position) is derived from
         the bound axis index; dense attention uses offset 0.
         """
-        x, aux_total = self.backbone(params, ids)
-        logits = self.head_logits(params, x)
+        x, aux_total, qobs = self.backbone(params, ids, qscales=qscales,
+                                           collect=return_qobs)
+        if (return_qobs and self.cfg.matmul_dtype == "fp8"
+                and "head" in self.quant_roles()):
+            from ..ops import qmm
+
+            qobs = dict(qobs)
+            qobs["head"] = qmm.tensor_amax(self.final_norm(params, x))
+        logits = self.head_logits(params, x, qscales=qscales)
+        if return_qobs:
+            return (logits, aux_total, qobs) if return_aux else (logits,
+                                                                 qobs)
         return (logits, aux_total) if return_aux else logits
 
     # ---- fused chunked cross-entropy (cfg.ce_chunk > 0) ----
@@ -389,7 +490,8 @@ class Transformer(Module):
         n = T // k
         head = Linear(c.d_model, c.vocab_size, use_bias=False,
                       param_dtype=c.param_dtype,
-                      compute_dtype=c.compute_dtype)
+                      compute_dtype=c.compute_dtype,
+                      matmul_dtype=self._mm("head"), q_role="head")
 
         from ..ops import losses as losses_lib
 
@@ -433,7 +535,7 @@ class Transformer(Module):
         label_smoothing = float(smooth) if smooth else 0.0
 
         def loss_fn(params, batch):
-            x, _aux = self.backbone(params, batch["x"])
+            x, _aux, _qobs = self.backbone(params, batch["x"])
             x = self.final_norm(params, x)
             return self._chunked_ce_sum(params, x, batch["y"],
                                         batch.get("mask"), label_smoothing)

@@ -459,8 +459,13 @@ def with_master_weights(opt: Optimizer) -> Optimizer:
     """
 
     def init(params: Pytree) -> MasterState:
+        # jnp.array(copy=True), not astype: astype is an identity for
+        # params ALREADY f32, which would alias the master to the very
+        # param buffers it shadows — a donated train state then donates
+        # the same buffer twice and Execute() refuses (latent until an
+        # f32-params + master-weights combination actually ran)
         master = jax.tree_util.tree_map(
-            lambda p: p.astype(jnp.float32), params)
+            lambda p: jnp.array(p, jnp.float32, copy=True), params)
         return MasterState(master, opt.init(master))
 
     def update(grads: Pytree, state: MasterState, params: Pytree):

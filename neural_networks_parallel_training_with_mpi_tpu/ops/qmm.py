@@ -1,0 +1,338 @@
+"""Quantized-matmul seam: low-precision compute for training AND serving.
+
+PR 7 landed the *memory* half of mixed precision (bf16 storage, f32
+master weights in the sharded opt state, arXiv 2004.13336); this module
+is the *compute* half — ROADMAP item 5.  On TPU the MXU's int8/fp8
+throughput is a multiple of bf16 (the arithmetic lever the pjit/TPUv4
+training recipe of arXiv 2204.06514 assumes), and on the decode path an
+int8 activation x weight dot finishes the job ``ops.quant`` started:
+the PTQ path saves HBM *bandwidth* but still casts int8->bf16 in
+register, paying bf16 MXU rates.
+
+One seam, two consumers:
+
+* **Training** (``--matmul_dtype {bf16,int8,fp8}`` ->
+  ``models.core.Linear``): :func:`qdot` runs the dense contraction in
+  the quantized domain with a ``custom_vjp`` so the backward is
+  low-precision too.
+
+  - ``int8``: symmetric dynamic quantization — activations per-row over
+    the contraction dim, weights per-output-channel — int8 x int8 ->
+    int32 via ``lax.dot_general(preferred_element_type=int32)``, both
+    scales folded on the (small) output tile.  The backward re-derives
+    scales for the transposed contractions (a per-channel scale must
+    never span the contraction axis, so the forward scales cannot be
+    reused).  Stateless: wiring it into a layout touches nothing but
+    the model config.
+  - ``fp8``: e4m3 activations/weights, e5m2 gradients (the wider-range
+    format — gradients are where fp8 under/overflows first).  Weight
+    and gradient scales are exact per-tensor amax computed in-step
+    (the tensor is in hand); ACTIVATION scales use **delayed-scaling
+    calibration**: a per-tensor-role amax history carried as extra
+    state leaves in ``TrainState.qstate`` (see :func:`init_qstate`),
+    read at the top of the jitted step and updated at the bottom from
+    the step's observed amax — so the cast needs no extra pass over
+    the activation before scaling it.  Non-finite observations (a
+    skipped/overflowed step) never enter the history.
+
+* **Serving** (:func:`int8_serve_dot`, consumed by ``Linear.apply``
+  when the params carry ``ops.quant``'s PTQ ``w_scale`` and the model
+  was built with ``matmul_dtype='int8'``): a true int8 activation x
+  int8 weight dot with dynamic per-token activation scales — the
+  decode matmul itself now runs at int8 MXU rates instead of
+  dequant-then-bf16.
+
+Scale granularity: activations per-row (per token) for int8 and
+per-ROLE per-tensor for fp8 (one amax history per logical matmul site —
+qkv/attn_out/ff_in/ff_gate/ff_out/head — shared across a stack's
+layers; under ``scan_layers`` the layers share one program anyway, and
+a max over layers is simply a conservative per-tensor bound).  Weights
+per-output-channel (int8) / per-tensor (fp8).  Attention's score/value
+einsums stay in the compute dtype: they are the numerically hot
+contractions and carry none of the parameter-streaming cost.
+
+Dtype support is probed once per process (:func:`fp8_dot_supported`):
+where the backend cannot lower an fp8 x fp8 dot, the quantized values
+are upcast for the contraction — numerics of fp8 STORAGE preserved
+(every cast/clip identical), arithmetic in f32; MXU rate claims stay
+TPU-only (DESIGN.md §14).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Pytree = Any
+
+FORMATS = ("bf16", "int8", "fp8")
+
+# finite maxima of the fp8 formats (ml_dtypes): e4m3fn has no inf, max
+# 448; e5m2 keeps inf/nan, max finite 57344 — gradients get the range.
+E4M3_MAX = 448.0
+E5M2_MAX = 57344.0
+# floor for amax -> scale so an all-zero tensor maps to scale 1-ish
+# instead of dividing by zero (mirrors ops.quant.quantize_array)
+_AMAX_TINY = 1e-12
+
+# activation-amax history length for fp8 delayed scaling (TransformerEngine
+# convention: scale from the max over the last H steps' amax)
+HISTORY = 16
+
+
+def tensor_amax(x: jax.Array) -> jax.Array:
+    """f32 scalar max(|x|) with gradients stopped — the calibration
+    observation, never part of the differentiated graph."""
+    return lax.stop_gradient(
+        jnp.max(jnp.abs(x.astype(jnp.float32))))
+
+
+# ---------------------------------------------------------------------------
+# backend capability
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def fp8_dot_supported() -> bool:
+    """Can this backend lower an e4m3 x e4m3 -> f32 dot?  Probed by one
+    tiny AOT compile outside any trace (cached per process); False routes
+    the contraction through an f32 upcast of the SAME quantized values."""
+    try:
+        a = jnp.zeros((8, 8), jnp.float8_e4m3fn)
+        jax.jit(lambda x, y: lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)).lower(a, a).compile()
+        return True
+    except Exception:  # noqa: BLE001 — any lowering failure means "no"
+        return False
+
+
+def _dot_q(a: jax.Array, b: jax.Array, preferred) -> jax.Array:
+    """dot_general contracting a's last dim with b's first, in the
+    quantized domain where the backend supports it."""
+    dims = (((a.ndim - 1,), (0,)), ((), ()))
+    if a.dtype == jnp.int8 or fp8_dot_supported():
+        return lax.dot_general(a, b, dims, preferred_element_type=preferred)
+    return lax.dot_general(a.astype(jnp.float32), b.astype(jnp.float32),
+                           dims, preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# int8: dynamic symmetric quantization, both directions
+# ---------------------------------------------------------------------------
+
+def _q8_rowwise(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Quantize over the LAST (contraction) dim: int8 codes + f32 scale
+    shaped like x with the last dim kept at 1 (per-row / per-token).
+    The quantizer itself is ops.quant.quantize_array — ONE definition of
+    the symmetric formula and its zero-slice guard."""
+    from .quant import quantize_array
+
+    q, s = quantize_array(x, axis=-1)
+    return q, s[..., None]
+
+
+def _q8_colwise(w: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Quantize a (in, out) kernel over its FIRST (contraction) dim:
+    per-output-channel scales, shape (1, out) (same single-source
+    quantizer as :func:`_q8_rowwise`)."""
+    from .quant import quantize_array
+
+    q, s = quantize_array(w, axis=0)
+    return q, s[None, :]
+
+
+def int8_serve_dot(x: jax.Array, w_q: jax.Array,
+                   w_scale: jax.Array) -> jax.Array:
+    """Decode-path int8 x int8 dot against ``ops.quant`` PTQ weights:
+    ``x`` (..., in) float, ``w_q`` (in, out) int8 with per-output-channel
+    ``w_scale`` (out,).  Activations quantize per-token on the fly; both
+    scales fold on the output tile.  Returns f32."""
+    qx, sx = _q8_rowwise(x)
+    y = lax.dot_general(qx, w_q, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * sx * w_scale.astype(jnp.float32)
+
+
+@jax.custom_vjp
+def _qdot_int8(x: jax.Array, w: jax.Array) -> jax.Array:
+    y, _ = _qdot_int8_fwd(x, w)
+    return y
+
+
+def _qdot_int8_fwd(x, w):
+    qx, sx = _q8_rowwise(x)
+    qw, sw = _q8_colwise(w)
+    y = lax.dot_general(qx, qw, (((x.ndim - 1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.int32)
+    # residuals are the full-precision operands: the backward's transposed
+    # contractions need scales over DIFFERENT axes (a per-channel scale
+    # must not span the contraction), so the forward codes can't be reused
+    return (y.astype(jnp.float32) * sx * sw, (x, w))
+
+
+def _qdot_int8_bwd(res, dy):
+    x, w = res
+    x2 = x.reshape(-1, x.shape[-1])          # (N, in)
+    dy2 = dy.reshape(-1, dy.shape[-1])       # (N, out)
+    # dx = dy @ w.T — contraction over 'out': dy per-row, w per-'in'-row
+    # (w's rows span the out dim, so _q8_rowwise gives exactly the
+    # (in, 1) scales this contraction needs — one quantizer, both uses)
+    qdy_r, sdy_r = _q8_rowwise(dy)
+    qw_r, sw_r = _q8_rowwise(w)
+    dx = lax.dot_general(qdy_r, qw_r.T,
+                         (((dy.ndim - 1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.int32)
+    dx = (dx.astype(jnp.float32) * sdy_r * sw_r.reshape(1, -1)
+          ).reshape(x.shape).astype(x.dtype)
+    # dw = x.T @ dy — contraction over rows: both per-COLUMN scales
+    qxc, sxc = _q8_colwise(x2)               # scales (1, in)
+    qdyc, sdyc = _q8_colwise(dy2)            # scales (1, out)
+    dw = lax.dot_general(qxc.T, qdyc, (((1,), (0,)), ((), ())),
+                         preferred_element_type=jnp.int32)
+    dw = (dw.astype(jnp.float32) * sxc.T * sdyc).astype(w.dtype)
+    return dx, dw
+
+
+_qdot_int8.defvjp(_qdot_int8_fwd, _qdot_int8_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fp8: e4m3 fwd / e5m2 bwd with delayed activation scaling
+# ---------------------------------------------------------------------------
+
+def _cast_fp8(x: jax.Array, amax: jax.Array, fmt_max: float, dtype
+              ) -> Tuple[jax.Array, jax.Array]:
+    """Scale ``x`` so ``amax`` maps to the format max, saturate, cast.
+    Returns (codes, scale) with ``codes / scale`` reconstructing x.
+    ``amax <= 0`` means UNCALIBRATED (a fresh delayed-scaling history):
+    scale 1.0 — coarser resolution but no saturation, the safe warmup
+    until the first real observation lands in the history."""
+    amax = amax.astype(jnp.float32)
+    scale = jnp.where(amax > _AMAX_TINY, fmt_max / jnp.maximum(
+        amax, _AMAX_TINY), 1.0)
+    q = jnp.clip(x.astype(jnp.float32) * scale,
+                 -fmt_max, fmt_max).astype(dtype)
+    return q, scale
+
+
+@jax.custom_vjp
+def _qdot_fp8(x: jax.Array, w: jax.Array, a_amax: jax.Array) -> jax.Array:
+    y, _ = _qdot_fp8_fwd(x, w, a_amax)
+    return y
+
+
+def _qdot_fp8_fwd(x, w, a_amax):
+    qx, sx = _cast_fp8(x, a_amax, E4M3_MAX, jnp.float8_e4m3fn)
+    qw, sw = _cast_fp8(w, tensor_amax(w), E4M3_MAX, jnp.float8_e4m3fn)
+    y = _dot_q(qx, qw, jnp.float32) / (sx * sw)
+    # keep the fp8 CODES (not x/w): the backward contracts against
+    # exactly what the forward multiplied, and they are 1/4 the bytes
+    return y, (qx, sx, qw, sw)
+
+
+def _qdot_fp8_bwd(res, dy):
+    qx, sx, qw, sw = res
+    qdy, sdy = _cast_fp8(dy, tensor_amax(dy), E5M2_MAX, jnp.float8_e5m2)
+    # dx = dy @ w.T, dw = x.T @ dy — both in the quantized domain
+    dx = _dot_q(qdy, qw.T, jnp.float32) / (sdy * sw)
+    qx2 = qx.reshape(-1, qx.shape[-1])
+    qdy2 = qdy.reshape(-1, qdy.shape[-1])
+    dw = _dot_q(qx2.T, qdy2, jnp.float32) / (sx * sdy)
+    # the delayed amax is calibration state, not a differentiable input
+    return dx.reshape(qx.shape).astype(jnp.float32), dw, jnp.zeros(())
+
+
+_qdot_fp8.defvjp(_qdot_fp8_fwd, _qdot_fp8_bwd)
+
+
+# ---------------------------------------------------------------------------
+# the public seam
+# ---------------------------------------------------------------------------
+
+def qdot(x: jax.Array, w: jax.Array, *, fmt: str,
+         scales: Optional[jax.Array] = None) -> jax.Array:
+    """Low-precision dense contraction ``x @ w`` (w: (in, out)) in format
+    ``fmt``, differentiable with a low-precision backward.
+
+    ``scales`` is the fp8 delayed activation amax (f32 scalar from
+    :func:`delayed_amax`); None falls back to current scaling (amax of
+    ``x`` computed in place — the eval/decode path, where there is no
+    calibration state to thread).  int8 is always dynamically scaled.
+    Returns f32 (callers fold the compute-dtype cast + bias).  Operands
+    enter the quantizers through an f32 cast so the custom_vjp
+    cotangents have one well-defined dtype; the cast's own vjp restores
+    the caller's param/activation dtype on the way back."""
+    x = x.astype(jnp.float32)
+    w = w.astype(jnp.float32)
+    if fmt == "int8":
+        return _qdot_int8(x, w)
+    if fmt == "fp8":
+        a = scales if scales is not None else tensor_amax(x)
+        return _qdot_fp8(x, w, jnp.asarray(a, jnp.float32))
+    if fmt == "bf16":
+        raise ValueError("qdot is the quantized seam; bf16 is the plain "
+                         "jnp.matmul path (models.core.Linear)")
+    raise ValueError(f"unknown qdot format {fmt!r}; have {FORMATS}")
+
+
+# ---------------------------------------------------------------------------
+# fp8 delayed-scaling calibration state
+# ---------------------------------------------------------------------------
+
+def model_format(model) -> str:
+    """The model's matmul format ('bf16' when the seam is off / the
+    architecture does not thread it)."""
+    cfg = getattr(model, "cfg", None)
+    return getattr(cfg, "matmul_dtype", "bf16") or "bf16"
+
+
+def quant_roles(model) -> Tuple[str, ...]:
+    """The model's fp8 tensor roles (one amax history each)."""
+    hook = getattr(model, "quant_roles", None)
+    return tuple(hook()) if hook is not None else ()
+
+
+def init_qstate(model, history: int = HISTORY) -> Pytree:
+    """Fresh calibration state for an fp8 model: per-role amax history
+    vectors, init 0.0 = UNCALIBRATED (qdot's fp8 cast falls back to
+    scale 1.0 — safe, unsaturated — until the first observation lands;
+    from step 2 the delayed max is real).  () for non-fp8 models, so the
+    default ``TrainState.qstate`` stays leaf-free and bf16/int8
+    checkpoints are byte-identical to pre-seam ones."""
+    if model_format(model) != "fp8":
+        return ()
+    return {"amax": {r: jnp.zeros((history,), jnp.float32)
+                     for r in quant_roles(model)}}
+
+
+def qstate_specs(model, spec) -> Pytree:
+    """A pytree of ``spec`` (e.g. ``P()``) mirroring the model's qstate —
+    the shard_map/jit in_specs entry for the calibration leaves (always
+    replicated: scalar-ish histories, trivially identical on every
+    replica because observations are pmax'd before entering)."""
+    if model_format(model) != "fp8":
+        return ()
+    return {"amax": {r: spec for r in quant_roles(model)}}
+
+
+def delayed_amax(qstate: Pytree) -> Dict[str, jax.Array]:
+    """role -> delayed amax (max over the history window) — the scales
+    argument each Linear reads at the top of the step."""
+    return {r: jnp.max(h) for r, h in qstate["amax"].items()}
+
+
+def update_qstate(qstate: Pytree, observed: Dict[str, jax.Array]) -> Pytree:
+    """Roll each role's history one slot and record the step's observed
+    amax.  Non-finite observations (an overflowed forward — e.g. the step
+    the skip guard rejects) are dropped: the slot re-records the current
+    delayed amax instead, so one bad step cannot poison the scales."""
+    new = {}
+    for r, h in qstate["amax"].items():
+        obs = jnp.asarray(observed[r], jnp.float32)
+        obs = jnp.where(jnp.isfinite(obs), obs, jnp.max(h))
+        new[r] = jnp.concatenate([obs[None], h[:-1]])
+    return {"amax": new}

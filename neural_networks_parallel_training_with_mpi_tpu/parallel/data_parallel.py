@@ -71,6 +71,25 @@ def make_loss_fn(model, loss_name: str) -> Callable[[Pytree, Batch],
     return loss_fn
 
 
+def make_qloss_fn(model, loss_name: str):
+    """(params, batch, qamax) -> (loss_sum, (count, observed)) — the fp8
+    delayed-scaling variant of :func:`make_loss_fn`: the model reads the
+    per-role delayed amax ``qamax`` (ops.qmm.delayed_amax of
+    TrainState.qstate) and reports this step's observed amax, which the
+    step rolls into the calibration history after the update.  The fused
+    chunked-CE hook is deliberately bypassed (the trainer refuses
+    --ce_chunk with fp8 — the observations don't thread the chunk scan)."""
+    base = losses_lib.get(loss_name)
+
+    def loss_fn(params, batch, qamax):
+        pred, obs = model.apply(params, batch["x"], qscales=qamax,
+                                return_qobs=True)
+        s, c = base(pred, batch["y"], batch.get("mask"))
+        return s, (c, obs)
+
+    return loss_fn
+
+
 def data_axis_size(mesh: Mesh) -> int:
     import numpy as np
 
@@ -272,21 +291,44 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
             "with optim.with_clipping instead of silently not clipping")
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    loss_fn = make_loss_fn(model, loss_name)
+    from ..ops import qmm
+
+    fp8 = qmm.model_format(model) == "fp8"
+    loss_fn = (make_qloss_fn(model, loss_name) if fp8
+               else make_loss_fn(model, loss_name))
 
     def shard_step(state: TrainState, batch: Batch):
-        s, c, grads = _accumulated_sum_and_grads(
-            loss_fn, state.params, batch, accum_steps)
+        new_qstate = None
+        if fp8:
+            # delayed scaling (ops.qmm): read the per-role delayed amax
+            # from the calibration state, collect this step's observed
+            # amax from the differentiated forward, pmax it across
+            # replicas (every replica must roll the IDENTICAL history —
+            # the state is replicated) and record it after the update
+            qamax = qmm.delayed_amax(state.qstate)
+            s, c, grads, obs = _accumulated_q_sum_and_grads(
+                loss_fn, state.params, batch, accum_steps, qamax)
+            obs = {k: lax.pmax(v, DATA_AXES) for k, v in obs.items()}
+            new_qstate = qmm.update_qstate(state.qstate, obs)
+        else:
+            s, c, grads = _accumulated_sum_and_grads(
+                loss_fn, state.params, batch, accum_steps)
         if update_sharding == "zero1":
-            return zero1_shard_update(optimizer, state, s, c, grads, mesh,
-                                      grad_clip=grad_clip,
-                                      with_metrics=with_metrics)
+            new_state, out = zero1_shard_update(
+                optimizer, state, s, c, grads, mesh, grad_clip=grad_clip,
+                with_metrics=with_metrics)
+            if fp8:
+                new_state = new_state._replace(qstate=new_qstate)
+            return new_state, out
         if update_sharding == "sharded":
             from . import update_sharding as us
 
-            return us.sharded_update(optimizer, state, s, c, grads, mesh,
-                                     update_plan, grad_clip=grad_clip,
-                                     with_metrics=with_metrics)
+            new_state, out = us.sharded_update(
+                optimizer, state, s, c, grads, mesh, update_plan,
+                grad_clip=grad_clip, with_metrics=with_metrics)
+            if fp8:
+                new_state = new_state._replace(qstate=new_qstate)
+            return new_state, out
         if grad_reduction == "global_mean":
             total = lax.psum(c, DATA_AXES)
             grads = jax.tree_util.tree_map(
@@ -313,11 +355,13 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
 
             new_params, new_opt, metrics = telemetry.update_with_metrics(
                 optimizer, grads, state.opt_state, state.params, loss)
-            return (TrainState(state.step + 1, new_params, new_opt),
+            return (TrainState(state.step + 1, new_params, new_opt,
+                               new_qstate if fp8 else state.qstate),
                     metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
-        return TrainState(state.step + 1, new_params, new_opt), loss
+        return (TrainState(state.step + 1, new_params, new_opt,
+                           new_qstate if fp8 else state.qstate), loss)
 
     batch_spec = P(DATA_AXES)
     if update_sharding == "zero1":
@@ -328,6 +372,10 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
         state_spec = us.state_spec(optimizer, update_plan)
     else:
         state_spec = P()
+    if fp8 and not isinstance(state_spec, P):
+        # the calibration leaves are replicated on every layout; the
+        # structured zero1/sharded specs must mirror them explicitly
+        state_spec = state_spec._replace(qstate=qmm.qstate_specs(model, P()))
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_spec, batch_spec),
@@ -337,24 +385,46 @@ def make_train_step(model, optimizer: Optimizer, mesh: Mesh,
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
-def _sum_and_grads(loss_fn, params, batch):
-    """((sum, count), grads-of-sum) in one backward pass."""
-
-    def scalar(p):
-        s, c = loss_fn(p, batch)
-        return s, c
-
-    (s, c), grads = jax.value_and_grad(scalar, has_aux=True)(params)
-    return s, c, grads
-
-
 def _accumulated_sum_and_grads(loss_fn, params, batch, accum_steps):
     """Per-shard (loss_sum, count, grad-of-sum), microbatched when
     ``accum_steps > 1``.  Because every loss returns *sums* (ops.losses),
     accumulating microbatch sums and grad-sums in f32 is exactly the
-    unsplit computation."""
+    unsplit computation.  Thin adapter over the q-variant below (one
+    implementation of the reshape/divisibility/scan machinery): the
+    plain (params, batch) loss closure is lifted to the 3-arg contract
+    with an empty observation dict, which adds zero leaves to the scan
+    carry and zero ops to the program."""
+
+    def qfn(p, b, _qamax):
+        s, c = loss_fn(p, b)
+        return s, (c, {})
+
+    s, c, grads, _obs = _accumulated_q_sum_and_grads(
+        qfn, params, batch, accum_steps, {})
+    return s, c, grads
+
+
+def _q_sum_and_grads(loss_fn, params, batch, qamax):
+    """((sum, count), grads-of-sum, fp8 observations) in one backward
+    pass; ``loss_fn`` follows :func:`make_qloss_fn`'s 3-arg contract
+    (plain losses are lifted by the adapter above — obs = {})."""
+
+    def scalar(p):
+        s, (c, obs) = loss_fn(p, batch, qamax)
+        return s, (c, obs)
+
+    (s, (c, obs)), grads = jax.value_and_grad(scalar, has_aux=True)(params)
+    return s, c, grads, obs
+
+
+def _accumulated_q_sum_and_grads(loss_fn, params, batch, accum_steps,
+                                 qamax):
+    """THE microbatch accumulator (the plain variant above delegates
+    here): loss/grad SUMS add in f32 — exactly the unsplit computation —
+    and amax observations max-merge over the scan (amax of the union is
+    the max of amaxes)."""
     if accum_steps == 1:
-        return _sum_and_grads(loss_fn, params, batch)
+        return _q_sum_and_grads(loss_fn, params, batch, qamax)
     micro = {}
     for k, v in batch.items():
         rows = v.shape[0]
@@ -365,17 +435,20 @@ def _accumulated_sum_and_grads(loss_fn, params, batch, accum_steps):
         micro[k] = v.reshape((accum_steps, rows // accum_steps) + v.shape[1:])
 
     def body(carry, mb):
-        cs, cc, cg = carry
-        s, c, g = _sum_and_grads(loss_fn, params, mb)
+        cs, cc, cg, cobs = carry
+        s, c, g, obs = _q_sum_and_grads(loss_fn, params, mb, qamax)
         cg = jax.tree_util.tree_map(
             lambda a, b: a + b.astype(jnp.float32), cg, g)
-        return (cs + s, cc + c, cg), None
+        cobs = {k: jnp.maximum(cobs[k], obs[k]) for k in cobs}
+        return (cs + s, cc + c, cg, cobs), None
 
     zeros = jax.tree_util.tree_map(
         lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zeros)
-    (s, c, grads), _ = lax.scan(body, init, micro)
-    return s, c, grads
+    obs0 = {k: jnp.zeros((), jnp.float32) for k in qamax}
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), zeros,
+            obs0)
+    (s, c, grads, obs), _ = lax.scan(body, init, micro)
+    return s, c, grads, obs
 
 
 def make_eval_step(model, mesh: Mesh, loss_name: str = "mse",
@@ -444,4 +517,5 @@ def place_zero1_state(state: TrainState, mesh: Mesh,
         params=jax.device_put(state.params, rep),
         opt_state=jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            state.opt_state, opt_spec))
+            state.opt_state, opt_spec),
+        qstate=jax.device_put(state.qstate, rep))

@@ -53,6 +53,8 @@ def state_specs(model, params: Pytree, optimizer: Optimizer,
     all-gather pair itself and schedules it against the backward pass
     (the arXiv 2204.06514 formulation of arXiv 2004.13336's
     cross-replica update sharding)."""
+    from ..ops import qmm
+
     ps = tp.param_specs(model, params, mesh)
     if optimizer.state_specs is None:
         raise ValueError(f"{optimizer.name} lacks state_specs")
@@ -67,7 +69,8 @@ def state_specs(model, params: Pytree, optimizer: Optimizer,
             "(choices: replicated, sharded — zero1's flat buffer is a "
             "shard_map-path layout)")
     return TrainState(step=P(), params=ps,
-                      opt_state=optimizer.state_specs(opt_ps, params))
+                      opt_state=optimizer.state_specs(opt_ps, params),
+                      qstate=qmm.qstate_specs(model, P()))
 
 
 def batch_specs(batch: Batch) -> Pytree:
@@ -116,15 +119,28 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                 f"global batch {rows} not divisible by accum_steps="
                 f"{accum_steps} x data-axes size {data_size}")
 
-    def sum_and_grads(params, b):
-        def scalar(p):
-            pred = model.apply(p, b["x"])
-            return base(pred, b["y"], b.get("mask"))
+    from ..ops import qmm
 
-        (s, c), g = jax.value_and_grad(scalar, has_aux=True)(params)
-        return s, c, g
+    fp8 = qmm.model_format(model) == "fp8"
+
+    def sum_and_grads(params, b, qamax):
+        def scalar(p):
+            if fp8:
+                # delayed scaling (ops.qmm): global-view tensors, so the
+                # observed amax needs no cross-replica reduction — the
+                # partitioner inserts whatever the layout requires
+                pred, obs = model.apply(p, b["x"], qscales=qamax,
+                                        return_qobs=True)
+            else:
+                pred, obs = model.apply(p, b["x"]), {}
+            s, c = base(pred, b["y"], b.get("mask"))
+            return s, (c, obs)
+
+        (s, (c, obs)), g = jax.value_and_grad(scalar, has_aux=True)(params)
+        return s, c, g, obs
 
     def step_fn(state: TrainState, batch: Batch):
+        qamax = qmm.delayed_amax(state.qstate) if fp8 else None
         if accum_steps > 1:
             micro = {
                 k: v.reshape((v.shape[0] // accum_steps, accum_steps)
@@ -137,19 +153,24 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
                      for k, v in micro.items()}
 
             def body(carry, mb):
-                cs, cc, cg = carry
-                s, c, g = sum_and_grads(state.params, mb)
+                cs, cc, cg, cobs = carry
+                s, c, g, obs = sum_and_grads(state.params, mb, qamax)
                 cg = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32), cg, g)
-                return (cs + s, cc + c, cg), None
+                cobs = {k: jnp.maximum(cobs[k], obs[k]) for k in cobs}
+                return (cs + s, cc + c, cg, cobs), None
 
             zeros = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            obs0 = {k: jnp.zeros((), jnp.float32)
+                    for k in (qamax or {})}
             init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-                    zeros)
-            (s, c, grads), _ = lax.scan(body, init, micro)
+                    zeros, obs0)
+            (s, c, grads, obs), _ = lax.scan(body, init, micro)
         else:
-            s, c, grads = sum_and_grads(state.params, batch)
+            s, c, grads, obs = sum_and_grads(state.params, batch, qamax)
+        new_qstate = (qmm.update_qstate(state.qstate, obs) if fp8
+                      else state.qstate)
         loss = s / c
         grads = jax.tree_util.tree_map(lambda g: g / c, grads)
         if with_metrics:
@@ -157,11 +178,12 @@ def make_gspmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
 
             new_params, new_opt, metrics = telemetry.update_with_metrics(
                 optimizer, grads, state.opt_state, state.params, loss)
-            return (TrainState(state.step + 1, new_params, new_opt),
-                    metrics)
+            return (TrainState(state.step + 1, new_params, new_opt,
+                               new_qstate), metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
-        return TrainState(state.step + 1, new_params, new_opt), loss
+        return (TrainState(state.step + 1, new_params, new_opt,
+                           new_qstate), loss)
 
     dummy_params = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0)))
     sspec = state_specs(model, dummy_params, optimizer, mesh,

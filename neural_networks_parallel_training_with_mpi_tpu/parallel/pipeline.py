@@ -336,8 +336,12 @@ def _stage_fns(model: Transformer, tp: int):
             return out  # (x, aux) from the MoE FFN
     else:
         def block_body(h, layer_params):
-            # (h, aux): aux is the MoE load-balance scalar, 0 for dense FFN
-            return model._block(layer_params, h)
+            # (h, aux): aux is the MoE load-balance scalar, 0 for dense
+            # FFN.  _block's third output (fp8 calibration observations)
+            # is dropped: the pipeline layout refuses matmul_dtype != bf16
+            # at the Trainer, so it is always the empty dict here.
+            out, aux, _qobs = model._block(layer_params, h)
+            return out, aux
 
     if c.remat:
         from ..models.core import make_remat

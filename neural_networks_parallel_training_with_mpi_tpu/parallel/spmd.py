@@ -22,8 +22,10 @@ from ..ops.optim import Optimizer
 from ..train.state import TrainState
 from .data_parallel import (
     DATA_AXES,
+    _accumulated_q_sum_and_grads,
     _accumulated_sum_and_grads,
     make_loss_fn,
+    make_qloss_fn,
     zero1_shard_update,
     zero1_state_spec,
 )
@@ -102,23 +104,43 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
     # TransformerConfig.ce_chunk) fires here too: under sequence
     # parallelism the (B, T_local, vocab) logits shard it avoids is still
     # the dominant temp for large vocabularies
-    loss_sum = make_loss_fn(model, loss_name)
+    from ..ops import qmm
+
+    fp8 = qmm.model_format(model) == "fp8"
+    loss_sum = (make_qloss_fn(model, loss_name) if fp8
+                else make_loss_fn(model, loss_name))
 
     def shard_step(state: TrainState, batch: Batch):
-        s, c, grads = _accumulated_sum_and_grads(
-            loss_sum, state.params, batch, accum_steps)
+        new_qstate = None
+        if fp8:
+            # delayed scaling (ops.qmm): observed amax pmax'd over the
+            # data AND seq axes — every replica of the replicated
+            # calibration state must roll the identical history
+            qamax = qmm.delayed_amax(state.qstate)
+            s, c, grads, obs = _accumulated_q_sum_and_grads(
+                loss_sum, state.params, batch, accum_steps, qamax)
+            obs = {k: lax.pmax(v, reduce_axes) for k, v in obs.items()}
+            new_qstate = qmm.update_qstate(state.qstate, obs)
+        else:
+            s, c, grads = _accumulated_sum_and_grads(
+                loss_sum, state.params, batch, accum_steps)
         if update_sharding == "zero1":
-            return zero1_shard_update(optimizer, state, s, c, grads, mesh,
-                                      grad_clip=grad_clip,
-                                      extra_reduce_axes=extra,
-                                      with_metrics=with_metrics)
+            new_state, out = zero1_shard_update(
+                optimizer, state, s, c, grads, mesh, grad_clip=grad_clip,
+                extra_reduce_axes=extra, with_metrics=with_metrics)
+            if fp8:
+                new_state = new_state._replace(qstate=new_qstate)
+            return new_state, out
         if update_sharding == "sharded":
             from . import update_sharding as us
 
-            return us.sharded_update(optimizer, state, s, c, grads, mesh,
-                                     update_plan, grad_clip=grad_clip,
-                                     extra_reduce_axes=extra,
-                                     with_metrics=with_metrics)
+            new_state, out = us.sharded_update(
+                optimizer, state, s, c, grads, mesh, update_plan,
+                grad_clip=grad_clip, extra_reduce_axes=extra,
+                with_metrics=with_metrics)
+            if fp8:
+                new_state = new_state._replace(qstate=new_qstate)
+            return new_state, out
         total = lax.psum(c, reduce_axes)
         grads = jax.tree_util.tree_map(
             lambda g: lax.psum(g, reduce_axes) / total, grads)
@@ -128,11 +150,13 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
 
             new_params, new_opt, metrics = telemetry.update_with_metrics(
                 optimizer, grads, state.opt_state, state.params, loss)
-            return (TrainState(state.step + 1, new_params, new_opt),
+            return (TrainState(state.step + 1, new_params, new_opt,
+                               new_qstate if fp8 else state.qstate),
                     metrics)
         new_params, new_opt = optimizer.update(grads, state.opt_state,
                                                state.params)
-        return TrainState(state.step + 1, new_params, new_opt), loss
+        return (TrainState(state.step + 1, new_params, new_opt,
+                           new_qstate if fp8 else state.qstate), loss)
 
     if example_batch is None:
         raise ValueError("example_batch required to derive per-leaf specs")
@@ -145,6 +169,8 @@ def make_spmd_train_step(model, optimizer: Optimizer, mesh: Mesh,
         state_spec = us.state_spec(optimizer, update_plan)
     else:
         state_spec = P()
+    if fp8 and not isinstance(state_spec, P):
+        state_spec = state_spec._replace(qstate=qmm.qstate_specs(model, P()))
     mapped = jax.shard_map(
         shard_step, mesh=mesh,
         in_specs=(state_spec, specs),

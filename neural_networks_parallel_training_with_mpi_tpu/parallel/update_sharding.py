@@ -182,7 +182,8 @@ def place_state(state: TrainState, mesh: Mesh, optimizer: Optimizer,
         params=jax.device_put(state.params, rep),
         opt_state=jax.tree_util.tree_map(
             lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-            state.opt_state, opt_spec))
+            state.opt_state, opt_spec),
+        qstate=jax.device_put(state.qstate, rep))
 
 
 def _grad_sq(leaves) -> jax.Array:

@@ -21,10 +21,25 @@ class TrainState(NamedTuple):
     step: jax.Array          # int32 scalar
     params: Pytree
     opt_state: Pytree
+    # fp8 delayed-scaling calibration state (ops.qmm): per-tensor-role
+    # activation amax histories, read at the top of the jitted step and
+    # rolled at the bottom.  () — zero leaves — whenever the quantized
+    # matmul seam is off, so every pre-seam layout's state flattens to
+    # the exact same LEAF LIST (donation audits and the elastic
+    # reshard's field-ordered opt-state range unchanged); the treedef
+    # itself grows one leafless child, which pre-round-13 snapshots
+    # bridge through checkpoint._treedef_compatible (the defaulted-field
+    # probe), so old checkpoints still restore.  Replicated everywhere
+    # (scalar-ish leaves; observations are pmax'd across replicas before
+    # entering, so the histories stay identical).
+    qstate: Pytree = ()
 
     @classmethod
     def create(cls, model, optimizer, key: jax.Array) -> "TrainState":
+        from ..ops import qmm
+
         params = model.init(key)
         return cls(step=jnp.zeros((), jnp.int32),
                    params=params,
-                   opt_state=optimizer.init(params))
+                   opt_state=optimizer.init(params),
+                   qstate=qmm.init_qstate(model))

@@ -236,6 +236,39 @@ class Trainer:
                 "optimizer state (1/N per replica); it requires "
                 "update_sharding='sharded' — a replicated master would "
                 "duplicate param memory instead of saving it")
+        mm = cfg.model.matmul_dtype
+        if mm not in ("bf16", "int8", "fp8"):
+            raise ValueError(f"unknown --matmul_dtype {mm!r} "
+                             "(choices: bf16, int8, fp8)")
+        if mm != "bf16":
+            # quantized-matmul seam (ops.qmm, DESIGN.md §14): wired where
+            # the model's own forward runs whole matmuls — the DP /
+            # DP x seq shard_map and GSPMD layouts (all update_sharding
+            # forms; the global-norm/guard/metrics seam rides unchanged).
+            # The explicit-TP layouts (pipe, seq x tensor, expert x
+            # tensor) slice matmuls in their own block code and would
+            # silently bypass the seam — refuse instead.
+            if cfg.model.arch != "transformer":
+                raise ValueError(
+                    f"--matmul_dtype {mm} is the transformer's quantized "
+                    "dense-projection seam; it does nothing for "
+                    f"arch={cfg.model.arch!r}")
+            if (self.pipeline or self.expert or self.sp_tp or self.ep_tp):
+                raise NotImplementedError(
+                    f"--matmul_dtype {mm} is wired on the DP, DP x seq "
+                    "and GSPMD (tensor/fsdp) layouts; the pipe/expert/"
+                    "seq-x-tensor layouts run their own sliced matmuls "
+                    "outside the ops.qmm seam")
+            if cfg.model.moe_experts > 0:
+                raise ValueError(
+                    f"--matmul_dtype {mm} covers the dense projections "
+                    "(qkv/attn_out/ffn/head); the MoE expert einsums are "
+                    "not routed through the seam — drop --moe_experts")
+        if mm == "fp8" and cfg.model.ce_chunk > 0:
+            raise ValueError(
+                "--matmul_dtype fp8 needs the delayed-scaling amax "
+                "observations, which do not thread the --ce_chunk fused "
+                "scan; use int8/bf16 with --ce_chunk, or drop it")
         if cfg.pp_interleave > 1 and not self.pipeline:
             raise ValueError("--pp_interleave needs the pipeline layout "
                              "(--pp > 1); it schedules virtual stage-slices "
@@ -551,6 +584,11 @@ class Trainer:
                            "gspmd" if self.gspmd else "dp")
         if cfg.update_sharding != "replicated":
             self.layout_tag += f"+{cfg.update_sharding}"
+        if cfg.model.matmul_dtype != "bf16":
+            # the ledger names each (layout, matmul_dtype) pair's program:
+            # a format change is a NEW named compile event; flipping the
+            # calibration state (amax values, shapes fixed) is not
+            self.layout_tag += f"+matmul_dtype={cfg.model.matmul_dtype}"
         if not (self.expert or self.ep_tp):
             self.train_step = ledger_lib.instrument(
                 self.train_step, f"train_step[{self.layout_tag}]")
@@ -625,6 +663,8 @@ class Trainer:
                 state, self.mesh, self.optimizer,
                 interleave=self.cfg.pp_interleave)
             return self.state
+        from ..ops import qmm
+
         if self.zero1:
             import jax.numpy as jnp
 
@@ -632,7 +672,8 @@ class Trainer:
             host = TrainState(
                 step=jnp.zeros((), jnp.int32), params=params,
                 opt_state=dp.zero1_opt_state(self.optimizer, params,
-                                             self.mesh, place=False))
+                                             self.mesh, place=False),
+                qstate=qmm.init_qstate(self.model))
             self.state = dp.place_zero1_state(host, self.mesh,
                                               self.optimizer)
             return self.state
@@ -645,7 +686,8 @@ class Trainer:
             host = TrainState(
                 step=jnp.zeros((), jnp.int32), params=params,
                 opt_state=us_lib.init_opt_state(self.optimizer, params,
-                                                self.update_plan))
+                                                self.update_plan),
+                qstate=qmm.init_qstate(self.model))
             self.state = us_lib.place_state(host, self.mesh,
                                             self.optimizer,
                                             self.update_plan)
@@ -1120,8 +1162,12 @@ class Trainer:
                 return type(st)(*(fix_state(f) for f in st))
             return fix(st)
 
+        # qstate passes through untouched: the fp8 calibration histories
+        # carry no qkv column layout, and dropping them here would
+        # silently reset delayed scaling on any resume that re-permutes
         return TrainState(step=restored.step, params=fix(restored.params),
-                          opt_state=fix_state(restored.opt_state))
+                          opt_state=fix_state(restored.opt_state),
+                          qstate=restored.qstate)
 
     def save(self, final: bool = False) -> None:
         # every process calls in: checkpoint.save is leader-only for

@@ -565,6 +565,26 @@ def _repad_axis(saved: np.ndarray, want_shape: tuple, leaf_idx: int
     return np.pad(cur, widths)
 
 
+def _treedef_compatible(saved, t_treedef, t_leaves) -> bool:
+    """A snapshot written BEFORE a NamedTuple state gained a defaulted
+    trailing field (TrainState grew ``qstate=()`` in round 13) carries a
+    shorter-arity treedef for the same class; its leaf list is
+    identical, because the new field defaults to a leafless pytree.
+    Probe: unflattening the TEMPLATE's leaves through the SAVED treedef
+    reconstructs via the class's defaults — if the result has exactly
+    the template's structure, the snapshot is the same state modulo the
+    defaulted field and restore may proceed leaf-aligned.  Any genuine
+    mismatch (different optimizer, different model) still fails: the
+    probe either raises or reconstructs a different structure."""
+    try:
+        if saved.num_leaves != len(t_leaves):
+            return False
+        probe = jax.tree_util.tree_unflatten(saved, t_leaves)
+        return jax.tree_util.tree_structure(probe) == t_treedef
+    except Exception:  # noqa: BLE001 — arity/type mismatch = incompatible
+        return False
+
+
 def _restore_npz(path: Path, template: Optional[TrainState],
                  elastic: bool = False) -> TrainState:
     data = np.load(path / "state.npz")
@@ -582,7 +602,8 @@ def _restore_npz(path: Path, template: Optional[TrainState],
     treedef = pickle.loads((path / "treedef.pkl").read_bytes())
     if template is not None:
         t_leaves, t_treedef = jax.tree_util.tree_flatten(template)
-        if t_treedef != treedef:
+        if t_treedef != treedef and not _treedef_compatible(
+                treedef, t_treedef, t_leaves):
             raise ValueError(
                 f"checkpoint structure mismatch: saved {treedef}, "
                 f"expected {t_treedef} — wrong model/optimizer config, or a "

@@ -1,0 +1,592 @@
+"""Quantized-matmul seam (ops.qmm, DESIGN.md §14): qdot fwd/bwd numerics
+for int8 and fp8, the fp8 delayed-scaling state machine (init, roll,
+non-finite guard, uncalibrated fallback), training wiring across the DP
+layouts (qstate riding TrainState through the jitted step, replicas
+identical), the bf16 no-op pin, the compile-ledger calibration pin,
+checkpoint/elastic round-trips, and the serving int8-compute decode's
+greedy parity against the PTQ path."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.ops import optim, qmm
+from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+    data_parallel as dp,
+    mesh as mesh_lib,
+    sharding as shd,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+from neural_networks_parallel_training_with_mpi_tpu.utils import (
+    checkpoint as ckpt_lib,
+    compile_ledger as ledger_lib,
+    prng,
+)
+
+pytestmark = pytest.mark.quant
+
+
+# ---------------------------------------------------------------------------
+# qdot numerics
+# ---------------------------------------------------------------------------
+
+def _xw(seed=0, shape=(4, 16, 32), out=24):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((shape[-1], out)) * 0.1, jnp.float32)
+    return x, w
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8"])
+def test_qdot_forward_close(fmt):
+    x, w = _xw()
+    y = qmm.qdot(x, w, fmt=fmt)
+    ref = x @ w
+    # int8: per-row/per-channel symmetric scales bound the relative error
+    # tightly; fp8 e4m3 carries a 3-bit mantissa — looser but bounded
+    tol = 0.03 if fmt == "int8" else 0.15
+    assert float(jnp.max(jnp.abs(y - ref))) < tol
+    assert y.dtype == jnp.float32
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8"])
+def test_qdot_grads_close(fmt):
+    """The custom_vjp backward (quantized transposed contractions) tracks
+    the exact gradient in direction and magnitude."""
+    x, w = _xw(1)
+
+    def f(x, w):
+        return jnp.sum(qmm.qdot(x, w, fmt=fmt) ** 2)
+
+    def fr(x, w):
+        return jnp.sum((x @ w) ** 2)
+
+    gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(fr, argnums=(0, 1))(x, w)
+    for g, r in ((gx, rx), (gw, rw)):
+        rel = float(jnp.linalg.norm(g - r) / jnp.linalg.norm(r))
+        assert rel < 0.08, (fmt, rel)
+        # bf16-storage callers get their dtype back through the cast vjp
+    gxb = jax.grad(lambda x, w: jnp.sum(qmm.qdot(x, w, fmt=fmt)),
+                   argnums=0)(x.astype(jnp.bfloat16),
+                              w.astype(jnp.bfloat16))
+    assert gxb.dtype == jnp.bfloat16
+
+
+def test_qdot_rejects_bf16_and_unknown():
+    x, w = _xw(2, shape=(2, 8), out=4)
+    with pytest.raises(ValueError, match="plain"):
+        qmm.qdot(x, w, fmt="bf16")
+    with pytest.raises(ValueError, match="unknown"):
+        qmm.qdot(x, w, fmt="int4")
+
+
+def test_int8_serve_dot_vs_dequant():
+    """The serving dot (dynamic per-token activation scales x PTQ
+    weights) stays within the activation-rounding bound of the
+    dequant-then-f32 reference."""
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        dequantize_array, quantize_array,
+    )
+
+    x, w = _xw(3)
+    wq, ws = quantize_array(w)
+    ref = x @ dequantize_array(wq, ws)
+    got = qmm.int8_serve_dot(x, wq, ws)
+    assert float(jnp.max(jnp.abs(got - ref))) < 0.03
+
+
+# ---------------------------------------------------------------------------
+# delayed-scaling state machine
+# ---------------------------------------------------------------------------
+
+def _tiny(fmt="fp8", **kw):
+    return Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=32, n_layers=2, d_model=32, n_heads=4,
+        d_ff=64, matmul_dtype=fmt, **kw))
+
+
+def test_qstate_init_and_roles():
+    m = _tiny()
+    qs = qmm.init_qstate(m)
+    assert set(qs["amax"]) == {"qkv", "attn_out", "ff_in", "ff_out", "head"}
+    for h in qs["amax"].values():
+        assert h.shape == (qmm.HISTORY,) and float(h.sum()) == 0.0
+    assert qmm.init_qstate(_tiny("bf16")) == ()
+    assert qmm.init_qstate(_tiny("int8")) == ()
+    # swiglu adds the gate projection's role
+    assert "ff_gate" in qmm.init_qstate(
+        _tiny(activation="swiglu"))["amax"]
+
+
+def test_qstate_update_rolls_and_guards_nonfinite():
+    m = _tiny()
+    qs = qmm.init_qstate(m, history=4)
+    obs = {r: jnp.asarray(float(i + 1))
+           for i, r in enumerate(sorted(qs["amax"]))}
+    qs = qmm.update_qstate(qs, obs)
+    first = sorted(qs["amax"])[0]
+    np.testing.assert_allclose(np.asarray(qs["amax"][first]),
+                               [1.0, 0.0, 0.0, 0.0])
+    assert float(qmm.delayed_amax(qs)[first]) == 1.0
+    # a non-finite observation re-records the current delayed amax
+    bad = {r: jnp.asarray(np.inf) for r in qs["amax"]}
+    qs2 = qmm.update_qstate(qs, bad)
+    assert np.isfinite(np.asarray(qs2["amax"][first])).all()
+    np.testing.assert_allclose(np.asarray(qs2["amax"][first]),
+                               [1.0, 1.0, 0.0, 0.0])
+
+
+def test_uncalibrated_fp8_scale_is_safe():
+    """amax <= 0 (fresh history) must mean scale 1.0, not a huge scale
+    that saturates everything to the format max."""
+    x = jnp.asarray([[300.0, -2.0]], jnp.float32)  # within e4m3 range
+    w = jnp.eye(2, dtype=jnp.float32)
+    y = qmm.qdot(x, w, fmt="fp8", scales=jnp.asarray(0.0))
+    # scale 1: 300 is representable in e4m3 (no clip to 448 * tiny)
+    assert abs(float(y[0, 0]) - 300.0) < 20.0
+    assert abs(float(y[0, 1]) + 2.0) < 0.2
+
+
+# ---------------------------------------------------------------------------
+# training wiring (DP mesh)
+# ---------------------------------------------------------------------------
+
+def _mesh(n=4):
+    return mesh_lib.make_mesh(MeshConfig(data=n), devices=jax.devices()[:n])
+
+
+def _lm_batch(mesh, rows=8, seq=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return shd.shard_batch(mesh, {
+        "x": rng.integers(0, vocab, (rows, seq)).astype(np.int32),
+        "y": rng.integers(0, vocab, (rows, seq)).astype(np.int32),
+        "mask": np.ones((rows,), np.float32)})
+
+
+def test_bf16_default_is_exact_noop():
+    """The seam must be invisible when not engaged: default-config state
+    carries zero extra leaves, and the default model trains bitwise
+    identically to an explicit matmul_dtype='bf16' one."""
+    mesh = _mesh()
+    batch = _lm_batch(mesh)
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    params = {}
+    for fmt_kw in ({}, {"matmul_dtype": "bf16"}):
+        m = Transformer(TransformerConfig(
+            vocab_size=64, max_seq_len=32, n_layers=2, d_model=32,
+            n_heads=4, d_ff=64, **fmt_kw))
+        state = dp.replicate_state(
+            TrainState.create(m, opt, prng.init_key(0)), mesh)
+        assert state.qstate == ()
+        assert len(jax.tree_util.tree_leaves(state.qstate)) == 0
+        step = dp.make_train_step(m, opt, mesh, "cross_entropy",
+                                  donate=False)
+        for _ in range(2):
+            state, _ = step(state, batch)
+        params[bool(fmt_kw)] = jax.device_get(state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(params[False]),
+                    jax.tree_util.tree_leaves(params[True])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("fmt", ["int8", "fp8"])
+def test_quant_train_tracks_bf16_loss(fmt):
+    """Loss-curve parity envelope at tiny scale: the quantized arm's loss
+    stays within a documented band of the bf16 arm's over a short run
+    (the bench pins the same at CPU-bench scale)."""
+    mesh = _mesh()
+    batch = _lm_batch(mesh)
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    losses = {}
+    for f in ("bf16", fmt):
+        m = _tiny(f)
+        state = dp.replicate_state(
+            TrainState.create(m, opt, prng.init_key(0)), mesh)
+        step = dp.make_train_step(m, opt, mesh, "cross_entropy")
+        ls = []
+        for _ in range(6):
+            state, loss = step(state, batch)
+            ls.append(float(loss))
+        losses[f] = ls
+        if f == "fp8":
+            # the history rolled: slot 0 holds this step's (pmax'd) amax
+            hist = jax.device_get(state.qstate["amax"]["qkv"])
+            assert hist[0] > 0.0
+    deltas = [abs(a - b) for a, b in zip(losses["bf16"], losses[fmt])]
+    assert all(np.isfinite(losses[fmt]))
+    assert max(deltas) < 0.05, (losses, deltas)
+    # both arms actually train
+    assert losses[fmt][-1] < losses[fmt][0]
+
+
+def test_fp8_qstate_replicated_and_sharded_update():
+    """fp8 composes with update_sharding='sharded' (+ bf16 master
+    weights): the calibration leaves stay replicated and identical on
+    every device while the opt state is scattered."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        update_sharding as us,
+    )
+
+    mesh = _mesh()
+    batch = _lm_batch(mesh)
+    m = _tiny("fp8")
+    opt = optim.with_master_weights(optim.sgd(lr=1e-2, momentum=0.9))
+    params = m.init(prng.init_key(0))
+    plan = us.plan_updates(params, 4)
+    host = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=us.init_opt_state(opt, params, plan),
+                      qstate=qmm.init_qstate(m))
+    state = us.place_state(host, mesh, opt, plan)
+    step = dp.make_train_step(m, opt, mesh, "cross_entropy",
+                              update_sharding="sharded", update_plan=plan)
+    for _ in range(2):
+        state, loss = step(state, batch)
+    assert np.isfinite(float(loss))
+    hist = state.qstate["amax"]["ff_in"]
+    assert hist.sharding.is_fully_replicated
+    shards = [np.asarray(s.data) for s in hist.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+    assert float(np.asarray(shards[0])[0]) > 0.0
+
+
+def test_ledger_calibration_flips_add_zero_events():
+    """Compile-ledger pin (acceptance): each (format, layout) pair
+    compiles once; flipping the calibration state values adds ZERO
+    ledger events, and a format change shows up as a NEW event whose
+    name carries matmul_dtype."""
+    mesh = _mesh(2)
+    batch = _lm_batch(mesh)
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    led = ledger_lib.Ledger(None)
+    ledger_lib.install(led)
+    try:
+        for fmt in ("fp8", "bf16"):
+            m = _tiny(fmt)
+            state = dp.replicate_state(
+                TrainState.create(m, opt, prng.init_key(0)), mesh)
+            tag = "dp" + (f"+matmul_dtype={fmt}" if fmt != "bf16" else "")
+            step = ledger_lib.instrument(
+                dp.make_train_step(m, opt, mesh, "cross_entropy",
+                                   donate=False),
+                f"train_step[{tag}]")
+            for _ in range(3):  # amax history values change every step
+                state, _ = step(state, batch)
+            assert len(led.events_for(f"train_step[{tag}]")) == 1
+    finally:
+        ledger_lib.install(None)
+    names = [e["name"] for e in led.events]
+    assert names == ["train_step[dp+matmul_dtype=fp8]", "train_step[dp]"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / elastic round-trips
+# ---------------------------------------------------------------------------
+
+def test_fp8_qstate_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Acceptance: delayed-scaling state survives checkpoint/restore —
+    the resumed run's losses match the uninterrupted run's exactly (same
+    program, replicated state, calibration history restored bitwise)."""
+    mesh = _mesh()
+    batch = _lm_batch(mesh)
+    m = _tiny("fp8")
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    step = dp.make_train_step(m, opt, mesh, "cross_entropy", donate=False)
+    state = dp.replicate_state(
+        TrainState.create(m, opt, prng.init_key(0)), mesh)
+    for _ in range(3):
+        state, _ = step(state, batch)
+    ckpt_lib.save(str(tmp_path), state, keep=0)
+    straight = state
+    straight_losses = []
+    for _ in range(3):
+        straight, loss = step(straight, batch)
+        straight_losses.append(float(loss))
+    template = dp.replicate_state(
+        TrainState.create(m, opt, prng.init_key(0)), mesh)
+    restored = ckpt_lib.restore(str(tmp_path), template)
+    assert restored is not None
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(state.qstate["amax"]["qkv"])),
+        np.asarray(restored.qstate["amax"]["qkv"]))
+    resumed = dp.replicate_state(restored, mesh)
+    resumed_losses = []
+    for _ in range(3):
+        resumed, loss = step(resumed, batch)
+        resumed_losses.append(float(loss))
+    np.testing.assert_allclose(resumed_losses, straight_losses, rtol=0,
+                               atol=0)
+
+
+def test_legacy_pre_qstate_checkpoint_restores(tmp_path):
+    """A snapshot written BEFORE TrainState grew the qstate field (its
+    treedef has 3 children) must still restore against the new 4-field
+    template — checkpoint._treedef_compatible bridges the defaulted
+    leafless field.  Emulated faithfully: a shadow 3-field NamedTuple
+    whose __module__/__qualname__ point at the real TrainState pickles
+    (and unpickles) exactly like a pre-round-13 treedef."""
+    from typing import Any, NamedTuple
+
+    class LegacyTrainState(NamedTuple):
+        step: Any
+        params: Any
+        opt_state: Any
+
+    LegacyTrainState.__module__ = TrainState.__module__
+    LegacyTrainState.__qualname__ = TrainState.__qualname__
+    LegacyTrainState.__name__ = TrainState.__name__
+
+    m = _tiny("bf16")
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    real = TrainState.create(m, opt, prng.init_key(0))
+    legacy = LegacyTrainState(real.step, real.params, real.opt_state)
+    # pickle stores classes by module+qualname and verifies the lookup:
+    # park the shadow at the real location for the save, so the written
+    # treedef.pkl carries exactly the reference a pre-round-13 build
+    # wrote — and resolves to the REAL 4-field class on restore
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        state as state_mod,
+    )
+
+    state_mod.TrainState = LegacyTrainState
+    try:
+        ckpt_lib.save(str(tmp_path), legacy, keep=0)
+    finally:
+        state_mod.TrainState = TrainState
+    restored = ckpt_lib.restore(str(tmp_path), real)
+    assert restored is not None
+    assert isinstance(restored, TrainState) and restored.qstate == ()
+    for a, b in zip(jax.tree_util.tree_leaves(real.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # a GENUINE structure mismatch still refuses: wrong optimizer
+    bad_template = TrainState.create(m, optim.adam(lr=1e-3),
+                                     prng.init_key(0))
+    with pytest.raises(ValueError, match="structure mismatch"):
+        ckpt_lib.restore(str(tmp_path), bad_template)
+
+
+def test_fp8_qstate_elastic_reshard(tmp_path):
+    """Acceptance: the calibration leaves ride the elastic N->M reshard
+    (replicated scalar-ish vectors — world-shape-independent), next to
+    opt state that does get re-padded."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        update_sharding as us,
+    )
+
+    m = _tiny("fp8")
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    mesh4 = _mesh(4)
+    batch = _lm_batch(mesh4)
+    params = m.init(prng.init_key(0))
+    plan = us.plan_updates(params, 4)
+    host = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=us.init_opt_state(opt, params, plan),
+                      qstate=qmm.init_qstate(m))
+    state = us.place_state(host, mesh4, opt, plan)
+    step = dp.make_train_step(m, opt, mesh4, "cross_entropy",
+                              update_sharding="sharded", update_plan=plan)
+    for _ in range(2):
+        state, _ = step(state, batch)
+    ckpt_lib.save(str(tmp_path), state, keep=0)
+    saved_hist = np.asarray(jax.device_get(state.qstate["amax"]["head"]))
+
+    # restore onto a 2-device world: sharded opt leaves re-pad, qstate
+    # restores bitwise (shape-identical)
+    mesh2 = _mesh(2)
+    params2 = m.init(prng.init_key(0))
+    plan2 = us.plan_updates(params2, 2)
+    template = TrainState(step=jnp.zeros((), jnp.int32), params=params2,
+                          opt_state=us.init_opt_state(opt, params2, plan2),
+                          qstate=qmm.init_qstate(m))
+    restored = ckpt_lib.restore(str(tmp_path), template, elastic=True)
+    assert restored is not None
+    np.testing.assert_array_equal(
+        np.asarray(restored.qstate["amax"]["head"]), saved_hist)
+    state2 = us.place_state(restored, mesh2, opt, plan2)
+    step2 = dp.make_train_step(m, opt, mesh2, "cross_entropy",
+                               update_sharding="sharded",
+                               update_plan=plan2)
+    state2, loss = step2(state2, _lm_batch(mesh2))
+    assert np.isfinite(float(loss))
+
+
+# ---------------------------------------------------------------------------
+# serving: int8 compute on the decode path
+# ---------------------------------------------------------------------------
+
+def test_int8_compute_decode_greedy_matches_ptq():
+    """The true int8 activation x weight decode (matmul_dtype='int8' over
+    ops.quant PTQ params) pins greedy-token parity against the
+    dequant-then-f32 PTQ path on the bench prompt."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+        generate,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, max_seq_len=48, n_layers=2,
+                            d_model=32, n_heads=4, d_ff=64)
+    params = Transformer(cfg).init(prng.init_key(0))
+    qp = quantize_params(params)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    ptq = generate(Transformer(cfg), qp, prompt, 16)
+    q8 = generate(Transformer(dataclasses.replace(cfg,
+                                                  matmul_dtype="int8")),
+                  qp, prompt, 16)
+    np.testing.assert_array_equal(np.asarray(ptq), np.asarray(q8))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kw", [{"n_kv_heads": 2}, {"scan_layers": True},
+                                {"pos_encoding": "rope"}])
+def test_int8_compute_decode_variants_close(kw):
+    """GQA / scan / rope variants: the int8-compute decode stays within
+    the stated token-agreement tolerance of the PTQ path (activation
+    rounding can flip near-tie argmaxes)."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.generate import (
+        generate,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    cfg = TransformerConfig(vocab_size=64, max_seq_len=48, n_layers=2,
+                            d_model=32, n_heads=4, d_ff=64, **kw)
+    params = Transformer(cfg).init(prng.init_key(1))
+    qp = quantize_params(params)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    a = generate(Transformer(cfg), qp, prompt, 12, kv_quant=True)
+    b = generate(Transformer(dataclasses.replace(cfg,
+                                                 matmul_dtype="int8")),
+                 qp, prompt, 12, kv_quant=True)
+    agree = (np.asarray(a) == np.asarray(b)).mean()
+    assert agree >= 0.8, (kw, np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# layout matrix + trainer validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fmt", ["int8", "fp8"])
+@pytest.mark.parametrize("layout", ["gspmd", "spmd", "zero1"])
+def test_quant_layout_matrix(fmt, layout):
+    """Per-format x per-layout wiring: GSPMD (tp x fsdp), DP x SP, and
+    zero1 all run the quantized step with finite loss and (fp8) a
+    rolling calibration history."""
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        gspmd, spmd,
+    )
+
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    rng = np.random.default_rng(0)
+    raw = {"x": rng.integers(0, 64, (8, 16)).astype(np.int32),
+           "y": rng.integers(0, 64, (8, 16)).astype(np.int32),
+           "mask": np.ones((8,), np.float32)}
+    if layout == "gspmd":
+        m = _tiny(fmt)
+        mesh = mesh_lib.make_mesh(MeshConfig(data=2, fsdp=2),
+                                  devices=jax.devices()[:4])
+        state = gspmd.shard_state(
+            m, TrainState.create(m, opt, prng.init_key(0)), opt, mesh)
+        batch = shd.shard_batch(mesh, raw)
+        step = gspmd.make_gspmd_train_step(m, opt, mesh, "cross_entropy",
+                                           example_batch=batch)
+    elif layout == "spmd":
+        m = _tiny(fmt, attention="ring")
+        mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=2),
+                                  devices=jax.devices()[:4])
+        state = dp.replicate_state(
+            TrainState.create(m, opt, prng.init_key(0)), mesh)
+        batch = spmd.place_batch(mesh, raw, "seq")
+        step = spmd.make_spmd_train_step(m, opt, mesh, "cross_entropy",
+                                         seq_axis="seq",
+                                         example_batch=batch)
+    else:  # zero1
+        m = _tiny(fmt)
+        mesh = _mesh(4)
+        params = m.init(prng.init_key(0))
+        host = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=dp.zero1_opt_state(opt, params, mesh, place=False),
+            qstate=qmm.init_qstate(m))
+        state = dp.place_zero1_state(host, mesh, opt)
+        batch = shd.shard_batch(mesh, raw)
+        step = dp.make_train_step(m, opt, mesh, "cross_entropy",
+                                  update_sharding="zero1")
+    for _ in range(2):
+        state, loss = step(state, batch)
+    assert np.isfinite(float(loss)), (fmt, layout, float(loss))
+    if fmt == "fp8":
+        assert float(jax.device_get(
+            state.qstate["amax"]["qkv"])[0]) > 0.0
+
+
+def test_matmul_skip_keeps_sites_full_precision():
+    """matmul_skip (the compute analogue of ops.quant's `skip`, wired
+    from --quantize_skip): a skipped role runs the plain matmul — with
+    EVERY role skipped, a quantized-format model is bitwise the bf16
+    model — and skipped roles carry no fp8 calibration history."""
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 12)),
+                      jnp.int32)
+    ref = None
+    all_roles = ("qkv", "attn_out", "ff_in", "ff_out", "head")
+    for fmt in ("bf16", "int8", "fp8"):
+        m = _tiny(fmt, matmul_skip=all_roles if fmt != "bf16" else ())
+        logits = m.apply(m.init(prng.init_key(0)), ids)
+        if ref is None:
+            ref = np.asarray(logits)
+        else:
+            np.testing.assert_array_equal(np.asarray(logits), ref)
+    m = _tiny("fp8", matmul_skip=("head",))
+    assert "head" not in qmm.quant_roles(m)
+    assert m._mm("head") == "bf16" and m._mm("qkv") == "fp8"
+    # and the partial-skip model still trains with a head-less qstate
+    mesh = _mesh(2)
+    opt = optim.sgd(lr=1e-2, momentum=0.9)
+    state = dp.replicate_state(
+        TrainState.create(m, opt, prng.init_key(0)), mesh)
+    step = dp.make_train_step(m, opt, mesh, "cross_entropy")
+    state, loss = step(state, _lm_batch(mesh))
+    assert np.isfinite(float(loss))
+    assert set(state.qstate["amax"]) == {"qkv", "attn_out", "ff_in",
+                                         "ff_out"}
+
+
+def test_trainer_refuses_unwired_quant_layouts():
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        DataConfig, ModelConfig, TrainConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.trainer import (
+        Trainer,
+    )
+
+    def cfg(**model_kw):
+        return TrainConfig(
+            nepochs=1, loss="cross_entropy",
+            data=DataConfig(dataset="lm", seq_len=16, n_samples=8,
+                            vocab_size=64),
+            model=ModelConfig(arch="transformer", n_layers=2, d_model=32,
+                              n_heads=4, d_ff=64, vocab_size=64,
+                              max_seq_len=16, attention="dense",
+                              **model_kw),
+            mesh=MeshConfig(data=-1))
+
+    with pytest.raises(ValueError, match="moe"):
+        Trainer(cfg(matmul_dtype="fp8", moe_experts=2))
+    with pytest.raises(ValueError, match="ce_chunk"):
+        Trainer(cfg(matmul_dtype="fp8", ce_chunk=8))
+    with pytest.raises(ValueError, match="transformer"):
+        Trainer(dataclasses.replace(
+            cfg(), model=ModelConfig(arch="mlp", matmul_dtype="int8")))

@@ -20,6 +20,8 @@ from neural_networks_parallel_training_with_mpi_tpu.ops.quant import (
 )
 from neural_networks_parallel_training_with_mpi_tpu.utils import prng
 
+pytestmark = pytest.mark.quant
+
 
 def test_quantize_array_roundtrip_bound():
     rng = np.random.default_rng(0)
@@ -37,6 +39,72 @@ def test_quantize_array_zero_column():
     q, scale = quantize_array(w)
     np.testing.assert_array_equal(np.asarray(q), 0)
     np.testing.assert_array_equal(np.asarray(scale), 1.0)  # no div-by-0
+
+
+def test_quantize_array_mixed_zero_columns():
+    """A kernel with SOME all-zero output columns: the zero columns get
+    scale 1 (no divide-by-zero) while the live columns round-trip within
+    their own scale/2 bound — one poisoned column cannot distort its
+    neighbours' scales."""
+    rng = np.random.default_rng(7)
+    w = np.asarray(rng.standard_normal((16, 6)), np.float32)
+    w[:, 1] = 0.0
+    w[:, 4] = 0.0
+    q, scale = quantize_array(jnp.asarray(w))
+    assert np.asarray(scale)[1] == 1.0 and np.asarray(scale)[4] == 1.0
+    np.testing.assert_array_equal(np.asarray(q)[:, 1], 0)
+    err = np.abs(np.asarray(dequantize_array(q, scale)) - w)
+    assert np.all(err <= np.asarray(scale)[None, :] / 2 + 1e-7)
+
+
+def test_quantize_array_nondefault_axis():
+    """axis= names the CONTRACTION dim the scale must not span: axis=-1
+    on a (out, in)-layout kernel keeps per-row scales, and the
+    reconstruction bound holds with the scale expanded on that axis."""
+    rng = np.random.default_rng(8)
+    w = jnp.asarray(rng.standard_normal((6, 16)), jnp.float32)
+    q, scale = quantize_array(w, axis=-1)
+    assert scale.shape == (6,)
+    recon = dequantize_array(q, scale, axis=-1)
+    err = np.abs(np.asarray(recon) - np.asarray(w))
+    assert np.all(err <= np.asarray(scale)[:, None] / 2 + 1e-7)
+    # and the two layouts agree: quantizing w.T with the default axis is
+    # the same codes transposed
+    qt, st = quantize_array(w.T)
+    np.testing.assert_array_equal(np.asarray(qt).T, np.asarray(q))
+    np.testing.assert_allclose(np.asarray(st), np.asarray(scale))
+
+
+def test_quantize_params_expert_dict_zero_and_gate():
+    """Expert-dict leaves as a first-class walk target (previously only
+    exercised through the full-model tests): an expert whose w_out is
+    all zero still quantizes safely (scale 1), w_gate (SwiGLU experts)
+    rides along, and the router gate stays untouched."""
+    from neural_networks_parallel_training_with_mpi_tpu.models.moe import MoEFFN
+
+    moe = MoEFFN(16, 32, 2, activation="swiglu")
+    params = moe.init(prng.init_key(0))
+    params["experts"]["w_out"] = jnp.zeros_like(params["experts"]["w_out"])
+    q = quantize_params({"moe": params})["moe"]
+    assert q["experts"]["w_in"].dtype == jnp.int8
+    assert q["experts"]["w_gate"].dtype == jnp.int8
+    assert q["experts"]["w_out"].dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(q["experts"]["w_out"]), 0)
+    np.testing.assert_array_equal(
+        np.asarray(q["experts"]["w_out_scale"]), 1.0)
+    assert q["gate"]["w"].dtype == jnp.float32  # router stays exact
+
+
+def test_quantized_bytes_accounting_pin():
+    """Closed-form accounting: quantized_bytes must equal the exact sum
+    of as-stored leaf bytes — int8 kernels 1 byte/elt, their f32 scales
+    4, untouched f32 leaves 4 (the quantity decode bandwidth streams)."""
+    lin = Linear(32, 16)
+    params = lin.init(prng.init_key(0))
+    full = quantized_bytes(params)
+    assert full == (32 * 16 + 16) * 4
+    q = quantize_params(params)
+    assert quantized_bytes(q) == 32 * 16 * 1 + 16 * 4 + 16 * 4
 
 
 def test_quantize_array_stacked_blocks():
