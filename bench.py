@@ -1204,6 +1204,7 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
                     or doc.get("rl_artifact")
                     or doc.get("update_sharding_artifact")
                     or doc.get("trace_artifact")
+                    or doc.get("obs_artifact")
                     or doc.get("prefix_cache_artifact")
                     or doc.get("quant_artifact"))
     return None
@@ -2149,6 +2150,206 @@ def bench_trace_overhead(out_path: str = "BENCH_TRACE.json",
     return out_path
 
 
+def bench_obs_overhead(out_path: str = "BENCH_OBS.json",
+                       reps: int = 5, chain: int = 2) -> str:
+    """Interleaved A/B of the FULL observability plane OFF vs ON at the
+    CPU-bench transformer scale (the DESIGN §7 methodology: per-rep
+    adjacent pairs so shared-core load drift cancels in the ratio).
+
+    The ON arm pays everything a fleet-observable trainer pays per
+    dispatch: the on-device metrics vector (telemetry ``with_metrics``
+    step), the lag-2 fetch, the metrics.jsonl write, the quantile-
+    sketch feeds + EMA z-score detectors, the kind="rollup" sketch
+    serialization on its cadence, and the per-role heartbeat.  Both
+    arms start from the same init and the final param digests are
+    compared — the bitwise sketches-on-vs-off pin, embedded as
+    evidence (the with_metrics bitwise half is pinned independently by
+    tests/test_telemetry.py; everything the sketch layer adds is host-
+    side arithmetic on already-fetched floats, so it CANNOT touch the
+    update math — the digest proves it)."""
+    import hashlib
+    import shutil
+    import tempfile
+    import types
+
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        telemetry as telemetry_lib,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    c = _LM
+    seq, batch_size = 128, 32
+    devices = jax.devices()
+    n = len(devices)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n), devices=devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=seq, n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32))
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    rng = np.random.default_rng(0)
+    raw = {
+        "x": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "y": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "mask": np.ones((batch_size,), np.float32),
+    }
+    batch = shd.shard_batch(mesh, raw)
+    step_off = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                  "global_mean")
+    step_on = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                                 "global_mean", with_metrics=True)
+    sync = _chain_sync_every()
+    telem_tmp = tempfile.mkdtemp(prefix="bench_obs_")
+    # every other dispatch crosses a rollup boundary: the ON arm pays
+    # sketch serialization INSIDE the measured window, not just at exit
+    telem_cfg = types.SimpleNamespace(
+        telemetry_dir=telem_tmp, metrics_every=1, flight_recorder=64,
+        rollup_every=2, alerts=True)
+    telem = telemetry_lib.Telemetry(
+        telem_cfg, model, (seq,), n_devices=n,
+        device_kind=devices[0].device_kind,
+        platform=devices[0].platform)
+
+    def fresh_state():
+        return dp.replicate_state(
+            TrainState.create(model, opt, prng.init_key(0)), mesh)
+
+    def digest(state):
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    step_counter = {"on": 0}
+
+    def run_chain(state, k, mode):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(k):
+            if mode == "off":
+                state, out = step_off(state, batch)
+            else:
+                state, out = step_on(state, batch)
+                if mode == "on":
+                    before = step_counter["on"]
+                    step_counter["on"] += 1
+                    telem.on_dispatch(step_counter["on"], 0, before, out,
+                                      1, batch_size)
+            if sync and (i + 1) % sync == 0:
+                jax.block_until_ready(out)
+        loss = out["loss"] if isinstance(out, dict) else out
+        val = float(jax.device_get(loss))
+        return time.perf_counter() - t0, state, val
+
+    try:
+        # three interleaved arms: 'off' (bare step), 'metrics' (the PR 2
+        # with_metrics step, NO telemetry driver — the on-device norms'
+        # own cost) and 'on' (full plane) — so the artifact attributes
+        # the off->on delta between the jitted-step norms and the new
+        # host-side sketch/rollup/alert/heartbeat layer
+        states = {"off": fresh_state(), "metrics": fresh_state(),
+                  "on": fresh_state()}
+        modes = {"off": "off", "metrics": "metrics", "on": "on"}
+        for name in states:  # warmup: jit compile all arms
+            _, states[name], _ = run_chain(states[name], 1, modes[name])
+        times = {"off": [], "metrics": [], "on": []}
+        loss_vals = {}
+        for _rep in range(reps):
+            for name in ("off", "metrics", "on"):
+                dt, states[name], loss_vals[name] = run_chain(
+                    states[name], chain, modes[name])
+                times[name].append(dt / chain)
+        telem.flush(final=True, step=step_counter["on"])
+        dig = {name: digest(s) for name, s in states.items()}
+        rollups = telem.rollups_written
+        alerts = telem.alerts_fired
+    finally:
+        telem.close()
+        shutil.rmtree(telem_tmp, ignore_errors=True)
+    assert all(np.isfinite(v) for v in loss_vals.values())
+    pair_ratios = [a / b for a, b in zip(times["on"], times["off"])]
+    plane_ratios = [a / b for a, b in zip(times["on"], times["metrics"])]
+    best_off, best_on = min(times["off"]), min(times["on"])
+    rec = {
+        "metric": "obs_overhead_ab",
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n,
+        "batch": batch_size,
+        "model": {"n_layers": c["n_layers"], "d_model": c["d_model"],
+                  "d_ff": c["d_ff"], "seq": seq, "vocab": c["vocab"]},
+        "reps": reps, "chain_steps": chain,
+        "arms": {
+            "obs_off": {"step_ms_best": round(best_off * 1e3, 2),
+                        "step_ms_median": round(
+                            float(np.median(times["off"])) * 1e3, 2)},
+            "metrics_step_only": {
+                "step_ms_best": round(min(times["metrics"]) * 1e3, 2),
+                "step_ms_median": round(
+                    float(np.median(times["metrics"])) * 1e3, 2)},
+            "obs_on": {"step_ms_best": round(best_on * 1e3, 2),
+                       "step_ms_median": round(
+                           float(np.median(times["on"])) * 1e3, 2)},
+        },
+        "overhead_best_pct": round((best_on / best_off - 1.0) * 100, 2),
+        "overhead_pair_median_pct": round(
+            (float(np.median(pair_ratios)) - 1.0) * 100, 2),
+        # the fleet plane's own increment: full plane vs the PR 2
+        # with_metrics step alone (the sketch feeds, detectors, rollup
+        # serialization, metrics write and heartbeat)
+        "plane_increment_pair_median_pct": round(
+            (float(np.median(plane_ratios)) - 1.0) * 100, 2),
+        "params_bitwise_identical": (dig["off"] == dig["on"]
+                                     == dig["metrics"]),
+        "params_sha256": dig["off"],
+        "rollups_written": int(rollups),
+        "alerts_fired": int(alerts),
+        "rollup_every": telem_cfg.rollup_every,
+        "note": ("interleaved OFF/METRICS/ON triples (DESIGN §7): the "
+                 "ON arm runs the with_metrics step and pays the lag-2 "
+                 "fetch, metrics.jsonl write, sketch feeds + EMA "
+                 "detectors, rollup serialization every rollup_every "
+                 "dispatches and the per-role heartbeat; the METRICS "
+                 "arm isolates the jitted step's own norm cost (the "
+                 "PR 2 layer), so plane_increment_pair_median_pct is "
+                 "what THIS plane adds; params bitwise-identical "
+                 "across all arms (sketches are host arithmetic on "
+                 "fetched floats)"),
+    }
+    out_path = _divert_cpu_overwrite(out_path, on_tpu)
+    log(f"[obs-overhead] off {best_off * 1e3:.1f} ms/step, on "
+        f"{best_on * 1e3:.1f} ms/step (pair-median "
+        f"{rec['overhead_pair_median_pct']:+.1f}%, plane increment "
+        f"{rec['plane_increment_pair_median_pct']:+.1f}% over the "
+        f"with_metrics step), {rollups} rollup(s) written, params "
+        f"bitwise "
+        f"{'equal' if rec['params_bitwise_identical'] else 'DIFFERENT'}")
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=2)
+    log(f"obs-overhead A/B -> {out_path}")
+    # raise AFTER writing: a failing run must leave an artifact that
+    # records params_bitwise_identical: false, not vanish
+    if not rec["params_bitwise_identical"]:
+        raise AssertionError(
+            f"obs on/off param digests differ: {dig}")
+    return out_path
+
+
 def bench_serve(out_path: str = "BENCH_SERVE.json",
                 attn_impl: str = "gathered") -> str:
     """The serving-subsystem bench (serve/): a CLOSED-LOOP load sweep of
@@ -3067,6 +3268,15 @@ def main() -> int:
                          "ledger OFF vs ON (train/trace.py) at the "
                          "CPU-bench transformer scale, with the params "
                          "bitwise pin embedded; write BENCH_TRACE.json")
+    ap.add_argument("--obs-overhead", action="store_true",
+                    help="interleaved A/B of the fleet observability "
+                         "plane OFF vs ON (with_metrics step + lag-2 "
+                         "fetch + sketch feeds + rollup serialization + "
+                         "per-role heartbeat) at the CPU-bench "
+                         "transformer scale; params-bitwise pin "
+                         "embedded; write BENCH_OBS.json")
+    ap.add_argument("--obs-overhead-inproc", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--trace-overhead-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
@@ -3125,6 +3335,9 @@ def main() -> int:
     if args.trace_overhead_inproc:
         print(json.dumps({"trace_artifact": bench_trace_overhead()}))
         return 0
+    if args.obs_overhead_inproc:
+        print(json.dumps({"obs_artifact": bench_obs_overhead()}))
+        return 0
     if args.quant_ab_inproc:
         print(json.dumps({"quant_artifact": bench_quant_ab()}))
         return 0
@@ -3132,7 +3345,7 @@ def main() -> int:
     if (args.attention or args.decode or args.serve or args.rl
             or args.paged_attn or args.prefix_cache
             or args.update_sharding_ab or args.trace_overhead
-            or args.quant_ab):
+            or args.obs_overhead or args.quant_ab):
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -3195,6 +3408,14 @@ def main() -> int:
             else:
                 path = bench_trace_overhead()
             print(json.dumps({"trace_artifact": path}))
+        if args.obs_overhead:
+            if choice == "cpu":
+                # same 8-virtual-device DP mesh as the sibling overhead
+                # measurements
+                path = _run_flag_cpu_child("--obs-overhead-inproc", 8)
+            else:
+                path = bench_obs_overhead()
+            print(json.dumps({"obs_artifact": path}))
         if args.quant_ab:
             if choice == "cpu":
                 # the train A/B needs a real data axis: 8 virtual devices

@@ -210,11 +210,15 @@ def _supervise(args, argv) -> int:
     every relaunch continues from the newest snapshot.
 
     With --telemetry_dir the supervisor additionally (a) watches the
-    child's heartbeat.json when --hang_timeout is set — an external hang
-    detector that works even when the child process is frozen whole,
-    armed at 4x the in-process timeout so the child's own watchdog fires
-    first — and (b) points the relaunch log at the child's
-    postmortem.json flight-recorder dump after an abnormal exit.
+    child's OWN role-qualified heartbeat (heartbeat-<role>-p<P>.json,
+    per the world env channel; leader-written, so only the rank-0
+    supervisor's monitor ever arms) when --hang_timeout is set — an
+    external hang detector that works even when the child process is
+    frozen whole, armed at 4x the in-process timeout so the child's own
+    watchdog fires first — (b) points the relaunch log at the child's
+    postmortem.json flight-recorder dump after an abnormal exit, and
+    (c) summarizes the kind="alert" records the child emitted during
+    its lifetime next to each exit (observe-only).
 
     With --elastic the supervisor reacts to repeated peer-loss exits
     (43/42) by probing the surviving topology — the coordinator-aware
@@ -229,11 +233,21 @@ def _supervise(args, argv) -> int:
     child = strip_supervisor_flags(argv)
     if args.checkpoint_dir and "--resume" not in child:
         child.append("--resume")
-    heartbeat = postmortem = None
+    heartbeat = postmortem = alerts = None
     heartbeat_timeout = 0.0
     if getattr(args, "telemetry_dir", None):
-        heartbeat = os.path.join(args.telemetry_dir, "heartbeat.json")
+        # watch exactly THIS child's heartbeat: the role-qualified file
+        # its telemetry will write (workload decides the role; the
+        # process id rides the world env channel) — never the freshest
+        # sibling, which a co-resident process could keep beating while
+        # our child hangs
+        from .train.resilience import heartbeat_filename
+
+        role = "rl" if getattr(args, "workload", "lm") == "rl" else "train"
+        heartbeat = os.path.join(args.telemetry_dir,
+                                 heartbeat_filename(role))
         postmortem = os.path.join(args.telemetry_dir, "postmortem.json")
+        alerts = os.path.join(args.telemetry_dir, "metrics.jsonl")
         if getattr(args, "hang_timeout", 0.0) > 0:
             heartbeat_timeout = max(4.0 * args.hang_timeout, 60.0)
     probe = None
@@ -254,6 +268,7 @@ def _supervise(args, argv) -> int:
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=heartbeat_timeout,
                      postmortem_path=postmortem,
+                     alerts_path=alerts,
                      ckpt_dir=args.checkpoint_dir,
                      elastic=getattr(args, "elastic", False),
                      min_devices=getattr(args, "min_devices", 0),

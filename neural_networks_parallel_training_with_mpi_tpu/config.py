@@ -331,6 +331,18 @@ class TrainConfig:
     # flight-recorder ring size (last N step records + events kept for the
     # postmortem dump); 0 disables the recorder
     flight_recorder: int = 64
+    # fleet-plane rollups (utils/sketches.py, DESIGN.md §7): every N steps
+    # emit a kind="rollup" record into metrics.jsonl carrying SERIALIZED
+    # quantile-sketch state (loss/grad_norm/step_time/samples-per-sec/mfu)
+    # + counters, stamped with the (process, run, incarnation) identity —
+    # the snapshots tools/obs_agg.py merges into fleet percentiles.
+    # 0 = off (a final rollup still writes at flush when a cadence is set)
+    rollup_every: int = 0
+    # kind="alert" records (EMA z-score anomalies on loss/grad_norm/
+    # samples-per-sec + immediate non-finite alerts) into metrics.jsonl;
+    # observe-and-annotate only — the rollback/abort policy stays
+    # ResilienceMonitor's.  On whenever telemetry is on.
+    alerts: bool = True
     # ---- distributed tracing + compile ledger (train/trace.py,
     # utils/compile_ledger.py; off by default, zero cost when off) ----
     # host-side span timeline (load/dispatch/fetch/eval/ckpt/rollback and
@@ -734,6 +746,19 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="flight-recorder ring size: last N step records/"
                         "events dumped to postmortem.json on abnormal "
                         "exit (0 = off)")
+    p.add_argument("--rollup_every", type=int, default=0, metavar="N",
+                   help="fleet-plane rollups: every N steps write a "
+                        "kind=rollup record (serialized quantile-sketch "
+                        "state + counters, utils/sketches.py) into "
+                        "metrics.jsonl for tools/obs_agg.py to merge "
+                        "into fleet percentiles (needs --telemetry_dir; "
+                        "0 = off)")
+    _add_bool_flag(p, "alerts", True,
+                   "kind=alert records in metrics.jsonl: EMA z-score "
+                   "anomalies on loss/grad_norm/samples-per-sec and "
+                   "SLO burn rate on the serving side (observe-and-"
+                   "annotate; tools/metrics_summary.py renders them and "
+                   "the supervisor logs them next to relaunch decisions)")
     _add_bool_flag(p, "trace", False,
                    "host-side span tracing + compile-event ledger "
                    "(train/trace.py): per-process trace-p{P}-i{I}.jsonl "
@@ -898,6 +923,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         telemetry_dir=args.telemetry_dir,
         metrics_every=args.metrics_every,
         flight_recorder=args.flight_recorder,
+        rollup_every=args.rollup_every,
+        alerts=args.alerts,
         trace=args.trace or args.trace_dir is not None,
         trace_dir=args.trace_dir,
         xla_trace_dir=args.xla_trace_dir,

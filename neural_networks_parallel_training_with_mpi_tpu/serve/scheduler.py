@@ -33,9 +33,21 @@ top of the library loop the repo had before this subsystem:
   fused paged-attention kernel skips, measurable per tick) and
   ``kind="serve_req"`` per-request completion records (TTFT/ITL) go into
   the same ``metrics.jsonl`` stream PR 2's trainer writes, and the
-  heartbeat file is the same atomic ``heartbeat.json`` —
-  ``train.resilience.supervise(heartbeat_path=...)`` and
-  ``tools/metrics_summary.py`` work on a serving process unchanged.
+  heartbeat is the same atomic snapshot under the role-qualified name
+  ``heartbeat-serve-p<P>.json`` (two programs sharing one dir no longer
+  collide) — ``train.resilience.supervise(heartbeat_path=...)`` and
+  ``tools/metrics_summary.py`` work on a serving process unchanged
+  through the back-compat fallback read.
+* **Fleet plane** (DESIGN.md §7): ``rollup_every`` snapshots the
+  streaming quantile sketches (TTFT/ITL/total, queue depth, block
+  utilization, tokens/s — ``utils/sketches.py``) as ``kind="rollup"``
+  records ``tools/obs_agg.py`` merges across replicas into fleet
+  percentiles; deadline misses burn an SLO error budget whose
+  burn-rate alerts land as ``kind="alert"`` records (observe-and-
+  annotate); with a tracer installed, each request id threads an
+  admit -> prefill -> decode -> retire Perfetto FLOW across the tick
+  spans (``train/trace.py``) — the primitive a cross-replica block
+  handoff will ride.
 """
 
 from __future__ import annotations
@@ -49,9 +61,11 @@ from dataclasses import dataclass
 from typing import Any, Deque, Dict, List, Optional
 
 from ..models.transformer import Transformer
+from ..train import telemetry as telemetry_lib
 from ..train import trace as trace_lib
 from ..train.telemetry import Heartbeat
 from ..utils.logging import log
+from ..utils.sketches import ErrorBudget, Gauge, QuantileSketch
 from .paged_kv import PagedDecodeServer
 
 Pytree = Any
@@ -85,6 +99,20 @@ class ServeConfig:
     #                                so TTFT collapses to the suffix
     telemetry_dir: Optional[str] = None
     metrics_every: int = 25        # ticks between kind="serve" records
+    # fleet-plane rollups (utils/sketches.py): every N ticks emit a
+    # kind="rollup" record carrying SERIALIZED quantile-sketch state
+    # (TTFT/ITL/total, queue depth, block utilization, tokens/s) +
+    # cumulative counters, stamped with the (process, run, incarnation)
+    # identity so tools/obs_agg.py can merge fleet percentiles without
+    # raw samples.  0 = off (a final rollup still writes on close when
+    # any cadence was configured)
+    rollup_every: int = 0
+    # SLO burn-rate alerting over deadline misses (kind="alert"
+    # records; observe-and-annotate — the scheduler never acts on
+    # them).  Only requests WITH a deadline count toward the budget.
+    alerts: bool = True
+    slo_target: float = 0.99       # SLO: fraction of deadlines met
+    slo_burn_threshold: float = 2.0  # alert at >= this x budget burn
     # span tracing + compile ledger (train/trace.py): per-tick
     # admit/prefill/decode/retire spans and the serve programs' compile
     # events under this dir; None = ride any tracer the enclosing
@@ -135,23 +163,43 @@ class Request:
 
 class _ServeTelemetry:
     """Serving metrics through the PR 2 channel: kind="serve" /
-    "serve_req" records into metrics.jsonl + the standard heartbeat.
+    "serve_req" records into metrics.jsonl + the role-qualified
+    heartbeat, plus the fleet plane's kind="rollup" sketch snapshots
+    and kind="alert" SLO burn-rate records (utils/sketches.py).
     No-op when ``telemetry_dir`` is unset."""
 
-    def __init__(self, dirpath: Optional[str], metrics_every: int):
+    # the quantile-sketched serving series: latency percentiles are THE
+    # serving SLO numbers and only compose fleet-wide through sketches
+    SKETCH_KEYS = ("ttft_ms", "itl_ms", "total_ms", "queue_depth",
+                   "block_utilization", "tokens_per_sec")
+
+    def __init__(self, cfg: "ServeConfig"):
+        dirpath = cfg.telemetry_dir
         self.enabled = bool(dirpath)
-        self.metrics_every = max(1, int(metrics_every))
+        self.metrics_every = max(1, int(cfg.metrics_every))
+        self.rollup_every = max(0, int(cfg.rollup_every))
         self._jsonl = None
         self.heartbeat = Heartbeat(None)
+        self.alerts_fired = 0
+        self.rollups_written = 0
         if not self.enabled:
             return
         os.makedirs(dirpath, exist_ok=True)
         self.metrics_path = os.path.join(dirpath, "metrics.jsonl")
         self._jsonl = open(self.metrics_path, "a")
-        self.heartbeat = Heartbeat(os.path.join(dirpath, "heartbeat.json"))
+        self.heartbeat = Heartbeat(os.path.join(
+            dirpath, telemetry_lib.heartbeat_filename("serve")))
         self._t0 = time.perf_counter()
         self._last_tokens = 0
         self._last_t = self._t0
+        self._sketches = {k: QuantileSketch() for k in self.SKETCH_KEYS}
+        self._gauges = {k: Gauge() for k in ("tokens_per_sec",
+                                             "queue_depth",
+                                             "block_utilization")}
+        self._counters: Dict[str, int] = {}
+        self._budget = (ErrorBudget("slo", target=cfg.slo_target,
+                                    burn_threshold=cfg.slo_burn_threshold)
+                        if cfg.alerts else None)
 
     def _write(self, rec: Dict[str, Any]) -> None:
         if self._jsonl is not None:
@@ -161,37 +209,106 @@ class _ServeTelemetry:
     def on_tick(self, tick: int, snap: Dict[str, Any]) -> None:
         if not self.enabled:
             return
+        # per-tick sketch feed (host floats, no device traffic): queue
+        # and pool state distributions, not just their sampled points
+        self._sketches["queue_depth"].add(snap["queue_depth"])
+        self._sketches["block_utilization"].add(
+            snap["block_utilization"])
         if tick % self.metrics_every:
             # the heartbeat still refreshes (throttled internally): the
             # supervisor's staleness monitor watches mtime, not records
             self.heartbeat.beat(tick, None)
+            self._maybe_rollup(tick)
             return
         now = time.perf_counter()
         rec = {"kind": "serve", "step": int(tick),
                "t": round(now - self._t0, 6), **snap}
         dt = now - self._last_t
         if dt > 0:
-            rec["tokens_per_sec"] = round(
-                (snap["tokens_out"] - self._last_tokens) / dt, 2)
+            tps = round((snap["tokens_out"] - self._last_tokens) / dt, 2)
+            rec["tokens_per_sec"] = tps
+            self._sketches["tokens_per_sec"].add(tps)
+            self._gauges["tokens_per_sec"].set(tps)
+        self._gauges["queue_depth"].set(snap["queue_depth"])
+        self._gauges["block_utilization"].set(snap["block_utilization"])
+        for key in ("admitted", "rejected", "evicted", "completed",
+                    "tokens_out"):
+            if key in snap:
+                self._counters[key] = int(snap[key])
         self._last_tokens = snap["tokens_out"]
         self._last_t = now
         self._write(rec)
         self.heartbeat.beat(tick, rec)
+        self._maybe_rollup(tick)
 
     def on_request_done(self, req: Request, n_generated: int) -> None:
         if not self.enabled:
             return
+        total_ms = round((req.t_done - req.t_submit) * 1e3, 3)
+        ttft, itl = round(req.ttft_ms, 3), round(req.itl_ms, 3)
         self._write({
             "kind": "serve_req", "rid": req.rid,
             "t": round(time.perf_counter() - self._t0, 6),
             "prompt_tokens": len(req.prompt),
             "new_tokens": int(n_generated),
-            "ttft_ms": round(req.ttft_ms, 3),
-            "itl_ms": round(req.itl_ms, 3),
-            "total_ms": round((req.t_done - req.t_submit) * 1e3, 3),
+            "ttft_ms": ttft,
+            "itl_ms": itl,
+            "total_ms": total_ms,
             "evictions": req.evictions,
             "deadline_missed": req.deadline_missed,
         })
+        self._sketches["ttft_ms"].add(ttft)
+        self._sketches["itl_ms"].add(itl)
+        self._sketches["total_ms"].add(total_ms)
+        self._counters["requests"] = self._counters.get("requests", 0) + 1
+        if math.isfinite(req.deadline):
+            # only SLO-carrying requests burn (or bank) the budget
+            missed = bool(req.deadline_missed)
+            self._counters["deadline_total"] = (
+                self._counters.get("deadline_total", 0) + 1)
+            if missed:
+                self._counters["deadline_missed"] = (
+                    self._counters.get("deadline_missed", 0) + 1)
+            if self._budget is not None:
+                alert = self._budget.observe(missed)
+                if alert:
+                    self._emit_alert(alert, rid=req.rid)
+
+    def _emit_alert(self, alert: Dict[str, Any], **extra) -> None:
+        self.alerts_fired += 1
+        rec = {"kind": "alert", "role": "serve",
+               "t": round(time.perf_counter() - self._t0, 6),
+               "t_unix": round(time.time(), 3), **alert, **extra}
+        self._write(rec)
+        log(f"[serve] ALERT {alert.get('alert')} "
+            f"(burn rate {alert.get('burn_rate')}x of the "
+            f"{alert.get('target')} SLO budget)")
+
+    def _maybe_rollup(self, tick: int, final: bool = False) -> None:
+        if self.rollup_every <= 0:
+            return
+        if not final and tick % self.rollup_every:
+            return
+        ident = trace_lib.run_identity()
+        counters = dict(self._counters)
+        counters["alerts"] = self.alerts_fired
+        if self._budget is not None:
+            counters["slo_events"] = self._budget.events
+            counters["slo_misses"] = self._budget.misses
+        rec = {
+            "kind": "rollup", "role": "serve", "step": int(tick),
+            "t": round(time.perf_counter() - self._t0, 6),
+            "t_unix": round(time.time(), 3),
+            "p": ident["process_id"], "run": ident["run_id"],
+            "inc": ident["incarnation"],
+            "sketches": {k: s.to_dict()
+                         for k, s in self._sketches.items() if s.n},
+            "counters": counters,
+            "gauges": {k: g.to_dict() for k, g in self._gauges.items()
+                       if g.last is not None},
+        }
+        self.rollups_written += 1
+        self._write(rec)
 
     def close(self, tick: int, snap: Optional[Dict[str, Any]] = None
               ) -> None:
@@ -205,6 +322,11 @@ class _ServeTelemetry:
                          "t": round(time.perf_counter() - self._t0, 6),
                          "final": True, **snap}
             self._write(final_rec)
+            for key in ("admitted", "rejected", "evicted", "completed",
+                        "tokens_out"):
+                if key in snap:
+                    self._counters[key] = int(snap[key])
+        self._maybe_rollup(tick, final=True)
         self.heartbeat.beat(tick, final_rec, force=True, final=True)
         if self._jsonl is not None:
             self._jsonl.close()
@@ -258,8 +380,12 @@ class Scheduler:
         self.attended_keys = 0
         self.padded_keys = 0
         self.kernel_keys = 0
-        self.telemetry = _ServeTelemetry(cfg.telemetry_dir,
-                                         cfg.metrics_every)
+        self.telemetry = _ServeTelemetry(cfg)
+        # per-request flow-trace ids must stay unique across the fleet's
+        # merged timeline: prefix the scheduler-local rid with this
+        # process's identity (free when no tracer is installed)
+        self._flow_prefix = (
+            f"p{trace_lib.run_identity()['process_id']}-r")
 
     # ---- client surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -332,6 +458,16 @@ class Scheduler:
         if self.server.any_active():
             with trace_lib.span("decode", tick=self.tick_no):
                 self._grow_or_evict()
+                if trace_lib.active() is not None:
+                    # flow step per decoding stream: the arrow chain
+                    # that links this tick's decode span into each
+                    # in-flight request's admit->...->retire path
+                    for rid in self._srv_rid:
+                        if rid not in self._prefilling:
+                            trace_lib.flow(
+                                "req", f"{self._flow_prefix}{rid}", "t",
+                                rid=rid, stage="decode",
+                                tick=self.tick_no)
                 acct = self.server.keys_accounting()
                 self.attended_keys += acct["attended_keys"]
                 self.padded_keys += acct["padded_keys"]
@@ -408,6 +544,10 @@ class Scheduler:
             self._sched_rid[srv_rid] = req.rid
             self._prefilling.append(req.rid)
             self.admitted += 1
+            # flow START (or re-start after an eviction's re-admission)
+            trace_lib.flow("req", f"{self._flow_prefix}{req.rid}", "s",
+                           rid=req.rid, stage="admit",
+                           prompt_tokens=p, tick=self.tick_no)
 
     def _prefill_tick(self) -> List[int]:
         """At most one prefill chunk per tick (interleaving: decoding
@@ -417,6 +557,8 @@ class Scheduler:
             return done_now
         rid = self._prefilling[0]
         srv_rid = self._srv_rid[rid]
+        trace_lib.flow("req", f"{self._flow_prefix}{rid}", "t",
+                       rid=rid, stage="prefill", tick=self.tick_no)
         if self.server.prefill_step(srv_rid, self.cfg.prefill_chunk):
             self._prefilling.popleft()
             self.reqs[rid].t_first = self.now()
@@ -470,6 +612,8 @@ class Scheduler:
         self._srv_rid.pop(rid)
         req = self.reqs[rid]
         req.t_done = self.now()
+        trace_lib.flow("req", f"{self._flow_prefix}{rid}", "f",
+                       rid=rid, stage="retire", tick=self.tick_no)
         if req.t_first is None:
             req.t_first = req.t_done
         toks = self.server.result(srv_rid)

@@ -65,7 +65,7 @@ import signal
 import subprocess
 import sys
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 EXIT_OK = 0
 EXIT_HANG = 42      # utils.watchdog.HangWatchdog
@@ -404,21 +404,72 @@ def default_probe(timeout_s: float = 60.0,
     return None
 
 
-def heartbeat_age_s(path: str, now: Optional[float] = None
-                    ) -> Optional[float]:
-    """Seconds since the telemetry heartbeat file was last refreshed
-    (mtime-based: train.telemetry's atomic replace bumps it on every
-    write), or None if absent.  Lives HERE, stdlib-only, because the
-    generic supervisor (tools/supervise.py) wraps arbitrary commands on
-    hosts that may not even have JAX installed — it must never pull in
-    the jax-importing telemetry module; telemetry re-exports this."""
+def heartbeat_filename(role: str, process_id: Optional[int] = None
+                       ) -> str:
+    """Per-role/per-process heartbeat file name:
+    ``heartbeat-<role>-p<P>.json`` (see ``train.telemetry``'s module
+    docstring for the collision this naming fixes).  Lives HERE,
+    stdlib-only, so the supervisor can derive its child's exact watch
+    target without importing the jax-heavy telemetry module;
+    ``process_id`` defaults to the DESIGN §10 world env channel."""
     import os
 
-    try:
-        mtime = os.stat(path).st_mtime
-    except OSError:
+    if process_id is None:
+        try:
+            process_id = int(os.environ.get(_PROCESS_ID_ENV) or 0)
+        except ValueError:
+            process_id = 0
+    return f"heartbeat-{role}-p{int(process_id)}.json"
+
+
+def find_heartbeats(dirpath: str) -> List[str]:
+    """Every heartbeat file in a telemetry dir: the legacy shared
+    ``heartbeat.json`` plus the per-role/process
+    ``heartbeat-<role>-p<P>.json`` forms ``train.telemetry`` writes
+    since the fleet observability plane (two programs sharing one dir
+    used to last-writer-win over one file)."""
+    import glob
+    import os
+
+    return sorted(glob.glob(os.path.join(dirpath, "heartbeat*.json")))
+
+
+def heartbeat_age_s(path: str, now: Optional[float] = None
+                    ) -> Optional[float]:
+    """Seconds since the telemetry heartbeat was last refreshed
+    (mtime-based: train.telemetry's atomic replace bumps it on every
+    write), or None if absent.  ``path`` may be an exact heartbeat
+    file, a telemetry DIRECTORY (freshest of all heartbeats within), or
+    the legacy GENERIC ``<dir>/heartbeat.json`` — only that generic
+    name falls back to the freshest ``heartbeat*.json`` sibling, so a
+    supervisor configured against the pre-fleet layout keeps watching a
+    child that writes the per-role name.  A missing ROLE-QUALIFIED
+    path deliberately does NOT fall back: the external hang monitor
+    must watch its own child's file, and answering with a co-resident
+    process's fresher heartbeat would mask exactly the hung-writer case
+    the per-role naming exists to expose.  Lives HERE, stdlib-only,
+    because the generic supervisor (tools/supervise.py) wraps arbitrary
+    commands on hosts that may not even have JAX installed — it must
+    never pull in the jax-importing telemetry module; telemetry
+    re-exports this."""
+    import os
+
+    candidates = [path]
+    if os.path.isdir(path):
+        candidates = find_heartbeats(path)
+    elif (not os.path.exists(path)
+          and os.path.basename(path) == "heartbeat.json"):
+        candidates = find_heartbeats(os.path.dirname(path) or ".")
+    best: Optional[float] = None
+    for p in candidates:
+        try:
+            mtime = os.stat(p).st_mtime
+        except OSError:
+            continue
+        best = mtime if best is None else max(best, mtime)
+    if best is None:
         return None
-    return max(0.0, (time.time() if now is None else now) - mtime)
+    return max(0.0, (time.time() if now is None else now) - best)
 
 
 _ckpt_manifest_mod = None
@@ -464,6 +515,46 @@ def _restore_target(ckpt_dir: str):
         else:
             return step, bad, path
     return None, bad, None
+
+
+def alerts_between(path: Optional[str], start_pos: int
+                   ) -> Tuple[List[dict], int]:
+    """``kind="alert"`` records appended to a metrics JSONL past byte
+    ``start_pos`` (the supervisor remembers the size before each launch,
+    so the scan covers exactly one child's lifetime), plus the new end
+    position.  Stdlib-only and bounded: reads only the appended tail.
+    A file that SHRANK (fresh dir reused) rescans from 0."""
+    import os
+
+    if not path:
+        return [], start_pos
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return [], start_pos
+    if size < start_pos:
+        start_pos = 0
+    if size == start_pos:
+        return [], size
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            f.seek(start_pos)
+            for line in f:
+                line = line.strip()
+                if not line or '"alert"' not in line:
+                    continue
+                try:
+                    import json
+
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of a live run
+                if isinstance(rec, dict) and rec.get("kind") == "alert":
+                    out.append(rec)
+    except OSError:
+        return [], start_pos
+    return out, size
 
 
 def _run_child(cmd: Sequence[str], env: Optional[dict],
@@ -534,6 +625,7 @@ def supervise(cmd: Sequence[str], max_restarts: int,
               heartbeat_timeout: float = 0.0,
               postmortem_path: Optional[str] = None,
               ckpt_dir: Optional[str] = None,
+              alerts_path: Optional[str] = None,
               jitter: float = 0.5,
               elastic: bool = False,
               min_devices: int = 0,
@@ -578,6 +670,12 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     detector (see :func:`_run_child`).  ``postmortem_path``: when a child
     dies abnormally and the telemetry flight recorder dumped a postmortem
     during THIS child's lifetime, the relaunch log points at it.
+    ``alerts_path`` (the child's metrics.jsonl): ``kind="alert"``
+    records the child emitted during its lifetime — SLO burn-rate, EMA
+    z-score anomalies — are summarized next to each exit, so the
+    relaunch log shows what the telemetry plane SAW before the death.
+    Observe-and-annotate only: alerts never change the retry decision
+    (the exit-code contract owns that).
     ``ckpt_dir``: before each relaunch, log the newest VERIFIED snapshot
     (full manifest-checksum pass, utils.ckpt_manifest) the child's
     ``--resume`` will land on — so an operator tailing the supervisor sees
@@ -619,8 +717,26 @@ def supervise(cmd: Sequence[str], max_restarts: int,
         child_env[INCARNATION_ENV] = str(attempt - 1)
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
         launched = time.time()
+        alert_pos = 0
+        if alerts_path:
+            try:
+                alert_pos = _os.path.getsize(alerts_path)
+            except OSError:
+                alert_pos = 0
         rc = _run_child(cmd, child_env, heartbeat_path, heartbeat_timeout,
                         log)
+        if alerts_path:
+            alerts, _ = alerts_between(alerts_path, alert_pos)
+            if alerts:
+                by_name: dict = {}
+                for a in alerts:
+                    key = str(a.get("alert"))
+                    by_name[key] = by_name.get(key, 0) + 1
+                rendered = ", ".join(f"{k} x{v}"
+                                     for k, v in sorted(by_name.items()))
+                log(f"[supervise] {len(alerts)} telemetry alert(s) "
+                    f"during this child: {rendered} (observe-only; the "
+                    "exit code decides the relaunch)")
         # any ABNORMAL exit — including the no-retry anomaly abort (44),
         # whose dump is the flagship black-box case — gets the pointer
         if rc != EXIT_OK and postmortem_path:

@@ -52,15 +52,27 @@ Layout under ``--telemetry_dir``::
 
     metrics.jsonl     per-step records (step, loss, grad_norm, param_norm,
                       update_ratio, skipped, step_time_ms, samples/sec, mfu)
-    heartbeat.json    freshest run-health snapshot (atomic replace)
+                      plus kind="rollup" sketch snapshots (serialized
+                      utils/sketches.py state on the --rollup_every
+                      cadence, merged fleet-wide by tools/obs_agg.py)
+                      and kind="alert" records (EMA z-score anomalies on
+                      loss/grad_norm/samples-per-sec; observe-and-
+                      annotate — nothing acts on them)
+    heartbeat-<role>-p<P>.json
+                      freshest run-health snapshot (atomic replace), one
+                      file per role ("train"/"rl"/"serve") and process —
+                      two programs sharing one dir can no longer blind
+                      the staleness monitor by last-writer-winning over a
+                      single heartbeat.json (readers fall back from the
+                      legacy shared name to the freshest qualified file)
     postmortem.json   flight-recorder dump, written on abnormal events
 
 The stream is SHARED with the serving runtime: serve/scheduler.py writes
 ``kind="serve"`` tick records and ``kind="serve_req"`` per-request
-completions into the same metrics.jsonl schema and refreshes the same
-heartbeat file (through :class:`Heartbeat`), so the supervisor's
-stale-heartbeat monitor and tools/metrics_summary.py treat a serving
-process exactly like a training run.
+completions into the same metrics.jsonl schema and beats its own
+role-qualified heartbeat (through :class:`Heartbeat`), so the
+supervisor's stale-heartbeat monitor and tools/metrics_summary.py treat
+a serving process exactly like a training run.
 
 Everything is zero-cost when ``telemetry_dir`` is unset, and file writes
 are leader-only (multi-host safe).
@@ -79,6 +91,8 @@ import jax.numpy as jnp
 
 from ..ops.optim import GuardedState, Optimizer, global_norm
 from ..utils.logging import is_leader, log
+from ..utils.sketches import EmaZScore, Gauge, QuantileSketch
+from . import trace as trace_lib
 
 Pytree = Any
 
@@ -304,12 +318,50 @@ def _atomic_write_json(path: str, doc: Dict[str, Any]) -> None:
     os.replace(tmp, path)  # readers never observe a torn file
 
 
+def heartbeat_filename(role: str, process_id: Optional[int] = None) -> str:
+    """Per-role/per-process heartbeat file name:
+    ``heartbeat-<role>-p<P>.json``.  Two programs sharing one
+    ``--telemetry_dir`` (a trainer and a serving replica, or two
+    serving replicas with distinct ``NNPT_PROCESS_ID``) used to
+    last-writer-win over ONE ``heartbeat.json``, blinding the
+    supervisor's staleness monitor to whichever wrote second; now each
+    writer owns its file and generic readers (``read_heartbeat``,
+    tools/metrics_summary.py, tools/obs_agg.py) fall back from the
+    legacy shared name to the freshest qualified one — while the
+    supervisor's hang monitor watches exactly its child's file.
+    Delegates to the stdlib-only ``resilience.heartbeat_filename``
+    (the naming's single source), with the process id resolved through
+    ``trace.run_identity`` so the jax fallback applies."""
+    if process_id is None:
+        process_id = trace_lib.run_identity()["process_id"]
+    from .resilience import heartbeat_filename as _hb_name
+
+    return _hb_name(role, process_id)
+
+
 def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
+    """Load a heartbeat document.  Back-compat: when ``path`` is the
+    legacy shared ``heartbeat.json`` (or a telemetry dir) and only
+    role-qualified files exist, the FRESHEST of those is returned —
+    callers keyed to the old layout keep working against per-role
+    writers."""
+    from .resilience import find_heartbeats
+
+    candidates = [path] if os.path.isfile(path) else (
+        find_heartbeats(path if os.path.isdir(path)
+                        else os.path.dirname(path) or "."))
+    best: Optional[Dict[str, Any]] = None
+    best_m = None
+    for p in candidates:
+        try:
+            m = os.stat(p).st_mtime
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if best_m is None or m > best_m:
+            best, best_m = doc, m
+    return best
 
 
 # staleness helper lives in resilience (stdlib-only, so the generic
@@ -426,13 +478,37 @@ class Telemetry:
         self.enabled = bool(cfg.telemetry_dir)
         self.dir = cfg.telemetry_dir
         self.kind = kind
+        # the heartbeat/rollup role tag: "train" for the LM trainer's
+        # kind="step" stream, else the kind itself ("rl", "serve")
+        self.role = "train" if kind == "step" else kind
         self._flops_override = flops_per_row
         self.metrics_every = max(0, int(cfg.metrics_every))
+        self.rollup_every = max(0, int(getattr(cfg, "rollup_every", 0)))
+        self.alerts_enabled = bool(getattr(cfg, "alerts", True))
         self._queue: List[tuple] = []  # (step, epoch, out, n_steps, rows, t)
         self._last_t: Optional[float] = None
         self.last_record: Optional[Dict[str, Any]] = None
         self.skipped_total = 0        # newest observed cumulative counter
         self._resync_skips = False    # set on rollback: counter rewound
+        self.alerts_fired = 0
+        self.rollups_written = 0
+        # streaming SLO sketches (utils/sketches.py): cumulative per
+        # incarnation, snapshotted into kind="rollup" records so
+        # tools/obs_agg.py can merge fleet percentiles without raw
+        # samples.  Detectors are the kind="alert" sources: loss /
+        # grad-norm spikes (EMA z above) and throughput collapse (below)
+        self._sketches = {k: QuantileSketch() for k in (
+            "loss", "grad_norm", "step_time_ms", "samples_per_sec",
+            "mfu")}
+        self._gauges = {k: Gauge() for k in ("steps_per_sec", "mfu")}
+        self._detectors = {
+            "loss": EmaZScore("loss", direction="above"),
+            "grad_norm": EmaZScore("grad_norm", direction="above"),
+            "samples_per_sec": EmaZScore("samples_per_sec",
+                                         direction="below"),
+        }
+        self._records_seen = 0
+        self._last_rollup_step = 0
         if not self.enabled:
             self.recorder = FlightRecorder(0, None)
             self.heartbeat = Heartbeat(None)
@@ -441,7 +517,8 @@ class Telemetry:
         if is_leader():
             os.makedirs(self.dir, exist_ok=True)
         self.metrics_path = os.path.join(self.dir, "metrics.jsonl")
-        self.heartbeat_path = os.path.join(self.dir, "heartbeat.json")
+        self.heartbeat_path = os.path.join(self.dir,
+                                           heartbeat_filename(self.role))
         self.postmortem_path = os.path.join(self.dir, "postmortem.json")
         self.recorder = FlightRecorder(int(cfg.flight_recorder),
                                        self.postmortem_path)
@@ -490,8 +567,6 @@ class Telemetry:
                             skipped_total=self.skipped_total)
 
     def _fetch(self, entry) -> None:
-        from . import trace as trace_lib
-
         step, epoch, out, n_steps, rows, t_prev, t_disp = entry
         with trace_lib.span("fetch", what="metrics", step=int(step)):
             fetched = jax.device_get(out)
@@ -529,6 +604,84 @@ class Telemetry:
         if self._jsonl is not None:
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+        self._observe(rec, step)
+
+    # ---- streaming sketches, rollups, alerts -----------------------------
+
+    def _observe(self, rec: Dict[str, Any], step: int) -> None:
+        """Feed the fetched record into the sketch layer + anomaly
+        detectors and emit rollup/alert records on their cadences.
+        Host-side arithmetic on already-fetched floats — nothing here
+        touches a device."""
+        self._records_seen += 1
+        for key, sketch in self._sketches.items():
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                sketch.add(v)
+        ema = self.heartbeat.ema_steps_per_sec
+        if ema is not None:
+            self._gauges["steps_per_sec"].set(ema)
+        if isinstance(rec.get("mfu"), (int, float)):
+            self._gauges["mfu"].set(rec["mfu"])
+        if self.alerts_enabled:
+            for key, det in self._detectors.items():
+                v = rec.get(key)
+                if isinstance(v, (int, float)):
+                    alert = det.observe(v, step=step)
+                    if alert:
+                        self._emit_alert(alert, step)
+        if (self.rollup_every > 0
+                and (step // self.rollup_every
+                     > self._last_rollup_step // self.rollup_every)):
+            self._last_rollup_step = step
+            self._write_rollup(step)
+
+    def _emit_alert(self, alert: Dict[str, Any], step: int) -> None:
+        """One ``kind="alert"`` record into the metrics stream + a
+        flight-recorder event.  Observe-and-annotate only: nothing here
+        feeds back into training decisions — the supervisor logs these
+        next to its relaunch reasoning, and the rollback/abort policy
+        stays ``ResilienceMonitor``'s."""
+        self.alerts_fired += 1
+        rec = {"kind": "alert", "role": self.role, "step": int(step),
+               "t": round(time.perf_counter() - self._t0, 6),
+               "t_unix": round(time.time(), 3), **alert}
+        self.recorder.event("alert", step, alert=alert.get("alert"),
+                            value=alert.get("value"), z=alert.get("z"))
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        log(f"[telemetry] ALERT {alert.get('alert')} at step {step} "
+            f"(value {alert.get('value')})")
+
+    def _write_rollup(self, step: int) -> None:
+        """Snapshot the SERIALIZED sketch state (not point stats) as a
+        ``kind="rollup"`` record, stamped with the (process, run,
+        incarnation) identity so ``tools/obs_agg.py`` can pick the
+        newest snapshot per writer and merge fleet percentiles.
+        Sketches are cumulative over this incarnation — the aggregator
+        takes the latest record per identity, never a sum of
+        records."""
+        if self._jsonl is None:
+            return
+        ident = trace_lib.run_identity()
+        rec = {
+            "kind": "rollup", "role": self.role, "step": int(step),
+            "t": round(time.perf_counter() - self._t0, 6),
+            "t_unix": round(time.time(), 3),
+            "p": ident["process_id"], "run": ident["run_id"],
+            "inc": ident["incarnation"],
+            "sketches": {k: s.to_dict()
+                         for k, s in self._sketches.items() if s.n},
+            "counters": {"metrics_records": self._records_seen,
+                         "skipped_total": int(self.skipped_total),
+                         "alerts": self.alerts_fired},
+            "gauges": {k: g.to_dict() for k, g in self._gauges.items()
+                       if g.last is not None},
+        }
+        self.rollups_written += 1
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
 
     # ---- events ----------------------------------------------------------
 
@@ -653,6 +806,10 @@ class Telemetry:
         if final:
             if step is None:
                 step = int((self.last_record or {}).get("step", 0))
+            if self.rollup_every > 0 and self._records_seen:
+                # terminal snapshot regardless of cadence: the
+                # aggregator must see the run's complete sketches
+                self._write_rollup(step)
             self.heartbeat.beat(step, self.last_record, force=True,
                                 final=True,
                                 skipped_total=self.skipped_total)

@@ -39,6 +39,14 @@ Span taxonomy (the fixed vocabulary the report tool groups by):
 ``compile:<n>`` a ledger-observed XLA compile (utils/compile_ledger.py)
 ==============  ========================================================
 
+Besides spans, a tracer can emit **flow points** (:func:`flow`): the
+Chrome s/t/f arrow chain that links spans by an id.  The serving
+scheduler threads each request id through admit -> every prefill chunk
+-> every decode tick -> retire, so ``tools/trace_report.py``'s merged
+Perfetto timeline draws one request's whole life as a connected arrow
+path across the per-tick phase spans (and, once blocks hand off across
+replicas, across processes).
+
 Relationship to the XLA profiler (``--xla_trace_dir`` →
 ``utils.profiling.trace``): the profiler captures *device* activity —
 per-op HLO timelines, one heavyweight capture window, leader-gated,
@@ -159,6 +167,21 @@ class Tracer:
                             "t": round(time.time(), 6), **self._ident,
                             **attrs})
 
+    def flow(self, name: str, flow_id: Any, phase: str, **attrs) -> None:
+        """One point of a Perfetto FLOW — an arrow chain linking spans
+        across ticks/threads/processes by ``flow_id``.  ``phase``:
+        ``"s"`` start, ``"t"`` step, ``"f"`` finish (the Chrome
+        trace-event flow vocabulary).  The serving scheduler threads a
+        request id through admit -> each prefill chunk -> decode ticks
+        -> retire this way, so one request's life is one connected
+        arrow path across the per-tick phase spans."""
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        self._emit_bounded({"kind": "flow", "name": name,
+                            "id": str(flow_id), "fph": phase,
+                            "t": round(time.time(), 6), **self._ident,
+                            **attrs})
+
     def close(self) -> None:
         with self._lock:
             if self._f is None:
@@ -226,6 +249,15 @@ def instant(name: str, **attrs) -> None:
     tracer = _ACTIVE
     if tracer is not None:
         tracer.instant(name, **attrs)
+
+
+def flow(name: str, flow_id: Any, phase: str, **attrs) -> None:
+    """Emit one flow point (see :meth:`Tracer.flow`); no-op when no
+    tracer is installed — per-request flow tracing costs nothing on an
+    untraced serving process."""
+    tracer = _ACTIVE
+    if tracer is not None:
+        tracer.flow(name, flow_id, phase, **attrs)
 
 
 def active() -> Optional[Tracer]:

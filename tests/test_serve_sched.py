@@ -378,8 +378,17 @@ def test_telemetry_serve_records_and_heartbeat(tmp_path):
     last = serves[-1]
     assert last["completed"] == 2 and last["tokens_out"] == 13
     assert last["block_utilization"] >= 0
-    hb = json.load(open(os.path.join(tdir, "heartbeat.json")))
+    # per-role heartbeat file (fleet plane): a serving process owns
+    # heartbeat-serve-p<P>.json; the legacy shared path still resolves
+    # through the back-compat read
+    hb = json.load(open(os.path.join(tdir, "heartbeat-serve-p0.json")))
     assert hb["final"] is True and hb["step"] == sched.tick_no
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        telemetry as telemetry_lib,
+    )
+    legacy = telemetry_lib.read_heartbeat(
+        os.path.join(tdir, "heartbeat.json"))
+    assert legacy == hb
     # the stdlib summary tool renders the serving section
     import importlib.util
     spec = importlib.util.spec_from_file_location(

@@ -1,0 +1,262 @@
+"""Streaming SLO sketches (utils/sketches.py): the quantile layer the
+fleet observability plane composes across processes.
+
+Pins, by acceptance criterion:
+
+* **rank error**: merging K random shards answers every queried
+  quantile within the sketch's STATED rank-error bound of the exact
+  numpy percentile — across distributions (uniform, lognormal, bimodal)
+  and shard counts.
+* **round-trip**: serialize -> deserialize -> identical answers (the
+  rollup records in metrics.jsonl carry exactly this form).
+* **edge cases**: empty, one-sample and constant-series sketches.
+* **gauges**: last-write + envelope semantics and the serialized
+  round-trip the aggregator parses (fleet sum/mean lives in obs_agg).
+* **alerting**: EMA z-score arms after warmup and fires on spikes (and
+  immediately on non-finite); the SLO error budget fires when misses
+  burn the budget past the threshold and stays quiet at compliant
+  rates.
+
+Pure python — no jax, no devices; the whole file runs in the budgeted
+core lane.  ``-m obs`` runs the observability lane alone.
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.utils.sketches import (
+    EmaZScore,
+    ErrorBudget,
+    Gauge,
+    QuantileSketch,
+    merge_sketch_dicts,
+)
+
+pytestmark = pytest.mark.obs
+
+QS = (0.01, 0.05, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99)
+
+
+def _rank_error(sketch, data, q):
+    """Observed rank error of sketch.quantile(q) as a fraction of n:
+    distance between the answer's true rank range and the target rank."""
+    ans = sketch.quantile(q)
+    data = np.sort(data)
+    n = len(data)
+    target = max(1, min(n, math.ceil(q * n)))
+    lo = np.searchsorted(data, ans, side="left") + 1   # 1-based ranks
+    hi = np.searchsorted(data, ans, side="right")
+    if lo <= target <= hi:
+        return 0.0
+    return min(abs(lo - target), abs(hi - target)) / n
+
+
+def _draws(rng, dist, n):
+    if dist == "uniform":
+        return rng.uniform(0, 100, n)
+    if dist == "lognormal":
+        return rng.lognormal(3.0, 1.5, n)  # latency-shaped heavy tail
+    # bimodal: cache-hit vs cache-miss TTFT
+    return np.where(rng.random(n) < 0.7, rng.normal(10, 1, n),
+                    rng.normal(55, 5, n))
+
+
+@pytest.mark.parametrize("dist", ["uniform", "lognormal", "bimodal"])
+@pytest.mark.parametrize("shards", [1, 4, 13])
+def test_merged_shards_within_stated_rank_error(dist, shards):
+    """THE acceptance property: K independently-built shard sketches
+    merge into one whose every quantile answer is within the merged
+    sketch's stated rank-error bound of exact numpy over the
+    concatenated data."""
+    rng = np.random.default_rng(hash((dist, shards)) % 2 ** 31)
+    parts = [_draws(rng, dist, int(rng.integers(50, 2000)))
+             for _ in range(shards)]
+    docs = []
+    for part in parts:
+        s = QuantileSketch()
+        for v in part:
+            s.add(float(v))
+        # through the SERIALIZED form — the path the aggregator runs
+        docs.append(json.loads(json.dumps(s.to_dict())))
+    fleet = merge_sketch_dicts(docs)
+    data = np.concatenate(parts)
+    assert fleet.n == len(data)
+    bound = fleet.rank_error_bound
+    # eps=0.005, doubled by ONE K-way merge level (never more: the
+    # fleet path is a single merge_many pass, not a pairwise chain)
+    assert bound <= 0.01 + 1e-12 or shards == 1
+    for q in QS:
+        err = _rank_error(fleet, data, q)
+        assert err <= bound + 1.0 / len(data), (q, err, bound)
+    # exact companions ride along unsketche
+    assert fleet.quantile(0.0) == data.min()
+    assert fleet.quantile(1.0) == data.max()
+    assert abs(fleet.mean - data.mean()) < 1e-6 * max(1, abs(data.mean()))
+
+
+def test_single_sketch_bounded_memory_and_error():
+    """A lone (unmerged) sketch states the tighter eps bound and keeps
+    O(1/eps) tuples no matter how many samples stream through."""
+    rng = np.random.default_rng(7)
+    data = rng.normal(0, 1, 20_000)
+    s = QuantileSketch(eps=0.01)
+    for v in data:
+        s.add(float(v))
+    assert s.rank_error_bound == 0.01
+    assert len(s.to_dict()["tuples"]) < 600  # ~1/eps scale, not n
+    for q in QS:
+        assert _rank_error(s, data, q) <= 0.01 + 1.0 / len(data)
+
+
+def test_serialization_round_trip_exact():
+    rng = np.random.default_rng(3)
+    s = QuantileSketch()
+    for v in rng.exponential(5.0, 500):
+        s.add(float(v))
+    doc = json.loads(json.dumps(s.to_dict()))
+    back = QuantileSketch.from_dict(doc)
+    assert back.n == s.n and back.rank_error_bound == s.rank_error_bound
+    for q in (0.0,) + QS + (1.0,):
+        assert back.quantile(q) == s.quantile(q)
+    assert back.to_dict() == s.to_dict()
+
+
+def test_empty_and_tiny_sketches():
+    s = QuantileSketch()
+    assert s.quantile(0.5) is None and s.mean is None
+    assert QuantileSketch.from_dict(s.to_dict()).quantile(0.99) is None
+    s.add(42.0)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert s.quantile(q) == 42.0
+    assert s.mean == 42.0
+    s.add(float("nan"))   # non-finite is the alert layer's job
+    s.add(float("inf"))
+    assert s.n == 1
+    two = QuantileSketch()
+    two.add(1.0)
+    two.add(3.0)
+    assert two.quantile(0.0) == 1.0 and two.quantile(1.0) == 3.0
+    const = QuantileSketch()
+    for _ in range(100):
+        const.add(5.0)
+    assert const.quantile(0.5) == 5.0 and const.quantile(0.99) == 5.0
+
+
+def test_merge_with_empty_and_into_empty():
+    a = QuantileSketch()
+    for v in range(100):
+        a.add(float(v))
+    empty = QuantileSketch()
+    assert empty.merge(QuantileSketch()).n == 0
+    adopted = QuantileSketch().merge(a)
+    # adopting a lone shard keeps its tighter (unmerged) bound
+    assert adopted.n == 100 and not adopted.merged
+    assert adopted.rank_error_bound == a.rank_error_bound
+    before = a.quantile(0.5)
+    a.merge(QuantileSketch())  # no-op
+    assert a.quantile(0.5) == before and not a.merged
+
+
+def test_merge_sketch_dicts_helper():
+    rng = np.random.default_rng(11)
+    docs, allv = [], []
+    for _ in range(5):
+        s = QuantileSketch()
+        vals = rng.uniform(0, 10, 300)
+        allv.append(vals)
+        for v in vals:
+            s.add(float(v))
+        docs.append(s.to_dict())
+    fleet = merge_sketch_dicts(docs)
+    data = np.concatenate(allv)
+    assert fleet.n == len(data)
+    assert _rank_error(fleet, data, 0.5) <= fleet.rank_error_bound + 1e-3
+
+
+# ----------------------------------------------------------------- gauges
+
+def test_gauge_envelope_and_round_trip():
+    g1 = Gauge()
+    g1.set(10.0, t_unix=100.0)
+    g1.set(12.0, t_unix=101.0)
+    g1.set(3.0, t_unix=200.0)
+    assert g1.last == 3.0 and g1.t == 200.0      # last write wins
+    assert g1.vmin == 3.0 and g1.vmax == 12.0    # envelope retained
+    doc = json.loads(json.dumps(g1.to_dict()))
+    assert Gauge.from_dict(doc).to_dict() == g1.to_dict()
+    # a malformed serialized gauge parses to an empty one, not a crash
+    assert Gauge.from_dict({"last": "broken"}).last is None
+    g3 = Gauge()
+    g3.set(float("nan"))
+    assert g3.last is None  # non-finite never lands
+
+
+# --------------------------------------------------------------- alerting
+
+def test_ema_zscore_warmup_then_spike():
+    det = EmaZScore("loss", z_threshold=6.0, warmup=20, cooldown=5)
+    rng = np.random.default_rng(0)
+    fired = []
+    for i in range(200):
+        a = det.observe(2.0 + 0.01 * float(rng.normal()), step=i)
+        assert a is None, (i, a)  # steady series never alerts
+        fired.append(a)
+    alert = det.observe(50.0, step=200)   # 4800-sigma spike
+    assert alert is not None and alert["alert"] == "loss_zscore"
+    assert alert["z"] > 6.0 and alert["step"] == 200
+    # cooldown throttles the storm that follows a level shift
+    assert det.observe(50.0, step=201) is None
+    # during warmup even a spike stays quiet (noisy fresh-init steps)
+    cold = EmaZScore("loss", warmup=20)
+    for i in range(5):
+        assert cold.observe(2.0) is None
+    assert cold.observe(1e9) is None
+
+
+def test_ema_zscore_nonfinite_and_direction():
+    det = EmaZScore("loss", warmup=1000)  # warmup can't be the trigger
+    det.observe(1.0)
+    a = det.observe(float("nan"))
+    assert a is not None and a["reason"] == "nonfinite"
+    below = EmaZScore("steps_per_sec", direction="below", warmup=10,
+                      z_threshold=6.0)
+    rng = np.random.default_rng(1)
+    for _ in range(100):
+        assert below.observe(100.0 + 0.1 * float(rng.normal())) is None
+    assert below.observe(130.0) is None          # above: wrong direction
+    assert below.observe(1.0) is not None        # collapse: fires
+
+
+def test_error_budget_burn_rate():
+    # 99% SLO, 2x burn threshold: a 5% miss rate burns at 5x -> fires
+    eb = ErrorBudget("slo", target=0.99, window=100, burn_threshold=2.0,
+                     min_events=20, cooldown=10)
+    rng = np.random.default_rng(2)
+    alerts = [eb.observe(rng.random() < 0.05) for _ in range(500)]
+    hits = [a for a in alerts if a]
+    assert hits, "5% misses against a 1% budget must alert"
+    assert all(a["burn_rate"] >= 2.0 for a in hits)
+    assert all(a["alert"] == "slo_burn_rate" for a in hits)
+    # cooldown: alerts are spaced, not one per observation
+    assert len(hits) < len([a for a in alerts]) / 10
+    # a compliant service (0.1% misses against 1% budget) stays quiet
+    quiet = ErrorBudget("slo", target=0.99, window=100,
+                        burn_threshold=2.0, min_events=20)
+    assert not any(quiet.observe(rng.random() < 0.001)
+                   for _ in range(2000))
+    # fewer than min_events can never alert (two misses in a row at
+    # startup is not a trend)
+    tiny = ErrorBudget("slo", target=0.99, min_events=20)
+    assert not any(tiny.observe(True) for _ in range(19))
+
+
+def test_error_budget_validates_target():
+    with pytest.raises(ValueError):
+        ErrorBudget(target=1.0)
+    with pytest.raises(ValueError):
+        QuantileSketch(eps=0.6)
+    with pytest.raises(ValueError):
+        EmaZScore("x", direction="sideways")

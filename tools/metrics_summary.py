@@ -174,6 +174,52 @@ def summarize(records: List[Dict[str, Any]],
             if key in last:
                 tick_stats[key] = last[key]
         out["serving_ticks"] = tick_stats
+    # kind="alert" records (train.telemetry EMA z-score anomalies,
+    # serve/scheduler.py SLO burn rate): count by name + the last few,
+    # so a triage pass sees WHAT fired without grepping the stream
+    alert_recs = [r for r in records if r.get("kind") == "alert"]
+    if alert_recs:
+        by_name: Dict[str, int] = {}
+        for a in alert_recs:
+            key = str(a.get("alert"))
+            by_name[key] = by_name.get(key, 0) + 1
+        out["alerts"] = {
+            "n": len(alert_recs), "by_name": by_name,
+            "last": [{k: a.get(k) for k in
+                      ("alert", "role", "step", "value", "z",
+                       "burn_rate", "rid") if a.get(k) is not None}
+                     for a in alert_recs[-5:]]}
+    # kind="rollup" sketch snapshots (utils/sketches.py, loaded by file
+    # path like trace_report): the NEWEST per (role, run, p, inc) merge
+    # into per-role percentiles — the same math tools/obs_agg.py runs
+    # fleet-wide, composed here so --json callers get one document
+    rollup_recs = [r for r in records if r.get("kind") == "rollup"]
+    if rollup_recs:
+        sketches_mod = _sketches_mod()
+        latest: Dict[tuple, Dict[str, Any]] = {}
+        for r in rollup_recs:
+            latest[(str(r.get("role")), str(r.get("run", "")),
+                    int(r.get("p", 0)), int(r.get("inc", 0)))] = r
+        views: Dict[str, Dict[str, Any]] = {}
+        for (role, _run, _p, _inc), r in sorted(latest.items()):
+            view = views.setdefault(role, {"writers": 0, "docs": {},
+                                           "counters": {}})
+            view["writers"] += 1
+            for name, doc in (r.get("sketches") or {}).items():
+                view["docs"].setdefault(name, []).append(doc)
+            for name, val in (r.get("counters") or {}).items():
+                if isinstance(val, (int, float)):
+                    view["counters"][name] = (
+                        view["counters"].get(name, 0) + val)
+        out["rollups"] = {}
+        for role, view in views.items():
+            out["rollups"][role] = {
+                "writers": view["writers"],
+                "counters": view["counters"],
+                "sketches": {
+                    name: sketches_mod.merge_sketch_dicts(docs).summary(
+                        (0.5, 0.9, 0.99))
+                    for name, docs in sorted(view["docs"].items())}}
     # elastic topology-change events (kind=topology, train.telemetry):
     # the moments the run resumed on a different world than the one that
     # saved its checkpoint — effective batch/accumulation may change there
@@ -304,6 +350,23 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
             lines.append(
                 f"  updates/s      p50 {rl['updates_per_sec']['p50']:.6g}"
                 f"   max {rl['updates_per_sec']['max']:.6g}")
+    if "alerts" in summary:
+        al = summary["alerts"]
+        lines.append(f"ALERTS: {al['n']} (" + ", ".join(
+            f"{k} x{v}" for k, v in al["by_name"].items()) + ")")
+        for a in al["last"]:
+            detail = a.get("burn_rate") or a.get("z") or a.get("value")
+            lines.append(f"  {a.get('alert')} @ step {a.get('step')}"
+                         + (f" = {detail}" if detail is not None else ""))
+    for role, view in (summary.get("rollups") or {}).items():
+        lines.append(f"rollups [{role}]: {view['writers']} writer(s)")
+        for name, s in view["sketches"].items():
+            if s.get("p50") is None:
+                continue
+            lines.append(
+                f"  {name:<18} p50 {s['p50']:.6g}   p90 {s['p90']:.6g}"
+                f"   p99 {s['p99']:.6g}   (n={s['n']}, "
+                f"±{s['rank_error_bound'] * 100:.1f}% rank)")
     lines += serving_lines(summary)
     if heartbeat is not None:
         age = ("?" if heartbeat_age is None
@@ -337,6 +400,29 @@ def _trace_report_mod():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+_sketches_cache = None
+
+
+def _sketches_mod():
+    """utils/sketches.py loaded by file path (the ckpt_fsck convention,
+    shared with tools/obs_agg.py) — merging rollup snapshots must work
+    on a jax-less host under ``python -S``."""
+    global _sketches_cache
+    if _sketches_cache is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "neural_networks_parallel_training_with_mpi_tpu", "utils",
+            "sketches.py")
+        spec = importlib.util.spec_from_file_location("_nnpt_sketches",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _sketches_cache = mod
+    return _sketches_cache
 
 
 def trace_view(path: str) -> Optional[Dict[str, Any]]:
@@ -378,22 +464,33 @@ def main(argv=None) -> int:
 
     heartbeat = postmortem = None
     heartbeat_age = None
+    heartbeats = []
     if os.path.isdir(args.path):
+        import glob as glob_lib
+
         metrics_path = os.path.join(args.path, "metrics.jsonl")
-        hb_path = os.path.join(args.path, "heartbeat.json")
         pm_path = os.path.join(args.path, "postmortem.json")
-        for p, slot in ((hb_path, "hb"), (pm_path, "pm")):
+        # every heartbeat in the dir: the legacy shared heartbeat.json
+        # and/or the per-role heartbeat-<role>-p<P>.json forms (two
+        # programs sharing one dir each own a file now); the FRESHEST
+        # one keeps the single-heartbeat render/json shape
+        for p in sorted(glob_lib.glob(
+                os.path.join(args.path, "heartbeat*.json"))):
             try:
                 with open(p) as f:
                     doc = json.load(f)
-                if slot == "hb":
-                    heartbeat = doc
-                    heartbeat_age = max(0.0,
-                                        time.time() - os.stat(p).st_mtime)
-                else:
-                    postmortem = doc
+                age = max(0.0, time.time() - os.stat(p).st_mtime)
             except (OSError, ValueError):
-                pass
+                continue
+            heartbeats.append({"file": os.path.basename(p),
+                               "age_s": round(age, 3), **doc})
+            if heartbeat_age is None or age < heartbeat_age:
+                heartbeat, heartbeat_age = doc, age
+        try:
+            with open(pm_path) as f:
+                postmortem = json.load(f)
+        except (OSError, ValueError):
+            pass
     else:
         metrics_path = args.path
     try:
@@ -412,6 +509,8 @@ def main(argv=None) -> int:
                        if k in ("n_records", "serving", "serving_ticks")}
         summary["heartbeat"] = heartbeat
         summary["heartbeat_age_s"] = heartbeat_age
+        if len(heartbeats) > 1:
+            summary["heartbeats"] = heartbeats
         summary["postmortem_reason"] = (postmortem or {}).get("reason")
         if trace is not None:
             trace.pop("_render", None)

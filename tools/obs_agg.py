@@ -1,0 +1,547 @@
+"""Fleet observability aggregator: merge N telemetry dirs into one view.
+
+Every training/serving process writes its own ``--telemetry_dir``
+(metrics.jsonl with ``kind="rollup"`` sketch snapshots + ``kind="alert"``
+records, per-role heartbeats — train/telemetry.py, serve/scheduler.py).
+This tool tails any number of those dirs and merges them into ONE
+fleet-level picture:
+
+* **Merged percentiles** — the serialized quantile-sketch states
+  (utils/sketches.py, loaded by file path) from the NEWEST rollup per
+  ``(dir, role, run, process, incarnation)`` identity are merged in one
+  K-way pass, so fleet p50/p99 TTFT/ITL, step time, MFU, queue depth and
+  block utilization are honest to the sketches' stated 2ε rank-error
+  bound — never an average of per-process percentiles.
+* **Counters/gauges** — counters (tokens out, requests, deadline
+  misses, skips) sum across every identity, incarnations included (a
+  relaunched replica's earlier tokens still happened); gauges (tokens/s,
+  queue depth, MFU) come only from each process's LATEST incarnation
+  (a dead incarnation's queue depth is not load).
+* **Alerts** — ``kind="alert"`` records from every stream within
+  ``--alert-window`` seconds, plus aggregator-side heartbeat-staleness
+  alerts (a non-final heartbeat older than ``--stale-after``).
+* **Outputs** — an atomically-replaced ``fleet.json`` (``--out``),
+  Prometheus text exposition (``--prom`` file and/or ``--http PORT``
+  serving ``/metrics`` + ``/fleet.json``), a one-shot text summary, a
+  ``--watch N`` refresh loop, and ``--dashboard`` (ANSI terminal
+  rendering) for a live fleet view.
+
+Zero dependencies beyond the stdlib (proven under ``python -S`` like
+``ckpt_fsck``/``trace_report``) — triage a telemetry bundle copied off a
+pod on a host with no JAX::
+
+    python tools/obs_agg.py RUN_A RUN_B --out fleet.json --prom fleet.prom
+    python tools/obs_agg.py RUN_* --watch 5 --dashboard
+    python tools/obs_agg.py RUN_* --http 9100          # /metrics endpoint
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as glob_lib
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_SKETCHES_PY = (pathlib.Path(__file__).resolve().parent.parent
+                / "neural_networks_parallel_training_with_mpi_tpu"
+                / "utils" / "sketches.py")
+
+
+def _load_sketches_mod():
+    spec = importlib.util.spec_from_file_location("_nnpt_sketches",
+                                                  _SKETCHES_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+sk = _load_sketches_mod()
+
+# fleet gauges that ADD across processes (load) vs. average (intensity)
+_ADDITIVE_GAUGES = ("tokens_per_sec", "queue_depth")
+_MEAN_GAUGES = ("mfu", "block_utilization", "steps_per_sec")
+# the headline fleet metrics, in render order
+_FLEET_METRICS = ("ttft_ms", "itl_ms", "total_ms", "tokens_per_sec",
+                  "mfu", "step_time_ms", "loss", "grad_norm",
+                  "samples_per_sec", "queue_depth", "block_utilization")
+DEFAULT_STALE_AFTER_S = 120.0
+DEFAULT_ALERT_WINDOW_S = 3600.0
+
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail line of a live run
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError:
+        pass
+    return records
+
+
+def collect_dir(dirpath: str) -> Dict[str, Any]:
+    """Everything the aggregator needs from one telemetry dir: rollup
+    and alert records, heartbeat files with their staleness, and the
+    latest point stats per stream kind (a dir with no rollups still
+    contributes its heartbeat + alerts)."""
+    recs = _load_jsonl(os.path.join(dirpath, "metrics.jsonl"))
+    heartbeats = []
+    for hb_path in sorted(glob_lib.glob(
+            os.path.join(dirpath, "heartbeat*.json"))):
+        try:
+            with open(hb_path) as f:
+                doc = json.load(f)
+            age = max(0.0, time.time() - os.stat(hb_path).st_mtime)
+        except (OSError, ValueError):
+            continue
+        name = os.path.basename(hb_path)
+        role, proc = "?", 0
+        if name.startswith("heartbeat-"):
+            parts = name[len("heartbeat-"):-len(".json")].rsplit("-p", 1)
+            role = parts[0] or "?"
+            try:
+                proc = int(parts[1])
+            except (IndexError, ValueError):
+                proc = 0
+        heartbeats.append({"dir": dirpath, "file": name, "role": role,
+                           "process": proc, "age_s": round(age, 3),
+                           "final": bool(doc.get("final")),
+                           "step": doc.get("step"),
+                           "steps_per_sec_ema":
+                               doc.get("steps_per_sec_ema")})
+    return {
+        "dir": dirpath,
+        "rollups": [r for r in recs if r.get("kind") == "rollup"],
+        "alerts": [r for r in recs if r.get("kind") == "alert"],
+        "heartbeats": heartbeats,
+    }
+
+
+def _identity(dirpath: str, rec: Dict[str, Any]) -> Tuple:
+    return (dirpath, str(rec.get("role", "?")), str(rec.get("run", "")),
+            int(rec.get("p", 0)), int(rec.get("inc", 0)))
+
+
+def aggregate(dirs: List[str],
+              stale_after_s: float = DEFAULT_STALE_AFTER_S,
+              alert_window_s: float = DEFAULT_ALERT_WINDOW_S
+              ) -> Dict[str, Any]:
+    """One fleet document from N telemetry dirs (see module
+    docstring)."""
+    now = time.time()
+    collected = [collect_dir(d) for d in dirs]
+    # newest rollup per writer identity: sketches/counters are
+    # CUMULATIVE per incarnation, so the latest snapshot supersedes all
+    # earlier ones from the same (dir, role, run, p, inc)
+    latest: Dict[Tuple, Dict[str, Any]] = {}
+    for c in collected:
+        for r in c["rollups"]:
+            latest[_identity(c["dir"], r)] = r
+    # per-(dir, role, run, p): the newest incarnation (gauges only count
+    # from live incarnations — a dead attempt's queue depth is not load)
+    newest_inc: Dict[Tuple, int] = {}
+    for key in latest:
+        d, role, run, p, inc = key
+        pk = (d, role, run, p)
+        newest_inc[pk] = max(newest_inc.get(pk, -1), inc)
+
+    roles: Dict[str, Dict[str, Any]] = {}
+    for key, rec in sorted(latest.items()):
+        d, role, run, p, inc = key
+        view = roles.setdefault(role, {"writers": 0, "sketch_docs": {},
+                                       "counters": {}, "gauges": {}})
+        view["writers"] += 1
+        for name, doc in (rec.get("sketches") or {}).items():
+            view["sketch_docs"].setdefault(name, []).append(doc)
+        for name, val in (rec.get("counters") or {}).items():
+            if isinstance(val, (int, float)):
+                view["counters"][name] = (view["counters"].get(name, 0)
+                                          + val)
+        if inc == newest_inc[(d, role, run, p)]:
+            for name, doc in (rec.get("gauges") or {}).items():
+                gauge = sk.Gauge.from_dict(doc or {})
+                if gauge.last is not None:
+                    view["gauges"].setdefault(name, []).append(
+                        gauge.last)
+
+    out_roles: Dict[str, Any] = {}
+    fleet: Dict[str, Any] = {}
+    for role, view in sorted(roles.items()):
+        merged: Dict[str, Any] = {}
+        for name, docs in sorted(view["sketch_docs"].items()):
+            sketch = sk.merge_sketch_dicts(docs)
+            merged[name] = sketch.summary((0.5, 0.9, 0.99))
+        gauges = {}
+        for name, vals in sorted(view["gauges"].items()):
+            gauges[name] = (round(sum(vals), 4)
+                            if name in _ADDITIVE_GAUGES
+                            else round(sum(vals) / len(vals), 9))
+        out_roles[role] = {"writers": view["writers"],
+                           "sketches": merged,
+                           "counters": view["counters"],
+                           "gauges": gauges}
+        for name in _FLEET_METRICS:
+            if name in merged and name not in fleet:
+                fleet[name] = merged[name]
+        for name, val in gauges.items():
+            # gauges win over sketch summaries for rate-like headline
+            # numbers: a sketch of historical tokens/s is not current
+            # load, the summed latest gauges are
+            if name in _ADDITIVE_GAUGES:
+                fleet[name] = val
+
+    # ---- alerts ----------------------------------------------------------
+    def scrub(rec: Dict[str, Any]) -> Dict[str, Any]:
+        # foreign alert records can carry non-finite floats (python's
+        # json reader accepts the NaN extension); stringify them so
+        # fleet.json / the HTTP endpoint stay STRICT JSON
+        import math
+
+        return {k: (v if not isinstance(v, float) or math.isfinite(v)
+                    else str(v))
+                for k, v in rec.items()}
+
+    alerts: List[Dict[str, Any]] = []
+    for c in collected:
+        for a in c["alerts"]:
+            t_unix = a.get("t_unix")
+            if (isinstance(t_unix, (int, float))
+                    and now - t_unix > alert_window_s):
+                continue
+            alerts.append(scrub({**a, "dir": c["dir"]}))
+    heartbeats: List[Dict[str, Any]] = []
+    for c in collected:
+        heartbeats.extend(c["heartbeats"])
+        for hb in c["heartbeats"]:
+            if not hb["final"] and hb["age_s"] > stale_after_s:
+                alerts.append({
+                    "kind": "alert", "alert": "heartbeat_stale",
+                    "reason": "heartbeat_stale", "role": hb["role"],
+                    "dir": hb["dir"], "file": hb["file"],
+                    "age_s": hb["age_s"],
+                    "stale_after_s": stale_after_s,
+                    "t_unix": round(now, 3)})
+    by_name: Dict[str, int] = {}
+    for a in alerts:
+        key = str(a.get("alert"))
+        by_name[key] = by_name.get(key, 0) + 1
+
+    return {
+        "generated_unix": round(now, 3),
+        "generated_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime(now)),
+        "dirs": list(dirs),
+        "writers": [
+            {"dir": k[0], "role": k[1], "run": k[2], "process": k[3],
+             "incarnation": k[4], "step": latest[k].get("step"),
+             "t_unix": latest[k].get("t_unix")}
+            for k in sorted(latest)],
+        "roles": out_roles,
+        "fleet": fleet,
+        "heartbeats": heartbeats,
+        "alerts": {"n": len(alerts), "by_name": by_name,
+                   "window_s": alert_window_s,
+                   "recent": alerts[-20:]},
+    }
+
+
+def write_fleet(doc: Dict[str, Any], path: str) -> None:
+    """Atomic replace — a scraping router never reads a torn file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (format 0.0.4)
+# ---------------------------------------------------------------------------
+
+def _esc(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace(
+        "\n", "\\n")
+
+
+def _metric_name(s: str) -> str:
+    return "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in s)
+
+
+def to_prometheus(doc: Dict[str, Any], prefix: str = "nnpt") -> str:
+    """Render the fleet document as Prometheus text exposition:
+    sketches become summaries (quantile-labeled gauges + _sum/_count),
+    counters become _total counters, gauges and heartbeat ages become
+    gauges, alert counts a labeled gauge."""
+    lines: List[str] = []
+
+    def emit(name: str, value: Any, labels: Dict[str, Any],
+             mtype: Optional[str] = None, help_: Optional[str] = None
+             ) -> None:
+        if value is None:
+            return
+        full = f"{prefix}_{_metric_name(name)}"
+        if help_ is not None:
+            lines.append(f"# HELP {full} {help_}")
+        if mtype is not None:
+            lines.append(f"# TYPE {full} {mtype}")
+        lab = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+        lines.append(f"{full}{{{lab}}} {value}" if lab
+                     else f"{full} {value}")
+
+    typed: set = set()
+    for role, view in (doc.get("roles") or {}).items():
+        for name, summ in (view.get("sketches") or {}).items():
+            full = _metric_name(name)
+            if full not in typed:
+                typed.add(full)
+                lines.append(f"# TYPE {prefix}_{full} summary")
+            for q in ("p50", "p90", "p99"):
+                if summ.get(q) is not None:
+                    emit(name, summ[q],
+                         {"role": role, "quantile": str(
+                             {"p50": 0.5, "p90": 0.9, "p99": 0.99}[q])})
+            if summ.get("n"):
+                emit(f"{name}_sum", round(summ["n"] * (summ["mean"] or 0),
+                                          6), {"role": role})
+                emit(f"{name}_count", summ["n"], {"role": role})
+        for name, val in (view.get("counters") or {}).items():
+            emit(f"{name}_total", val, {"role": role}, mtype="counter"
+                 if f"{name}_total" not in typed else None)
+            typed.add(f"{name}_total")
+        for name, val in (view.get("gauges") or {}).items():
+            # '_current' keeps the gauge family disjoint from the
+            # sketch summary of the same series (tokens_per_sec both
+            # has historical percentiles and a live rate): one metric
+            # family must not mix summary and typeless-gauge samples
+            emit(f"{name}_current", val, {"role": role},
+                 mtype="gauge" if f"{name}_current" not in typed
+                 else None)
+            typed.add(f"{name}_current")
+    for hb in doc.get("heartbeats") or []:
+        emit("heartbeat_age_seconds", hb["age_s"],
+             {"dir": hb["dir"], "role": hb["role"],
+              "p": hb["process"]},
+             mtype="gauge" if "hb" not in typed else None)
+        typed.add("hb")
+    alerts = doc.get("alerts") or {}
+    lines.append(f"# TYPE {prefix}_alerts gauge")
+    emit("alerts", alerts.get("n", 0), {})
+    for name, n in (alerts.get("by_name") or {}).items():
+        emit("alerts_by_name", n, {"alert": name},
+             mtype="gauge" if "abn" not in typed else None)
+        typed.add("abn")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_text(doc: Dict[str, Any]) -> str:
+    lines = [f"fleet @ {doc['generated_iso']} — "
+             f"{len(doc['dirs'])} dir(s), "
+             f"{len(doc['writers'])} writer(s)"]
+    for w in doc["writers"]:
+        lines.append(f"  {w['role']:<6} p{w['process']} inc "
+                     f"{w['incarnation']} step {w['step']}  "
+                     f"[{os.path.basename(w['dir'].rstrip('/')) or w['dir']}]")
+    for role, view in (doc.get("roles") or {}).items():
+        lines.append(f"{role}: {view['writers']} writer(s)")
+        for name, s in view["sketches"].items():
+            if s.get("p50") is None:
+                continue
+            lines.append(
+                f"  {name:<18} p50 {s['p50']:.6g}   p90 {s['p90']:.6g}"
+                f"   p99 {s['p99']:.6g}   (n={s['n']}, "
+                f"±{s['rank_error_bound'] * 100:.1f}% rank)")
+        counters = view.get("counters") or {}
+        if counters:
+            lines.append("  counters: " + ", ".join(
+                f"{k}={v:g}" for k, v in counters.items()))
+        for name, val in (view.get("gauges") or {}).items():
+            lines.append(f"  {name:<18} {val:.6g} "
+                         f"({'sum' if name in _ADDITIVE_GAUGES else 'mean'}"
+                         " across live writers)")
+    for hb in doc.get("heartbeats") or []:
+        mark = ("FINAL" if hb["final"]
+                else ("STALE" if hb["age_s"]
+                      > (doc.get("stale_after_s") or DEFAULT_STALE_AFTER_S)
+                      else "fresh"))
+        lines.append(f"heartbeat {hb['role']:<6} p{hb['process']} "
+                     f"step {hb['step']}: {hb['age_s']:.1f}s old "
+                     f"[{mark}]")
+    alerts = doc.get("alerts") or {}
+    if alerts.get("n"):
+        lines.append(f"ALERTS ({alerts['n']} in the last "
+                     f"{alerts['window_s']:.0f}s): " + ", ".join(
+                         f"{k} x{v}"
+                         for k, v in alerts["by_name"].items()))
+        for a in alerts["recent"][-5:]:
+            detail = a.get("burn_rate") or a.get("z") or a.get("age_s")
+            lines.append(f"  {a.get('alert')} "
+                         f"[{a.get('role', '?')}]"
+                         + (f" = {detail}" if detail is not None else ""))
+    else:
+        lines.append("no active alerts")
+    return "\n".join(lines)
+
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def render_dashboard(doc: Dict[str, Any]) -> str:
+    """The --watch --dashboard terminal view: clear screen + the text
+    summary with a banner line on top."""
+    fleet = doc.get("fleet") or {}
+    banner = []
+    for key in ("ttft_ms", "itl_ms"):
+        s = fleet.get(key)
+        if isinstance(s, dict) and s.get("p50") is not None:
+            banner.append(f"{key.split('_')[0]} p50/p99 "
+                          f"{s['p50']:.1f}/{s['p99']:.1f}ms")
+    for key in ("tokens_per_sec", "queue_depth"):
+        v = fleet.get(key)
+        if isinstance(v, (int, float)):
+            banner.append(f"{key}={v:g}")
+    mfu = (doc.get("roles", {}).get("train", {}).get("sketches", {})
+           .get("mfu"))
+    if mfu and mfu.get("p50") is not None:
+        banner.append(f"mfu p50 {mfu['p50']:.3f}")
+    n_alerts = (doc.get("alerts") or {}).get("n", 0)
+    banner.append(f"alerts={n_alerts}")
+    return (_CLEAR + "NNPT FLEET  |  " + "  |  ".join(banner) + "\n"
+            + "-" * 72 + "\n" + render_text(doc))
+
+
+# ---------------------------------------------------------------------------
+# HTTP exposition
+# ---------------------------------------------------------------------------
+
+def make_http_server(port: int, aggregate_fn):
+    """A ThreadingHTTPServer exposing /metrics (Prometheus text) and
+    /fleet.json, re-aggregating on each GET (the fleet is small; the
+    scrape interval is the cache)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            try:
+                doc = aggregate_fn()
+                if self.path.startswith("/metrics"):
+                    body = to_prometheus(doc).encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/fleet"):
+                    body = json.dumps(doc, indent=2).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:  # a scrape must fail loudly, not hang
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet: scrapes are not events
+            pass
+
+    return ThreadingHTTPServer(("", int(port)), Handler)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dirs", nargs="+",
+                    help="telemetry dirs (each a --telemetry_dir with "
+                         "metrics.jsonl + heartbeat files)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the merged fleet document here "
+                         "(atomic replace)")
+    ap.add_argument("--prom", default=None, metavar="PATH",
+                    help="write Prometheus text exposition here "
+                         "(atomic replace)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the fleet document as JSON instead of "
+                         "the text summary")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                    help="re-aggregate every SECS seconds until "
+                         "interrupted (0 = one shot)")
+    ap.add_argument("--dashboard", action="store_true",
+                    help="ANSI terminal dashboard rendering (pairs with "
+                         "--watch)")
+    ap.add_argument("--http", type=int, default=0, metavar="PORT",
+                    help="serve /metrics (Prometheus) and /fleet.json "
+                         "on this port until interrupted")
+    ap.add_argument("--stale-after", type=float,
+                    default=DEFAULT_STALE_AFTER_S, metavar="SECS",
+                    help="a non-final heartbeat older than this raises "
+                         "a heartbeat_stale alert")
+    ap.add_argument("--alert-window", type=float,
+                    default=DEFAULT_ALERT_WINDOW_S, metavar="SECS",
+                    help="only alerts newer than this appear in the "
+                         "fleet view")
+    args = ap.parse_args(argv)
+
+    missing = [d for d in args.dirs if not os.path.isdir(d)]
+    if missing:
+        print(f"ERROR: not a directory: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    def run_once() -> Dict[str, Any]:
+        doc = aggregate(args.dirs, stale_after_s=args.stale_after,
+                        alert_window_s=args.alert_window)
+        doc["stale_after_s"] = args.stale_after
+        if args.out:
+            write_fleet(doc, args.out)
+        if args.prom:
+            tmp = f"{args.prom}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(to_prometheus(doc))
+            os.replace(tmp, args.prom)
+        return doc
+
+    if args.http:
+        server = make_http_server(args.http, run_once)
+        print(f"serving /metrics and /fleet.json on :{args.http} "
+              "(Ctrl-C to stop)", file=sys.stderr)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            server.server_close()
+        return 0
+
+    while True:
+        doc = run_once()
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        elif args.dashboard:
+            print(render_dashboard(doc), flush=True)
+        else:
+            print(render_text(doc))
+        if args.watch <= 0:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

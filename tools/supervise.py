@@ -74,16 +74,27 @@ def main(argv=None) -> int:
                         "counts as failed")
     p.add_argument("--telemetry-dir", default=None,
                    help="the child's --telemetry_dir: watch its "
-                        "heartbeat.json for staleness (with "
-                        "--heartbeat-timeout) and point the relaunch log "
-                        "at its postmortem.json after abnormal exits")
+                        "heartbeat for staleness (with "
+                        "--heartbeat-timeout; the freshest "
+                        "heartbeat*.json in the dir — per-role "
+                        "heartbeat-<role>-p<P>.json or the legacy "
+                        "shared heartbeat.json), summarize kind=alert "
+                        "records each child emitted next to its exit, "
+                        "and point the relaunch log at postmortem.json "
+                        "after abnormal exits")
     p.add_argument("--heartbeat-timeout", type=float, default=0.0,
                    help="kill the child as hung (exit-42 retry) when its "
                         "heartbeat goes stale for this many seconds "
                         "(0 = off; needs --telemetry-dir or --heartbeat)")
     p.add_argument("--heartbeat", default=None,
                    help="explicit heartbeat file (overrides the "
-                        "--telemetry-dir derived path)")
+                        "--telemetry-dir derived path).  When several "
+                        "programs share one telemetry dir, pass YOUR "
+                        "child's heartbeat-<role>-p<P>.json here — the "
+                        "derived legacy path falls back to the "
+                        "freshest heartbeat in the dir, which another "
+                        "program's beats could keep fresh while your "
+                        "child hangs")
     p.add_argument("--checkpoint-dir", default=None,
                    help="the child's --checkpoint_dir: before each "
                         "relaunch, log the newest VERIFIED snapshot "
@@ -107,11 +118,14 @@ def main(argv=None) -> int:
                 "--heartbeat")
     postmortem = (os.path.join(args.telemetry_dir, "postmortem.json")
                   if args.telemetry_dir else None)
+    alerts = (os.path.join(args.telemetry_dir, "metrics.jsonl")
+              if args.telemetry_dir else None)
     return supervise(cmd, max_restarts=args.max_restarts,
                      backoff=args.backoff, backoff_cap=args.backoff_cap,
                      heartbeat_path=heartbeat,
                      heartbeat_timeout=args.heartbeat_timeout,
                      postmortem_path=postmortem,
+                     alerts_path=alerts,
                      ckpt_dir=args.checkpoint_dir,
                      elastic=args.elastic,
                      min_devices=args.min_devices,

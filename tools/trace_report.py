@@ -10,9 +10,16 @@ per process × incarnation) and writes:
   wall-clock axis, so a supervised multi-process run that crashed and
   relaunched shows both incarnations of every rank with the relaunch
   gap visible between them;
-* a text summary — per-phase time share per (process, incarnation)
-  and the compile ledger rollup (compiles, recompiles, total compile
-  seconds, what changed).
+* a text summary — per-phase time share per (process, incarnation),
+  per-request flow-point counts, the DROPPED-span count from each
+  bounded tracer's footer (a truncated track is flagged TRUNCATED
+  instead of reading as a quiet tail), and the compile ledger rollup
+  (compiles, recompiles, total compile seconds, what changed).
+
+Flow records (``kind="flow"``, train/trace.py ``Tracer.flow``) become
+Chrome ``s``/``t``/``f`` flow events bound to the enclosing phase
+slices, so Perfetto draws one request's admit -> prefill -> decode ->
+retire arrows across the scheduler's tick spans.
 
 Zero dependencies beyond the stdlib (proven under ``python -S`` like
 ``ckpt_fsck``) — usable on a host with no JAX to triage a trace dir
@@ -62,12 +69,10 @@ def load_dir(dirpath: str) -> Dict[str, List[Dict[str, Any]]]:
     for path in sorted(glob.glob(os.path.join(dirpath, "trace-*.jsonl"))):
         for rec in _load_jsonl(path):
             kind = rec.get("kind")
-            if kind == "span":
+            if kind in ("span", "instant", "flow"):
                 spans.append(rec)
             elif kind == "meta":
                 metas.append(rec)
-            elif kind == "instant":
-                spans.append(rec)
     for path in sorted(glob.glob(os.path.join(dirpath,
                                               "compiles-*.jsonl"))):
         compiles.extend(r for r in _load_jsonl(path)
@@ -88,7 +93,8 @@ def _groups(records: List[Dict[str, Any]]
     return out
 
 
-_META_KEYS = ("kind", "name", "t", "dur", "p", "run", "inc", "thread")
+_META_KEYS = ("kind", "name", "t", "dur", "p", "run", "inc", "thread",
+              "id", "fph")
 
 
 def to_chrome(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
@@ -119,6 +125,17 @@ def to_chrome(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
                   "ts": round((float(r.get("t", t0)) - t0) * 1e6, 1)}
             if r.get("kind") == "instant":
                 ev.update(ph="i", s="p")
+            elif r.get("kind") == "flow":
+                # Chrome flow events (s/t/f): Perfetto binds each point
+                # to the slice enclosing its ts on this track and draws
+                # the arrows — one request's admit -> prefill chunks ->
+                # decode ticks -> retire path across the tick spans
+                # (train/trace.py Tracer.flow; the id carries the
+                # process prefix, so merged fleet flows never collide)
+                ev.update(ph=str(r.get("fph", "t")), cat="flow",
+                          id=str(r.get("id", "?")))
+                if ev["ph"] == "f":
+                    ev["bp"] = "e"  # bind the finish to the enclosing slice
             else:
                 ev.update(ph="X",
                           dur=round(float(r.get("dur", 0.0)) * 1e6, 1))
@@ -138,12 +155,26 @@ def summarize(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     share + span counts, run ids seen, relaunch gaps, and the compile
     ledger totals per incarnation."""
     spans = [r for r in data["spans"] if r.get("kind") == "span"]
+    flows = [r for r in data["spans"] if r.get("kind") == "flow"]
     out: Dict[str, Any] = {"runs": sorted({_key(r)[0] for r in spans}),
                            "groups": [], "compiles": []}
+    # the bounded-trace footer: each tracer's final meta record counts
+    # the spans dropped past the event cap.  Surfacing it per track is
+    # what keeps a truncated timeline from reading as a complete one —
+    # a 100k-event serving run that dropped 40k spans LOOKS quiet at
+    # the end, and only this counter says otherwise.
+    dropped: Dict[Key, int] = {}
+    for m in data["metas"]:
+        if m.get("final"):
+            d = int(m.get("dropped", 0) or 0)
+            key = _key(m)
+            dropped[key] = max(dropped.get(key, 0), d)
+    out["dropped_spans_total"] = sum(dropped.values())
+    flow_groups = _groups(flows)
     groups = _groups(spans)
-    for key in sorted(groups):
+    for key in sorted(set(groups) | set(flow_groups) | set(dropped)):
         run, p, inc = key
-        recs = groups[key]
+        recs = groups.get(key, [])
         starts = [float(r["t"]) for r in recs]
         ends = [float(r["t"]) + float(r.get("dur", 0.0)) for r in recs]
         wall = max(ends) - min(starts) if recs else 0.0
@@ -160,6 +191,8 @@ def summarize(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
         out["groups"].append({
             "run": run, "process": p, "incarnation": inc,
             "n_spans": len(recs),
+            "n_flows": len(flow_groups.get(key, [])),
+            "dropped_spans": dropped.get(key, 0),
             "t_first": round(min(starts), 6) if starts else None,
             "t_last": round(max(ends), 6) if ends else None,
             "wall_s": round(wall, 6),
@@ -210,9 +243,15 @@ def render_text(summary: Dict[str, Any]) -> str:
     runs = summary.get("runs", [])
     lines.append(f"runs: {', '.join(runs) if runs else '(none)'}")
     for g in summary["groups"]:
+        flows = (f" (+{g['n_flows']} flow points)"
+                 if g.get("n_flows") else "")
         lines.append(f"proc {g['process']} / incarnation "
                      f"{g['incarnation']}: {g['n_spans']} spans over "
-                     f"{g['wall_s']:.3f}s wall")
+                     f"{g['wall_s']:.3f}s wall{flows}")
+        if g.get("dropped_spans"):
+            lines.append(f"  TRUNCATED: {g['dropped_spans']} span(s) "
+                         "dropped past the event cap — this track's "
+                         "tail is missing, not quiet")
         phases = sorted(g["phases"].items(),
                         key=lambda kv: -kv[1]["total_s"])
         for name, ph in phases:
