@@ -1200,6 +1200,7 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
             return (doc.get("attention_artifact")
                     or doc.get("decode_artifact")
                     or doc.get("serve_artifact")
+                    or doc.get("serve_fleet_artifact")
                     or doc.get("paged_attn_artifact")
                     or doc.get("rl_artifact")
                     or doc.get("update_sharding_artifact")
@@ -2555,6 +2556,144 @@ def bench_serve(out_path: str = "BENCH_SERVE.json",
     return out_path
 
 
+def bench_serve_fleet(out_path: str = "BENCH_FLEET.json") -> str:
+    """The serving-fleet bench (serve/fleet.py): aggregate tokens/s vs
+    REPLICA COUNT (1/2/4 subprocess replicas, each its own jax runtime,
+    under the group supervisor and the SLO-aware router) at saturating
+    offered load (closed-loop clients > total fleet slots), per-class
+    TTFT percentiles (interactive-with-SLO vs bulk), and a router
+    overload point where the bounded fleet queue REJECTS.
+
+    Honesty on the CPU host: this box has ONE core, so N concurrently
+    time-sliced CPU-bound replicas can never beat one (physics, not
+    routing — the ``cpu_bound_control`` rows measure exactly that: a
+    ratio AT OR UNDER 1.0, and in practice UNDER it, since IPC + a
+    second runtime add pure overhead).  A real serving replica is
+    DEVICE-bound: the host's tick work (admission, block tables,
+    sampling bookkeeping) is a small slice of a decode step that runs
+    on the accelerator
+    while sibling replicas' steps run on THEIR accelerators.  The
+    sweep therefore pads each replica's decode tick with
+    ``device_emulation_ms`` of emulated device latency
+    (``--step-sleep-ms`` in the worker — measured host tick cost at
+    this scale is ~0.6 ms, disclosed below),
+    which is the regime the fleet targets; the scaling rows then
+    measure what the ROUTER + supervisor + IPC actually add — the part
+    this subsystem is responsible for.  Same convention family as the
+    CPU MFU divisor (DESIGN.md §7): an emulated-device number, clearly
+    labeled, never passed off as chip throughput."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        launch_fleet, run_fleet_closed_loop,
+    )
+
+    devices = jax.devices()
+    device_ms = 15.0
+    model = dict(vocab=256, seq=128, layers=2, d_model=64, heads=4,
+                 d_ff=128, init_seed=0)
+    serve = dict(slots=4, block_size=16, prefill_chunk=32,
+                 queue_depth=16)
+    classes = [{"name": "interactive", "slo_ms": 2000.0},
+               {"name": "bulk", "slo_ms": None}]
+    results: dict = {
+        "model": model, "serve_per_replica": serve,
+        "device_emulation_ms": device_ms,
+        "host_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else os.cpu_count(),
+    }
+
+    def run_arm(n, *, sleep_ms, clients, rpc, queue_depth=128,
+                seed=1):
+        fleet = launch_fleet(
+            n, model=model, serve=serve, step_sleep_ms=sleep_ms,
+            router_kwargs=dict(queue_depth=queue_depth),
+            prewarm=True, max_restarts=1, log=lambda m: None)
+        try:
+            fleet.wait_ready(600)
+            row = run_fleet_closed_loop(
+                fleet, clients, rpc, vocab_size=model["vocab"],
+                prompt_lens=(4, 24), max_new=(8, 24), seed=seed,
+                classes=classes)
+            row["replicas"] = n
+            row["offered_clients"] = clients
+            row["fleet_slots"] = n * serve["slots"]
+            return row
+        finally:
+            fleet.close()
+
+    # ---- the scaling sweep: 1/2/4 replicas, saturating load ----------
+    sweep = []
+    for n in (1, 2, 4):
+        row = run_arm(n, sleep_ms=device_ms, clients=6 * n, rpc=4)
+        log(f"[fleet n={n}] {row['tokens_per_sec']} tok/s "
+            f"(interactive ttft p50 "
+            f"{row['ttft_ms_p50_interactive']:.1f} ms, "
+            f"requeued {row['requeued']})")
+        sweep.append(row)
+    results["fleet_sweep"] = sweep
+    base = sweep[0]["tokens_per_sec"]
+    speedup_2 = round(sweep[1]["tokens_per_sec"] / base, 2)
+    speedup_4 = round(sweep[2]["tokens_per_sec"] / base, 2)
+
+    # ---- CPU-bound control: no emulated device latency ----------------
+    # N time-sliced CPU-bound replicas on one core CANNOT scale; this
+    # row set proves the sweep above is measuring fleet overlap, not a
+    # measurement artifact (if the control ALSO scaled, something would
+    # be wrong with the harness)
+    control = []
+    for n in (1, 2):
+        row = run_arm(n, sleep_ms=0.0, clients=6 * n, rpc=3, seed=2)
+        control.append({"replicas": n,
+                        "tokens_per_sec": row["tokens_per_sec"]})
+    results["cpu_bound_control"] = {
+        "rows": control,
+        "ratio_2x": round(control[1]["tokens_per_sec"]
+                          / control[0]["tokens_per_sec"], 2),
+        "note": ("no device emulation: both replicas time-slice the "
+                 "single host core, so the ratio is bounded by ~1.0 "
+                 "and in practice lands UNDER it (IPC + a second "
+                 "runtime are pure overhead) — the fleet's scaling "
+                 "claim lives in the device-bound regime above, and "
+                 "on real accelerators (one replica per host/chip)"),
+    }
+
+    # ---- router overload: the bounded fleet queue rejects -------------
+    over = run_arm(2, sleep_ms=device_ms, clients=24, rpc=2,
+                   queue_depth=6, seed=3)
+    results["router_overload"] = {
+        "router_queue_depth": 6,
+        "offered_clients": 24,
+        "router_rejections": over["router_rejections"],
+        "submit_retries": over["submit_retries"],
+        "completed": over["requests"],
+        "ttft_ms_p99_interactive": over["ttft_ms_p99_interactive"],
+        "note": ("overload sheds at the ROUTER's one bounded queue "
+                 "(clients retry, closed-loop); replica-local queues "
+                 "stay shallow so waiting work remains re-placeable"),
+    }
+
+    results["acceptance"] = {
+        "tokens_per_sec_1_2_4": [r["tokens_per_sec"] for r in sweep],
+        "speedup_2_replicas": speedup_2,
+        "speedup_2_ge_1_6": bool(speedup_2 >= 1.6),
+        "speedup_4_replicas": speedup_4,
+        "speedup_4_ge_2_5": bool(speedup_4 >= 2.5),
+        "router_rejections_observed":
+            int(over["router_rejections"]) > 0,
+        "per_class_ttft_embedded": True,
+    }
+    results["platform"] = devices[0].platform
+    results["device_kind"] = devices[0].device_kind
+    out_path = _divert_cpu_overwrite(
+        out_path, devices[0].platform not in ("cpu",))
+    with open(out_path, "w") as f:
+        json.dump(results, f, indent=2)
+    log(f"serve fleet bench -> {out_path} (2x {speedup_2}, "
+        f"4x {speedup_4})")
+    return out_path
+
+
 def bench_paged_attn(out_path: str = "BENCH_PAGED_ATTN.json") -> str:
     """The fused paged-attention bench (ops.pallas_kernels.paged_attention
     behind serve/paged_kv.py's ``attn_impl`` seam): (1) a gathered-vs-
@@ -3214,6 +3353,15 @@ def main() -> int:
                          "BENCH_SERVE.json")
     ap.add_argument("--serve-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--serve-fleet", action="store_true",
+                    help="serving-fleet bench (serve/fleet.py): "
+                         "aggregate tokens/s vs replica count (1/2/4 "
+                         "subprocess replicas under the group "
+                         "supervisor + SLO-aware router) at saturating "
+                         "load, per-class TTFT percentiles, router "
+                         "overload rejection; write BENCH_FLEET.json")
+    ap.add_argument("--serve-fleet-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--serve-attn-impl", choices=["gathered", "fused"],
                     default="gathered",
                     help="attention dispatch for the --serve sweep: "
@@ -3319,6 +3467,9 @@ def main() -> int:
         print(json.dumps({"serve_artifact":
                           bench_serve(attn_impl=args.serve_attn_impl)}))
         return 0
+    if args.serve_fleet_inproc:
+        print(json.dumps({"serve_fleet_artifact": bench_serve_fleet()}))
+        return 0
     if args.paged_attn_inproc:
         print(json.dumps({"paged_attn_artifact": bench_paged_attn()}))
         return 0
@@ -3343,6 +3494,7 @@ def main() -> int:
         return 0
 
     if (args.attention or args.decode or args.serve or args.rl
+            or args.serve_fleet
             or args.paged_attn or args.prefix_cache
             or args.update_sharding_ab or args.trace_overhead
             or args.obs_overhead or args.quant_ab):
@@ -3373,6 +3525,13 @@ def main() -> int:
             else:
                 path = bench_serve(attn_impl=args.serve_attn_impl)
             print(json.dumps({"serve_artifact": path}))
+        if args.serve_fleet:
+            # always the CPU-child shape: the fleet IS subprocess
+            # replicas (each pins its own cpu backend); an exclusive
+            # single-chip tunnel cannot host 4 replica runtimes anyway
+            path = _run_flag_cpu_child("--serve-fleet-inproc", 1,
+                                       timeout=3000)
+            print(json.dumps({"serve_fleet_artifact": path}))
         if args.paged_attn:
             if choice == "cpu":
                 path = _run_flag_cpu_child("--paged-attn-inproc", 1)

@@ -36,10 +36,29 @@ from .paged_kv import (
 )
 from .paged_kv import ATTN_IMPLS
 from .scheduler import Request, Scheduler, ServeConfig
-from .loadgen import make_requests, prewarm, run_closed_loop, sweep_loads
+from .loadgen import (
+    make_requests,
+    prewarm,
+    run_closed_loop,
+    run_fleet_closed_loop,
+    sweep_loads,
+)
+from .fleet import (
+    Fleet,
+    FleetRequest,
+    FleetRouter,
+    InprocReplica,
+    LoadSignal,
+    ProcReplica,
+    TPGenerateReplica,
+    launch_fleet,
+)
 
 __all__ = [
     "ATTN_IMPLS", "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
     "PrefixIndex", "init_paged_kv", "Request", "Scheduler", "ServeConfig",
     "make_requests", "prewarm", "run_closed_loop", "sweep_loads",
+    "Fleet", "FleetRequest", "FleetRouter", "InprocReplica", "LoadSignal",
+    "ProcReplica", "TPGenerateReplica", "launch_fleet",
+    "run_fleet_closed_loop",
 ]

@@ -1,0 +1,18 @@
+"""Replica worker entry point.
+
+``python -m neural_networks_parallel_training_with_mpi_tpu.serve.\
+_fleet_worker --worker ...`` — a dedicated runnable module (NOT
+re-exported by ``serve/__init__``) so runpy never finds the target
+already imported by the package init (the "found in sys.modules"
+warning ``-m serve.fleet`` would trip).  All logic lives in
+:func:`serve.fleet.worker_main`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .fleet import worker_main
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

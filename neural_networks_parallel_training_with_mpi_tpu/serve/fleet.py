@@ -1,0 +1,1326 @@
+"""Serving fleet: N replica programs behind one SLO-aware router.
+
+One ``Scheduler`` over one ``PagedDecodeServer`` is a single REPLICA —
+a single program on a single replica group, which is where every
+subsystem in this repo stopped before this module (ROADMAP item 1).
+Millions-of-users traffic needs several cooperating single-purpose
+programs joined by queues (the Podracer shape, arXiv 2104.06272): here,
+N serving replica processes under the process-group supervisor
+(``train.resilience.GroupSupervisor``) with a front-end router
+load-balancing one bounded fleet wait queue across them.
+
+* **Replica handles** — the router speaks one interface
+  (:class:`ReplicaHandle`) to three replica shapes:
+  :class:`InprocReplica` (a ``serve.Scheduler`` in this process — the
+  budgeted core-lane test shape, and the zero-IPC baseline),
+  :class:`ProcReplica` (a subprocess running :func:`worker_main`,
+  newline-JSON over stdio — the production shape, one process per
+  replica so an XLA crash takes out ONE replica's runtime), and
+  :class:`TPGenerateReplica` (one replica SPANNING a tensor-parallel
+  mesh through ``models.generate_tp`` — ragged batched decode on
+  ``tensor``-sharded params, token-identical to the single-device
+  replica since both are pinned against ``models.generate``).
+* **Placement** — least-loaded with deadline feasibility, fed by each
+  replica's LIVE load report: the ``kind="rollup"`` record the
+  telemetry plane already emits (``Scheduler.load_report`` — serialized
+  ``utils/sketches.py`` quantile state for TTFT/ITL plus instantaneous
+  queue-depth/block-utilization occupancy).  One telemetry path: the
+  router parses the same document ``tools/obs_agg.py`` merges, so the
+  admission signal and the dashboard can never disagree about what a
+  replica reported.
+* **Admission** — overload is rejected at the ROUTER (one bounded fleet
+  queue), not by N replica queues rejecting blind: each replica keeps
+  only a shallow local backlog (``replica_queue_cap``) so almost all
+  waiting work sits where it can still be re-placed.  A request whose
+  deadline no replica can plausibly meet (predicted wait from the TTFT
+  rollup + queue occupancy exceeds its slack) can be rejected up front
+  (``reject_infeasible=True``) instead of admitted into a miss.
+* **Replica death drains cleanly** — the router keeps the authoritative
+  ledger of every dispatched request; when a replica dies (crash,
+  SIGKILL, hang-kill) its uncompleted requests REQUEUE at the front of
+  the fleet queue in original submission order and re-place on
+  siblings.  Greedy decode is deterministic, so re-execution reproduces
+  byte-identical tokens (pinned by tests/test_fleet.py); p99 TTFT
+  degrades, no request starves.  The supervisor relaunches the dead
+  replica under its own backoff/budget without disturbing siblings, and
+  the relaunched process re-registers through its ``ready`` event.
+
+``python -m neural_networks_parallel_training_with_mpi_tpu.serve.fleet
+--worker ...`` is the replica-process entry (:func:`worker_main`);
+``tools/serve_fleet.py`` is the operator launcher over
+:func:`launch_fleet`.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.sketches import Gauge, QuantileSketch
+
+Pytree = Any
+
+# wire protocol (one JSON object per line):
+#   parent -> worker : {"op": "submit", "rid", "prompt", "max_new",
+#                       "slo_ms"} | {"op": "drain"} | {"op": "exit"}
+#   worker -> parent : {"ev": "ready", ...} | {"ev": "done", "rid",
+#                       "tokens", "ttft_ms", "itl_ms", ...}
+#                     | {"ev": "reject", "rid"}
+#                     | {"ev": "status", "report": <load_report>}
+#                     | {"ev": "drained", "requests": [...]}
+# fleet rids ride the wire verbatim, so completions need no id
+# translation on the way back.
+
+
+# ---------------------------------------------------------------------------
+# load signal
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LoadSignal:
+    """One replica's placement signal, parsed from its
+    ``Scheduler.load_report()`` rollup record (serialized sketches +
+    ``now`` occupancy) — NOT from private scheduler state, so a
+    subprocess replica and an in-process one feed the router
+    identically."""
+    t_unix: float = 0.0
+    queue_depth: int = 0
+    in_flight: int = 0
+    free_slots: int = 0
+    slots: int = 1
+    queue_cap: int = 0
+    free_blocks: int = 0
+    block_utilization: float = 0.0
+    ttft_p50_ms: Optional[float] = None
+    ttft_p99_ms: Optional[float] = None
+    replica: Optional[int] = None
+
+    @classmethod
+    def from_report(cls, rec: Dict[str, Any]) -> "LoadSignal":
+        now = rec.get("now") or {}
+        sig = cls(
+            t_unix=float(rec.get("t_unix") or 0.0),
+            queue_depth=int(now.get("queue_depth", 0)),
+            in_flight=int(now.get("in_flight", now.get("live", 0))),
+            free_slots=int(now.get("free_slots", 0)),
+            slots=max(1, int(now.get("slots", 1))),
+            queue_cap=int(now.get("queue_cap", 0)),
+            free_blocks=int(now.get("free_blocks", 0)),
+            block_utilization=float(now.get("block_utilization", 0.0)),
+            replica=rec.get("replica"),
+        )
+        doc = (rec.get("sketches") or {}).get("ttft_ms")
+        if doc:
+            sk = QuantileSketch.from_dict(doc)
+            sig.ttft_p50_ms = sk.quantile(0.5)
+            sig.ttft_p99_ms = sk.quantile(0.99)
+        return sig
+
+    @property
+    def occupancy(self) -> float:
+        """Queued + running work, normalized by the replica's slot
+        count — the least-loaded score (heterogeneous replicas compare
+        by RELATIVE load, not absolute stream counts)."""
+        return (self.in_flight + self.queue_depth) / self.slots
+
+
+# ---------------------------------------------------------------------------
+# the router's request ledger
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FleetRequest:
+    """One request's fleet-level lifecycle.  The ROUTER owns this
+    ledger — it is what makes replica death recoverable: a dead
+    replica's uncompleted entries requeue from here, never from the
+    dead process's memory."""
+    rid: int
+    prompt: List[int]
+    max_new: int
+    slo_ms: Optional[float]
+    t_submit: float
+    deadline: float
+    replica: Optional[str] = None      # current / last placement
+    t_dispatch: Optional[float] = None
+    t_done: Optional[float] = None
+    requeues: int = 0                  # times re-placed after a death
+    ttft_ms: Optional[float] = None    # fleet-level: router wait included
+    itl_ms: Optional[float] = None
+    n_generated: Optional[int] = None
+
+    @property
+    def deadline_missed(self) -> Optional[bool]:
+        if self.t_done is None:
+            return None
+        return bool(math.isfinite(self.deadline)
+                    and self.t_done > self.deadline)
+
+
+# ---------------------------------------------------------------------------
+# replica handles
+# ---------------------------------------------------------------------------
+
+class ReplicaHandle:
+    """What the router needs from a replica, regardless of where it
+    runs.  ``submit`` may refuse (False) — the request stays at the
+    fleet queue head; ``pump`` advances the replica (in-process shapes)
+    and returns completion dicts carrying the FLEET rid."""
+
+    name: str = "replica"
+    role: str = "replica"
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    def accepting(self) -> bool:
+        raise NotImplementedError
+
+    def load(self) -> Optional[LoadSignal]:
+        raise NotImplementedError
+
+    def submit(self, req: FleetRequest) -> bool:
+        raise NotImplementedError
+
+    def pump(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def assigned(self) -> List[int]:
+        """Fleet rids dispatched here and not yet completed."""
+        raise NotImplementedError
+
+    def take_assigned(self) -> List[int]:
+        """Drop and return the assigned set (the router requeues them
+        after a death)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InprocReplica(ReplicaHandle):
+    """A ``serve.Scheduler`` in this process.  The core-lane test shape
+    (no subprocesses inside the budgeted lane) and the mechanism
+    baseline: everything the router does to a subprocess replica it
+    does to this one, through the same load-report record."""
+
+    def __init__(self, scheduler, name: str = "replica-0"):
+        self.name = name
+        self.sched = scheduler
+        self._local: Dict[int, int] = {}     # fleet rid -> scheduler rid
+        self._dead = False
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def accepting(self) -> bool:
+        return (not self._dead
+                and self.sched.pending() < self.sched.cfg.queue_depth)
+
+    def load(self) -> Optional[LoadSignal]:
+        if self._dead:
+            return None
+        return LoadSignal.from_report(self.sched.load_report())
+
+    def submit(self, req: FleetRequest) -> bool:
+        if self._dead:
+            return False
+        lrid = self.sched.submit(req.prompt, req.max_new,
+                                 slo_ms=req.slo_ms)
+        if lrid is None:
+            return False
+        self._local[req.rid] = lrid
+        return True
+
+    def pump(self) -> List[Dict[str, Any]]:
+        if self._dead or not (self.sched.pending()
+                              or self.sched.in_flight()):
+            return []
+        done_local = set(self.sched.tick())
+        out = []
+        for frid, lrid in list(self._local.items()):
+            if lrid not in done_local:
+                continue
+            st = self.sched.stats(lrid)
+            out.append({"rid": frid,
+                        "tokens": self.sched.result(lrid),
+                        "ttft_ms": st.ttft_ms, "itl_ms": st.itl_ms,
+                        "evictions": st.evictions})
+            del self._local[frid]
+        return out
+
+    def assigned(self) -> List[int]:
+        return list(self._local)
+
+    def take_assigned(self) -> List[int]:
+        rids = list(self._local)
+        self._local.clear()
+        return rids
+
+    def fail(self) -> None:
+        """Test hook: simulate this replica's death (the in-process
+        analogue of SIGKILL — its scheduler state is unreachable)."""
+        self._dead = True
+
+    def drain(self) -> List[Dict[str, Any]]:
+        return self.sched.drain()
+
+    def close(self) -> None:
+        if not self._dead:
+            self.sched.close()
+
+
+class TPGenerateReplica(ReplicaHandle):
+    """One replica SPANNING a tensor-parallel mesh: batched ragged
+    decode through ``models.generate_tp`` on ``tensor``-sharded params
+    (the native Megatron layout).  This is a batch engine, not a
+    continuous-batching scheduler — each :meth:`pump` takes up to
+    ``batch`` queued requests and decodes them in ONE shard_mapped
+    program across the mesh, so TTFT is batch-granular; what it buys is
+    a replica whose model no longer fits (or saturates) one device.
+    Prompt width, batch and total length pad to power-of-two buckets so
+    the compiled-program set stays O(log²), the same discipline as the
+    paged server's prefill buckets.  Greedy tokens are identical to the
+    single-device replica: both paths are pinned against
+    ``models.generate`` (tests/test_generate_tp.py,
+    tests/test_serve_paged.py) and the fleet pin closes the triangle
+    (tests/test_fleet.py)."""
+
+    def __init__(self, model, params_tp, mesh, *, batch: int = 4,
+                 queue_cap: int = 64, name: str = "tp-replica",
+                 pad_id: int = 0, now_fn=time.monotonic):
+        self.name = name
+        self.model = model
+        self.params_tp = params_tp
+        self.mesh = mesh
+        self.batch = int(batch)
+        self.queue_cap = int(queue_cap)
+        self.pad_id = int(pad_id)
+        self.now = now_fn
+        self._queue: Deque[FleetRequest] = collections.deque()
+        self._dead = False
+        self._ttft = QuantileSketch()
+        self._itl = QuantileSketch()
+        self._q_gauge = Gauge()
+        self._batches = 0
+
+    @staticmethod
+    def _bucket(n: int, lo: int = 8) -> int:
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def accepting(self) -> bool:
+        return not self._dead and len(self._queue) < self.queue_cap
+
+    def load_report(self) -> Dict[str, Any]:
+        """The same record shape ``Scheduler.load_report`` emits, built
+        from this engine's own sketches — the router must not
+        special-case replica shapes."""
+        self._q_gauge.set(len(self._queue))
+        return {
+            "kind": "rollup", "role": "serve",
+            "t_unix": round(time.time(), 3),
+            "sketches": {k: s.to_dict()
+                         for k, s in (("ttft_ms", self._ttft),
+                                      ("itl_ms", self._itl)) if s.n},
+            "counters": {"batches": self._batches},
+            "gauges": {"queue_depth": self._q_gauge.to_dict()},
+            "now": {"queue_depth": len(self._queue), "in_flight": 0,
+                    "free_slots": self.batch, "slots": self.batch,
+                    "queue_cap": self.queue_cap, "free_blocks": 1 << 20,
+                    "block_utilization": 0.0},
+        }
+
+    def load(self) -> Optional[LoadSignal]:
+        if self._dead:
+            return None
+        return LoadSignal.from_report(self.load_report())
+
+    def submit(self, req: FleetRequest) -> bool:
+        if not self.accepting():
+            return False
+        self._queue.append(req)
+        return True
+
+    def pump(self) -> List[Dict[str, Any]]:
+        if self._dead or not self._queue:
+            return []
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models.generate_tp import generate_tp
+
+        reqs = [self._queue.popleft()
+                for _ in range(min(self.batch, len(self._queue)))]
+        lens = [len(r.prompt) for r in reqs]
+        p_pad = self._bucket(max(lens))
+        total = self._bucket(max(l + r.max_new
+                                 for l, r in zip(lens, reqs)),
+                             lo=p_pad + 1)
+        b_pad = self._bucket(len(reqs), lo=1)
+        prompts = np.full((b_pad, p_pad), self.pad_id, np.int32)
+        plens = np.ones((b_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            prompts[i, :lens[i]] = r.prompt
+            plens[i] = lens[i]
+        t0 = self.now()
+        toks = generate_tp(self.model, self.params_tp,
+                           jnp.asarray(prompts), self.mesh,
+                           max_new_tokens=total - p_pad,
+                           prompt_lens=jnp.asarray(plens),
+                           pad_id=self.pad_id)
+        toks = np.asarray(toks)
+        t1 = self.now()
+        self._batches += 1
+        out = []
+        for i, r in enumerate(reqs):
+            row = [int(t) for t in toks[i, :lens[i] + r.max_new]]
+            ttft = (t1 - t0) * 1e3   # batch-granular: first token
+            #                          lands when the batch returns
+            itl = 0.0 if r.max_new <= 1 else ttft / (r.max_new - 1)
+            self._ttft.add(ttft)
+            self._itl.add(itl)
+            out.append({"rid": r.rid, "tokens": row,
+                        "ttft_ms": ttft, "itl_ms": itl, "evictions": 0})
+        return out
+
+    def assigned(self) -> List[int]:
+        return [r.rid for r in self._queue]
+
+    def take_assigned(self) -> List[int]:
+        rids = [r.rid for r in self._queue]
+        self._queue.clear()
+        return rids
+
+    def fail(self) -> None:
+        self._dead = True
+
+
+class ProcReplica(ReplicaHandle):
+    """A replica SUBPROCESS speaking the newline-JSON protocol (module
+    header).  A dedicated reader thread drains the child's stdout into
+    an event queue so the router's pump never blocks on a slow or dead
+    pipe; writes detect a broken pipe and mark the replica down (the
+    supervisor owns the relaunch, :meth:`attach` re-binds the fresh
+    process and the ``ready`` event re-opens admission)."""
+
+    def __init__(self, name: str, role: str = "replica"):
+        self.name = name
+        self.role = role
+        self._proc = None
+        self._stdin = None
+        self._events: Deque[Dict[str, Any]] = collections.deque()
+        self._lock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+        self._assigned: Dict[int, FleetRequest] = {}
+        self.ready = False
+        self._signal: Optional[LoadSignal] = None
+        self.drained: Optional[List[Dict[str, Any]]] = None
+        self.incarnation = -1
+
+    # ---- supervisor wiring --------------------------------------------
+    def attach(self, proc, incarnation: int = 0) -> None:
+        """Bind to a freshly spawned worker process (GroupSupervisor's
+        ``on_spawn`` callback lands here on every (re)launch)."""
+        self._proc = proc
+        self._stdin = proc.stdin
+        self.ready = False
+        self._signal = None
+        self.incarnation = incarnation
+        t = threading.Thread(target=self._read_loop,
+                             args=(proc.stdout,), daemon=True)
+        t.start()
+        self._reader = t
+
+    def _read_loop(self, stream) -> None:
+        try:
+            for line in stream:
+                line = line.strip()
+                if not line or not line.startswith("{"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "ev" in rec:
+                    with self._lock:
+                        self._events.append(rec)
+        except (OSError, ValueError):
+            pass  # dead pipe: the supervisor reaps the exit
+
+    # ---- handle interface ---------------------------------------------
+    def alive(self) -> bool:
+        return (self._proc is not None
+                and self._proc.poll() is None)
+
+    def accepting(self) -> bool:
+        return self.alive() and self.ready
+
+    def load(self) -> Optional[LoadSignal]:
+        return self._signal
+
+    def _send(self, obj: Dict[str, Any]) -> bool:
+        if self._stdin is None:
+            return False
+        try:
+            self._stdin.write(json.dumps(obj) + "\n")
+            self._stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def submit(self, req: FleetRequest) -> bool:
+        if not self.accepting():
+            return False
+        if not self._send({"op": "submit", "rid": req.rid,
+                           "prompt": req.prompt,
+                           "max_new": req.max_new,
+                           "slo_ms": req.slo_ms}):
+            return False
+        self._assigned[req.rid] = req
+        return True
+
+    def request_drain(self) -> bool:
+        return self._send({"op": "drain"})
+
+    def request_exit(self) -> bool:
+        return self._send({"op": "exit"})
+
+    def pump(self) -> List[Dict[str, Any]]:
+        out = []
+        while True:
+            with self._lock:
+                if not self._events:
+                    break
+                rec = self._events.popleft()
+            ev = rec.get("ev")
+            if ev == "ready":
+                self.ready = True
+            elif ev == "status":
+                try:
+                    self._signal = LoadSignal.from_report(
+                        rec.get("report") or {})
+                except (TypeError, ValueError, KeyError):
+                    pass
+            elif ev == "done":
+                self._assigned.pop(int(rec["rid"]), None)
+                out.append(rec)
+            elif ev == "reject":
+                # the worker's local queue refused (should not happen
+                # while the router respects its caps): back to the
+                # fleet queue like a death-requeue of one request
+                req = self._assigned.pop(int(rec["rid"]), None)
+                if req is not None:
+                    rec["requeue"] = req
+                    out.append(rec)
+            elif ev == "drained":
+                self.drained = rec.get("requests") or []
+        return out
+
+    def assigned(self) -> List[int]:
+        return list(self._assigned)
+
+    def take_assigned(self) -> List[int]:
+        rids = list(self._assigned)
+        self._assigned.clear()
+        return rids
+
+    def close(self) -> None:
+        self.request_exit()
+
+
+# ---------------------------------------------------------------------------
+# the router
+# ---------------------------------------------------------------------------
+
+class FleetRouter:
+    """SLO-aware front-end over N :class:`ReplicaHandle`\\ s (module
+    docstring).  ``pump()`` is the service loop step: collect
+    completions (advancing in-process replicas), requeue any dead
+    replica's ledger entries, place queued work.  Single-threaded by
+    design — subprocess replicas compute concurrently; the router is
+    pure host bookkeeping."""
+
+    def __init__(self, replicas: Sequence[ReplicaHandle], *,
+                 queue_depth: int = 256,
+                 default_slo_ms: Optional[float] = None,
+                 replica_queue_cap: int = 2,
+                 reject_infeasible: bool = False,
+                 feasibility_margin: float = 1.5,
+                 telemetry_dir: Optional[str] = None,
+                 rollup_every: int = 50,
+                 now_fn=time.monotonic):
+        self.replicas = list(replicas)
+        if not self.replicas:
+            raise ValueError("a fleet needs at least one replica")
+        names = [h.name for h in self.replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.queue_depth = int(queue_depth)
+        self.default_slo_ms = default_slo_ms
+        self.replica_queue_cap = int(replica_queue_cap)
+        self.reject_infeasible = bool(reject_infeasible)
+        self.feasibility_margin = float(feasibility_margin)
+        self.now = now_fn
+        self.queue: Deque[FleetRequest] = collections.deque()
+        self.reqs: Dict[int, FleetRequest] = {}
+        self._results: Dict[int, List[int]] = {}
+        self._next_rid = 0
+        self._pumps = 0
+        # completions collected OUTSIDE pump() (on_replica_down drains
+        # a dead handle's raced events); the next pump() surfaces them
+        self._completed_backlog: List[int] = []
+        # counters (the router's own rollup record reports these)
+        self.routed = 0
+        self.rejected = 0            # bounded-queue + infeasible rejects
+        self.rejected_infeasible = 0
+        self.requeued = 0
+        self.completed = 0
+        self.replica_deaths = 0
+        self._completed_by: Dict[str, int] = {h.name: 0
+                                              for h in self.replicas}
+        self._was_alive: Dict[str, bool] = {h.name: True
+                                            for h in self.replicas}
+        # router telemetry: same sketch/rollup shape as a replica, role
+        # "router", so obs_agg renders router vs replica side by side
+        self._ttft = QuantileSketch()
+        self._q_gauge = Gauge()
+        self.rollup_every = max(0, int(rollup_every))
+        self._jsonl = None
+        self._t0 = time.perf_counter()
+        self._heartbeat = None
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(telemetry_dir,
+                                            "metrics.jsonl"), "a")
+            from ..train import telemetry as telemetry_lib
+
+            self._heartbeat = telemetry_lib.Heartbeat(os.path.join(
+                telemetry_dir,
+                telemetry_lib.heartbeat_filename("router")))
+
+    # ---- client surface ------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               slo_ms: Optional[float] = None) -> Optional[int]:
+        """Enqueue at the fleet; returns the fleet rid, or None when
+        admission rejects (bounded queue full, or — with
+        ``reject_infeasible`` — no replica can plausibly meet the
+        deadline).  Validation mirrors ``Scheduler.submit``'s loud
+        refusal for never-servable requests."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens {max_new_tokens} < 1")
+        if len(self.queue) >= self.queue_depth:
+            self.rejected += 1
+            return None
+        slo = self.default_slo_ms if slo_ms is None else slo_ms
+        now = self.now()
+        deadline = now + slo / 1e3 if slo is not None else math.inf
+        if (self.reject_infeasible and math.isfinite(deadline)
+                and not self._any_feasible(deadline, now)):
+            self.rejected += 1
+            self.rejected_infeasible += 1
+            return None
+        rid = self._next_rid
+        self._next_rid += 1
+        req = FleetRequest(rid=rid, prompt=prompt_ids,
+                           max_new=int(max_new_tokens), slo_ms=slo,
+                           t_submit=now, deadline=deadline)
+        self.reqs[rid] = req
+        self.queue.append(req)
+        return rid
+
+    def done(self, rid: int) -> bool:
+        if rid in self._results:
+            return True
+        if rid in self.reqs:
+            return False
+        raise KeyError(f"request {rid}: unknown or already consumed")
+
+    def result(self, rid: int) -> List[int]:
+        return self._results.pop(rid)
+
+    def stats(self, rid: int) -> FleetRequest:
+        return self.reqs[rid]
+
+    def pending(self) -> int:
+        return len(self.queue)
+
+    def in_flight(self) -> int:
+        return sum(len(h.assigned()) for h in self.replicas)
+
+    def per_replica_completed(self) -> Dict[str, int]:
+        return dict(self._completed_by)
+
+    # ---- placement -----------------------------------------------------
+    def _est_wait_ms(self, h: ReplicaHandle,
+                     sig: Optional[LoadSignal]) -> Optional[float]:
+        """Predicted time-to-first-token on ``h`` from its rollup: the
+        replica's observed TTFT p50 scaled by its relative backlog.
+        None = no signal yet (cold replica) — treated as feasible, the
+        optimistic default that lets a fresh fleet admit its first
+        requests."""
+        if sig is None or sig.ttft_p50_ms is None:
+            return None
+        # max(), not sum: the replica's reported occupancy already
+        # CONTAINS the requests the router dispatched there — adding
+        # h.assigned() on top would double the predicted wait and
+        # reject genuinely feasible deadlines (the same discipline as
+        # _place's occupancy)
+        backlog = max(sig.in_flight + sig.queue_depth,
+                      len(h.assigned())) / sig.slots
+        return sig.ttft_p50_ms * max(1.0, backlog)
+
+    def _any_feasible(self, deadline: float, now: float) -> bool:
+        slack_ms = (deadline - now) * 1e3
+        for h in self.replicas:
+            if not h.accepting():
+                continue
+            est = self._est_wait_ms(h, h.load())
+            if est is None or est * self.feasibility_margin <= slack_ms:
+                return True
+        return False
+
+    def _place(self, req: FleetRequest,
+               sigs: Optional[Dict[str, Optional[LoadSignal]]] = None
+               ) -> Optional[ReplicaHandle]:
+        """Least-loaded placement over the live load signals, deadline
+        feasibility preferred: among accepting replicas whose router-
+        side backlog is under ``slots + replica_queue_cap``, pick the
+        lowest (occupancy, block_utilization) — the occupancy fed by
+        the replica's own reported rollup combined with what the router
+        knows it has dispatched there (robust to status staleness in
+        both directions)."""
+        best = None
+        best_key = None
+        for h in self.replicas:
+            if not h.accepting():
+                continue
+            sig = (sigs[h.name] if sigs is not None
+                   and h.name in sigs else h.load())
+            n_assigned = len(h.assigned())
+            slots = sig.slots if sig is not None else 1
+            if n_assigned >= slots + self.replica_queue_cap:
+                continue
+            if sig is None:
+                occ, util = n_assigned, 0.0
+            else:
+                occ = max(sig.occupancy,
+                          n_assigned / max(1, sig.slots))
+                util = sig.block_utilization
+            feasible = True
+            if math.isfinite(req.deadline):
+                est = self._est_wait_ms(h, sig)
+                slack_ms = (req.deadline - self.now()) * 1e3
+                feasible = (est is None
+                            or est * self.feasibility_margin
+                            <= slack_ms)
+            key = (not feasible, occ, util, h.name)
+            if best_key is None or key < best_key:
+                best, best_key = h, key
+        return best
+
+    # ---- the service loop ----------------------------------------------
+    def pump(self) -> List[int]:
+        """One router pass; returns fleet rids completed during it."""
+        self._pumps += 1
+        done_now: List[int] = self._completed_backlog
+        self._completed_backlog = []
+        for h in self.replicas:
+            # death detection BEFORE pumping: a dead handle's last
+            # events still drain (completions that raced the death are
+            # honored, not re-run)
+            alive = h.alive()
+            for rec in h.pump():
+                if rec.get("ev") == "reject":
+                    self._requeue_one(int(rec["rid"]), h.name)
+                    continue
+                done_now.append(self._complete(h, rec))
+            if not alive and self._was_alive.get(h.name, True):
+                self._on_death(h)
+            self._was_alive[h.name] = alive
+        self._dispatch()
+        if self._heartbeat is not None:
+            self._heartbeat.beat(self._pumps, None)
+        if (self._jsonl is not None and self.rollup_every
+                and self._pumps % self.rollup_every == 0):
+            self._write_rollup()
+        return done_now
+
+    def _dispatch(self) -> None:
+        # load signals fetched ONCE per pass: an InprocReplica's load()
+        # serializes + re-parses its whole sketch state, and the signal
+        # cannot change between consecutive placements within one pass
+        # (the router-side assigned() count, which does, is read live)
+        sigs = {h.name: h.load() for h in self.replicas
+                if h.accepting()}
+        while self.queue:
+            req = self.queue[0]
+            h = self._place(req, sigs)
+            if h is None:
+                return
+            if not h.submit(req):
+                # refused at the wire (filled up / died this instant):
+                # try the next candidate on the next pump
+                return
+            self.queue.popleft()
+            req.replica = h.name
+            req.t_dispatch = self.now()
+            self.routed += 1
+
+    def _complete(self, h: ReplicaHandle, rec: Dict[str, Any]) -> int:
+        rid = int(rec["rid"])
+        req = self.reqs[rid]
+        req.t_done = self.now()
+        wait_ms = ((req.t_dispatch or req.t_submit)
+                   - req.t_submit) * 1e3
+        req.ttft_ms = (wait_ms + float(rec["ttft_ms"])
+                       if rec.get("ttft_ms") is not None else wait_ms)
+        req.itl_ms = rec.get("itl_ms")
+        toks = [int(t) for t in rec["tokens"]]
+        self._results[rid] = toks
+        req.n_generated = len(toks) - len(req.prompt)
+        self.completed += 1
+        self._completed_by[h.name] = (
+            self._completed_by.get(h.name, 0) + 1)
+        if req.ttft_ms is not None:
+            self._ttft.add(req.ttft_ms)
+        return rid
+
+    def _requeue_one(self, rid: int, from_name: str) -> None:
+        req = self.reqs.get(rid)
+        if req is None or rid in self._results:
+            return
+        req.requeues += 1
+        req.replica = None
+        req.t_dispatch = None
+        self.requeued += 1
+        # FRONT of the queue, original submission order among requeued
+        # peers: the oldest obligation keeps its place — no starvation
+        pos = 0
+        while (pos < len(self.queue)
+               and self.queue[pos].t_submit <= req.t_submit
+               and self.queue[pos].requeues > 0):
+            pos += 1
+        self.queue.insert(pos, req)
+
+    def _on_death(self, h: ReplicaHandle) -> None:
+        self.replica_deaths += 1
+        rids = h.take_assigned()
+        # requeue in original submission order so insert-at-front
+        # preserves it
+        for rid in sorted(rids,
+                          key=lambda r: (self.reqs[r].t_submit, r),
+                          reverse=True):
+            self._requeue_one(rid, h.name)
+        if getattr(h, "drained", None):
+            # a gracefully drained replica reported its consumed-token
+            # state; the ledger already holds these requests — the
+            # report is observability, not a second source of truth
+            h.drained = None
+
+    def on_replica_down(self, name: str) -> None:
+        """External death notice (the fleet supervisor's exit event) —
+        idempotent with pump()'s own detection.  Drains the dead
+        handle's pending events FIRST: a completion that raced the
+        death must be honored (surfaced via the next pump()), never
+        requeued into a duplicate execution."""
+        for h in self.replicas:
+            if h.name != name:
+                continue
+            for rec in h.pump():
+                if rec.get("ev") == "reject":
+                    self._requeue_one(int(rec["rid"]), h.name)
+                else:
+                    self._completed_backlog.append(
+                        self._complete(h, rec))
+            if h.assigned():
+                self._on_death(h)
+            self._was_alive[name] = False
+
+    # ---- telemetry -----------------------------------------------------
+    def load_report(self) -> Dict[str, Any]:
+        """The router's own rollup record (role="router") — same
+        serialized-sketch shape as a replica's, so the fleet aggregator
+        renders router-observed TTFT next to replica-observed TTFT."""
+        from ..train import trace as trace_lib
+
+        # cached: a fabricated per-call run id would split this router
+        # into N aggregator "writers" whose cumulative counters then
+        # double-count (see _ServeTelemetry.rollup_record)
+        if not hasattr(self, "_ident"):
+            self._ident = trace_lib.run_identity()
+        ident = self._ident
+        self._q_gauge.set(len(self.queue))
+        return {
+            "kind": "rollup", "role": "router", "step": self._pumps,
+            "t": round(time.perf_counter() - self._t0, 6),
+            "t_unix": round(time.time(), 3),
+            "p": ident["process_id"], "run": ident["run_id"],
+            "inc": ident["incarnation"],
+            "sketches": ({"ttft_ms": self._ttft.to_dict()}
+                         if self._ttft.n else {}),
+            "counters": {"routed": self.routed,
+                         "rejected": self.rejected,
+                         "rejected_infeasible": self.rejected_infeasible,
+                         "requeued": self.requeued,
+                         "completed": self.completed,
+                         "replica_deaths": self.replica_deaths},
+            "gauges": {"queue_depth": self._q_gauge.to_dict()},
+            "now": {"queue_depth": len(self.queue),
+                    "in_flight": self.in_flight()},
+        }
+
+    def _write_rollup(self) -> None:
+        try:
+            self._jsonl.write(json.dumps(self.load_report()) + "\n")
+            self._jsonl.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._write_rollup()
+            if self._heartbeat is not None:
+                self._heartbeat.beat(self._pumps, None, force=True,
+                                     final=True)
+            self._jsonl.close()
+            self._jsonl = None
+
+
+# ---------------------------------------------------------------------------
+# fleet assembly (subprocess replicas under the group supervisor)
+# ---------------------------------------------------------------------------
+
+def worker_cmd(python: str, *, replica: int, model: Dict[str, Any],
+               serve: Dict[str, Any], telemetry_dir: Optional[str],
+               status_every: int = 5, step_sleep_ms: float = 0.0,
+               tp: int = 0, crash_at_request: int = 0,
+               prewarm: bool = False) -> List[str]:
+    """The replica worker command line (see :func:`worker_main`)."""
+    cmd = [python, "-m",
+           "neural_networks_parallel_training_with_mpi_tpu.serve"
+           "._fleet_worker",
+           "--worker", "--replica", str(int(replica))]
+    for k, v in model.items():
+        cmd += [f"--{k.replace('_', '-')}", str(v)]
+    for k, v in serve.items():
+        if isinstance(v, bool):
+            if v:
+                cmd += [f"--{k.replace('_', '-')}"]
+        elif v is not None:
+            cmd += [f"--{k.replace('_', '-')}", str(v)]
+    if telemetry_dir:
+        cmd += ["--telemetry-dir", telemetry_dir]
+    cmd += ["--status-every", str(int(status_every))]
+    if step_sleep_ms:
+        cmd += ["--step-sleep-ms", str(float(step_sleep_ms))]
+    if tp:
+        cmd += ["--tp", str(int(tp))]
+    if crash_at_request:
+        cmd += ["--crash-at-request", str(int(crash_at_request))]
+    if prewarm:
+        cmd += ["--prewarm"]
+    return cmd
+
+
+@dataclass
+class Fleet:
+    """A running fleet: the router, its subprocess replica handles, and
+    the group supervisor babysitting them.  ``pump()`` is the whole
+    service loop from the owner's side: supervisor events (exits →
+    router requeue; relaunches re-attach through ``on_spawn``) then one
+    router pass."""
+    router: FleetRouter
+    supervisor: Any
+    handles: List[ProcReplica]
+    telemetry_dirs: List[str] = field(default_factory=list)
+    events: List[dict] = field(default_factory=list)
+
+    def pump(self) -> List[int]:
+        for e in self.supervisor.poll():
+            self.events.append(e)
+            if e["event"] in ("exit", "hang_kill"):
+                self.router.on_replica_down(e["child"])
+        return self.router.pump()
+
+    # client surface: a Fleet IS a router whose replicas happen to be
+    # supervised subprocesses — load drivers (serve.loadgen.
+    # run_fleet_closed_loop) work on either unchanged
+    def submit(self, prompt_ids, max_new_tokens: int,
+               slo_ms: Optional[float] = None) -> Optional[int]:
+        return self.router.submit(prompt_ids, max_new_tokens,
+                                  slo_ms=slo_ms)
+
+    def result(self, rid: int) -> List[int]:
+        return self.router.result(rid)
+
+    def stats(self, rid: int) -> FleetRequest:
+        return self.router.stats(rid)
+
+    def done(self, rid: int) -> bool:
+        return self.router.done(rid)
+
+    def per_replica_completed(self) -> Dict[str, int]:
+        return self.router.per_replica_completed()
+
+    @property
+    def rejected(self) -> int:
+        return self.router.rejected
+
+    @property
+    def requeued(self) -> int:
+        return self.router.requeued
+
+    def wait_ready(self, timeout_s: float = 180.0) -> None:
+        """Block until every replica has compiled + reported ready (or
+        been given up on by the supervisor)."""
+        t0 = time.time()
+        while time.time() - t0 < timeout_s:
+            self.pump()
+            pending = [h.name for h in self.handles
+                       if not h.ready
+                       and self.supervisor.done(h.name) is None]
+            if not pending:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"replicas never became ready: {pending}")
+
+    def close(self) -> None:
+        for h in self.handles:
+            h.request_exit()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(
+                h.alive() for h in self.handles):
+            self.supervisor.poll()
+            time.sleep(0.05)
+        self.supervisor.terminate_all()
+        self.router.close()
+
+
+def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
+                 serve: Dict[str, Any],
+                 telemetry_root: Optional[str] = None,
+                 router_kwargs: Optional[Dict[str, Any]] = None,
+                 status_every: int = 5, step_sleep_ms: float = 0.0,
+                 tp: int = 0, max_restarts: int = 2,
+                 backoff: float = 0.5, backoff_cap: float = 10.0,
+                 heartbeat_timeout: float = 0.0,
+                 crash_at_request: int = 0,
+                 prewarm: bool = False,
+                 python: Optional[str] = None,
+                 log=None) -> Fleet:
+    """Assemble a subprocess fleet: N workers (each its own jax
+    runtime) under a :class:`train.resilience.GroupSupervisor`, wired
+    into a :class:`FleetRouter`.  ``model``/``serve`` are the worker's
+    geometry flags (:func:`worker_cmd`); every replica gets its own
+    telemetry dir under ``telemetry_root`` (``replica-K/``) and a
+    distinct ``NNPT_PROCESS_ID`` so heartbeats, rollup identities and
+    flow-trace ids never collide (tools/obs_agg.py merges the dirs)."""
+    import subprocess
+
+    from ..train.resilience import ChildSpec, GroupSupervisor
+
+    python = python or sys.executable
+    handles: List[ProcReplica] = []
+    specs: List[ChildSpec] = []
+    tdirs: List[str] = []
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for k in range(int(n_replicas)):
+        tdir = (os.path.join(telemetry_root, f"replica-{k}")
+                if telemetry_root else None)
+        tdirs.append(tdir)
+        handle = ProcReplica(name=f"replica-{k}")
+        handles.append(handle)
+        cmd = worker_cmd(python, replica=k, model=model, serve=serve,
+                         telemetry_dir=tdir, status_every=status_every,
+                         step_sleep_ms=step_sleep_ms, tp=tp,
+                         crash_at_request=(crash_at_request
+                                           if k == 0 else 0),
+                         prewarm=prewarm)
+        env = {"NNPT_PROCESS_ID": str(k),
+               "PYTHONPATH": repo_root + os.pathsep
+               + os.environ.get("PYTHONPATH", "")}
+
+        def spawn(spec, env, _cmd=cmd):
+            return subprocess.Popen(
+                _cmd, env=env, stdin=subprocess.PIPE,
+                stdout=subprocess.PIPE, text=True, bufsize=1)
+
+        def on_spawn(spec, proc, inc, _h=handle):
+            _h.attach(proc, inc)
+
+        specs.append(ChildSpec(
+            name=f"replica-{k}", cmd=cmd, role="serve-replica",
+            env=env, max_restarts=max_restarts, backoff=backoff,
+            backoff_cap=backoff_cap,
+            heartbeat_path=(os.path.join(
+                tdir, f"heartbeat-serve-p{k}.json") if tdir else None),
+            heartbeat_timeout=heartbeat_timeout,
+            spawn=spawn, on_spawn=on_spawn))
+    sup = GroupSupervisor(specs, log=log)
+    router_tdir = (os.path.join(telemetry_root, "router")
+                   if telemetry_root else None)
+    router = FleetRouter(handles, telemetry_dir=router_tdir,
+                         **(router_kwargs or {}))
+    fleet = Fleet(router=router, supervisor=sup, handles=handles,
+                  telemetry_dirs=[d for d in tdirs if d]
+                  + ([router_tdir] if router_tdir else []))
+    sup.start()
+    return fleet
+
+
+# ---------------------------------------------------------------------------
+# the replica worker process
+# ---------------------------------------------------------------------------
+
+def _worker_argparser():
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="serve.fleet --worker",
+        description="one serving replica speaking the fleet pipe "
+                    "protocol on stdio")
+    ap.add_argument("--worker", action="store_true", required=True)
+    ap.add_argument("--replica", type=int, default=0)
+    # model geometry (replicas must agree bit-for-bit: same flags ->
+    # same init -> same params -> identical greedy tokens anywhere)
+    ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--d-model", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--d-ff", type=int, default=128)
+    ap.add_argument("--init-seed", type=int, default=0)
+    # serve geometry
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="0 = a non-starved pool for slots x max_len")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--queue-depth", type=int, default=64)
+    ap.add_argument("--attn-impl", default="gathered")
+    ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--kv-quant", action="store_true")
+    ap.add_argument("--temperature", type=float, default=0.0)
+    # fleet plumbing
+    ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("--status-every", type=int, default=5,
+                    help="ticks between status (load-report) events")
+    ap.add_argument("--step-sleep-ms", type=float, default=0.0,
+                    help="emulated device latency added per decode "
+                         "tick (bench.py --serve-fleet: on a CPU-only "
+                         "host this stands in for the accelerator step "
+                         "the host would overlap; disclosed in the "
+                         "artifact)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="span this replica over a tensor-parallel "
+                         "mesh of N local (virtual) devices through "
+                         "generate_tp (0 = single-device paged "
+                         "scheduler)")
+    ap.add_argument("--crash-at-request", type=int, default=0,
+                    help="fault injection: os._exit(17) when the Nth "
+                         "submit arrives (chaos tests / example 23)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="pay every prefill-bucket + decode compile "
+                         "BEFORE reporting ready (serve.loadgen."
+                         "prewarm), so measured fleet TTFTs are "
+                         "steady-state from the first routed request")
+    ap.add_argument("--platform", default="cpu")
+    return ap
+
+
+def worker_main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _worker_argparser().parse_args(argv)
+    # protocol stream = the REAL stdout fd; everything else (library
+    # log(), XLA warnings) is pointed at stderr so a stray print can
+    # never tear a protocol line
+    proto = os.fdopen(os.dup(1), "w", buffering=1)
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    from ..utils import platform as plat
+
+    if args.platform == "cpu":
+        plat.pin("cpu", num_devices=max(1, args.tp))
+
+    import selectors
+
+    from ..models import Transformer, TransformerConfig
+    from ..utils import prng
+    from .scheduler import Scheduler, ServeConfig
+
+    model = Transformer(TransformerConfig(
+        vocab_size=args.vocab, max_seq_len=args.seq,
+        n_layers=args.layers, d_model=args.d_model, n_heads=args.heads,
+        d_ff=args.d_ff))
+    params = model.init(prng.init_key(args.init_seed))
+
+    def emit(obj: Dict[str, Any]) -> None:
+        proto.write(json.dumps(obj) + "\n")
+        proto.flush()
+
+    engine: ReplicaHandle
+    sched: Optional[Scheduler] = None
+    if args.tp and args.tp > 1:
+        import jax
+
+        from ..config import MeshConfig
+        from ..parallel import megatron
+        from ..parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh(
+            MeshConfig(data=1, tensor=args.tp),
+            devices=jax.devices()[:args.tp])
+        params_tp = dict(params)
+        params_tp["blocks"] = megatron.permute_qkv(
+            params["blocks"], model.cfg.d_model, model.cfg.n_heads,
+            args.tp, kv_heads=model.cfg.kv_heads)
+        engine = TPGenerateReplica(model, params_tp, mesh,
+                                   batch=args.slots,
+                                   queue_cap=args.queue_depth,
+                                   name=f"replica-{args.replica}")
+    else:
+        num_blocks = args.num_blocks or (
+            1 + args.slots * (-(-args.seq // args.block_size)))
+        sched = Scheduler(model, params, ServeConfig(
+            slots=args.slots, num_blocks=num_blocks,
+            block_size=args.block_size,
+            prefill_chunk=args.prefill_chunk,
+            queue_depth=args.queue_depth, attn_impl=args.attn_impl,
+            prefix_cache=args.prefix_cache, kv_quant=args.kv_quant,
+            temperature=args.temperature,
+            telemetry_dir=args.telemetry_dir,
+            rollup_every=max(1, args.status_every) * 5,
+            replica=args.replica))
+        if args.prewarm:
+            import dataclasses
+
+            from .loadgen import prewarm
+
+            # a throwaway scheduler with identical geometry/sampling:
+            # compiled programs are lru-cached per (model, geometry,
+            # sampling, attn_impl), so its warmth is THIS scheduler's
+            prewarm(lambda: Scheduler(model, params, dataclasses.replace(
+                sched.cfg, telemetry_dir=None, trace_dir=None)))
+        engine = InprocReplica(sched, name=f"replica-{args.replica}")
+
+    # raw non-blocking stdin: a burst of submit lines must all drain in
+    # one pass (a buffered readline-per-select would admit one request
+    # per idle timeout); selectors only provide the idle wait
+    stdin_fd = sys.stdin.fileno()
+    os.set_blocking(stdin_fd, False)
+    sel = selectors.DefaultSelector()
+    sel.register(stdin_fd, selectors.EVENT_READ)
+    inbuf = b""
+
+    def read_ops() -> Tuple[List[Dict[str, Any]], bool]:
+        nonlocal inbuf
+        eof = False
+        while True:
+            try:
+                chunk = os.read(stdin_fd, 65536)
+            except BlockingIOError:
+                break
+            except OSError:
+                eof = True
+                break
+            if chunk == b"":
+                eof = True
+                break
+            inbuf += chunk
+        ops = []
+        while b"\n" in inbuf:
+            line, inbuf = inbuf.split(b"\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                op = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(op, dict):
+                ops.append(op)
+        return ops, eof
+
+    emit({"ev": "ready", "replica": args.replica, "pid": os.getpid(),
+          "tp": args.tp, "incarnation":
+          os.environ.get("NNPT_INCARNATION", "0")})
+    submits_seen = 0
+    ticks = 0
+    last_status = 0.0
+    stop = False
+    while not stop:
+        # 1) drain control ops without blocking while work is pending
+        busy = bool(engine.assigned()) or (
+            sched is not None and (sched.pending()
+                                   or sched.in_flight()))
+        if not busy:
+            sel.select(timeout=0.05)    # idle: park until ops arrive
+        ops, eof = read_ops()
+        if eof:
+            stop = True    # parent hung up: exit cleanly
+        for op in ops:
+            kind = op.get("op")
+            if kind == "submit":
+                submits_seen += 1
+                if (args.crash_at_request
+                        and submits_seen >= args.crash_at_request):
+                    proto.flush()
+                    os._exit(17)   # injected crash: SIGKILL-shaped
+                req = FleetRequest(
+                    rid=int(op["rid"]),
+                    prompt=[int(t) for t in op["prompt"]],
+                    max_new=int(op["max_new"]),
+                    slo_ms=op.get("slo_ms"),
+                    t_submit=time.monotonic(), deadline=math.inf)
+                if not engine.submit(req):
+                    emit({"ev": "reject", "rid": req.rid})
+            elif kind == "drain":
+                if sched is not None:
+                    reqs = sched.drain()
+                    sched.server.allocator.assert_drained()
+                else:
+                    reqs = [{"rid": r, "prefilled": 0, "generated": 0}
+                            for r in engine.take_assigned()]
+                emit({"ev": "drained", "requests": reqs})
+            elif kind == "exit":
+                stop = True
+        if stop:
+            break
+        # 2) advance the engine one step; report completions
+        for rec in engine.pump():
+            rec.pop("requeue", None)
+            emit({"ev": "done", **rec})
+        ticks += 1
+        if args.step_sleep_ms and busy:
+            time.sleep(args.step_sleep_ms / 1e3)
+        # 3) status cadence: every N ticks while busy, ~4 Hz floor
+        now = time.monotonic()
+        if (ticks % max(1, args.status_every) == 0
+                or now - last_status > 0.25):
+            report = (sched.load_report() if sched is not None
+                      else engine.load_report())
+            emit({"ev": "status", "report": report})
+            last_status = now
+    if sched is not None:
+        sched.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

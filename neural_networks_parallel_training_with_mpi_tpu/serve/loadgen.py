@@ -82,7 +82,7 @@ def prewarm(make_scheduler, *, prompt_lens=(4, 24)) -> None:
 def make_requests(clients: int, requests_per_client: int, *,
                   vocab_size: int, prompt_lens=(4, 24), max_new=(8, 32),
                   seed: int = 0, shared_prefix_len: int = 0,
-                  shared_fraction: float = 0.0
+                  shared_fraction: float = 0.0, stream: int = 0
                   ) -> List[List[Dict[str, Any]]]:
     """Pre-generate every client's request list (client-major, one RNG
     pass) so the stream is a pure function of the arguments — queue
@@ -90,8 +90,18 @@ def make_requests(clients: int, requests_per_client: int, *,
     requests get generated, which is what lets two scheduler arms serve
     byte-identical traffic for an A/B.  With ``shared_prefix_len`` > 0,
     a ``shared_fraction`` of requests prepend ONE fixed shared prefix
-    (drawn first from the same seed) to their random suffix."""
-    rng = np.random.default_rng(seed)
+    (drawn first from the same seed) to their random suffix.
+
+    ``stream`` partitions the request space per DRIVEN REPLICA: N
+    loadgens driving N fleet replicas from one operator ``seed`` must
+    not replay the identical request stream (colliding flow-trace ids
+    on the merged timeline — see the scheduler's ``_flow_prefix`` — and
+    N byte-identical ``tokens_sha256`` inputs that would vacuously
+    "agree"); ``stream=k`` mixes ``k`` into the RNG seed sequence, while
+    ``stream=0`` keeps the historical ``default_rng(seed)`` draws so
+    every committed bench artifact's traffic is reproducible."""
+    rng = (np.random.default_rng(seed) if not stream
+           else np.random.default_rng((int(seed), int(stream))))
     shared = (rng.integers(0, vocab_size, (shared_prefix_len,)).tolist()
               if shared_prefix_len > 0 else [])
     out: List[List[Dict[str, Any]]] = []
@@ -121,7 +131,7 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                     max_new=(8, 32), seed: int = 0,
                     slo_ms: Optional[float] = None,
                     shared_prefix_len: int = 0,
-                    shared_fraction: float = 0.0,
+                    shared_fraction: float = 0.0, stream: int = 0,
                     max_ticks: int = 200_000) -> Dict[str, Any]:
     """Drive ``scheduler`` with ``clients`` closed-loop clients until
     each has completed ``requests_per_client`` requests; returns the
@@ -137,7 +147,7 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                          vocab_size=vocab_size, prompt_lens=prompt_lens,
                          max_new=max_new, seed=seed,
                          shared_prefix_len=shared_prefix_len,
-                         shared_fraction=shared_fraction)
+                         shared_fraction=shared_fraction, stream=stream)
     next_idx = [0] * int(clients)
     outstanding: List[Optional[int]] = [None] * int(clients)
     finished: List[int] = []
@@ -187,6 +197,11 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
     # (client-major), so two arms serving the same plan hash equal iff
     # every generated token matches
     h = hashlib.sha256()
+    if stream:
+        # replica-partitioned streams carry their stream tag in the
+        # digest preamble: two replicas' digests can then never collide
+        # unless someone ALSO collapsed their request streams
+        h.update(repr(("stream", int(stream))).encode())
     for ci, i, toks in sorted(results.values()):
         h.update(repr((ci, i, toks)).encode())
     row = {
@@ -248,3 +263,109 @@ def sweep_loads(make_scheduler, loads: List[int],
         finally:
             sched.close()
     return rows
+
+
+def run_fleet_closed_loop(router, clients: int,
+                          requests_per_client: int, *, vocab_size: int,
+                          prompt_lens=(4, 24), max_new=(8, 32),
+                          seed: int = 0,
+                          classes: Optional[List[Dict[str, Any]]] = None,
+                          stream: int = 0,
+                          max_wall_s: float = 600.0) -> Dict[str, Any]:
+    """The MULTI-REPLICA closed-loop driver: ``clients`` one-outstanding
+    clients against a ``serve.fleet.FleetRouter`` instead of one
+    scheduler.  Same pre-generated request plan as
+    :func:`run_closed_loop` (pure function of seed/stream — fleet arms
+    at different replica counts serve byte-identical traffic), plus
+    per-CLASS SLOs: ``classes`` is a list of ``{"name", "slo_ms"}``
+    dicts assigned client-major (client ``ci`` runs class ``ci % K`` —
+    an interactive client and a bulk client are different CLIENTS, not
+    different requests of one), and the row reports TTFT percentiles
+    per class — the split the router's deadline-aware placement is
+    judged on.  Rejections at the ROUTER (fleet queue full / SLO
+    infeasible) surface as ``router_rejections`` with clients retrying,
+    the closed-loop discipline."""
+    classes = classes or [{"name": "all", "slo_ms": None}]
+    plan = make_requests(clients, requests_per_client,
+                         vocab_size=vocab_size, prompt_lens=prompt_lens,
+                         max_new=max_new, seed=seed, stream=stream)
+    cls_of = [classes[ci % len(classes)] for ci in range(int(clients))]
+    next_idx = [0] * int(clients)
+    outstanding: List[Optional[int]] = [None] * int(clients)
+    finished: List[int] = []
+    owner: Dict[int, int] = {}          # fleet rid -> client
+    tokens_of: Dict[int, tuple] = {}    # fleet rid -> (ci, idx, tokens)
+    submit_retries = 0
+    t0 = time.perf_counter()
+    while True:
+        progressed = False
+        for ci in range(int(clients)):
+            if outstanding[ci] is not None or \
+                    next_idx[ci] >= requests_per_client:
+                continue
+            req = plan[ci][next_idx[ci]]
+            rid = router.submit(req["prompt"], req["max_new"],
+                                slo_ms=cls_of[ci]["slo_ms"])
+            if rid is None:
+                submit_retries += 1
+                continue
+            owner[rid] = ci
+            tokens_of[rid] = (ci, next_idx[ci], None)
+            outstanding[ci] = rid
+            next_idx[ci] += 1
+            progressed = True
+        for rid in router.pump():
+            ci = owner[rid]
+            outstanding[ci] = None
+            finished.append(rid)
+            c, i, _ = tokens_of[rid]
+            tokens_of[rid] = (c, i, router.result(rid))
+            progressed = True
+        if all(i >= requests_per_client for i in next_idx) and \
+                all(o is None for o in outstanding):
+            break
+        if time.perf_counter() - t0 > max_wall_s:
+            raise RuntimeError(
+                f"fleet load run not drained in {max_wall_s}s: "
+                f"{len(finished)}/{clients * requests_per_client} done, "
+                f"outstanding={[o for o in outstanding if o is not None]}")
+        if not progressed:
+            # subprocess replicas own the compute; a busy-spinning
+            # driver would steal their core
+            time.sleep(0.002)
+    wall = time.perf_counter() - t0
+    stats = [router.stats(rid) for rid in finished]
+    h = hashlib.sha256()
+    if stream:
+        h.update(repr(("stream", int(stream))).encode())
+    for ci, i, toks in sorted(tokens_of.values()):
+        h.update(repr((ci, i, toks)).encode())
+    tokens_out = sum(s.n_generated or 0 for s in stats)
+    row: Dict[str, Any] = {
+        "clients": int(clients),
+        "requests": len(finished),
+        "wall_s": round(wall, 3),
+        "tokens_out": tokens_out,
+        "tokens_per_sec": round(tokens_out / wall, 1),
+        "submit_retries": submit_retries,
+        "router_rejections": router.rejected,
+        "requeued": router.requeued,
+        "tokens_sha256": h.hexdigest(),
+    }
+    ttft_all = [s.ttft_ms for s in stats if s.ttft_ms is not None]
+    row["ttft_ms_p50"] = _pct(ttft_all, 50)
+    row["ttft_ms_p99"] = _pct(ttft_all, 99)
+    for k in classes:
+        vals = [s.ttft_ms for rid, s in zip(finished, stats)
+                if cls_of[owner[rid]]["name"] == k["name"]
+                and s.ttft_ms is not None]
+        row[f"ttft_ms_p50_{k['name']}"] = _pct(vals, 50)
+        row[f"ttft_ms_p99_{k['name']}"] = _pct(vals, 99)
+        row[f"requests_{k['name']}"] = len(vals)
+        if k["slo_ms"] is not None:
+            row[f"deadline_missed_{k['name']}"] = sum(
+                1 for rid, s in zip(finished, stats)
+                if cls_of[owner[rid]]["name"] == k["name"]
+                and s.ttft_ms is not None and s.deadline_missed)
+    row["per_replica_completed"] = router.per_replica_completed()
+    return row

@@ -123,6 +123,13 @@ class ServeConfig:
     #                                results) are pruned so a long-lived
     #                                serving process cannot grow without
     #                                bound
+    replica: Optional[int] = None  # fleet replica index (serve/fleet.py):
+    #                                stamps rollup records and qualifies
+    #                                per-request flow-trace ids so two
+    #                                replicas of one process identity can
+    #                                never collide on a merged timeline
+    #                                (the scheduler-local rid restarts at
+    #                                0 in every replica)
 
 
 @dataclass
@@ -166,7 +173,13 @@ class _ServeTelemetry:
     "serve_req" records into metrics.jsonl + the role-qualified
     heartbeat, plus the fleet plane's kind="rollup" sketch snapshots
     and kind="alert" SLO burn-rate records (utils/sketches.py).
-    No-op when ``telemetry_dir`` is unset."""
+
+    The in-memory sketch/counter/gauge state is ALWAYS maintained (host
+    arithmetic, bounded O(1/eps) memory): the fleet router's placement
+    signal is :meth:`rollup_record` — the same serialized-sketch record
+    the file stream carries — and a replica must be routable whether or
+    not an operator pointed a ``telemetry_dir`` at it.  File/heartbeat
+    IO stays gated on ``telemetry_dir``."""
 
     # the quantile-sketched serving series: latency percentiles are THE
     # serving SLO numbers and only compose fleet-wide through sketches
@@ -178,17 +191,11 @@ class _ServeTelemetry:
         self.enabled = bool(dirpath)
         self.metrics_every = max(1, int(cfg.metrics_every))
         self.rollup_every = max(0, int(cfg.rollup_every))
+        self.replica = cfg.replica
         self._jsonl = None
         self.heartbeat = Heartbeat(None)
         self.alerts_fired = 0
         self.rollups_written = 0
-        if not self.enabled:
-            return
-        os.makedirs(dirpath, exist_ok=True)
-        self.metrics_path = os.path.join(dirpath, "metrics.jsonl")
-        self._jsonl = open(self.metrics_path, "a")
-        self.heartbeat = Heartbeat(os.path.join(
-            dirpath, telemetry_lib.heartbeat_filename("serve")))
         self._t0 = time.perf_counter()
         self._last_tokens = 0
         self._last_t = self._t0
@@ -200,6 +207,13 @@ class _ServeTelemetry:
         self._budget = (ErrorBudget("slo", target=cfg.slo_target,
                                     burn_threshold=cfg.slo_burn_threshold)
                         if cfg.alerts else None)
+        if not self.enabled:
+            return
+        os.makedirs(dirpath, exist_ok=True)
+        self.metrics_path = os.path.join(dirpath, "metrics.jsonl")
+        self._jsonl = open(self.metrics_path, "a")
+        self.heartbeat = Heartbeat(os.path.join(
+            dirpath, telemetry_lib.heartbeat_filename("serve")))
 
     def _write(self, rec: Dict[str, Any]) -> None:
         if self._jsonl is not None:
@@ -207,8 +221,6 @@ class _ServeTelemetry:
             self._jsonl.flush()
 
     def on_tick(self, tick: int, snap: Dict[str, Any]) -> None:
-        if not self.enabled:
-            return
         # per-tick sketch feed (host floats, no device traffic): queue
         # and pool state distributions, not just their sampled points
         self._sketches["queue_depth"].add(snap["queue_depth"])
@@ -242,8 +254,6 @@ class _ServeTelemetry:
         self._maybe_rollup(tick)
 
     def on_request_done(self, req: Request, n_generated: int) -> None:
-        if not self.enabled:
-            return
         total_ms = round((req.t_done - req.t_submit) * 1e3, 3)
         ttft, itl = round(req.ttft_ms, 3), round(req.itl_ms, 3)
         self._write({
@@ -271,7 +281,7 @@ class _ServeTelemetry:
                     self._counters.get("deadline_missed", 0) + 1)
             if self._budget is not None:
                 alert = self._budget.observe(missed)
-                if alert:
+                if alert and self.enabled:
                     self._emit_alert(alert, rid=req.rid)
 
     def _emit_alert(self, alert: Dict[str, Any], **extra) -> None:
@@ -284,12 +294,30 @@ class _ServeTelemetry:
             f"(burn rate {alert.get('burn_rate')}x of the "
             f"{alert.get('target')} SLO budget)")
 
-    def _maybe_rollup(self, tick: int, final: bool = False) -> None:
-        if self.rollup_every <= 0:
-            return
-        if not final and tick % self.rollup_every:
-            return
-        ident = trace_lib.run_identity()
+    def rollup_record(self, tick: int,
+                      snap: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+        """The ``kind="rollup"`` record for this scheduler RIGHT NOW —
+        the identical serialized-sketch document the telemetry file
+        stream carries (tools/obs_agg.py merges it), which is also THE
+        fleet router's placement signal (``Scheduler.load_report``): one
+        telemetry path, not two.  With ``snap`` (a live
+        :meth:`Scheduler._snapshot`), the occupancy gauges refresh first
+        and the record carries a ``now`` sub-dict of instantaneous
+        queue/pool state — rollup cadence must not stale an admission
+        decision."""
+        if snap is not None:
+            self._gauges["queue_depth"].set(snap["queue_depth"])
+            self._gauges["block_utilization"].set(
+                snap["block_utilization"])
+        # identity cached per writer: run_identity() FABRICATES a fresh
+        # run id when NNPT_RUN_ID is unset, and a per-record call would
+        # split one scheduler's cumulative rollups across several
+        # "writers" in the aggregator — which then SUMS the same
+        # cumulative counters once per fabricated id
+        if not hasattr(self, "_ident"):
+            self._ident = trace_lib.run_identity()
+        ident = self._ident
         counters = dict(self._counters)
         counters["alerts"] = self.alerts_fired
         if self._budget is not None:
@@ -307,6 +335,21 @@ class _ServeTelemetry:
             "gauges": {k: g.to_dict() for k, g in self._gauges.items()
                        if g.last is not None},
         }
+        if self.replica is not None:
+            rec["replica"] = int(self.replica)
+        if snap is not None:
+            rec["now"] = {k: snap[k] for k in
+                          ("queue_depth", "live", "prefilling",
+                           "free_blocks", "block_utilization",
+                           "committed_tokens") if k in snap}
+        return rec
+
+    def _maybe_rollup(self, tick: int, final: bool = False) -> None:
+        if self.rollup_every <= 0:
+            return
+        if not final and tick % self.rollup_every:
+            return
+        rec = self.rollup_record(tick)
         self.rollups_written += 1
         self._write(rec)
 
@@ -383,9 +426,13 @@ class Scheduler:
         self.telemetry = _ServeTelemetry(cfg)
         # per-request flow-trace ids must stay unique across the fleet's
         # merged timeline: prefix the scheduler-local rid with this
-        # process's identity (free when no tracer is installed)
+        # process's identity (free when no tracer is installed) AND the
+        # replica index when one is set — N replica processes launched
+        # from one operator shell can share a process id, and their
+        # scheduler-local rids all count from 0
+        rep = "" if cfg.replica is None else f"R{int(cfg.replica)}-"
         self._flow_prefix = (
-            f"p{trace_lib.run_identity()['process_id']}-r")
+            f"p{trace_lib.run_identity()['process_id']}-{rep}r")
 
     # ---- client surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -497,6 +544,67 @@ class Scheduler:
         if self._tracer is not None:
             trace_lib.stop_run(self._tracer)
             self._tracer = None
+
+    # ---- fleet surface (serve/fleet.py) --------------------------------
+    def load_report(self) -> Dict[str, Any]:
+        """This replica's live load signal for a fleet router: the
+        ``kind="rollup"`` record the telemetry stream already emits
+        (serialized utils/sketches state — TTFT/ITL percentiles, queue
+        depth, block utilization) refreshed with a ``now`` sub-dict of
+        instantaneous occupancy, plus the admission capacity the router
+        needs (``free_slots``).  One record shape everywhere: the router
+        parses the same document tools/obs_agg.py merges."""
+        rec = self.telemetry.rollup_record(self.tick_no, self._snapshot())
+        rec["now"]["free_slots"] = self.server.free_slots()
+        rec["now"]["in_flight"] = len(self._srv_rid)
+        rec["now"]["slots"] = self.cfg.slots
+        rec["now"]["queue_cap"] = self.cfg.queue_depth
+        return rec
+
+    def drain(self) -> List[Dict[str, Any]]:
+        """Stop serving and hand every unfinished request back for
+        requeue: evicts all in-flight streams (their blocks release, the
+        allocator's ``assert_drained`` holds afterwards) and empties the
+        wait queue, returning one descriptor per request in ORIGINAL
+        submission order — ``{"rid", "prompt", "max_new", "slo_ms",
+        "prefilled", "generated"}``.  ``prefilled``/``generated`` are the
+        consumed-token state at drain time (observability: how much work
+        the drain discards); the tokens themselves are NOT carried —
+        greedy decode is deterministic, so re-admission on any replica
+        with the same params reproduces them exactly (pinned by
+        tests/test_serve_sched.py).  Completed-but-unconsumed results
+        stay readable via :meth:`result`."""
+        out: List[Dict[str, Any]] = []
+        for rid in list(self._srv_rid):
+            srv_rid = self._srv_rid.pop(rid)
+            self._sched_rid.pop(srv_rid)
+            req = self.reqs[rid]
+            st = self.server._streams[srv_rid]
+            slot = self.server._slot_of[srv_rid]
+            prefilled, p = st.prefilled, len(req.prompt)
+            # generated-so-far: position p holds the first sampled token
+            # once prefill completes, then one per decode step
+            generated = (int(self.server._pos_host[slot]) - p + 1
+                         if prefilled >= p else 0)
+            self.server.evict(srv_rid)
+            if rid in self._prefilling:
+                self._prefilling.remove(rid)
+            req.t_first = None      # TTFT restarts on re-admission
+            out.append({"rid": rid, "prompt": list(req.prompt),
+                        "max_new": req.max_new, "slo_ms": req.slo_ms,
+                        "prefilled": prefilled,
+                        "generated": max(0, generated),
+                        "t_submit": req.t_submit,
+                        "evictions": req.evictions})
+        for req in self.queue:
+            out.append({"rid": req.rid, "prompt": list(req.prompt),
+                        "max_new": req.max_new, "slo_ms": req.slo_ms,
+                        "prefilled": 0, "generated": 0,
+                        "t_submit": req.t_submit,
+                        "evictions": req.evictions})
+        self.queue.clear()
+        out.sort(key=lambda d: (d["t_submit"], d["rid"]))
+        return out
 
     # ---- internals -----------------------------------------------------
     def _committed_tokens(self) -> int:

@@ -906,3 +906,266 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                 f"< --min_devices {floor}; re-probing in {delay:.1f}s "
                 f"({restarts_used + 1}/{max_restarts})")
             _sleep(delay)
+
+
+# ---------------------------------------------------------------------------
+# process-group supervision (DESIGN.md §11 "Serving fleet")
+# ---------------------------------------------------------------------------
+
+from dataclasses import dataclass, field as _field  # noqa: E402  (grouped
+#   with the subsystem it serves; the module above predates dataclasses)
+
+
+@dataclass
+class ChildSpec:
+    """One supervised child of a :class:`GroupSupervisor`: its command,
+    role, and PER-CHILD contracts — heartbeat staleness bound, restart
+    budget/backoff, and the exit codes that stop it for good.  ``spawn``
+    overrides process creation (the fleet router passes a callable that
+    wires stdio pipes and hands the Popen back); ``on_spawn`` fires
+    after every (re)launch so the owner can re-attach to the fresh
+    process."""
+    name: str
+    cmd: Sequence[str] = ()
+    role: str = "worker"
+    env: Optional[dict] = None
+    heartbeat_path: Optional[str] = None
+    heartbeat_timeout: float = 0.0
+    max_restarts: int = 3
+    backoff: float = 0.5
+    backoff_cap: float = 30.0
+    no_retry: Tuple[int, ...] = _NO_RETRY
+    spawn: Optional[Callable] = None      # (spec, env) -> Popen-like
+    on_spawn: Optional[Callable] = None   # (spec, proc, incarnation)
+
+
+@dataclass
+class _ChildState:
+    spec: ChildSpec
+    proc: Any = None
+    incarnation: int = -1          # attempts - 1 (stamped into the env)
+    restarts_used: int = 0
+    launched_at: float = 0.0
+    hb_armed: bool = False
+    relaunch_at: Optional[float] = None   # pending backoff deadline
+    final_rc: Optional[int] = None        # set once the child is done
+    gave_up: bool = False
+    events: List[dict] = _field(default_factory=list)
+
+
+class GroupSupervisor:
+    """Role-aware supervision of a PROCESS GROUP — the multi-child
+    generalization of :func:`supervise`, which babysits exactly one
+    child.  N children (serving replicas, a prefill tier, a router
+    sidecar, ...) each carry their own :class:`ChildSpec` contract, and
+    one failing child is relaunched with ITS backoff/budget without
+    disturbing its siblings — the fleet property a serving tier needs
+    (kill one replica: the others keep serving while it restarts).
+
+    Deliberately NON-BLOCKING: :meth:`poll` reaps exits, kills
+    stale-heartbeat children (reported as :data:`EXIT_HANG`, the same
+    external-hang contract as :func:`_run_child`), executes due
+    relaunches, and returns the events since the previous poll — so the
+    owner (a fleet router pumping request traffic, a test) stays in
+    control of the loop instead of parking inside a blocking
+    ``supervise()`` call.  Exit-code handling is per child:
+    ``spec.no_retry`` stops that child for good (``stopped`` event),
+    anything else relaunches under ``backoff * 2^k`` (downward-jittered,
+    capped — the :func:`supervise` policy) until ``max_restarts`` is
+    spent (``gave_up``).  Every launch stamps the shared
+    :data:`RUN_ID_ENV` plus the child's :data:`INCARNATION_ENV`, so
+    trace/telemetry merging works exactly as under the single-child
+    supervisor.  Stdlib-only, like everything else in this module."""
+
+    def __init__(self, specs: Sequence[ChildSpec],
+                 log: Optional[Callable[[str], None]] = None,
+                 jitter: float = 0.5,
+                 env: Optional[dict] = None,
+                 _rand: Callable[[], float] = random.random,
+                 now_fn: Callable[[], float] = time.time):
+        import os as _os
+
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate child names: {names}")
+        self._log = log or (lambda m: print(m, file=sys.stderr,
+                                            flush=True))
+        self._jitter = float(jitter)
+        self._rand = _rand
+        self._now = now_fn
+        self._base_env = dict(env if env is not None else _os.environ)
+        self.run_id = self._base_env.get(RUN_ID_ENV) or (
+            f"run-{int(time.time())}-{_os.getpid()}")
+        self._children = {s.name: _ChildState(spec=s) for s in specs}
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        for st in self._children.values():
+            self._launch(st)
+
+    def _launch(self, st: _ChildState) -> None:
+        spec = st.spec
+        env = dict(self._base_env)
+        if spec.env:
+            env.update(spec.env)
+        st.incarnation += 1
+        env[RUN_ID_ENV] = self.run_id
+        env[INCARNATION_ENV] = str(st.incarnation)
+        if spec.spawn is not None:
+            st.proc = spec.spawn(spec, env)
+        else:
+            st.proc = subprocess.Popen(list(spec.cmd), env=env)
+        st.launched_at = self._now()
+        st.hb_armed = False
+        st.relaunch_at = None
+        self._log(f"[group] {spec.role}/{spec.name} inc "
+                  f"{st.incarnation}: pid {st.proc.pid}")
+        if spec.on_spawn is not None:
+            spec.on_spawn(spec, st.proc, st.incarnation)
+
+    def _next_delay(self, st: _ChildState) -> float:
+        d = min(st.spec.backoff * (2.0 ** st.restarts_used),
+                st.spec.backoff_cap)
+        if self._jitter > 0:
+            d *= 1.0 - self._jitter * self._rand()
+        return d
+
+    def _check_heartbeat(self, st: _ChildState) -> bool:
+        """True when the child was just killed as hung (rc handled by
+        the caller's reap on the next lines)."""
+        spec = st.spec
+        if not (spec.heartbeat_path and spec.heartbeat_timeout > 0):
+            return False
+        age = heartbeat_age_s(spec.heartbeat_path)
+        now = self._now()
+        if not st.hb_armed:
+            # arm at THIS incarnation's first write (same discipline as
+            # _run_child: first-compile must never be killed as a hang,
+            # and a previous incarnation's file must not count)
+            if age is not None and age < now - st.launched_at:
+                st.hb_armed = True
+            return False
+        if age is not None and age > spec.heartbeat_timeout:
+            self._log(f"[group] {spec.role}/{spec.name}: heartbeat "
+                      f"stale for {age:.0f}s "
+                      f"(> {spec.heartbeat_timeout:.0f}s): killing as "
+                      "hung")
+            st.proc.terminate()
+            try:
+                st.proc.wait(timeout=10)
+            except Exception:
+                st.proc.kill()
+                st.proc.wait()
+            return True
+        return False
+
+    def poll(self) -> List[dict]:
+        """One non-blocking supervision pass; returns the events since
+        the last poll: ``exit`` (rc, relaunch decision), ``hang_kill``,
+        ``relaunch``, ``stopped`` (no-retry exit), ``gave_up`` (budget
+        spent)."""
+        events: List[dict] = []
+
+        def ev(st: _ChildState, kind: str, **extra) -> None:
+            e = {"event": kind, "child": st.spec.name,
+                 "role": st.spec.role, "incarnation": st.incarnation,
+                 **extra}
+            st.events.append(e)
+            events.append(e)
+
+        now = self._now()
+        for st in self._children.values():
+            if st.final_rc is not None or st.gave_up:
+                continue
+            if st.proc is not None and st.proc.poll() is None:
+                if self._check_heartbeat(st):
+                    rc = st.proc.poll()
+                    ev(st, "hang_kill", rc=rc)
+                    # treat as EXIT_HANG for the retry contract, like
+                    # _run_child: a graceful SIGTERM exit 0 here still
+                    # means "stalled but signal-responsive", not done
+                    self._after_exit(st, EXIT_HANG, ev)
+                continue
+            if st.proc is not None and st.relaunch_at is None:
+                rc = st.proc.poll()
+                ev(st, "exit", rc=rc)
+                self._after_exit(st, rc, ev)
+                continue
+            if st.relaunch_at is not None and now >= st.relaunch_at:
+                st.restarts_used += 1
+                self._launch(st)
+                ev(st, "relaunch", restarts_used=st.restarts_used,
+                   max_restarts=st.spec.max_restarts)
+        return events
+
+    def _after_exit(self, st: _ChildState, rc: int, ev) -> None:
+        spec = st.spec
+        if rc in spec.no_retry:
+            st.final_rc = rc
+            ev(st, "stopped", rc=rc)
+            self._log(f"[group] {spec.role}/{spec.name} exited {rc} "
+                      "(no-retry contract): stopped")
+            return
+        if st.restarts_used >= spec.max_restarts:
+            st.gave_up = True
+            st.final_rc = rc
+            ev(st, "gave_up", rc=rc,
+               max_restarts=spec.max_restarts)
+            self._log(f"[group] {spec.role}/{spec.name}: "
+                      f"{spec.max_restarts} restarts exhausted "
+                      f"(last exit {rc}) — giving up on this child")
+            return
+        delay = self._next_delay(st)
+        st.relaunch_at = self._now() + delay
+        self._log(f"[group] {spec.role}/{spec.name} exit {rc}; "
+                  f"relaunching in {delay:.1f}s "
+                  f"({st.restarts_used + 1}/{spec.max_restarts}); "
+                  "siblings undisturbed")
+
+    # ---- introspection -------------------------------------------------
+    def proc(self, name: str):
+        return self._children[name].proc
+
+    def incarnation(self, name: str) -> int:
+        return self._children[name].incarnation
+
+    def alive(self, name: str) -> bool:
+        st = self._children[name]
+        return (st.proc is not None and st.relaunch_at is None
+                and st.final_rc is None and not st.gave_up
+                and st.proc.poll() is None)
+
+    def pending_relaunch(self, name: str) -> bool:
+        return self._children[name].relaunch_at is not None
+
+    def done(self, name: str) -> Optional[int]:
+        """Final rc once the child will never run again, else None."""
+        st = self._children[name]
+        return st.final_rc if (st.final_rc is not None or st.gave_up) \
+            else None
+
+    def running(self) -> bool:
+        """Any child not yet in a TERMINAL state (stopped/gave up)?  A
+        child whose process has exited but whose exit has not been
+        reaped by :meth:`poll` still counts — its retry decision is
+        pending, so the owner must keep polling."""
+        return any(st.final_rc is None and not st.gave_up
+                   for st in self._children.values())
+
+    def terminate_all(self, grace_s: float = 10.0) -> None:
+        for st in self._children.values():
+            st.relaunch_at = None
+            if st.proc is not None and st.proc.poll() is None:
+                st.proc.terminate()
+        deadline = time.time() + grace_s
+        for st in self._children.values():
+            if st.proc is None:
+                continue
+            try:
+                st.proc.wait(timeout=max(0.1, deadline - time.time()))
+            except Exception:
+                st.proc.kill()
+                try:
+                    st.proc.wait(timeout=5)
+                except Exception:
+                    pass

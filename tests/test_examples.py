@@ -350,3 +350,21 @@ def test_example_22_prefix_cached_serving_completes():
     assert "CoW fork(s)" in out.stdout
     assert "near-zero-TTFT admission verified" in out.stdout
     assert "block pool fully drained" in out.stdout
+
+
+def test_example_23_serving_fleet_completes():
+    """The serving fleet end to end on CPU: 2 supervised subprocess
+    replicas behind the SLO-aware router, a SIGKILL mid-load, requeue
+    with byte-identical tokens (asserted in-script against the
+    undisturbed single-scheduler reference), supervisor relaunch with
+    the sibling undisturbed, and the merged per-replica obs_agg view."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "23_serving_fleet.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "tokens byte-identical across the kill" in out.stdout
+    assert ("supervisor: replica-0 relaunched; replica-1 undisturbed"
+            in out.stdout)
+    assert "per-writer" in out.stdout        # obs_agg breakdown rows

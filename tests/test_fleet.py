@@ -1,0 +1,781 @@
+"""Serving fleet (serve/fleet.py + train.resilience.GroupSupervisor).
+
+Pins, by acceptance criterion:
+
+* **group supervision**: per-child exit contracts (no-retry stops, a
+  crash relaunches under that child's backoff/budget, the budget ends
+  in ``gave_up``), a stale per-child heartbeat kills as a hang, and a
+  relaunch never disturbs siblings (their pids are untouched).
+* **router admission uses live replica rollups**: saturating one
+  replica (through its own scheduler, invisible to the router's
+  dispatch ledger) shifts placement to the idle one — the signal is
+  ``Scheduler.load_report()``, the serialized utils/sketches rollup
+  record, not private state.
+* **overload rejects at the ROUTER**: one bounded fleet queue; replica
+  local queues stay shallow (``replica_queue_cap``).  SLO-infeasible
+  requests can be rejected up front from the TTFT rollup.
+* **replica death drains cleanly**: in-flight requests requeue at the
+  router and complete on siblings with tokens byte-identical to an
+  undisturbed reference (greedy decode is deterministic); no request
+  starves.  The subprocess version (SIGKILL mid-load under the group
+  supervisor, relaunch included) is the chaos e2e.
+* **tensor-parallel replica**: one replica spanning a 2-device mesh
+  through ``generate_tp`` emits tokens identical to the single-device
+  paged replica (core-lane pin).
+
+Cheap in-process pins run in the budgeted core lane; the multi-process
+e2e is slow/chaos.  ``-m fleet`` runs the lane alone.
+"""
+
+import json
+import math
+import os
+import pathlib
+import signal
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.models import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.serve import (
+    FleetRouter, InprocReplica, LoadSignal, Scheduler, ServeConfig,
+    TPGenerateReplica, launch_fleet, make_requests,
+    run_fleet_closed_loop,
+)
+from neural_networks_parallel_training_with_mpi_tpu.serve.fleet import (
+    FleetRequest, ReplicaHandle,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (
+    EXIT_HANG, ChildSpec, GroupSupervisor,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+from neural_networks_parallel_training_with_mpi_tpu.utils.sketches import (
+    QuantileSketch,
+)
+
+pytestmark = pytest.mark.fleet
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+V = 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    return model, model.init(prng.init_key(0))
+
+
+def _sched(model, params, *, slots=4, queue_depth=16, replica=None,
+           num_blocks=None, **kw):
+    return Scheduler(model, params, ServeConfig(
+        slots=slots, num_blocks=num_blocks or (1 + slots * 4),
+        block_size=16, prefill_chunk=16, queue_depth=queue_depth,
+        replica=replica, **kw))
+
+
+def _reference_tokens(model, params, plan):
+    """Every request of a client-major plan through ONE scheduler —
+    the undisturbed greedy reference."""
+    out = {}
+    sched = _sched(model, params, slots=4, queue_depth=256,
+                   num_blocks=64)
+    try:
+        rids = {}
+        for ci, reqs in enumerate(plan):
+            for i, r in enumerate(reqs):
+                rid = sched.submit(r["prompt"], r["max_new"])
+                assert rid is not None
+                rids[(ci, i)] = rid
+        sched.run_until_drained()
+        for key, rid in rids.items():
+            out[key] = sched.result(rid)
+    finally:
+        sched.close()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# group supervisor (stdlib children: fast enough for the core lane)
+# ---------------------------------------------------------------------------
+
+def _pump_group(g, until, timeout_s=15.0):
+    evs = []
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        evs += g.poll()
+        if until(evs):
+            return evs
+        time.sleep(0.02)
+    raise AssertionError(f"condition never met; events={evs}")
+
+
+def test_group_supervisor_per_child_exit_contracts():
+    crash = ChildSpec(name="crash",
+                      cmd=[sys.executable, "-c", "raise SystemExit(7)"],
+                      max_restarts=2, backoff=0.05, backoff_cap=0.1)
+    clean = ChildSpec(name="clean",
+                      cmd=[sys.executable, "-c", "raise SystemExit(0)"],
+                      max_restarts=2, backoff=0.05)
+    noretry = ChildSpec(name="anomaly",
+                        cmd=[sys.executable, "-c",
+                             "raise SystemExit(44)"],
+                        max_restarts=2, backoff=0.05)
+    g = GroupSupervisor([crash, clean, noretry], log=lambda m: None)
+    g.start()
+    evs = _pump_group(g, lambda evs: not g.running())
+    kinds = {(e["child"], e["event"]) for e in evs}
+    assert ("clean", "stopped") in kinds       # exit 0: no-retry
+    assert ("anomaly", "stopped") in kinds     # exit 44: no-retry
+    assert ("crash", "gave_up") in kinds       # budget exhausted
+    n_relaunch = sum(1 for e in evs
+                     if (e["child"], e["event"]) == ("crash",
+                                                     "relaunch"))
+    assert n_relaunch == 2
+    assert g.done("crash") == 7
+    assert g.done("clean") == 0
+    assert g.done("anomaly") == 44
+
+
+def test_group_supervisor_relaunch_leaves_siblings_undisturbed():
+    crash = ChildSpec(name="crash",
+                      cmd=[sys.executable, "-c", "raise SystemExit(1)"],
+                      max_restarts=1, backoff=0.05, backoff_cap=0.1)
+    steady = ChildSpec(name="steady",
+                       cmd=[sys.executable, "-c",
+                            "import time; time.sleep(60)"],
+                       max_restarts=1)
+    g = GroupSupervisor([crash, steady], log=lambda m: None)
+    g.start()
+    steady_pid = g.proc("steady").pid
+    try:
+        evs = _pump_group(
+            g, lambda evs: any(e["child"] == "crash"
+                               and e["event"] == "relaunch"
+                               for e in evs))
+        # the sibling's process is the SAME pid — probe-and-relaunch
+        # touched only the dead child
+        assert g.proc("steady").pid == steady_pid
+        assert g.alive("steady")
+        assert not any(e["child"] == "steady" for e in evs
+                       if e["event"] in ("exit", "relaunch"))
+    finally:
+        g.terminate_all()
+
+
+def test_group_supervisor_heartbeat_hang_kill(tmp_path):
+    hb = tmp_path / "heartbeat-serve-p0.json"
+    # the child beats once then wedges: the per-child monitor must arm
+    # on that first write and kill at staleness, reporting EXIT_HANG
+    src = (f"import pathlib, time; "
+           f"pathlib.Path({str(hb)!r}).write_text('{{}}'); "
+           "time.sleep(120)")
+    spec = ChildSpec(name="wedged", cmd=[sys.executable, "-c", src],
+                     heartbeat_path=str(hb), heartbeat_timeout=0.5,
+                     max_restarts=0, backoff=0.05)
+    g = GroupSupervisor([spec], log=lambda m: None)
+    g.start()
+    try:
+        evs = _pump_group(
+            g, lambda evs: any(e["event"] == "hang_kill" for e in evs),
+            timeout_s=30.0)
+        kills = [e for e in evs if e["event"] == "hang_kill"]
+        assert kills, evs
+        # max_restarts=0: the hang spends the budget -> gave_up with
+        # the EXIT_HANG classification
+        _pump_group(g, lambda evs: not g.running(), timeout_s=10.0)
+        assert g.done("wedged") == EXIT_HANG
+    finally:
+        g.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# router policy (in-process replicas; the budgeted core-lane shape)
+# ---------------------------------------------------------------------------
+
+def test_load_report_is_the_rollup_record(lm):
+    """The router's placement signal IS the telemetry rollup document:
+    kind/sketches/now parse into a LoadSignal without any scheduler
+    internals."""
+    model, params = lm
+    sched = _sched(model, params)
+    try:
+        rid = sched.submit([1, 2, 3], 4)
+        assert rid is not None
+        sched.tick()
+        rec = sched.load_report()
+        assert rec["kind"] == "rollup" and rec["role"] == "serve"
+        assert "queue_depth" in rec["now"]
+        sig = LoadSignal.from_report(rec)
+        assert sig.in_flight == 1
+        assert sig.slots == 4 and sig.free_slots == 3
+        assert 0.0 <= sig.block_utilization <= 1.0
+        sched.run_until_drained()
+        sched.result(rid)
+        done = sched.load_report()
+        assert json.dumps(done)    # wire-serializable as-is
+        sig2 = LoadSignal.from_report(done)
+        assert sig2.in_flight == 0
+        assert sig2.ttft_p50_ms is not None   # sketches carried over
+    finally:
+        sched.close()
+
+
+def test_router_places_on_idle_replica(lm):
+    """ACCEPTANCE: saturate one replica and placement shifts to the
+    idle one, driven by the live rollup (queue depth / occupancy), not
+    by the router's own dispatch ledger (the saturating load bypasses
+    the router entirely)."""
+    model, params = lm
+    hot = InprocReplica(_sched(model, params, replica=0), name="hot")
+    idle = InprocReplica(_sched(model, params, replica=1), name="idle")
+    # saturate 'hot' BEHIND the router's back: fill every slot + queue
+    for _ in range(6):
+        assert hot.sched.submit([1, 2, 3, 4], 8) is not None
+    hot.sched.tick()
+    assert LoadSignal.from_report(hot.sched.load_report()).occupancy > 0
+    router = FleetRouter([hot, idle], queue_depth=32)
+    rids = [router.submit([5, 6, 7], 4) for _ in range(4)]
+    assert all(r is not None for r in rids)
+    for _ in range(200):
+        router.pump()
+        if all(router.done(r) for r in rids):
+            break
+    assert all(router.done(r) for r in rids)
+    placed = router.per_replica_completed()
+    assert placed["idle"] == 4 and placed["hot"] == 0, placed
+    hot.close()
+    idle.close()
+
+
+def test_router_rejects_overload_at_router_not_blind(lm):
+    """One bounded FLEET queue sheds overload; replica-local queues
+    stay shallow (slots + replica_queue_cap), so waiting work remains
+    re-placeable at the router."""
+    model, params = lm
+    a = InprocReplica(_sched(model, params, replica=0), name="a")
+    b = InprocReplica(_sched(model, params, replica=1), name="b")
+    router = FleetRouter([a, b], queue_depth=4, replica_queue_cap=1)
+    rids = [router.submit([1, 2], 4) for _ in range(40)]
+    accepted = [r for r in rids if r is not None]
+    router.pump()   # one dispatch pass, no replica progress yet
+    assert router.rejected >= 40 - (4 + 2 * (4 + 1))
+    assert router.rejected == sum(1 for r in rids if r is None)
+    for h in (a, b):
+        # local backlog bounded by slots + cap
+        assert len(h.assigned()) <= 4 + 1
+    # everything accepted eventually completes (no starvation)
+    for _ in range(500):
+        router.pump()
+        if all(router.done(r) for r in accepted):
+            break
+    assert all(router.done(r) for r in accepted)
+    a.close()
+    b.close()
+
+
+class _StubReplica(ReplicaHandle):
+    """A load-signal stub for admission-policy pins (never serves)."""
+
+    def __init__(self, name, ttft_p50_ms, slots=4):
+        self.name = name
+        sk = QuantileSketch()
+        sk.add(ttft_p50_ms)
+        self._rec = {"kind": "rollup", "role": "serve",
+                     "sketches": {"ttft_ms": sk.to_dict()},
+                     "now": {"queue_depth": 0, "in_flight": 0,
+                             "free_slots": slots, "slots": slots,
+                             "queue_cap": 16, "free_blocks": 100,
+                             "block_utilization": 0.0}}
+
+    def alive(self):
+        return True
+
+    def accepting(self):
+        return True
+
+    def load(self):
+        return LoadSignal.from_report(self._rec)
+
+    def submit(self, req):
+        return False
+
+    def pump(self):
+        return []
+
+    def assigned(self):
+        return []
+
+    def take_assigned(self):
+        return []
+
+
+def test_router_slo_infeasible_rejection():
+    """With reject_infeasible, a deadline no replica's TTFT rollup can
+    plausibly meet is rejected at admission (counted separately);
+    feasible deadlines and SLO-less requests still admit."""
+    slow = _StubReplica("slow", ttft_p50_ms=500.0)
+    router = FleetRouter([slow], queue_depth=8,
+                         reject_infeasible=True,
+                         feasibility_margin=1.0)
+    assert router.submit([1, 2], 4, slo_ms=10.0) is None
+    assert router.rejected_infeasible == 1
+    assert router.submit([1, 2], 4, slo_ms=10_000.0) is not None
+    assert router.submit([1, 2], 4) is not None     # no SLO: admits
+    assert router.rejected == 1
+
+
+def test_router_requeues_dead_replica_tokens_exact(lm):
+    """In-process death: the failed replica's in-flight requests
+    requeue at the router and complete on the sibling with tokens
+    byte-identical to the undisturbed reference; no request starves."""
+    model, params = lm
+    plan = make_requests(4, 2, vocab_size=V, prompt_lens=(3, 10),
+                         max_new=(4, 8), seed=11)
+    ref = _reference_tokens(model, params, plan)
+    a = InprocReplica(_sched(model, params, replica=0), name="a")
+    b = InprocReplica(_sched(model, params, replica=1), name="b")
+    router = FleetRouter([a, b], queue_depth=32)
+    rids = {}
+    for ci, reqs in enumerate(plan):
+        for i, r in enumerate(reqs):
+            rid = router.submit(r["prompt"], r["max_new"])
+            assert rid is not None
+            rids[(ci, i)] = rid
+    for _ in range(3):   # part-way: some prefill/decode on both
+        router.pump()
+    assert a.assigned() or b.assigned()
+    victim, survivor = (a, b) if a.assigned() else (b, a)
+    n_inflight = len(victim.assigned())
+    victim.fail()
+    for _ in range(2000):
+        router.pump()
+        if all(router.done(r) for r in rids.values()):
+            break
+    assert all(router.done(r) for r in rids.values())   # no starvation
+    assert router.requeued >= n_inflight > 0
+    assert router.replica_deaths == 1
+    for key, rid in rids.items():
+        assert router.result(rid) == ref[key], key
+    survivor.close()
+
+
+def test_scheduler_drain_feeds_router_requeue(lm):
+    """Graceful shrink: drain() hands the in-flight set back in
+    submission order; re-submission through the router reproduces the
+    same tokens on another replica."""
+    model, params = lm
+    donor = _sched(model, params, replica=0)
+    sink = InprocReplica(_sched(model, params, replica=1), name="sink")
+    router = FleetRouter([sink], queue_depth=32)
+    subs = [([1 + i, 2 + i, 3 + i], 5) for i in range(4)]
+    for p, n in subs:
+        assert donor.submit(p, n) is not None
+    for _ in range(3):
+        donor.tick()
+    drained = donor.drain()
+    donor.server.allocator.assert_drained()
+    assert [d["prompt"] for d in drained] == [p for p, _ in subs]
+    rids = [router.submit(d["prompt"], d["max_new"],
+                          slo_ms=d["slo_ms"]) for d in drained]
+    for _ in range(500):
+        router.pump()
+        if all(router.done(r) for r in rids):
+            break
+    ref = _reference_tokens(
+        model, params, [[{"prompt": p, "max_new": n}] for p, n in subs])
+    for i, rid in enumerate(rids):
+        assert router.result(rid) == ref[(i, 0)]
+    donor.close()
+    sink.close()
+
+
+class _RacyHandle(ReplicaHandle):
+    """A handle whose completion events buffer like a subprocess pipe:
+    lets a test stage 'completed, then died, events still queued'."""
+
+    def __init__(self, name="racy"):
+        self.name = name
+        self._assigned = {}
+        self.events = []
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def accepting(self):
+        return self._alive
+
+    def load(self):
+        return None
+
+    def submit(self, req):
+        if not self._alive:
+            return False
+        self._assigned[req.rid] = req
+        return True
+
+    def pump(self):
+        out, self.events = self.events, []
+        for rec in out:
+            self._assigned.pop(int(rec["rid"]), None)
+        return out
+
+    def assigned(self):
+        return list(self._assigned)
+
+    def take_assigned(self):
+        rids = list(self._assigned)
+        self._assigned.clear()
+        return rids
+
+
+def test_raced_completion_on_death_is_honored_not_requeued():
+    """A completion event that raced the replica's death (buffered on
+    the pipe when the supervisor reports the exit) must be honored —
+    surfacing from the next pump — never requeued into a duplicate
+    execution."""
+    racy = _RacyHandle()
+    router = FleetRouter([racy], queue_depth=8)
+    rid = router.submit([1, 2], 2)
+    router.pump()                      # dispatched to racy
+    assert racy.assigned() == [rid]
+    # the worker finished the request and THEN died: the done event is
+    # still queued when the death notice arrives
+    racy.events.append({"ev": "done", "rid": rid,
+                        "tokens": [1, 2, 9, 9], "ttft_ms": 1.0,
+                        "itl_ms": 1.0})
+    racy._alive = False
+    router.on_replica_down(racy.name)
+    assert router.requeued == 0        # honored, not re-run
+    done = router.pump()
+    assert done == [rid]
+    assert router.result(rid) == [1, 2, 9, 9]
+    assert len(router.queue) == 0
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel replica (core-lane acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_tp_replica_tokens_identical_to_single_device(lm):
+    """ACCEPTANCE: one replica spanning a 2-device tensor-parallel mesh
+    through generate_tp serves the same requests as the single-device
+    paged replica with IDENTICAL tokens (greedy)."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+        mesh as mesh_lib,
+    )
+
+    model, params = lm
+    mesh = mesh_lib.make_mesh(MeshConfig(data=1, tensor=2),
+                              devices=np.asarray(jax.devices()[:2]))
+    ptp = dict(params)
+    ptp["blocks"] = megatron.permute_qkv(
+        params["blocks"], model.cfg.d_model, model.cfg.n_heads, 2,
+        kv_heads=model.cfg.kv_heads)
+    tp = TPGenerateReplica(model, ptp, mesh, batch=4, name="tp")
+    paged = InprocReplica(_sched(model, params, queue_depth=32),
+                          name="paged")
+    plan = make_requests(4, 2, vocab_size=V, prompt_lens=(3, 10),
+                         max_new=(4, 8), seed=7)
+    reqs = [r for client in plan for r in client]
+    got = {"tp": {}, "paged": {}}
+    for i, r in enumerate(reqs):
+        for h in (tp, paged):
+            assert h.submit(FleetRequest(i, list(r["prompt"]),
+                                         r["max_new"], None, 0.0,
+                                         math.inf))
+    for _ in range(500):
+        for name, h in (("tp", tp), ("paged", paged)):
+            for rec in h.pump():
+                got[name][rec["rid"]] = rec["tokens"]
+        if all(len(got[n]) == len(reqs) for n in got):
+            break
+    assert all(len(got[n]) == len(reqs) for n in got)
+    for i in range(len(reqs)):
+        assert got["tp"][i] == got["paged"][i], i
+    paged.close()
+
+
+def test_tp_replica_routes_in_a_mixed_fleet(lm):
+    """A TP replica is just another ReplicaHandle: a mixed fleet
+    (1 paged + 1 TP) drains a closed loop with exact fleet-level token
+    accounting."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import (
+        MeshConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        megatron,
+        mesh as mesh_lib,
+    )
+
+    model, params = lm
+    mesh = mesh_lib.make_mesh(MeshConfig(data=1, tensor=2),
+                              devices=np.asarray(jax.devices()[:2]))
+    ptp = dict(params)
+    ptp["blocks"] = megatron.permute_qkv(
+        params["blocks"], model.cfg.d_model, model.cfg.n_heads, 2,
+        kv_heads=model.cfg.kv_heads)
+    tp = TPGenerateReplica(model, ptp, mesh, batch=2, name="tp")
+    paged = InprocReplica(_sched(model, params), name="paged")
+    router = FleetRouter([paged, tp], queue_depth=32)
+    row = run_fleet_closed_loop(router, 4, 2, vocab_size=V,
+                                prompt_lens=(3, 10), max_new=(4, 8),
+                                seed=13)
+    assert row["requests"] == 8
+    assert row["tokens_out"] > 0
+    assert sum(row["per_replica_completed"].values()) == 8
+    paged.close()
+
+
+# ---------------------------------------------------------------------------
+# loadgen seed partitioning (satellite)
+# ---------------------------------------------------------------------------
+
+def test_make_requests_stream_partitions_seed_space():
+    base = make_requests(2, 3, vocab_size=V, seed=5)
+    again = make_requests(2, 3, vocab_size=V, seed=5, stream=0)
+    assert base == again            # stream=0 keeps historical draws
+    r1 = make_requests(2, 3, vocab_size=V, seed=5, stream=1)
+    r2 = make_requests(2, 3, vocab_size=V, seed=5, stream=2)
+    assert r1 != base and r2 != base and r1 != r2
+    # determinism per stream
+    assert r1 == make_requests(2, 3, vocab_size=V, seed=5, stream=1)
+
+
+def test_scheduler_flow_prefix_unique_per_replica(lm):
+    model, params = lm
+    s0 = _sched(model, params, replica=0)
+    s1 = _sched(model, params, replica=1)
+    try:
+        assert s0._flow_prefix != s1._flow_prefix
+        assert "R1-" in s1._flow_prefix
+    finally:
+        s0.close()
+        s1.close()
+
+
+# ---------------------------------------------------------------------------
+# obs_agg per-replica breakdown (satellite)
+# ---------------------------------------------------------------------------
+
+def test_obs_agg_breakdown_rows(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_fleet_obs_agg", str(REPO / "tools" / "obs_agg.py"))
+    agg_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(agg_mod)
+
+    def rollup(role, p, replica, ttft, q):
+        sk = QuantileSketch()
+        for v in ttft:
+            sk.add(v)
+        rec = {"kind": "rollup", "role": role, "step": 10,
+               "t_unix": time.time(), "p": p, "run": "r", "inc": 0,
+               "sketches": {"ttft_ms": sk.to_dict()},
+               "counters": {"completed": len(ttft)},
+               "gauges": {}, "now": {"queue_depth": q}}
+        if replica is not None:
+            rec["replica"] = replica
+        return rec
+
+    for k, (ttfts, q) in enumerate([([5.0, 6.0], 0),
+                                    ([50.0, 60.0], 7)]):
+        d = tmp_path / f"replica-{k}"
+        d.mkdir()
+        with open(d / "metrics.jsonl", "w") as f:
+            f.write(json.dumps(rollup("serve", k, k, ttfts, q)) + "\n")
+    rd = tmp_path / "router"
+    rd.mkdir()
+    with open(rd / "metrics.jsonl", "w") as f:
+        f.write(json.dumps(rollup("router", 0, None, [7.0, 70.0], 1))
+                + "\n")
+    doc = agg_mod.aggregate([str(tmp_path / "replica-0"),
+                             str(tmp_path / "replica-1"), str(rd)])
+    rows = {(r["role"], r["replica"]): r for r in doc["breakdown"]}
+    assert rows[("serve", 1)]["queue_depth"] == 7     # the hot replica
+    assert rows[("serve", 0)]["ttft_ms_p50"] < \
+        rows[("serve", 1)]["ttft_ms_p50"]
+    assert ("router", 0) in rows                       # router row too
+    text = agg_mod.render_text(doc)
+    assert "per-writer" in text and "serve r1 p1" in text
+
+
+# ---------------------------------------------------------------------------
+# multi-process e2e (slow/chaos: subprocess replicas + SIGKILL)
+# ---------------------------------------------------------------------------
+
+MODEL_FLAGS = dict(vocab=V, seq=64, layers=2, d_model=32, heads=4,
+                   d_ff=64, init_seed=0)
+SERVE_FLAGS = dict(slots=4, num_blocks=17, block_size=16,
+                   prefill_chunk=16, queue_depth=16)
+
+
+@pytest.mark.slow
+def test_worker_protocol_roundtrip(tmp_path):
+    """One subprocess replica: ready -> submit -> done with tokens
+    matching the in-process scheduler, status events carrying the
+    rollup record, clean drain on exit."""
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    params = model.init(prng.init_key(0))
+    plan = make_requests(2, 2, vocab_size=V, prompt_lens=(3, 10),
+                         max_new=(4, 8), seed=3)
+    ref = _reference_tokens(model, params, plan)
+    fleet = launch_fleet(1, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         telemetry_root=str(tmp_path),
+                         log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        rids = {}
+        for ci, reqs in enumerate(plan):
+            for i, r in enumerate(reqs):
+                rid = fleet.submit(r["prompt"], r["max_new"])
+                assert rid is not None
+                rids[(ci, i)] = rid
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            fleet.pump()
+            if all(fleet.done(r) for r in rids.values()):
+                break
+            time.sleep(0.005)
+        assert all(fleet.done(r) for r in rids.values())
+        for key, rid in rids.items():
+            assert fleet.result(rid) == ref[key], key
+        # the live load signal arrived over the wire as a rollup record
+        sig = fleet.handles[0].load()
+        assert sig is not None and sig.slots == 4
+        # replica telemetry landed in its own dir under its identity
+        mpath = tmp_path / "replica-0" / "metrics.jsonl"
+        assert mpath.exists()
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_chaos_kill_replica_mid_load(tmp_path):
+    """ACCEPTANCE e2e: SIGKILL one subprocess replica mid-load under
+    the group supervisor — every in-flight request completes after
+    requeue with tokens byte-identical to the undisturbed reference,
+    no request starves, the supervisor relaunches the dead replica and
+    the sibling keeps serving throughout (its pid never changes)."""
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    params = model.init(prng.init_key(0))
+    clients, per_client = 6, 3
+    plan = make_requests(clients, per_client, vocab_size=V,
+                         prompt_lens=(3, 10), max_new=(6, 10), seed=5)
+    ref = _reference_tokens(model, params, plan)
+    fleet = launch_fleet(2, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         telemetry_root=str(tmp_path),
+                         backoff=0.2, backoff_cap=1.0,
+                         log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        sibling_pid = fleet.supervisor.proc("replica-1").pid
+        rids = {}
+        next_i = {ci: 0 for ci in range(clients)}
+        outstanding = {ci: None for ci in range(clients)}
+        killed = False
+        t0 = time.time()
+        while time.time() - t0 < 300:
+            for ci in range(clients):
+                if outstanding[ci] is not None or \
+                        next_i[ci] >= per_client:
+                    continue
+                r = plan[ci][next_i[ci]]
+                rid = fleet.submit(r["prompt"], r["max_new"])
+                if rid is None:
+                    continue
+                rids[(ci, next_i[ci])] = rid
+                outstanding[ci] = rid
+                next_i[ci] += 1
+            for rid in fleet.pump():
+                for ci in range(clients):
+                    if outstanding[ci] == rid:
+                        outstanding[ci] = None
+            n_done = sum(1 for r in rids.values() if fleet.done(r))
+            if not killed and n_done >= 3:
+                # mid-load: some requests done, others in flight
+                victim = fleet.supervisor.proc("replica-0")
+                os.kill(victim.pid, signal.SIGKILL)
+                killed = True
+            if len(rids) == clients * per_client and \
+                    all(v is None for v in outstanding.values()):
+                break
+            time.sleep(0.002)
+        assert killed, "load finished before the kill could land"
+        assert len(rids) == clients * per_client
+        assert all(fleet.done(r) for r in rids.values())  # no starvation
+        # byte-identical to the undisturbed reference, requeues included
+        for key, rid in rids.items():
+            assert fleet.result(rid) == ref[key], key
+        assert fleet.router.replica_deaths >= 1
+        assert fleet.router.requeued >= 1
+        # supervisor relaunches ONLY the dead replica (the load can
+        # drain before the backoff elapses — wait the relaunch out)
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            fleet.pump()
+            if any(e["child"] == "replica-0"
+                   and e["event"] == "relaunch" for e in fleet.events):
+                break
+            time.sleep(0.02)
+        evs = [(e["child"], e["event"]) for e in fleet.events]
+        assert ("replica-0", "exit") in evs
+        assert ("replica-0", "relaunch") in evs
+        assert ("replica-1", "relaunch") not in evs
+        assert fleet.supervisor.proc("replica-1").pid == sibling_pid
+        assert fleet.per_replica_completed()["replica-1"] > 0
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_crash_at_request_fault_injection(tmp_path):
+    """The worker's --crash-at-request fault hook: replica 0 dies on
+    its 2nd submit, the fleet still completes everything exactly."""
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    params = model.init(prng.init_key(0))
+    plan = make_requests(4, 2, vocab_size=V, prompt_lens=(3, 10),
+                         max_new=(4, 8), seed=9)
+    ref = _reference_tokens(model, params, plan)
+    fleet = launch_fleet(2, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         backoff=0.2, backoff_cap=1.0,
+                         crash_at_request=2, log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        row = run_fleet_closed_loop(fleet, 4, 2, vocab_size=V,
+                                    prompt_lens=(3, 10),
+                                    max_new=(4, 8), seed=9)
+        assert row["requests"] == 8
+        assert row["requeued"] >= 1
+        # tokens_sha256 is over (client, idx, tokens) — compare against
+        # the reference digest computed the same way
+        import hashlib
+
+        h = hashlib.sha256()
+        for key in sorted(ref):
+            h.update(repr((key[0], key[1], ref[key])).encode())
+        assert row["tokens_sha256"] == h.hexdigest()
+    finally:
+        fleet.close()

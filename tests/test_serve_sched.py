@@ -462,3 +462,101 @@ def test_bench_serve_writes_artifact(tmp_path, monkeypatch):
     cap = doc["capacity_equal_memory"]
     assert cap["paged_streams_admitted"] > cap["dense_streams_admitted"]
     assert doc["dense_host_sync_fix"]["tokens_per_sec_host_tracked"] > 0
+
+
+# ---------------------------------------------------------------------------
+# drain/requeue semantics (the fleet router's replica-death contract,
+# pinned in ISOLATION: one scheduler, no router, no subprocesses)
+# ---------------------------------------------------------------------------
+
+def test_drain_returns_inflight_with_consumed_state():
+    """drain() hands back every unfinished request in submission order
+    with its consumed-token state (prefilled/generated), leaves the
+    allocator fully drained, and keeps completed results readable."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    sched = Scheduler(model, params, ServeConfig(
+        slots=2, num_blocks=17, block_size=16, prefill_chunk=8,
+        queue_depth=8))
+    done_rid = sched.submit([1, 2, 3], 2)       # will complete pre-drain
+    mid_rid = sched.submit(list(range(1, 21)), 8)   # long prompt: will
+    #                                                 be mid-prefill
+    for _ in range(40):
+        sched.tick()
+        if sched.done(done_rid):
+            break
+    assert sched.done(done_rid)
+    queued_rid = sched.submit([7, 8, 9], 4)
+    sched.tick()
+    drained = sched.drain()
+    sched.server.allocator.assert_drained()
+    assert sched.in_flight() == 0 and sched.pending() == 0
+    by_rid = {d["rid"]: d for d in drained}
+    assert set(by_rid) == {mid_rid, queued_rid}
+    assert [d["rid"] for d in drained] == [mid_rid, queued_rid]  # order
+    # consumed-token state: the long prompt made progress; the one
+    # still queued at drain time consumed nothing
+    assert 0 < by_rid[mid_rid]["prefilled"] + by_rid[mid_rid]["generated"]
+    assert by_rid[queued_rid]["prefilled"] == 0
+    assert by_rid[queued_rid]["generated"] == 0
+    assert by_rid[mid_rid]["prompt"] == list(range(1, 21))
+    # the completed request survived the drain
+    assert sched.result(done_rid)[:3] == [1, 2, 3]
+    sched.close()
+
+
+def test_drain_readmission_reproduces_identical_tokens():
+    """Re-admitting a drained request on a FRESH scheduler reproduces
+    byte-identical tokens (greedy determinism — the requeue-exactness
+    argument the fleet router relies on), including requests drained
+    mid-decode."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    subs = [([3, 1, 4, 1, 5], 12), (list(range(2, 14)), 14),
+            ([9, 2, 6], 10)]
+    refs = [_reference(model, params, p, n) for p, n in subs]
+    sched = Scheduler(model, params, ServeConfig(
+        slots=4, num_blocks=33, block_size=16, prefill_chunk=8,
+        queue_depth=8))
+    rids = [sched.submit(p, n) for p, n in subs]
+    assert all(r is not None for r in rids)
+    for _ in range(6):   # far enough that some streams are DECODING
+        sched.tick()
+    assert any(sched.server.active)   # at least one mid-decode
+    drained = sched.drain()
+    sched.server.allocator.assert_drained()
+    assert len(drained) == len(subs)
+    fresh = Scheduler(model, params, ServeConfig(
+        slots=4, num_blocks=33, block_size=16, prefill_chunk=8,
+        queue_depth=8))
+    rid2 = {d["rid"]: fresh.submit(d["prompt"], d["max_new"],
+                                   slo_ms=d["slo_ms"])
+            for d in drained}
+    fresh.run_until_drained()
+    for old_rid, ref in zip(rids, refs):
+        assert fresh.result(rid2[old_rid]) == ref
+    fresh.server.allocator.assert_drained()
+    sched.close()
+    fresh.close()
+
+
+def test_drain_with_prefix_cache_refcounts_drain():
+    """drain() under prefix sharing: shared/borrowed blocks release
+    through the refcount path — assert_drained (all refcounts zero)
+    holds even when streams were sharing prefix blocks at drain time."""
+    model = _model()
+    params = model.init(prng.init_key(0))
+    shared = list(range(1, 33))     # two full shared blocks
+    sched = Scheduler(model, params, ServeConfig(
+        slots=4, num_blocks=33, block_size=16, prefill_chunk=32,
+        queue_depth=8, prefix_cache=True))
+    r1 = sched.submit(shared + [40, 41], 4)
+    for _ in range(4):
+        sched.tick()
+    r2 = sched.submit(shared + [50, 51], 4)   # prefix-matches r1's blocks
+    sched.tick()
+    assert not sched.done(r1) or not sched.done(r2)
+    drained = sched.drain()
+    sched.server.allocator.assert_drained()   # refcounts all zero
+    assert {d["rid"] for d in drained} <= {r1, r2}
+    sched.close()

@@ -177,6 +177,43 @@ def aggregate(dirs: List[str],
                     view["gauges"].setdefault(name, []).append(
                         gauge.last)
 
+    # per-writer breakdown (newest incarnation only): the row that makes
+    # ONE hot replica visible next to the fleet aggregate — router vs
+    # replica p50/p99 side by side, per-replica queue depth — instead of
+    # a merged percentile that averages the hotspot away
+    breakdown: List[Dict[str, Any]] = []
+    for key, rec in sorted(latest.items()):
+        d, role, run, p, inc = key
+        if inc != newest_inc[(d, role, run, p)]:
+            continue
+        row: Dict[str, Any] = {
+            "dir": d, "role": role, "process": p, "incarnation": inc,
+            "replica": rec.get("replica", p), "step": rec.get("step"),
+        }
+        for name in ("ttft_ms", "itl_ms", "step_time_ms"):
+            doc = (rec.get("sketches") or {}).get(name)
+            if doc:
+                sketch = sk.QuantileSketch.from_dict(doc)
+                row[f"{name}_p50"] = sketch.quantile(0.5)
+                row[f"{name}_p99"] = sketch.quantile(0.99)
+        for name in ("queue_depth", "block_utilization",
+                     "tokens_per_sec"):
+            doc = (rec.get("gauges") or {}).get(name)
+            if doc:
+                gauge = sk.Gauge.from_dict(doc)
+                if gauge.last is not None:
+                    row[name] = gauge.last
+        now_state = rec.get("now") or {}
+        for name in ("queue_depth", "block_utilization", "in_flight"):
+            if name in now_state:
+                row[name] = now_state[name]
+        cn = rec.get("counters") or {}
+        for name in ("completed", "requeued", "rejected",
+                     "replica_deaths"):
+            if name in cn:
+                row[name] = cn[name]
+        breakdown.append(row)
+
     out_roles: Dict[str, Any] = {}
     fleet: Dict[str, Any] = {}
     for role, view in sorted(roles.items()):
@@ -250,6 +287,7 @@ def aggregate(dirs: List[str],
              "t_unix": latest[k].get("t_unix")}
             for k in sorted(latest)],
         "roles": out_roles,
+        "breakdown": breakdown,
         "fleet": fleet,
         "heartbeats": heartbeats,
         "alerts": {"n": len(alerts), "by_name": by_name,
@@ -374,6 +412,33 @@ def render_text(doc: Dict[str, Any]) -> str:
             lines.append(f"  {name:<18} {val:.6g} "
                          f"({'sum' if name in _ADDITIVE_GAUGES else 'mean'}"
                          " across live writers)")
+    breakdown = doc.get("breakdown") or []
+    if breakdown:
+        lines.append("per-writer (newest incarnation):")
+        for row in breakdown:
+            who = (f"{row['role']}"
+                   + (f" r{row['replica']}"
+                      if row["role"] != "router" else "")
+                   + f" p{row['process']}")
+            bits = []
+            if row.get("ttft_ms_p50") is not None:
+                p99 = row.get("ttft_ms_p99")
+                bits.append(
+                    f"ttft p50/p99 {row['ttft_ms_p50']:.1f}/"
+                    + (f"{p99:.1f}ms" if p99 is not None else "?ms"))
+            if row.get("step_time_ms_p50") is not None:
+                bits.append(f"step p50 {row['step_time_ms_p50']:.1f}ms")
+            if row.get("queue_depth") is not None:
+                bits.append(f"q={row['queue_depth']:g}")
+            if row.get("block_utilization") is not None:
+                bits.append(f"util={row['block_utilization']:.2f}")
+            if row.get("completed") is not None:
+                bits.append(f"done={row['completed']:g}")
+            if row.get("requeued"):
+                bits.append(f"requeued={row['requeued']:g}")
+            if row.get("replica_deaths"):
+                bits.append(f"deaths={row['replica_deaths']:g}")
+            lines.append(f"  {who:<16} " + "  ".join(bits))
     for hb in doc.get("heartbeats") or []:
         mark = ("FINAL" if hb["final"]
                 else ("STALE" if hb["age_s"]
