@@ -59,8 +59,12 @@ for key, rid in rids.items():
 ref_sched.close()
 
 # ---- the fleet: 2 supervised subprocess replicas + router ------------
+# heartbeat_timeout catches the LIVE-but-stuck replica (wedged device,
+# deadlocked loop) whose pipes stay open; dead processes are caught
+# instantly by pipe-EOF regardless, as the SIGKILL below demonstrates
 fleet = launch_fleet(2, model=MODEL, serve=SERVE, telemetry_root=TELE,
                      backoff=0.3, backoff_cap=1.0,
+                     heartbeat_timeout=30.0,
                      log=lambda m: print(m))
 try:
     fleet.wait_ready()

@@ -1,0 +1,90 @@
+#!/usr/bin/env bash
+# Fleet autopilot: the closed control loop over the serving fleet
+# (serve/autopilot.py).  The Autopilot consumes the same per-replica
+# rollup records the observability plane aggregates plus the router's
+# live queue, and actuates through the fleet's runtime-membership
+# surface — every decision guarded by hysteresis holds, cooldowns, and
+# bounded backoff, and every decision recorded with its inputs.
+#
+# Two arms, both wrapping tools/serve_fleet.py --autopilot:
+#
+# 1. GOOD ROLLOUT — 2 prewarmed replicas under sustained load; 2 s in,
+#    a verified weight snapshot (same init seed, so tokens stay
+#    byte-identical) is pushed as generation 1.  The autopilot spawns a
+#    canary, shifts a hashed 25% traffic slice once it reports ready,
+#    judges it over a fixed window (completions, SLO misses, windowed
+#    TTFT ratio vs the stable generation), promotes, grows generation 1
+#    to the old width, and drains generation 0 out (exit 47, ledger
+#    intact).  Zero downtime: every request completes, and the flow
+#    ledger attributes every completion to the generation that served
+#    it.
+#
+# 2. CORRUPT CANARY — the snapshot payload is corrupted AFTER the
+#    manifest commit (re-committed, so the autopilot's pre-spawn verify
+#    passes — the TOCTOU shape).  The canary worker re-verifies against
+#    its OWN load, fails, exits 44 (anomaly: terminal, no relaunch);
+#    the autopilot rolls back automatically and generation 0 serves
+#    every request, undisturbed.
+set -euo pipefail
+
+OUT=/tmp/nnpt_autopilot_example
+rm -rf "$OUT" && mkdir -p "$OUT"
+
+common=(--replicas 2 --vocab 64 --seq 64 --layers 2 --d-model 32
+        --heads 4 --d-ff 64 --slots 4 --block-size 16
+        --prefill-chunk 16 --step-sleep-ms 15 --slo-ms 8000
+        --autopilot --min-replicas 2 --max-replicas 3 --json)
+
+echo "== arm 1: good rollout (canary -> judge -> promote -> drain old) =="
+python tools/serve_fleet.py "${common[@]}" \
+    --prewarm --clients 8 --requests-per-client 60 \
+    --rollout-after 2 --rollout-mode good \
+    --canary-fraction 0.25 --canary-window 4 \
+    --telemetry-dir "$OUT/good" > "$OUT/good.json"
+
+echo "== arm 2: corrupt canary (verify-passes-then-load-fails -> rollback) =="
+python tools/serve_fleet.py "${common[@]}" \
+    --clients 4 --requests-per-client 40 \
+    --rollout-after 2 --rollout-mode corrupt \
+    --telemetry-dir "$OUT/corrupt" > "$OUT/corrupt.json"
+
+python - <<'EOF'
+import json
+
+good = json.load(open("/tmp/nnpt_autopilot_example/good.json"))
+acts = [d["action"] for d in good["decisions"]]
+assert "canary_spawn" in acts and "canary_traffic" in acts, acts
+assert "canary_promote" in acts and "rollout_complete" in acts, acts
+assert "canary_rollback" not in acts, acts
+per_gen = {int(k): v for k, v in
+           good["per_generation_completed"].items()}
+assert set(per_gen) == {0, 1} and sum(per_gen.values()) == \
+    good["requests"], per_gen
+done = [d for d in good["decisions"]
+        if d["action"] == "rollout_complete"][0]
+promote = [d for d in good["decisions"]
+           if d["action"] == "canary_promote"][0]
+print(f"rollout: promoted at t={promote['t']}s "
+      f"(p50 ratio {promote['p50_ratio']}, "
+      f"miss frac {promote['miss_frac']}), "
+      f"complete at t={done['t']}s (wall {done['wall_s']}s)")
+print(f"zero downtime: all {good['requests']} requests completed "
+      f"({good['requeued']} drain handoffs requeued); "
+      f"per-generation attribution {per_gen}")
+
+bad = json.load(open("/tmp/nnpt_autopilot_example/corrupt.json"))
+acts = [d["action"] for d in bad["decisions"]]
+assert "canary_rollback" in acts, acts
+assert "canary_promote" not in acts, acts
+rb = [d for d in bad["decisions"]
+      if d["action"] == "canary_rollback"][0]
+assert "rc 44" in rb["reason"], rb
+per_gen = {int(k): v for k, v in
+           bad["per_generation_completed"].items()}
+assert per_gen == {0: bad["requests"]}, per_gen
+print(f"corrupt canary: rolled back at t={rb['t']}s "
+      f"({rb['reason']}); generation 0 undisturbed "
+      f"(all {bad['requests']} requests, "
+      f"{bad['requeued']} requeued)")
+EOF
+echo "fleet autopilot example done"

@@ -53,6 +53,12 @@ from .fleet import (
     TPGenerateReplica,
     launch_fleet,
 )
+from .autopilot import (
+    Autopilot,
+    AutopilotConfig,
+    load_weight_snapshot,
+    save_weight_snapshot,
+)
 
 __all__ = [
     "ATTN_IMPLS", "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
@@ -61,4 +67,6 @@ __all__ = [
     "Fleet", "FleetRequest", "FleetRouter", "InprocReplica", "LoadSignal",
     "ProcReplica", "TPGenerateReplica", "launch_fleet",
     "run_fleet_closed_loop",
+    "Autopilot", "AutopilotConfig", "load_weight_snapshot",
+    "save_weight_snapshot",
 ]

@@ -69,14 +69,26 @@ Pytree = Any
 
 # wire protocol (one JSON object per line):
 #   parent -> worker : {"op": "submit", "rid", "prompt", "max_new",
-#                       "slo_ms"} | {"op": "drain"} | {"op": "exit"}
+#                       "slo_ms"} | {"op": "drain"}
+#                     | {"op": "decommission"} | {"op": "exit"}
 #   worker -> parent : {"ev": "ready", ...} | {"ev": "done", "rid",
 #                       "tokens", "ttft_ms", "itl_ms", ...}
 #                     | {"ev": "reject", "rid"}
 #                     | {"ev": "status", "report": <load_report>}
 #                     | {"ev": "drained", "requests": [...]}
+#                     | {"ev": "load_error", "error": ...}
 # fleet rids ride the wire verbatim, so completions need no id
-# translation on the way back.
+# translation on the way back.  "decommission" is "drain" followed by a
+# terminal exit with train.resilience.EXIT_DECOMMISSION (47) — the
+# autopilot's scale-in handshake (the supervisor must have retired the
+# child first so the exit is final, not relaunched).
+
+# replica ids encode the WEIGHT GENERATION: a generation-g replica gets
+# id g * GEN_STRIDE + k, so its flow-trace prefix (p{id}-R{id}-r...) and
+# telemetry identity attribute every token it emits to its generation
+# (id // GEN_STRIDE) without a side channel — the PR 14 trace contract
+# the zero-downtime rollout is judged on.
+GEN_STRIDE = 1000
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +166,8 @@ class FleetRequest:
     ttft_ms: Optional[float] = None    # fleet-level: router wait included
     itl_ms: Optional[float] = None
     n_generated: Optional[int] = None
+    generation: Optional[int] = None   # weight generation that COMPLETED
+    #                                    this request (set at completion)
 
     @property
     def deadline_missed(self) -> Optional[bool]:
@@ -175,6 +189,7 @@ class ReplicaHandle:
 
     name: str = "replica"
     role: str = "replica"
+    generation: int = 0     # weight generation this replica serves
 
     def alive(self) -> bool:
         raise NotImplementedError
@@ -415,9 +430,11 @@ class ProcReplica(ReplicaHandle):
     supervisor owns the relaunch, :meth:`attach` re-binds the fresh
     process and the ``ready`` event re-opens admission)."""
 
-    def __init__(self, name: str, role: str = "replica"):
+    def __init__(self, name: str, role: str = "replica",
+                 generation: int = 0):
         self.name = name
         self.role = role
+        self.generation = int(generation)
         self._proc = None
         self._stdin = None
         self._events: Deque[Dict[str, Any]] = collections.deque()
@@ -426,6 +443,9 @@ class ProcReplica(ReplicaHandle):
         self._assigned: Dict[int, FleetRequest] = {}
         self.ready = False
         self._signal: Optional[LoadSignal] = None
+        self.report: Optional[Dict[str, Any]] = None   # last RAW rollup
+        #   (the serve.autopilot judge reads the same document obs_agg
+        #   merges, through this field instead of the filesystem)
         self.drained: Optional[List[Dict[str, Any]]] = None
         self.incarnation = -1
 
@@ -494,6 +514,13 @@ class ProcReplica(ReplicaHandle):
     def request_drain(self) -> bool:
         return self._send({"op": "drain"})
 
+    def request_decommission(self) -> bool:
+        """Ask the worker to drain and exit
+        :data:`train.resilience.EXIT_DECOMMISSION` — retire the child at
+        the supervisor FIRST (``GroupSupervisor.retire``) so the exit is
+        terminal even if the drain stalls and escalates to a kill."""
+        return self._send({"op": "decommission"})
+
     def request_exit(self) -> bool:
         return self._send({"op": "exit"})
 
@@ -509,8 +536,8 @@ class ProcReplica(ReplicaHandle):
                 self.ready = True
             elif ev == "status":
                 try:
-                    self._signal = LoadSignal.from_report(
-                        rec.get("report") or {})
+                    self.report = rec.get("report") or {}
+                    self._signal = LoadSignal.from_report(self.report)
                 except (TypeError, ValueError, KeyError):
                     pass
             elif ev == "done":
@@ -588,10 +615,26 @@ class FleetRouter:
         self.requeued = 0
         self.completed = 0
         self.replica_deaths = 0
+        self.deadline_misses = 0
         self._completed_by: Dict[str, int] = {h.name: 0
                                               for h in self.replicas}
+        self._missed_by: Dict[str, int] = {h.name: 0
+                                           for h in self.replicas}
+        self._completed_by_gen: Dict[int, int] = {}
+        # windowed per-completion samples (t, replica, generation,
+        # ttft_ms, missed) for the autopilot's canary judge; bounded so
+        # a long-lived router cannot grow it
+        self.recent: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=512)
         self._was_alive: Dict[str, bool] = {h.name: True
                                             for h in self.replicas}
+        # generation-aware traffic policy (serve.autopilot rollouts):
+        # placement PREFERS the primary generation — or, for the
+        # deterministic rid-modulo canary slice, the canary generation —
+        # and falls back to any accepting replica when the preferred
+        # generation has none (availability beats generation purity)
+        self._primary_gen = 0
+        self._canary: Optional[Tuple[int, float]] = None
         # router telemetry: same sketch/rollup shape as a replica, role
         # "router", so obs_agg renders router vs replica side by side
         self._ttft = QuantileSketch()
@@ -665,6 +708,79 @@ class FleetRouter:
     def per_replica_completed(self) -> Dict[str, int]:
         return dict(self._completed_by)
 
+    def per_replica_missed(self) -> Dict[str, int]:
+        """Completed-past-deadline counts per replica name — the canary
+        judge's per-slice SLO-burn input."""
+        return dict(self._missed_by)
+
+    def per_generation_completed(self) -> Dict[int, int]:
+        """Completions per weight generation — with the flow traces'
+        ``R{id}`` prefix (``id // GEN_STRIDE`` = generation), the two
+        views of rollout attribution that must agree."""
+        return dict(self._completed_by_gen)
+
+    # ---- fleet membership (the autopilot's scale/rollout surface) ------
+    def add_replica(self, h: ReplicaHandle,
+                    generation: Optional[int] = None) -> None:
+        """Register a NEW replica at runtime (scale-out, or a rollout
+        spawning the next weight generation).  It receives traffic as
+        soon as it reports ready; the traffic policy (:meth:`set_traffic`)
+        decides which requests PREFER it."""
+        if any(r.name == h.name for r in self.replicas):
+            raise ValueError(f"duplicate replica name: {h.name!r}")
+        if generation is not None:
+            h.generation = int(generation)
+        self.replicas.append(h)
+        self._completed_by.setdefault(h.name, 0)
+        self._missed_by.setdefault(h.name, 0)
+        self._was_alive[h.name] = h.alive()
+
+    def remove_replica(self, name: str) -> None:
+        """Deregister a replica (after a decommission completes or a
+        canary rolls back).  The dead handle's raced completion events
+        drain first and are HONORED; anything still assigned requeues
+        exactly once through the ledger.  History counters persist so
+        the bench/judge can still read what the replica served."""
+        for i, h in enumerate(self.replicas):
+            if h.name != name:
+                continue
+            self.on_replica_down(name)
+            del self.replicas[i]
+            self._was_alive.pop(name, None)
+            return
+        raise KeyError(f"unknown replica {name!r}")
+
+    def set_traffic(self, primary_generation: int,
+                    canary_generation: Optional[int] = None,
+                    canary_fraction: float = 0.0) -> None:
+        """Generation-aware traffic shift.  ``canary_fraction`` of rids
+        (a deterministic rid-modulo slice, so the split is reproducible
+        and survives requeues) prefer ``canary_generation``; everything
+        else prefers ``primary_generation``.  Preference, not partition:
+        when no replica of the desired generation is accepting,
+        placement falls back to any accepting replica — a rollout must
+        never become downtime."""
+        self._primary_gen = int(primary_generation)
+        if canary_generation is None or canary_fraction <= 0.0:
+            self._canary = None
+        else:
+            self._canary = (int(canary_generation),
+                            min(1.0, float(canary_fraction)))
+
+    def _desired_gen(self, req: FleetRequest) -> int:
+        if self._canary is not None:
+            gen, frac = self._canary
+            # Knuth multiplicative hash, NOT rid % 1000 directly:
+            # rids issue sequentially, so an unhashed modulo slice is a
+            # PREFIX of rid space — requests submitted before the
+            # canary came up, i.e. zero canary traffic.  The hash
+            # spreads the slice uniformly over arrival order while
+            # staying deterministic per rid (a requeued request keeps
+            # its generation preference).
+            if ((req.rid * 2654435761) % 1000) < int(round(frac * 1000)):
+                return gen
+        return self._primary_gen
+
     # ---- placement -----------------------------------------------------
     def _est_wait_ms(self, h: ReplicaHandle,
                      sig: Optional[LoadSignal]) -> Optional[float]:
@@ -706,6 +822,7 @@ class FleetRouter:
         both directions)."""
         best = None
         best_key = None
+        desired_gen = self._desired_gen(req)
         for h in self.replicas:
             if not h.accepting():
                 continue
@@ -728,7 +845,11 @@ class FleetRouter:
                 feasible = (est is None
                             or est * self.feasibility_margin
                             <= slack_ms)
-            key = (not feasible, occ, util, h.name)
+            # generation preference ranks BELOW feasibility (a rollout
+            # must not turn deadlines into misses) and ABOVE load (the
+            # canary slice really lands on the canary when it can)
+            off_gen = getattr(h, "generation", 0) != desired_gen
+            key = (not feasible, off_gen, occ, util, h.name)
             if best_key is None or key < best_key:
                 best, best_key = h, key
         return best
@@ -793,11 +914,25 @@ class FleetRouter:
         toks = [int(t) for t in rec["tokens"]]
         self._results[rid] = toks
         req.n_generated = len(toks) - len(req.prompt)
+        req.generation = getattr(h, "generation", 0)
         self.completed += 1
         self._completed_by[h.name] = (
             self._completed_by.get(h.name, 0) + 1)
+        self._completed_by_gen[req.generation] = (
+            self._completed_by_gen.get(req.generation, 0) + 1)
+        if req.deadline_missed:
+            self.deadline_misses += 1
+            self._missed_by[h.name] = self._missed_by.get(h.name, 0) + 1
         if req.ttft_ms is not None:
             self._ttft.add(req.ttft_ms)
+        # bounded recent-completions window: the autopilot's canary
+        # judge needs WINDOWED per-generation latency, which a lifetime
+        # sketch cannot answer (a fresh replica's first-compile TTFTs
+        # would dominate its p50 forever)
+        self.recent.append({
+            "t": req.t_done, "replica": h.name,
+            "generation": req.generation, "ttft_ms": req.ttft_ms,
+            "missed": bool(req.deadline_missed)})
         return rid
 
     def _requeue_one(self, rid: int, from_name: str) -> None:
@@ -878,7 +1013,8 @@ class FleetRouter:
                          "rejected_infeasible": self.rejected_infeasible,
                          "requeued": self.requeued,
                          "completed": self.completed,
-                         "replica_deaths": self.replica_deaths},
+                         "replica_deaths": self.replica_deaths,
+                         "deadline_misses": self.deadline_misses},
             "gauges": {"queue_depth": self._q_gauge.to_dict()},
             "now": {"queue_depth": len(self.queue),
                     "in_flight": self.in_flight()},
@@ -909,7 +1045,9 @@ def worker_cmd(python: str, *, replica: int, model: Dict[str, Any],
                serve: Dict[str, Any], telemetry_dir: Optional[str],
                status_every: int = 5, step_sleep_ms: float = 0.0,
                tp: int = 0, crash_at_request: int = 0,
-               prewarm: bool = False) -> List[str]:
+               prewarm: bool = False, generation: int = 0,
+               ckpt: Optional[str] = None,
+               faults: Optional[str] = None) -> List[str]:
     """The replica worker command line (see :func:`worker_main`)."""
     cmd = [python, "-m",
            "neural_networks_parallel_training_with_mpi_tpu.serve"
@@ -934,7 +1072,65 @@ def worker_cmd(python: str, *, replica: int, model: Dict[str, Any],
         cmd += ["--crash-at-request", str(int(crash_at_request))]
     if prewarm:
         cmd += ["--prewarm"]
+    if generation:
+        cmd += ["--generation", str(int(generation))]
+    if ckpt:
+        cmd += ["--ckpt", str(ckpt)]
+    if faults:
+        cmd += ["--faults", str(faults)]
     return cmd
+
+
+def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
+                   ckpt: Optional[str] = None,
+                   faults: Optional[str] = None,
+                   step_sleep_ms: Optional[float] = None,
+                   crash_at_request: int = 0):
+    """Build one subprocess replica's (handle, ChildSpec, telemetry dir)
+    from a fleet spawn config — the per-replica constructor shared by
+    :func:`launch_fleet` and :meth:`Fleet.add_replica` (the autopilot's
+    scale-out / rollout path).  Generation-g replicas get the strided id
+    ``g * GEN_STRIDE + k`` (flow-trace/telemetry attribution, module
+    header)."""
+    import subprocess
+
+    from ..train.resilience import ChildSpec
+
+    rid = int(generation) * GEN_STRIDE + int(k)
+    name = f"replica-{rid}"
+    tdir = (os.path.join(cfg["telemetry_root"], name)
+            if cfg["telemetry_root"] else None)
+    handle = ProcReplica(name=name, generation=generation)
+    cmd = worker_cmd(
+        cfg["python"], replica=rid, model=cfg["model"],
+        serve=cfg["serve"], telemetry_dir=tdir,
+        status_every=cfg["status_every"],
+        step_sleep_ms=(cfg["step_sleep_ms"] if step_sleep_ms is None
+                       else step_sleep_ms),
+        tp=cfg["tp"], crash_at_request=crash_at_request,
+        prewarm=cfg["prewarm"], generation=generation, ckpt=ckpt,
+        faults=faults)
+    env = {"NNPT_PROCESS_ID": str(rid),
+           "PYTHONPATH": cfg["repo_root"] + os.pathsep
+           + os.environ.get("PYTHONPATH", "")}
+
+    def spawn(spec, env, _cmd=cmd):
+        return subprocess.Popen(
+            _cmd, env=env, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, text=True, bufsize=1)
+
+    def on_spawn(spec, proc, inc, _h=handle):
+        _h.attach(proc, inc)
+
+    spec = ChildSpec(
+        name=name, cmd=cmd, role="serve-replica", env=env,
+        max_restarts=cfg["max_restarts"], backoff=cfg["backoff"],
+        backoff_cap=cfg["backoff_cap"],
+        heartbeat_path=(os.path.join(
+            tdir, f"heartbeat-serve-p{rid}.json") if tdir else None),
+        heartbeat_timeout=cfg["heartbeat_timeout"],
+        spawn=spawn, on_spawn=on_spawn)
+    return handle, spec, tdir
 
 
 @dataclass
@@ -949,13 +1145,23 @@ class Fleet:
     handles: List[ProcReplica]
     telemetry_dirs: List[str] = field(default_factory=list)
     events: List[dict] = field(default_factory=list)
+    spawn_cfg: Optional[Dict[str, Any]] = None   # launch_fleet's recipe,
+    #   so add_replica can scale out / spawn generations at runtime
+    autopilot: Any = None    # attached control loop, ticked from pump()
+    _next_index: int = 0     # next per-generation replica index k
 
     def pump(self) -> List[int]:
         for e in self.supervisor.poll():
             self.events.append(e)
             if e["event"] in ("exit", "hang_kill"):
                 self.router.on_replica_down(e["child"])
-        return self.router.pump()
+        done = self.router.pump()
+        if self.autopilot is not None:
+            # the control loop rides the service loop: no extra thread,
+            # so its steady-state cost is visible (and priced) in the
+            # same tokens/s the fleet reports (bench --autopilot)
+            self.autopilot.tick()
+        return done
 
     # client surface: a Fleet IS a router whose replicas happen to be
     # supervised subprocesses — load drivers (serve.loadgen.
@@ -984,6 +1190,71 @@ class Fleet:
     @property
     def requeued(self) -> int:
         return self.router.requeued
+
+    # ---- runtime membership (the autopilot's actuation surface) --------
+    def add_replica(self, *, generation: int = 0,
+                    ckpt: Optional[str] = None,
+                    faults: Optional[str] = None,
+                    step_sleep_ms: Optional[float] = None
+                    ) -> ProcReplica:
+        """Spawn ONE new supervised replica at runtime from the stored
+        launch recipe: scale-out (same generation) or a rollout spawning
+        ``generation`` from a verified weight snapshot (``ckpt``).  The
+        replica starts taking traffic when its ready event lands;
+        ``faults`` injects the fleet fault kinds (utils/faults.py) into
+        just this worker."""
+        if self.spawn_cfg is None:
+            raise RuntimeError(
+                "this Fleet was not built by launch_fleet (no spawn "
+                "config to scale out from)")
+        k = self._next_index
+        self._next_index += 1
+        handle, spec, tdir = _spawn_replica(
+            self.spawn_cfg, k, generation=generation, ckpt=ckpt,
+            faults=faults, step_sleep_ms=step_sleep_ms)
+        self.handles.append(handle)
+        if tdir:
+            self.telemetry_dirs.append(tdir)
+        self.supervisor.add_child(spec)    # launches immediately
+        self.router.add_replica(handle, generation=generation)
+        return handle
+
+    def decommission(self, name: str) -> bool:
+        """Begin intentional removal: retire the child at the supervisor
+        (its next exit is terminal — no relaunch, no budget burn), then
+        ask the worker to drain and exit 47.  Returns whether the
+        decommission op reached the worker's pipe; the caller watches
+        :meth:`replica_done` and escalates to :meth:`force_kill` if the
+        drain stalls."""
+        self.supervisor.retire(name)
+        for h in self.handles:
+            if h.name == name:
+                return h.request_decommission()
+        return False
+
+    def force_kill(self, name: str) -> None:
+        """Stalled-drain escalation: SIGKILL the (already retired)
+        child.  The router's ledger requeues its in-flight work exactly
+        once; the retirement keeps the supervisor from relaunching it."""
+        proc = self.supervisor.proc(name)
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+    def replica_done(self, name: str) -> Optional[int]:
+        """Final exit code once the child will never run again (None
+        while it is still alive or could relaunch)."""
+        return self.supervisor.done(name)
+
+    def remove_replica(self, name: str) -> None:
+        """Forget a terminal replica: router deregistration (raced
+        completions honored, leftovers requeued once) + supervisor
+        bookkeeping cleanup + handle removal."""
+        self.router.remove_replica(name)
+        try:
+            self.supervisor.remove_child(name)
+        except (KeyError, ValueError):
+            pass
+        self.handles = [h for h in self.handles if h.name != name]
 
     def wait_ready(self, timeout_s: float = 180.0) -> None:
         """Block until every replica has compiled + reported ready (or
@@ -1030,48 +1301,28 @@ def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
     telemetry dir under ``telemetry_root`` (``replica-K/``) and a
     distinct ``NNPT_PROCESS_ID`` so heartbeats, rollup identities and
     flow-trace ids never collide (tools/obs_agg.py merges the dirs)."""
-    import subprocess
-
-    from ..train.resilience import ChildSpec, GroupSupervisor
+    from ..train.resilience import GroupSupervisor
 
     python = python or sys.executable
-    handles: List[ProcReplica] = []
-    specs: List[ChildSpec] = []
-    tdirs: List[str] = []
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    cfg = dict(python=python, model=dict(model), serve=dict(serve),
+               telemetry_root=telemetry_root, status_every=status_every,
+               step_sleep_ms=step_sleep_ms, tp=tp,
+               max_restarts=max_restarts, backoff=backoff,
+               backoff_cap=backoff_cap,
+               heartbeat_timeout=heartbeat_timeout, prewarm=prewarm,
+               repo_root=repo_root)
+    handles: List[ProcReplica] = []
+    specs = []
+    tdirs: List[str] = []
     for k in range(int(n_replicas)):
-        tdir = (os.path.join(telemetry_root, f"replica-{k}")
-                if telemetry_root else None)
-        tdirs.append(tdir)
-        handle = ProcReplica(name=f"replica-{k}")
+        handle, spec, tdir = _spawn_replica(
+            cfg, k, crash_at_request=(crash_at_request
+                                      if k == 0 else 0))
         handles.append(handle)
-        cmd = worker_cmd(python, replica=k, model=model, serve=serve,
-                         telemetry_dir=tdir, status_every=status_every,
-                         step_sleep_ms=step_sleep_ms, tp=tp,
-                         crash_at_request=(crash_at_request
-                                           if k == 0 else 0),
-                         prewarm=prewarm)
-        env = {"NNPT_PROCESS_ID": str(k),
-               "PYTHONPATH": repo_root + os.pathsep
-               + os.environ.get("PYTHONPATH", "")}
-
-        def spawn(spec, env, _cmd=cmd):
-            return subprocess.Popen(
-                _cmd, env=env, stdin=subprocess.PIPE,
-                stdout=subprocess.PIPE, text=True, bufsize=1)
-
-        def on_spawn(spec, proc, inc, _h=handle):
-            _h.attach(proc, inc)
-
-        specs.append(ChildSpec(
-            name=f"replica-{k}", cmd=cmd, role="serve-replica",
-            env=env, max_restarts=max_restarts, backoff=backoff,
-            backoff_cap=backoff_cap,
-            heartbeat_path=(os.path.join(
-                tdir, f"heartbeat-serve-p{k}.json") if tdir else None),
-            heartbeat_timeout=heartbeat_timeout,
-            spawn=spawn, on_spawn=on_spawn))
+        specs.append(spec)
+        tdirs.append(tdir)
     sup = GroupSupervisor(specs, log=log)
     router_tdir = (os.path.join(telemetry_root, "router")
                    if telemetry_root else None)
@@ -1079,7 +1330,8 @@ def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
                          **(router_kwargs or {}))
     fleet = Fleet(router=router, supervisor=sup, handles=handles,
                   telemetry_dirs=[d for d in tdirs if d]
-                  + ([router_tdir] if router_tdir else []))
+                  + ([router_tdir] if router_tdir else []),
+                  spawn_cfg=cfg, _next_index=int(n_replicas))
     sup.start()
     return fleet
 
@@ -1135,6 +1387,23 @@ def _worker_argparser():
     ap.add_argument("--crash-at-request", type=int, default=0,
                     help="fault injection: os._exit(17) when the Nth "
                          "submit arrives (chaos tests / example 23)")
+    ap.add_argument("--generation", type=int, default=0,
+                    help="weight generation this replica serves "
+                         "(stamped into ready/status events; the "
+                         "replica id already encodes it as "
+                         "id // GEN_STRIDE)")
+    ap.add_argument("--ckpt", default=None,
+                    help="load params from this weight snapshot dir "
+                         "(serve.autopilot.save_weight_snapshot "
+                         "layout); manifest-verified before use — any "
+                         "integrity/shape failure exits EXIT_ANOMALY "
+                         "(44, deterministic no-retry), which is what "
+                         "drives a canary rollback")
+    ap.add_argument("--faults", default=None,
+                    help="utils/faults.py spec for the FLEET kinds "
+                         "(replica_kill@N, stall_drain@N-M); the step "
+                         "counter is this worker's accepted-submit "
+                         "count, proc= matches --replica")
     ap.add_argument("--prewarm", action="store_true",
                     help="pay every prefill-bucket + decode compile "
                          "BEFORE reporting ready (serve.loadgen."
@@ -1173,6 +1442,30 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
     def emit(obj: Dict[str, Any]) -> None:
         proto.write(json.dumps(obj) + "\n")
         proto.flush()
+
+    if args.ckpt:
+        # rollout path: replace the seed-derived params with a VERIFIED
+        # weight snapshot.  Failure is a deterministic no-retry exit —
+        # relaunching would re-read the same bad bytes; the autopilot
+        # reads the stopped child as "canary never came up" and rolls
+        # back with the old generation undisturbed.
+        try:
+            from .autopilot import load_weight_snapshot
+
+            params = load_weight_snapshot(args.ckpt, params)
+            print(f"[worker {args.replica}] loaded weight snapshot "
+                  f"{args.ckpt}", file=sys.stderr, flush=True)
+        except Exception as exc:
+            emit({"ev": "load_error", "error": str(exc)[:500]})
+            print(f"[worker {args.replica}] checkpoint load failed: "
+                  f"{exc}", file=sys.stderr, flush=True)
+            from ..train.resilience import EXIT_ANOMALY
+
+            return EXIT_ANOMALY
+
+    from ..utils.faults import FaultPlan
+
+    fault_plan = FaultPlan.from_config(args.faults or "")
 
     engine: ReplicaHandle
     sched: Optional[Scheduler] = None
@@ -1258,7 +1551,7 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
         return ops, eof
 
     emit({"ev": "ready", "replica": args.replica, "pid": os.getpid(),
-          "tp": args.tp, "incarnation":
+          "tp": args.tp, "generation": args.generation, "incarnation":
           os.environ.get("NNPT_INCARNATION", "0")})
     submits_seen = 0
     ticks = 0
@@ -1282,6 +1575,16 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                         and submits_seen >= args.crash_at_request):
                     proto.flush()
                     os._exit(17)   # injected crash: SIGKILL-shaped
+                if fault_plan is not None and fault_plan.fire_if_due(
+                        "replica_kill", submits_seen,
+                        proc=args.replica):
+                    import signal as signal_lib
+
+                    print(f"[faults] replica_kill at submit "
+                          f"{submits_seen}: SIGKILL", file=sys.stderr,
+                          flush=True)
+                    proto.flush()
+                    os.kill(os.getpid(), signal_lib.SIGKILL)
                 req = FleetRequest(
                     rid=int(op["rid"]),
                     prompt=[int(t) for t in op["prompt"]],
@@ -1290,7 +1593,15 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                     t_submit=time.monotonic(), deadline=math.inf)
                 if not engine.submit(req):
                     emit({"ev": "reject", "rid": req.rid})
-            elif kind == "drain":
+            elif kind in ("drain", "decommission"):
+                if fault_plan is not None and fault_plan.fire_if_due(
+                        "stall_drain", submits_seen,
+                        proc=args.replica):
+                    # wedged-shutdown stand-in: the op is swallowed; the
+                    # autopilot's drain timeout must escalate to a kill
+                    print(f"[faults] stall_drain: ignoring {kind}",
+                          file=sys.stderr, flush=True)
+                    continue
                 if sched is not None:
                     reqs = sched.drain()
                     sched.server.allocator.assert_drained()
@@ -1298,6 +1609,16 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                     reqs = [{"rid": r, "prefilled": 0, "generated": 0}
                             for r in engine.take_assigned()]
                 emit({"ev": "drained", "requests": reqs})
+                if kind == "decommission":
+                    # intentional-decommission handshake: drained state
+                    # reported, now exit the code the (already retired)
+                    # supervisor treats as terminal without budget burn
+                    proto.flush()
+                    if sched is not None:
+                        sched.close()
+                    from ..train.resilience import EXIT_DECOMMISSION
+
+                    return EXIT_DECOMMISSION
             elif kind == "exit":
                 stop = True
         if stop:
@@ -1315,6 +1636,7 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 or now - last_status > 0.25):
             report = (sched.load_report() if sched is not None
                       else engine.load_report())
+            report["generation"] = args.generation
             emit({"ev": "status", "report": report})
             last_status = now
     if sched is not None:

@@ -53,6 +53,11 @@ code         meaning                                       supervisor
              or per-device strike budget exhausted
 46           capacity abort: healthy devices stayed below  stop
              --min_devices for the whole restart budget
+47           intentional decommission: the autopilot (or   stop
+             an operator) drained and retired this child
+             on purpose — relaunching would undo the
+             scale-in, and the exit must not burn the
+             restart budget
 other        crash (segfault, OOM, fault injection, ...)   retry
 ===========  ============================================  =========
 """
@@ -74,11 +79,16 @@ EXIT_PEER = 43      # a collective raised/timed out, or world formation
 EXIT_ANOMALY = 44   # ResilienceMonitor exhausted its rollback budget
 EXIT_SDC = 45       # deterministic replica divergence / SDC strike budget
 EXIT_CAPACITY = 46  # healthy capacity stayed below --min_devices
+EXIT_DECOMMISSION = 47  # intentional decommission: drained + retired on
+                        # purpose (serve.autopilot scale-in / rollout)
 
 # exit codes the supervisor must NOT retry: 0 is success; 44 and 45 are
 # deterministic training failures that a relaunch would only replay; 46
-# means the hardware floor cannot be met — relaunching cannot create chips
-_NO_RETRY = (EXIT_OK, EXIT_ANOMALY, EXIT_SDC, EXIT_CAPACITY)
+# means the hardware floor cannot be met — relaunching cannot create
+# chips; 47 is a decommission the control plane ASKED for — a relaunch
+# would undo the scale-in and burn budget on a healthy exit
+_NO_RETRY = (EXIT_OK, EXIT_ANOMALY, EXIT_SDC, EXIT_CAPACITY,
+             EXIT_DECOMMISSION)
 
 # exit codes that count toward the elastic peer-loss streak: explicit
 # peer loss, and hangs (a dead peer often presents as a stalled
@@ -758,6 +768,10 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                 log("[supervise] child exited 46 (capacity abort): the "
                     "healthy world is below --min_devices — not retrying "
                     "(a relaunch cannot create chips)")
+            elif rc == EXIT_DECOMMISSION:
+                log("[supervise] child exited 47 (decommission): drained "
+                    "and retired on purpose — not retrying (no restart "
+                    "budget burned)")
             else:
                 log("[supervise] child completed (exit 0)")
             return rc
@@ -950,6 +964,9 @@ class _ChildState:
     relaunch_at: Optional[float] = None   # pending backoff deadline
     final_rc: Optional[int] = None        # set once the child is done
     gave_up: bool = False
+    retired: bool = False          # next exit is TERMINAL whatever its rc
+    last_rc: Optional[int] = None  # most recent reaped rc (retire() uses
+                                   # it to finalize a pending relaunch)
     events: List[dict] = _field(default_factory=list)
 
 
@@ -997,11 +1014,53 @@ class GroupSupervisor:
         self.run_id = self._base_env.get(RUN_ID_ENV) or (
             f"run-{int(time.time())}-{_os.getpid()}")
         self._children = {s.name: _ChildState(spec=s) for s in specs}
+        self._started = False
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        self._started = True
         for st in self._children.values():
             self._launch(st)
+
+    def add_child(self, spec: ChildSpec) -> None:
+        """Register (and, once :meth:`start` has run, immediately launch)
+        a NEW child at runtime — the scale-out half of the autopilot
+        contract.  Names stay unique for the supervisor's lifetime."""
+        if spec.name in self._children:
+            raise ValueError(f"duplicate child name: {spec.name!r}")
+        st = _ChildState(spec=spec)
+        self._children[spec.name] = st
+        if self._started:
+            self._launch(st)
+
+    def retire(self, name: str) -> None:
+        """Mark a child so its NEXT exit is terminal regardless of rc —
+        no relaunch, no backoff burn.  The scale-in half of the autopilot
+        contract: retire first, then ask the child to drain and exit
+        (:data:`EXIT_DECOMMISSION`); if the drain stalls and the owner
+        has to SIGKILL, the signal death still must not relaunch the
+        replica the control plane just removed.  A retire that lands
+        while a relaunch backoff is pending cancels it and finalizes the
+        child at its last reaped rc."""
+        st = self._children[name]
+        st.retired = True
+        if st.relaunch_at is not None:
+            st.relaunch_at = None
+            st.final_rc = st.last_rc
+            self._log(f"[group] {st.spec.role}/{name}: retired while a "
+                      "relaunch was pending — relaunch cancelled")
+        else:
+            self._log(f"[group] {st.spec.role}/{name}: retired (next "
+                      "exit is terminal)")
+
+    def remove_child(self, name: str) -> None:
+        """Forget a TERMINAL child (stopped / gave up) so long-lived
+        fleets don't accrue bookkeeping for every replica ever retired.
+        Refuses to drop a child that could still run."""
+        st = self._children[name]
+        if st.final_rc is None and not st.gave_up:
+            raise ValueError(f"child {name!r} is not terminal")
+        del self._children[name]
 
     def _launch(self, st: _ChildState) -> None:
         spec = st.spec
@@ -1100,11 +1159,14 @@ class GroupSupervisor:
 
     def _after_exit(self, st: _ChildState, rc: int, ev) -> None:
         spec = st.spec
-        if rc in spec.no_retry:
+        st.last_rc = rc
+        if st.retired or rc in spec.no_retry:
             st.final_rc = rc
             ev(st, "stopped", rc=rc)
+            why = ("retired" if st.retired and rc not in spec.no_retry
+                   else "no-retry contract")
             self._log(f"[group] {spec.role}/{spec.name} exited {rc} "
-                      "(no-retry contract): stopped")
+                      f"({why}): stopped")
             return
         if st.restarts_used >= spec.max_restarts:
             st.gave_up = True

@@ -79,6 +79,23 @@ world):
                      under ``--elastic`` with repeated losses, degrades
                      through a topology probe.
 
+Fleet faults against a serving-fleet WORKER (serve/fleet.py's
+``worker_main`` consumes these via :meth:`FaultPlan.fire_if_due`; the
+"step" counter is the worker's accepted-submit count, and ``proc=K``
+matches the worker's ``--replica`` id rather than a jax process index):
+
+    ``replica_kill`` SIGKILL this replica on its Nth accepted submit —
+                     the mid-scale-out / mid-load dead-replica stand-in:
+                     the router must requeue its in-flight requests onto
+                     siblings and the supervisor must relaunch it under
+                     its own budget, without cascading.
+    ``stall_drain``  ignore drain/decommission requests while the window
+                     is open — the wedged-shutdown stand-in: the
+                     autopilot's drain timeout must escalate (retire +
+                     kill) instead of waiting forever, and the ledger
+                     must still requeue the stalled replica's in-flight
+                     work exactly once.
+
 options
     ``max=N``     fire at most N times over this process's lifetime
                   (in-memory counter) — lets a NaN window be *passable*
@@ -111,10 +128,13 @@ from typing import Dict, List, Optional
 ENV_VAR = "NNPT_FAULTS"
 KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
          "ckpt_ioerr", "bitflip", "desync", "peer_kill", "peer_hang",
-         "device_loss")
+         "device_loss", "replica_kill", "stall_drain")
 # kinds that perturb the train state (FaultPlan.apply_state) rather than
 # the batch/process (FaultPlan.apply)
 STATE_KINDS = ("bitflip", "desync")
+# kinds a serving-fleet worker polls via FaultPlan.fire_if_due — never
+# fired by the Trainer's apply/apply_state paths
+FLEET_KINDS = ("replica_kill", "stall_drain")
 
 
 def _process_index() -> int:
@@ -423,11 +443,31 @@ class FaultPlan:
                      else state._replace(opt_state=target))
         return state
 
+    def fire_if_due(self, kind: str, step: int,
+                    proc: Optional[int] = None) -> bool:
+        """Generic due-check for callers that own their own fault
+        semantics (the fleet worker's :data:`FLEET_KINDS`): True — and
+        the fault is marked fired — iff a matching spec is due at
+        ``step``.  ``proc`` is the CALLER's identity (a fleet worker
+        passes its ``--replica`` id, not jax's process index), matched
+        against the spec's ``proc=`` option when both are set."""
+        for f in self.faults:
+            if f.kind != kind:
+                continue
+            if (f.proc is not None and proc is not None
+                    and f.proc != proc):
+                continue
+            if not f.should_fire(step):
+                continue
+            f.mark_fired()
+            return True
+        return False
+
     def apply(self, step: int, batch: Dict,
               ckpt_dir: Optional[str] = None) -> Dict:
         for f in self.faults:
-            if f.kind in STATE_KINDS:
-                continue  # apply_state's job (det: step-build time)
+            if f.kind in STATE_KINDS or f.kind in FLEET_KINDS:
+                continue  # apply_state's / fire_if_due's job
             if f.proc is not None and _process_index() != f.proc:
                 continue  # another process is the victim
             if not f.should_fire(step):

@@ -1,0 +1,952 @@
+"""Fleet autopilot tests (serve/autopilot.py + the PR's seams).
+
+Core lane (fast, in-process):
+* weight snapshots — manifest-verified roundtrip; corruption, missing
+  leaves, and shape drift all refuse with ValueError.
+* exit-code contract extension — EXIT_DECOMMISSION (47) is terminal
+  for both ``supervise()`` and ``GroupSupervisor`` (no relaunch, no
+  backoff burn); crash codes still retry under capped backoff;
+  ``retire()`` makes ANY subsequent exit terminal (including SIGKILL)
+  and cancels a pending relaunch.
+* drain/death race regression — a replica whose ``drained`` report
+  races its process exit must not double-requeue in-flight work.
+* control loop — hysteresis holds, cooldown, bounded action backoff,
+  scale-out/in decisions, stalled-drain escalation, canary judge
+  promote/rollback — all on a fake-clock fleet stand-in over the REAL
+  ``FleetRouter``, so the actuation surface is the tested one.
+* generation-aware traffic — hashed canary slice, placement
+  preference, per-completion generation attribution.
+
+Slow/chaos lane (subprocess replicas, out of tier-1): the fleet fault
+kinds (``replica_kill``, ``stall_drain``) and the corrupted-canary
+rollback, end to end.
+"""
+
+import math
+import pathlib
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from neural_networks_parallel_training_with_mpi_tpu.models import (
+    Transformer, TransformerConfig,
+)
+from neural_networks_parallel_training_with_mpi_tpu.serve import (
+    Autopilot, AutopilotConfig, FleetRouter, InprocReplica, LoadSignal,
+    Scheduler, ServeConfig, launch_fleet, load_weight_snapshot,
+    make_requests, save_weight_snapshot,
+)
+from neural_networks_parallel_training_with_mpi_tpu.serve.fleet import (
+    GEN_STRIDE, ReplicaHandle,
+)
+from neural_networks_parallel_training_with_mpi_tpu.train.resilience import (
+    EXIT_ANOMALY, EXIT_DECOMMISSION, ChildSpec, GroupSupervisor,
+    supervise,
+)
+from neural_networks_parallel_training_with_mpi_tpu.utils import (
+    ckpt_manifest, prng,
+)
+
+pytestmark = pytest.mark.fleet
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+V = 64
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    return model, model.init(prng.init_key(0))
+
+
+def _sched(model, params, *, slots=4, queue_depth=16, replica=None):
+    return Scheduler(model, params, ServeConfig(
+        slots=slots, num_blocks=1 + slots * 4, block_size=16,
+        prefill_chunk=16, queue_depth=queue_depth, replica=replica))
+
+
+# ---------------------------------------------------------------------------
+# weight snapshots
+# ---------------------------------------------------------------------------
+
+def test_snapshot_roundtrip_and_refusals(tmp_path):
+    params = {"w": np.ones((3, 4), np.float32),
+              "b": {"x": np.arange(5, dtype=np.int32)}}
+    snap = save_weight_snapshot(tmp_path, params, step=3,
+                                meta={"note": "t"})
+    assert pathlib.Path(snap).name == "ckpt-3"
+    assert ckpt_manifest.verify(snap) == []
+    out = load_weight_snapshot(snap, params)
+    assert np.array_equal(out["w"], params["w"])
+    assert np.array_equal(out["b"]["x"], params["b"]["x"])
+    # missing leaf: the template grew a head the snapshot never had
+    grown = dict(params, extra=np.zeros((2,), np.float32))
+    with pytest.raises(ValueError, match="missing leaf"):
+        load_weight_snapshot(snap, grown)
+    # shape drift
+    drifted = dict(params, w=np.ones((3, 5), np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        load_weight_snapshot(snap, drifted)
+    # payload corruption: the manifest's sha256 catches it BEFORE any
+    # bytes are deserialized
+    p = pathlib.Path(snap) / "weights.npz"
+    raw = bytearray(p.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    p.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="verification"):
+        load_weight_snapshot(snap, params)
+
+
+# ---------------------------------------------------------------------------
+# exit-code contract: 47 is terminal (satellite: supervise coverage)
+# ---------------------------------------------------------------------------
+
+def test_supervise_decommission_terminal_crash_still_retries():
+    """supervise(): 47 stops immediately (one attempt, no backoff
+    burn); a crash code still retries with the capped exponential
+    backoff schedule."""
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        resilience as res,
+    )
+
+    def run(code_seq, **kw):
+        it = iter(code_seq)
+        calls, sleeps = [], []
+
+        def fake_call(cmd, env=None):
+            rc = next(it)
+            calls.append(rc)
+            return rc
+
+        orig = res.subprocess.call
+        res.subprocess.call = fake_call
+        try:
+            rc = supervise(["x"], _sleep=sleeps.append,
+                           _rand=lambda: 0.0, **kw)
+        finally:
+            res.subprocess.call = orig
+        return rc, calls, sleeps
+
+    rc, calls, sleeps = run([EXIT_DECOMMISSION], max_restarts=3,
+                            backoff=0.5)
+    assert rc == EXIT_DECOMMISSION
+    assert len(calls) == 1          # terminal: no relaunch attempt
+    assert sleeps == []             # and no backoff burned
+    # the crash path is unchanged: capped exponential backoff, budget
+    # spent, last code surfaced
+    rc, calls, sleeps = run([1, 1, 1], max_restarts=2, backoff=0.5,
+                            backoff_cap=0.6)
+    assert rc == 1 and len(calls) == 3
+    assert len(sleeps) == 2
+    assert sleeps[0] == pytest.approx(0.5)
+    assert sleeps[1] == pytest.approx(0.6)   # doubled, then capped
+
+
+def _pump_group(g, until, timeout_s=15.0):
+    evs = []
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        evs += g.poll()
+        if until(evs):
+            return evs
+        time.sleep(0.02)
+    raise AssertionError(f"condition never met; events={evs}")
+
+
+def test_group_supervisor_exit47_terminal():
+    spec = ChildSpec(name="decomm",
+                     cmd=[sys.executable, "-c",
+                          "raise SystemExit(47)"],
+                     max_restarts=3, backoff=0.05)
+    g = GroupSupervisor([spec], log=lambda m: None)
+    g.start()
+    evs = _pump_group(g, lambda evs: not g.running())
+    kinds = [(e["child"], e["event"]) for e in evs]
+    assert ("decomm", "stopped") in kinds
+    assert ("decomm", "relaunch") not in kinds
+    assert g.done("decomm") == EXIT_DECOMMISSION
+
+
+def test_group_supervisor_retire_makes_any_exit_terminal():
+    """retire(): the autopilot marks a child decommissioned BEFORE
+    asking it to drain; even a SIGKILL escalation (rc outside the
+    no-retry set) must then stop, not relaunch."""
+    spec = ChildSpec(name="victim",
+                     cmd=[sys.executable, "-c",
+                          "import time; time.sleep(60)"],
+                     max_restarts=3, backoff=0.05)
+    g = GroupSupervisor([spec], log=lambda m: None)
+    g.start()
+    try:
+        g.retire("victim")
+        g.proc("victim").kill()
+        evs = _pump_group(g, lambda evs: not g.running())
+        kinds = [(e["child"], e["event"]) for e in evs]
+        assert ("victim", "stopped") in kinds
+        assert ("victim", "relaunch") not in kinds
+        assert g.done("victim") is not None
+    finally:
+        g.terminate_all()
+
+
+def test_group_supervisor_retire_cancels_pending_relaunch():
+    """A child sitting in its backoff window when retire() lands must
+    finalize at its last exit code instead of relaunching."""
+    spec = ChildSpec(name="crashy",
+                     cmd=[sys.executable, "-c",
+                          "raise SystemExit(9)"],
+                     max_restarts=5, backoff=30.0, backoff_cap=30.0)
+    g = GroupSupervisor([spec], log=lambda m: None)
+    g.start()
+    try:
+        _pump_group(g, lambda evs: any(e["event"] == "exit"
+                                       for e in evs))
+        # now inside the 30s backoff window: relaunch is pending
+        g.retire("crashy")
+        evs = _pump_group(g, lambda evs: not g.running(),
+                          timeout_s=5.0)
+        assert not any(e["event"] == "relaunch" for e in evs)
+        assert g.done("crashy") == 9
+    finally:
+        g.terminate_all()
+
+
+def test_group_supervisor_add_and_remove_child():
+    g = GroupSupervisor([], log=lambda m: None)
+    g.start()
+    try:
+        g.add_child(ChildSpec(
+            name="late", cmd=[sys.executable, "-c",
+                              "raise SystemExit(0)"],
+            max_restarts=0, backoff=0.05))
+        with pytest.raises(ValueError):
+            g.add_child(ChildSpec(name="late", cmd=["x"]))
+        _pump_group(g, lambda evs: g.done("late") is not None)
+        g.remove_child("late")
+        with pytest.raises(KeyError):
+            g.done("late")
+    finally:
+        g.terminate_all()
+
+
+# ---------------------------------------------------------------------------
+# drain/death race regression (satellite)
+# ---------------------------------------------------------------------------
+
+class _RacyHandle(ReplicaHandle):
+    """Completion events buffer like a subprocess pipe; ``drained`` can
+    be populated like a worker's consumed-token report."""
+
+    def __init__(self, name="racy"):
+        self.name = name
+        self._assigned = {}
+        self.events = []
+        self.drained = None
+        self._alive = True
+
+    def alive(self):
+        return self._alive
+
+    def accepting(self):
+        return self._alive
+
+    def load(self):
+        return LoadSignal.from_report({
+            "kind": "rollup", "role": "serve",
+            "now": {"queue_depth": 0,
+                    "in_flight": len(self._assigned),
+                    "free_slots": max(0, 4 - len(self._assigned)),
+                    "slots": 4, "queue_cap": 16, "free_blocks": 100,
+                    "block_utilization": 0.0}})
+
+    def submit(self, req):
+        if not self._alive:
+            return False
+        self._assigned[req.rid] = req
+        return True
+
+    def pump(self):
+        out, self.events = self.events, []
+        for rec in out:
+            self._assigned.pop(int(rec["rid"]), None)
+        return out
+
+    def assigned(self):
+        return list(self._assigned)
+
+    def take_assigned(self):
+        rids = list(self._assigned)
+        self._assigned.clear()
+        return rids
+
+
+def test_drained_report_racing_death_requeues_exactly_once(lm):
+    """REGRESSION (drain/death race): a decommissioned replica emits
+    its ``drained`` consumed-token report and exits; the death notice
+    arrives with the report still buffered.  In-flight requests must
+    requeue EXACTLY once — the drained report is observability, never a
+    second requeue source — and a completion that raced the exit is
+    honored, not re-run."""
+    model, params = lm
+    racy = _RacyHandle()
+    router = FleetRouter([racy], queue_depth=16)
+    rids = [router.submit([1 + i, 2], 3) for i in range(4)]
+    router.pump()
+    assert sorted(racy.assigned()) == sorted(rids)
+    # worker story: completed rids[0], drained the rest, exited 47
+    racy.events.append({"ev": "done", "rid": rids[0],
+                        "tokens": [1, 2, 9], "ttft_ms": 1.0,
+                        "itl_ms": 1.0})
+    racy._assigned.pop(rids[0])
+    racy.drained = [{"rid": rids[0], "prompt": [1, 2], "max_new": 3,
+                     "slo_ms": None}]     # stale: includes the done one
+    racy._alive = False
+    router.on_replica_down(racy.name)
+    assert router.requeued == 3           # exactly the in-flight set
+    assert racy.drained is None           # consumed as observability
+    # idempotent: a second death notice must not requeue again
+    router.on_replica_down(racy.name)
+    assert router.requeued == 3
+    # the raced completion surfaces from the next pump, never re-runs
+    done = router.pump()
+    assert rids[0] in done
+    assert router.done(rids[0])
+    assert router.result(rids[0]) == [1, 2, 9]   # result() consumes
+    # the requeued three complete on a replacement replica
+    sink = InprocReplica(_sched(model, params, replica=1), name="sink")
+    router.add_replica(sink)
+    for _ in range(500):
+        router.pump()
+        if all(router.done(r) for r in rids[1:]):
+            break
+    assert all(router.done(r) for r in rids[1:])
+    assert router.requeued == 3           # still exactly once
+    sink.close()
+
+
+# ---------------------------------------------------------------------------
+# control loop on a fake clock (the real FleetRouter is the substrate)
+# ---------------------------------------------------------------------------
+
+class _CtrlReplica(ReplicaHandle):
+    """A load-signal stub whose occupancy/readiness the test scripts."""
+
+    def __init__(self, name, generation=0, slots=4, in_flight=0,
+                 ready=True):
+        self.name = name
+        self.generation = generation
+        self.slots = slots
+        self.in_flight = in_flight
+        self.ready = ready
+        self.report = None
+
+    def alive(self):
+        return True
+
+    def accepting(self):
+        return self.ready
+
+    def load(self):
+        return LoadSignal.from_report({
+            "kind": "rollup", "role": "serve",
+            "now": {"queue_depth": 0, "in_flight": self.in_flight,
+                    "free_slots": max(0, self.slots - self.in_flight),
+                    "slots": self.slots, "queue_cap": 16,
+                    "free_blocks": 100, "block_utilization": 0.0}})
+
+    def submit(self, req):
+        return False
+
+    def pump(self):
+        return []
+
+    def assigned(self):
+        return []
+
+    def take_assigned(self):
+        return []
+
+
+class _FakeFleet:
+    """The Fleet actuation surface over a real router, with scripted
+    process lifecycle (spawn/exit) so no subprocess is needed."""
+
+    def __init__(self, router):
+        self.router = router
+        self.spawned = []
+        self.decommissioned = []
+        self.killed = []
+        self.done_rc = {}
+        self.fail_spawn = False
+        self._k = len(router.replicas)
+
+    def add_replica(self, *, generation=0, ckpt=None, faults=None,
+                    step_sleep_ms=None):
+        if self.fail_spawn:
+            raise RuntimeError("spawn refused")
+        rid = generation * GEN_STRIDE + self._k
+        self._k += 1
+        h = _CtrlReplica(f"replica-{rid}", generation=generation,
+                         ready=False)
+        h.ckpt = ckpt
+        self.router.add_replica(h, generation=generation)
+        self.spawned.append(h)
+        return h
+
+    def decommission(self, name):
+        self.decommissioned.append(name)
+        return True
+
+    def force_kill(self, name):
+        self.killed.append(name)
+
+    def replica_done(self, name):
+        return self.done_rc.get(name)
+
+    def remove_replica(self, name):
+        try:
+            self.router.remove_replica(name)
+        except KeyError:
+            pass
+
+
+def _autopilot(handles, cfg, t0=0.0):
+    clock = [t0]
+    router = FleetRouter(handles, queue_depth=64)
+    fleet = _FakeFleet(router)
+    ap = Autopilot(fleet, cfg, now_fn=lambda: clock[0])
+    return ap, fleet, router, clock
+
+
+def _actions(ap):
+    return [d["action"] for d in ap.decisions]
+
+
+def test_scale_out_requires_hold_then_fires_once():
+    """Hysteresis: the high signal must HOLD scale_out_hold_s — a blip
+    resets the timer; after the action, cooldown guards the next."""
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=3,
+                          interval_s=0.0, scale_out_hold_s=1.0,
+                          cooldown_s=5.0)
+    h = _CtrlReplica("replica-0", in_flight=8)      # occupancy 2.0
+    ap, fleet, router, clock = _autopilot([h], cfg)
+    ap.tick()
+    assert _actions(ap) == []          # high noted, hold not met
+    clock[0] = 0.6
+    h.in_flight = 2                    # blip down: occ 0.5, mid-band
+    ap.tick()
+    clock[0] = 1.2                     # 1.2s since t=0 but hold RESET
+    h.in_flight = 8
+    ap.tick()
+    assert _actions(ap) == []
+    clock[0] = 2.3                     # held high 1.1s since t=1.2
+    ap.tick()
+    assert _actions(ap) == ["scale_out"]
+    assert len(fleet.spawned) == 1
+    assert fleet.spawned[0].generation == 0
+    # still high, but one action is in flight + cooldown: no second
+    clock[0] = 2.5
+    ap.tick()
+    assert _actions(ap) == ["scale_out"]
+    # new replica reports ready -> reaction decision with timing
+    fleet.spawned[0].ready = True
+    clock[0] = 3.0
+    ap.tick()
+    assert _actions(ap)[-1] == "scale_out_ready"
+    assert ap.decisions[-1]["reaction_s"] == pytest.approx(0.7)
+
+
+def test_scale_out_failure_arms_exponential_backoff():
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=3,
+                          interval_s=0.0, scale_out_hold_s=0.5,
+                          cooldown_s=0.0, action_backoff_s=1.0,
+                          action_backoff_cap_s=2.5)
+    h = _CtrlReplica("replica-0", in_flight=8)
+    ap, fleet, router, clock = _autopilot([h], cfg)
+    fleet.fail_spawn = True
+    ap.tick()
+    clock[0] = 0.6
+    ap.tick()
+    assert _actions(ap) == ["action_backoff"]
+    assert ap.decisions[-1]["backoff_s"] == pytest.approx(1.0)
+    clock[0] = 2.3                     # past backoff; hold since 0.6
+    ap.tick()
+    clock[0] = 3.0
+    ap.tick()
+    assert ap.decisions[-1]["backoff_s"] == pytest.approx(2.0)
+    clock[0] = 6.0
+    ap.tick()
+    clock[0] = 7.0
+    ap.tick()
+    assert ap.decisions[-1]["backoff_s"] == pytest.approx(2.5)  # cap
+
+
+def test_scale_in_decommissions_newest_and_respects_min():
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=2,
+                          interval_s=0.0, scale_in_hold_s=1.0,
+                          cooldown_s=0.0, drain_timeout_s=5.0)
+    a = _CtrlReplica("replica-0", in_flight=0)
+    b = _CtrlReplica("replica-1", in_flight=0)
+    ap, fleet, router, clock = _autopilot([a, b], cfg)
+    ap.tick()
+    clock[0] = 1.1
+    ap.tick()
+    assert _actions(ap) == ["scale_in"]
+    assert fleet.decommissioned == ["replica-1"]    # newest out first
+    # drain completes -> removed from the router, decision carries rc
+    fleet.done_rc["replica-1"] = EXIT_DECOMMISSION
+    clock[0] = 1.5
+    ap.tick()
+    assert _actions(ap)[-1] == "drained"
+    assert ap.decisions[-1]["rc"] == EXIT_DECOMMISSION
+    assert [h.name for h in router.replicas] == ["replica-0"]
+    # at min_replicas now: the persisting low signal must NOT shrink
+    clock[0] = 10.0
+    ap.tick()
+    clock[0] = 12.0
+    ap.tick()
+    assert "scale_in" not in _actions(ap)[1:]
+
+
+def test_stalled_drain_escalates_to_force_kill():
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=2,
+                          interval_s=0.0, scale_in_hold_s=0.5,
+                          cooldown_s=0.0, drain_timeout_s=2.0)
+    a = _CtrlReplica("replica-0")
+    b = _CtrlReplica("replica-1")
+    ap, fleet, router, clock = _autopilot([a, b], cfg)
+    ap.tick()
+    clock[0] = 0.6
+    ap.tick()
+    assert fleet.decommissioned == ["replica-1"]
+    clock[0] = 2.7                     # past drain_timeout: escalate
+    ap.tick()
+    assert _actions(ap)[-1] == "drain_stalled_kill"
+    assert fleet.killed == ["replica-1"]
+    fleet.done_rc["replica-1"] = -9
+    clock[0] = 3.0
+    ap.tick()
+    assert ap.decisions[-1]["action"] == "drained"
+    assert ap.decisions[-1]["forced"] is True
+
+
+def test_rollout_rejects_unverified_snapshot(tmp_path):
+    """A bad manifest refuses BEFORE any spawn: the serving generation
+    is never touched."""
+    cfg = AutopilotConfig(interval_s=0.0)
+    a = _CtrlReplica("replica-0")
+    ap, fleet, router, clock = _autopilot([a], cfg)
+    bad = tmp_path / "nothing"
+    bad.mkdir()
+    assert ap.start_rollout(bad) is False
+    assert fleet.spawned == []
+    assert router._primary_gen == 0
+    assert _actions(ap) == ["rollout_rejected", "action_backoff"]
+
+
+def _good_snapshot(tmp_path):
+    return save_weight_snapshot(
+        tmp_path, {"w": np.ones((2, 2), np.float32)}, step=1)
+
+
+def test_canary_judge_promotes_healthy_generation(tmp_path):
+    cfg = AutopilotConfig(interval_s=0.0, canary_window_s=2.0,
+                          canary_min_completed=3, canary_fraction=0.25,
+                          canary_max_p50_ratio=3.0)
+    a = _CtrlReplica("replica-0")
+    b = _CtrlReplica("replica-1")
+    ap, fleet, router, clock = _autopilot([a, b], cfg)
+    assert ap.start_rollout(_good_snapshot(tmp_path)) is True
+    canary = fleet.spawned[0]
+    assert canary.generation == 1
+    assert canary.ckpt is not None
+    # not ready yet: no traffic shift
+    ap.tick()
+    assert router._canary is None
+    canary.ready = True
+    clock[0] = 1.0
+    ap.tick()
+    assert "canary_traffic" in _actions(ap)
+    assert router._canary == (1, 0.25)
+    assert router._primary_gen == 0    # canary slice only
+    # a healthy window: canary completions, no misses, comparable TTFT
+    router._completed_by[canary.name] = 6
+    for i in range(6):
+        router.recent.append({"t": 2.0 + 0.1 * i, "replica":
+                              canary.name, "generation": 1,
+                              "ttft_ms": 55.0, "missed": False})
+        router.recent.append({"t": 2.0 + 0.1 * i, "replica": "replica-0",
+                              "generation": 0, "ttft_ms": 50.0,
+                              "missed": False})
+    clock[0] = 3.1                     # window elapsed
+    ap.tick()
+    assert "canary_promote" in _actions(ap)
+    assert ap.decisions[-1]["p50_ratio"] == pytest.approx(1.1)
+    assert router._primary_gen == 1
+    assert router._canary is None
+    # old generation drains out; a replacement grew to the old width
+    assert sorted(fleet.decommissioned) == ["replica-0", "replica-1"]
+    assert len(fleet.spawned) == 2     # canary + 1 growth spawn
+    fleet.done_rc["replica-0"] = EXIT_DECOMMISSION
+    fleet.done_rc["replica-1"] = EXIT_DECOMMISSION
+    clock[0] = 3.5
+    ap.tick()
+    assert _actions(ap)[-1] == "rollout_complete"
+    assert {h.generation for h in router.replicas} == {1}
+
+
+def test_canary_judge_rolls_back_on_slo_burn(tmp_path):
+    cfg = AutopilotConfig(interval_s=0.0, canary_window_s=2.0,
+                          canary_min_completed=3,
+                          canary_max_miss_frac=0.25)
+    a = _CtrlReplica("replica-0")
+    ap, fleet, router, clock = _autopilot([a], cfg)
+    ap.start_rollout(_good_snapshot(tmp_path))
+    canary = fleet.spawned[0]
+    canary.ready = True
+    clock[0] = 1.0
+    ap.tick()
+    router._completed_by[canary.name] = 4
+    router._missed_by[canary.name] = 2           # 50% miss rate
+    clock[0] = 3.1
+    ap.tick()
+    assert _actions(ap)[-2] == "canary_rollback"
+    assert "SLO burn" in ap.decisions[-2]["reason"]
+    assert router._primary_gen == 0              # traffic restored
+    assert router._canary is None
+    assert fleet.decommissioned == [canary.name]
+    # backoff armed: an immediate retry is refused by the guard
+    assert ap.decisions[-1]["action"] == "action_backoff"
+
+
+def test_canary_death_rolls_back_old_gen_untouched(tmp_path):
+    """The corrupted-checkpoint shape: the canary child dies terminally
+    (exit 44 from a failed weight load) before ever serving — rollback,
+    with the old generation's replicas never decommissioned."""
+    cfg = AutopilotConfig(interval_s=0.0)
+    a = _CtrlReplica("replica-0")
+    ap, fleet, router, clock = _autopilot([a], cfg)
+    ap.start_rollout(_good_snapshot(tmp_path))
+    canary = fleet.spawned[0]
+    fleet.done_rc[canary.name] = EXIT_ANOMALY
+    clock[0] = 0.5
+    ap.tick()
+    roll = [d for d in ap.decisions
+            if d["action"] == "canary_rollback"]
+    assert roll and "died (rc 44)" in roll[0]["reason"]
+    assert router._primary_gen == 0
+    assert [h.name for h in router.replicas] == ["replica-0"]
+    assert fleet.decommissioned == []            # old gen untouched
+
+
+def test_rollout_in_progress_is_exclusive(tmp_path):
+    cfg = AutopilotConfig(interval_s=0.0)
+    a = _CtrlReplica("replica-0")
+    ap, fleet, router, clock = _autopilot([a], cfg)
+    ap.start_rollout(_good_snapshot(tmp_path))
+    with pytest.raises(RuntimeError, match="in progress"):
+        ap.start_rollout(_good_snapshot(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# generation-aware traffic + attribution
+# ---------------------------------------------------------------------------
+
+def test_canary_slice_is_uniform_over_sequential_rids():
+    """The hashed rid slice must hit ~fraction of ANY contiguous rid
+    range — sequentially issued rids included (a plain modulo slice
+    would put the whole canary share in a prefix that's already
+    served)."""
+    router = FleetRouter([_CtrlReplica("replica-0")], queue_depth=8)
+    router.set_traffic(0, canary_generation=1, canary_fraction=0.25)
+    for lo in (0, 500, 5000):
+        hits = sum(
+            1 for rid in range(lo, lo + 1000)
+            if router._desired_gen(
+                types.SimpleNamespace(rid=rid)) == 1)
+        assert 180 <= hits <= 320, (lo, hits)
+    router.set_traffic(0)              # canary cleared
+    assert all(router._desired_gen(types.SimpleNamespace(rid=r)) == 0
+               for r in range(100))
+
+
+def test_generation_attribution_and_placement_preference(lm):
+    """With a 100% canary slice every request prefers (and lands on)
+    the new generation; each completion carries its generation and the
+    per-generation ledger sums to the total."""
+    model, params = lm
+    old = InprocReplica(_sched(model, params, replica=0), name="old")
+    new = InprocReplica(_sched(model, params, replica=1), name="new")
+    router = FleetRouter([old], queue_depth=32)
+    router.add_replica(new, generation=1)
+    assert new.generation == 1
+    router.set_traffic(0, canary_generation=1, canary_fraction=1.0)
+    # <= the canary's slot budget, so generation preference is never
+    # forced to spill to the feasible-but-off-generation replica
+    plan = make_requests(2, 2, vocab_size=V, prompt_lens=(3, 8),
+                         max_new=(4, 6), seed=13)
+    rids = [router.submit(r["prompt"], r["max_new"])
+            for client in plan for r in client]
+    for _ in range(500):
+        router.pump()
+        if all(router.done(r) for r in rids):
+            break
+    assert all(router.done(r) for r in rids)
+    per_gen = router.per_generation_completed()
+    assert per_gen == {1: len(rids)}
+    assert all(router.reqs[r].generation == 1 for r in rids)
+    # flow-trace identity: strided replica ids recover the generation
+    assert (1 * GEN_STRIDE + 2) // GEN_STRIDE == 1
+    old.close()
+    new.close()
+
+
+def test_generation_preference_yields_to_availability(lm):
+    """A request whose preferred generation is saturated still serves:
+    generation ranks below feasibility/above load — never a partition
+    that strands traffic."""
+    model, params = lm
+    only = InprocReplica(_sched(model, params, replica=0), name="only")
+    router = FleetRouter([only], queue_depth=32)
+    # every request desires generation 1; no gen-1 replica exists
+    router.set_traffic(0, canary_generation=1, canary_fraction=1.0)
+    rid = router.submit([1, 2, 3], 4)
+    for _ in range(200):
+        router.pump()
+        if router.done(rid):
+            break
+    assert router.done(rid)
+    assert router.reqs[rid].generation == 0      # served by gen 0
+    only.close()
+
+
+def test_autopilot_breakdown_matches_obs_shape(lm):
+    """The judge's per-replica rows carry the obs_agg per-writer
+    breakdown fields, built from the same rollup records."""
+    model, params = lm
+    h = InprocReplica(_sched(model, params, replica=0), name="r0")
+    router = FleetRouter([h], queue_depth=8)
+    fleet = _FakeFleet(router)
+    ap = Autopilot(fleet, AutopilotConfig(interval_s=0.0))
+    rid = router.submit([1, 2, 3], 4)
+    for _ in range(200):
+        router.pump()
+        if router.done(rid):
+            break
+    rows = ap.breakdown()
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["name"] == "r0" and row["generation"] == 0
+    assert row["role"] == "serve"
+    assert row["ttft_ms_p50"] is not None
+    assert "queue_depth" in row and "block_utilization" in row
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet fault kinds (utils/faults.py) — plan-level pins
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_fleet_kinds_fire_once_and_match_proc():
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        faults as faults_lib,
+    )
+
+    plan = faults_lib.FaultPlan.from_config(
+        "replica_kill@3?proc=1002&max=1,stall_drain@0")
+    assert not plan.fire_if_due("replica_kill", 2, proc=1002)  # window
+    assert not plan.fire_if_due("replica_kill", 3, proc=7)   # proc gate
+    assert plan.fire_if_due("replica_kill", 3, proc=1002)
+    assert not plan.fire_if_due("replica_kill", 3, proc=1002)  # max=1
+    assert plan.fire_if_due("stall_drain", 0, proc=1002)
+    # fleet kinds never leak into the in-step apply() path
+    assert faults_lib.FLEET_KINDS == ("replica_kill", "stall_drain")
+
+
+# ---------------------------------------------------------------------------
+# slow/chaos: subprocess fleets under the autopilot
+# ---------------------------------------------------------------------------
+
+MODEL_FLAGS = dict(vocab=V, seq=64, layers=2, d_model=32, heads=4,
+                   d_ff=64, init_seed=0)
+SERVE_FLAGS = dict(slots=4, num_blocks=17, block_size=16,
+                   prefill_chunk=16, queue_depth=16)
+
+
+def _drive(fleet, plan, *, timeout_s=300, mid=None, mid_at=3):
+    """Closed-loop drive of a subprocess fleet; ``mid`` runs once after
+    ``mid_at`` completions.  Returns {key: tokens}."""
+    clients = len(plan)
+    rids, results = {}, {}
+    next_i = {ci: 0 for ci in range(clients)}
+    outstanding = {ci: None for ci in range(clients)}
+    fired = False
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        for ci in range(clients):
+            if outstanding[ci] is not None or \
+                    next_i[ci] >= len(plan[ci]):
+                continue
+            r = plan[ci][next_i[ci]]
+            rid = fleet.submit(r["prompt"], r["max_new"])
+            if rid is None:
+                continue
+            rids[(ci, next_i[ci])] = rid
+            outstanding[ci] = rid
+            next_i[ci] += 1
+        for rid in fleet.pump():
+            for ci in range(clients):
+                if outstanding[ci] == rid:
+                    outstanding[ci] = None
+        n_done = sum(1 for r in rids.values() if fleet.done(r))
+        if not fired and mid is not None and n_done >= mid_at:
+            fired = True
+            mid()
+        if (len(rids) == sum(len(p) for p in plan)
+                and all(fleet.done(r) for r in rids.values())):
+            for key, rid in rids.items():
+                results[key] = fleet.result(rid)
+            return results
+        time.sleep(0.005)
+    raise AssertionError(
+        f"fleet never drained: {len(results)}/{sum(map(len, plan))}")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_replica_kill_fault_mid_scale_out(tmp_path):
+    """A fleet-fault replica (``replica_kill@N``) SIGKILLs itself
+    mid-load while an autopilot scale-out is still in flight: the
+    supervisor relaunches the crashed replica (SIGKILL is a retry
+    code), the scale-out completes, and every request finishes exactly
+    once."""
+    fleet = launch_fleet(1, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         telemetry_root=str(tmp_path),
+                         backoff=0.2, backoff_cap=1.0,
+                         log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        # a second replica that kills itself on its 3rd accepted submit
+        h = fleet.add_replica(faults="replica_kill@3")
+        ap = Autopilot(fleet, AutopilotConfig(
+            min_replicas=2, max_replicas=2, interval_s=0.1))
+        fleet.autopilot = ap
+        plan = make_requests(6, 4, vocab_size=V, prompt_lens=(3, 10),
+                             max_new=(4, 8), seed=21)
+        results = _drive(fleet, plan)
+        assert len(results) == 24
+        # the relaunch may still be in its backoff window: pump it in
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            fleet.pump()
+            if any(e["event"] == "relaunch" and e["child"] == h.name
+                   for e in fleet.events):
+                break
+            time.sleep(0.05)
+        evs = [(e["event"], e["child"]) for e in fleet.events]
+        assert ("relaunch", h.name) in evs       # crash code retried
+        assert fleet.router.requeued >= 1        # the killed in-flights
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_stalled_drain_escalates_and_ledger_exact(tmp_path):
+    """A ``stall_drain`` replica swallows its decommission op; the
+    autopilot escalates to SIGKILL after drain_timeout_s, the retired
+    child stays down (no relaunch), and its in-flight work completes
+    on the survivor — no request lost or duplicated."""
+    fleet = launch_fleet(1, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         telemetry_root=str(tmp_path),
+                         log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        # window spans every accepted-submit count: the drain stalls no
+        # matter when the decommission op lands
+        h = fleet.add_replica(faults="stall_drain@0-1000000")
+        # scale-in hysteresis pinned far out: the idle wait below must
+        # not let the loop decommission the stall replica on its own
+        # before the scripted mid-load decommission exercises the
+        # escalation path
+        ap = Autopilot(fleet, AutopilotConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.1,
+            drain_timeout_s=2.0, scale_in_hold_s=3600.0))
+        fleet.autopilot = ap
+        t0 = time.time()
+        while time.time() - t0 < 120 and not h.accepting():
+            fleet.pump()
+            time.sleep(0.01)
+        assert h.accepting()
+
+        def mid():
+            ap._begin_decommission(ap._now(), h.name,
+                                   kind="test_scale_in")
+
+        plan = make_requests(6, 4, vocab_size=V, prompt_lens=(3, 10),
+                             max_new=(4, 8), seed=22)
+        results = _drive(fleet, plan, mid=mid)
+        assert len(results) == 24                # ledger-exact
+        acts = [d["action"] for d in ap.decisions]
+        assert "drain_stalled_kill" in acts
+        drained = [d for d in ap.decisions if d["action"] == "drained"]
+        assert drained and drained[0]["forced"] is True
+        evs = [(e["event"], e["child"]) for e in fleet.events]
+        assert ("relaunch", h.name) not in evs   # retired: terminal
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_corrupt_canary_checkpoint_rolls_back_e2e(tmp_path):
+    """ACCEPTANCE e2e: a canary checkpoint that passes the autopilot's
+    pre-spawn manifest verify but fails in the worker (payload
+    corrupted, manifest re-committed) exits 44; the rollout rolls back
+    automatically and the old generation serves every request,
+    undisturbed."""
+    model = Transformer(TransformerConfig(
+        vocab_size=V, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64))
+    snap = save_weight_snapshot(
+        tmp_path / "push", model.init(prng.init_key(0)), step=1)
+    p = pathlib.Path(snap) / "weights.npz"
+    raw = bytearray(p.read_bytes())
+    raw[0:4] = b"XXXX"                 # np.load fails deterministically
+    p.write_bytes(bytes(raw))
+    ckpt_manifest.commit(pathlib.Path(snap),
+                         {"step": 1, "kind": "weights"})
+    assert ckpt_manifest.verify(snap) == []      # TOCTOU shape
+    fleet = launch_fleet(1, model=MODEL_FLAGS, serve=SERVE_FLAGS,
+                         telemetry_root=str(tmp_path),
+                         log=lambda m: None)
+    try:
+        fleet.wait_ready(300)
+        ap = Autopilot(fleet, AutopilotConfig(
+            min_replicas=1, max_replicas=2, interval_s=0.1))
+        fleet.autopilot = ap
+
+        def mid():
+            assert ap.start_rollout(snap) is True
+
+        plan = make_requests(4, 4, vocab_size=V, prompt_lens=(3, 10),
+                             max_new=(4, 8), seed=23)
+        results = _drive(fleet, plan, mid=mid)
+        assert len(results) == 16
+        t0 = time.time()
+        while time.time() - t0 < 60 and ap._rollout is not None:
+            fleet.pump()
+            time.sleep(0.01)
+        roll = [d for d in ap.decisions
+                if d["action"] == "canary_rollback"]
+        assert roll and "rc 44" in roll[0]["reason"]
+        assert fleet.router._primary_gen == 0
+        assert fleet.router.per_generation_completed() == {0: 16}
+        assert [h.name for h in fleet.router.replicas] == ["replica-0"]
+    finally:
+        fleet.close()

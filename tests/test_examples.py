@@ -368,3 +368,22 @@ def test_example_23_serving_fleet_completes():
     assert ("supervisor: replica-0 relaunched; replica-1 undisturbed"
             in out.stdout)
     assert "per-writer" in out.stdout        # obs_agg breakdown rows
+
+
+def test_example_24_fleet_autopilot_completes():
+    """The fleet autopilot end to end on CPU, both arms: a mid-load
+    weight push that promotes through canary -> judge -> grow -> drain
+    (zero downtime, per-generation token attribution asserted
+    in-script), and a TOCTOU-corrupted canary checkpoint that fails in
+    the worker (exit 44) and rolls back with generation 0
+    undisturbed."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "24_fleet_autopilot.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "rollout: promoted at t=" in out.stdout
+    assert "zero downtime: all" in out.stdout
+    assert "corrupt canary: rolled back at t=" in out.stdout
+    assert "generation 0 undisturbed" in out.stdout

@@ -23,6 +23,16 @@ seconds into the load run — watch the router requeue its in-flight
 requests onto siblings (byte-identical tokens; greedy decode is
 deterministic) and the supervisor relaunch it.
 
+Autopilot: ``--autopilot`` attaches ``serve.autopilot.Autopilot`` to
+the fleet — occupancy/queue-driven scale-out/in between
+``--min-replicas`` and ``--max-replicas``, riding the same pump loop
+(no extra thread).  ``--rollout-after S`` pushes a weight snapshot
+mid-load as a canary generation; ``--rollout-mode`` picks the ending:
+``good`` promotes, ``slow`` (a deliberately laggy canary) and
+``corrupt`` (payload corrupted after manifest re-commit, so the worker
+itself fails verification and exits 44) both auto-roll-back with the
+old generation undisturbed.
+
 Example::
 
     python tools/serve_fleet.py --replicas 2 --clients 8 \
@@ -37,6 +47,51 @@ import pathlib
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def _prepare_snapshot(args, log):
+    """Build the to-be-pushed weights and commit them as a verified
+    snapshot (``serve.autopilot.save_weight_snapshot``).  In
+    ``corrupt`` mode the payload is flipped AND the manifest
+    re-committed over it — the autopilot's pre-spawn verify passes, the
+    canary worker's own load fails, the rollback path gets exercised
+    end to end."""
+    import tempfile
+
+    from neural_networks_parallel_training_with_mpi_tpu.models import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        save_weight_snapshot,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        ckpt_manifest, prng,
+    )
+
+    seed = (args.rollout_seed if args.rollout_seed is not None
+            else args.init_seed)
+    model = Transformer(TransformerConfig(
+        vocab_size=args.vocab, max_seq_len=args.seq,
+        n_layers=args.layers, d_model=args.d_model,
+        n_heads=args.heads, d_ff=args.d_ff))
+    params = model.init(prng.init_key(seed))
+    root = args.telemetry_dir or tempfile.mkdtemp(prefix="nnpt-snap-")
+    snap = save_weight_snapshot(
+        pathlib.Path(root) / "push", params, step=1,
+        meta={"init_seed": seed})
+    if args.rollout_mode == "corrupt":
+        p = pathlib.Path(snap) / "weights.npz"
+        raw = bytearray(p.read_bytes())
+        # clobber the zip magic, not a payload byte: np.savez stores
+        # uncompressed, so a mid-file flip would LOAD fine with silently
+        # wrong values — the header flip fails np.load deterministically
+        raw[0:4] = b"XXXX"
+        p.write_bytes(bytes(raw))
+        ckpt_manifest.commit(pathlib.Path(snap),
+                             {"step": 1, "kind": "weights"})
+        log(f"[fleet] chaos: corrupted snapshot payload at {snap}")
+    log(f"[fleet] weight snapshot ready: {snap}")
+    return snap
 
 
 def main(argv=None) -> int:
@@ -91,6 +146,11 @@ def main(argv=None) -> int:
     ap.add_argument("--step-sleep-ms", type=float, default=0.0,
                     help="emulated per-tick device latency in each "
                          "replica (bench.py --serve-fleet's knob)")
+    ap.add_argument("--prewarm", action="store_true",
+                    help="replicas pay every compile before reporting "
+                         "ready — use with --autopilot so a canary's "
+                         "first routed requests measure steady-state "
+                         "TTFT, not XLA compile time")
     # plumbing
     ap.add_argument("--telemetry-dir", default=None)
     ap.add_argument("--max-restarts", type=int, default=3)
@@ -98,10 +158,43 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-timeout", type=float, default=0.0,
                     help="kill a replica whose telemetry heartbeat "
                          "goes stale this long (0 = off; needs "
-                         "--telemetry-dir)")
+                         "--telemetry-dir).  Pipe-EOF already catches "
+                         "DEAD replicas instantly; the heartbeat is "
+                         "for the LIVE-but-stuck ones (wedged device, "
+                         "deadlocked loop) whose pipes stay open")
     ap.add_argument("--kill-replica-after", type=float, default=0.0,
                     help="chaos: SIGKILL replica 0 this many seconds "
                          "into the load run")
+    # autopilot (the control loop that ACTS on the signals above)
+    ap.add_argument("--autopilot", action="store_true",
+                    help="attach serve.autopilot.Autopilot: "
+                         "occupancy/queue-driven scale-out/in plus "
+                         "rollout management, ticked by the pump loop")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--scale-out-hold", type=float, default=0.75,
+                    help="seconds the high-load signal must HOLD "
+                         "before a scale-out fires (hysteresis)")
+    ap.add_argument("--rollout-after", type=float, default=0.0,
+                    help="push a weight snapshot as a canary "
+                         "generation this many seconds into the load "
+                         "run (needs --autopilot)")
+    ap.add_argument("--rollout-mode", default="good",
+                    choices=["good", "slow", "corrupt"],
+                    help="good = healthy canary, promotes; slow = "
+                         "canary with 100ms emulated device latency, "
+                         "rolls back on its SLO judgment; corrupt = "
+                         "snapshot payload corrupted (manifest "
+                         "re-committed so the autopilot's pre-spawn "
+                         "verify passes), worker fails its own "
+                         "verification and exits 44, rolls back")
+    ap.add_argument("--rollout-seed", type=int, default=None,
+                    help="init seed for the pushed weights (default: "
+                         "--init-seed, i.e. a same-weights push whose "
+                         "tokens stay byte-identical across "
+                         "generations)")
+    ap.add_argument("--canary-fraction", type=float, default=0.25)
+    ap.add_argument("--canary-window", type=float, default=3.0)
     ap.add_argument("--json", action="store_true",
                     help="print ONLY the result row as JSON")
     args = ap.parse_args(argv)
@@ -127,10 +220,44 @@ def main(argv=None) -> int:
                            reject_infeasible=args.reject_infeasible),
         step_sleep_ms=args.step_sleep_ms, tp=args.tp,
         max_restarts=args.max_restarts, backoff=args.backoff,
-        heartbeat_timeout=args.heartbeat_timeout, log=log)
+        heartbeat_timeout=args.heartbeat_timeout,
+        prewarm=args.prewarm, log=log)
     try:
         fleet.wait_ready()
         log(f"[fleet] {args.replicas} replica(s) ready")
+        ap_obj = None
+        if args.autopilot:
+            import time as time_lib
+
+            from neural_networks_parallel_training_with_mpi_tpu.serve \
+                import Autopilot, AutopilotConfig
+
+            ap_obj = Autopilot(fleet, AutopilotConfig(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                scale_out_hold_s=args.scale_out_hold,
+                canary_fraction=args.canary_fraction,
+                canary_window_s=args.canary_window), log=log)
+            fleet.autopilot = ap_obj
+            if args.rollout_after > 0:
+                snap = _prepare_snapshot(args, log)
+                t0 = time_lib.monotonic()
+                fired = []
+                orig_tick = ap_obj.tick
+
+                def tick():
+                    # rollout trigger rides the pump thread too: no
+                    # cross-thread mutation of router/supervisor state
+                    if (not fired and time_lib.monotonic() - t0
+                            >= args.rollout_after):
+                        fired.append(True)
+                        ap_obj.start_rollout(
+                            snap,
+                            step_sleep_ms=(100.0 if args.rollout_mode
+                                           == "slow" else None))
+                    return orig_tick()
+
+                ap_obj.tick = tick
         if args.kill_replica_after > 0:
             import os
             import signal
@@ -160,6 +287,11 @@ def main(argv=None) -> int:
         row["supervisor_events"] = [
             {k: e[k] for k in ("event", "child", "incarnation")
              if k in e} for e in fleet.events]
+        if ap_obj is not None:
+            row["autopilot"] = ap_obj.summary()
+            row["decisions"] = ap_obj.decisions
+            row["per_generation_completed"] = \
+                fleet.router.per_generation_completed()
         print(json.dumps(row, indent=None if args.json else 2))
         return 0
     finally:
