@@ -65,6 +65,63 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _git_rev() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10)
+        return out.stdout.strip() or None
+    except Exception:
+        return None
+
+
+#: bumped when the _meta block itself changes shape
+ARTIFACT_SCHEMA = 1
+
+
+def _emit_artifact(path: str, doc, honesty: dict | None = None) -> str:
+    """Write one BENCH_*.json artifact with the shared ``_meta`` stamp
+    and an atomic replace (a reader never sees a torn artifact).
+
+    Every artifact carries the same provenance block — schema version,
+    generation time, host, python, git revision — plus per-bench
+    *honesty flags* (cpu_fallback, interleaved methodology, bitwise
+    pins): ``tools/bench_diff.py`` refuses to compare artifacts whose
+    provenance says the numbers were measured under different rules.
+    ``cpu_fallback`` is derived from the record's own ``platform``
+    field when present; callers add bench-specific flags via
+    ``honesty``."""
+    import socket
+
+    meta: dict = {
+        "schema": ARTIFACT_SCHEMA,
+        "generated_unix": round(time.time(), 1),
+        "generated_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+        "host": socket.gethostname(),
+        "python": sys.version.split()[0],
+        "git_rev": _git_rev(),
+    }
+    flags = dict(honesty or {})
+    if isinstance(doc, dict):
+        platform_field = doc.get("platform")
+        if isinstance(platform_field, str) and "cpu_fallback" not in flags:
+            flags["cpu_fallback"] = platform_field == "cpu"
+        if "note" in doc and "interleaved" not in flags:
+            flags["interleaved"] = "interleaved" in str(doc["note"])
+    if flags:
+        meta["honesty"] = flags
+    if isinstance(doc, dict):
+        doc = {**doc, "_meta": meta}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
 def peak_flops(device_kind: str) -> float | None:
     """Chip peak dense bf16 FLOPs/s (None off-TPU) — kept as bench's public
     name; the table and the per-step FLOPs formula live in
@@ -681,11 +738,10 @@ def run_scaling_sweep(out_path: str = "BENCH_SCALING.json",
                  "its single-core meaning")
     note += " (chip-count scaling needs real chips)"
     if results:
-        with open(out_path, "w") as f:
-            json.dump({
-                "config": "wide", "mode": "weak_scaling",
-                "host_cpu_count": ncpu, "note": note,
-                "results": results}, f, indent=2)
+        _emit_artifact(out_path, {
+            "config": "wide", "mode": "weak_scaling",
+            "host_cpu_count": ncpu, "note": note,
+            "results": results})
         log(f"weak-scaling sweep -> {out_path}")
 
 
@@ -935,8 +991,7 @@ def preflight_config(config_name: str = "big_lm",
     rec["ok"] = bool(rec["eval_shape_ok"] and rec["lower_compile_ok"]
                      and (rec["fits_hbm"] or rec["chip_validated"])
                      and (smoke.get("ok", True)))
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    _emit_artifact(out_path, rec)
     log(f"preflight[{config_name}] -> {out_path}")
     return rec
 
@@ -1125,14 +1180,14 @@ def bench_attention(out_path: str = "BENCH_ATTENTION.json") -> None:
         results.append(row)
 
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump({"platform": devices[0].platform,
-                   "device_kind": devices[0].device_kind,
-                   "note": ("compiled kernels" if on_tpu else
-                            "CPU fallback: flash/ring_flash run in Pallas "
-                            "interpret mode — fills the comparison columns "
-                            "but measures the emulation, not kernel perf"),
-                   "results": results}, f, indent=2)
+    _emit_artifact(out_path, {
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "note": ("compiled kernels" if on_tpu else
+                 "CPU fallback: flash/ring_flash run in Pallas "
+                 "interpret mode — fills the comparison columns "
+                 "but measures the emulation, not kernel perf"),
+        "results": results})
     log(f"attention comparison -> {out_path}")
     return out_path
 
@@ -1548,8 +1603,7 @@ def bench_decode(out_path: str = "BENCH_DECODE.json") -> None:
                 }
         except (OSError, ValueError):
             pass
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"decode comparison -> {out_path}: {results}")
     return out_path
 
@@ -1749,8 +1803,7 @@ def bench_update_sharding(out_path: str = "BENCH_UPDATE_SHARDING.json",
         "with backward dots) + bf16 param storage halving param bytes "
         "with f32 masters costing 1/n_devices")
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    _emit_artifact(out_path, rec)
     log(f"update-sharding A/B -> {out_path}")
     return out_path
 
@@ -1986,8 +2039,7 @@ def bench_quant_ab(out_path: str = "BENCH_QUANT.json",
         "(dynamic scales, amax state) keeps step time in the same "
         "regime as bf16 even without quantized hardware")
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    _emit_artifact(out_path, rec)
     log(f"quant A/B -> {out_path}")
     return out_path
 
@@ -2140,8 +2192,7 @@ def bench_trace_overhead(out_path: str = "BENCH_TRACE.json",
         f"{rec['overhead_pair_median_pct']:+.1f}%), "
         f"{n_compiles} ledger compile(s), params bitwise "
         f"{'equal' if rec['params_bitwise_identical'] else 'DIFFERENT'}")
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    _emit_artifact(out_path, rec)
     log(f"trace-overhead A/B -> {out_path}")
     # raise AFTER writing: a failing run must leave an artifact that
     # records params_bitwise_identical: false, not vanish
@@ -2340,14 +2391,418 @@ def bench_obs_overhead(out_path: str = "BENCH_OBS.json",
         f"with_metrics step), {rollups} rollup(s) written, params "
         f"bitwise "
         f"{'equal' if rec['params_bitwise_identical'] else 'DIFFERENT'}")
-    with open(out_path, "w") as f:
-        json.dump(rec, f, indent=2)
+    _emit_artifact(out_path, rec)
     log(f"obs-overhead A/B -> {out_path}")
     # raise AFTER writing: a failing run must leave an artifact that
     # records params_bitwise_identical: false, not vanish
     if not rec["params_bitwise_identical"]:
         raise AssertionError(
             f"obs on/off param digests differ: {dig}")
+    return out_path
+
+
+_GOODPUT_CHAOS_CHILD = r'''
+import importlib.util
+import json
+import os
+import sys
+import time
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace = _load("_nnpt_trace", sys.argv[1])
+jz = _load("_nnpt_jsonl", sys.argv[2])
+gp = _load("_nnpt_goodput", sys.argv[3])
+gp._jsonl = jz
+trace_dir, telem_dir, marker = sys.argv[4], sys.argv[5], sys.argv[6]
+steps = int(sys.argv[7])
+
+tracer = trace.start_run(trace_dir, ledger=False)
+meter = gp.GoodputMeter()
+trace.add_listener(meter.on_span)
+crash = bool(marker) and not os.path.exists(marker)
+for i in range(steps):
+    with trace.span("fetch", step=i):
+        time.sleep(0.004)
+    with trace.span("dispatch", step=i):
+        time.sleep(0.03)
+    if crash and i == 2:
+        # first incarnation of the chaos child: die mid-run with the
+        # trace file mid-stream; the relaunch re-runs every step, so the
+        # offline ledger must price BOTH the supervisor gap
+        # (relaunch_gap) and the re-trained step window (rollback)
+        open(marker, "w").close()
+        os._exit(1)
+ident = trace.run_identity()
+rec = gp.goodput_record(meter.snapshot(), role="train", step=steps,
+                        ident=ident)
+trace.remove_listener(meter.on_span)
+tracer.close()
+os.makedirs(telem_dir, exist_ok=True)
+with open(os.path.join(telem_dir, "metrics.jsonl"), "a") as f:
+    f.write(json.dumps(rec) + "\n")
+'''
+
+
+def _load_tool(name: str):
+    """File-path load of a repo-root ``tools/`` module (they are
+    standalone scripts, not a package)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_bench_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _goodput_chaos_run(tmp: str) -> dict:
+    """Supervised 2-process chaos run for the goodput artifact: stdlib
+    ``python -S`` children emit real trace spans, one is crashed once
+    mid-run (``os._exit(1)``) and relaunched by :class:`GroupSupervisor`
+    with the lifecycle JSONL enabled, and the offline ledger must then
+    classify 100%% of both processes' wall-clock — the crash priced as a
+    ``relaunch_gap`` plus a ``rollback`` re-trained window, never
+    dropped."""
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        resilience as res,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        goodput as gp_lib,
+    )
+
+    pkg = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "neural_networks_parallel_training_with_mpi_tpu")
+    trace_py = os.path.join(pkg, "train", "trace.py")
+    jsonl_py = os.path.join(pkg, "utils", "jsonl.py")
+    goodput_py = os.path.join(pkg, "utils", "goodput.py")
+    script = os.path.join(tmp, "chaos_child.py")
+    with open(script, "w") as f:
+        f.write(_GOODPUT_CHAOS_CHILD)
+    trace_dir = os.path.join(tmp, "trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    telem_dirs = [os.path.join(tmp, f"telem{p}") for p in range(2)]
+    marker = os.path.join(tmp, "crashed.marker")
+
+    def cmd(p, mk):
+        return [sys.executable, "-S", script, trace_py, jsonl_py,
+                goodput_py, trace_dir, telem_dirs[p], mk, "6"]
+
+    specs = [
+        res.ChildSpec(name="w0", cmd=cmd(0, ""), role="train",
+                      env={"NNPT_PROCESS_ID": "0"}, backoff=0.2),
+        res.ChildSpec(name="w1", cmd=cmd(1, marker), role="train",
+                      env={"NNPT_PROCESS_ID": "1"}, backoff=0.2),
+    ]
+    sup = res.GroupSupervisor(
+        specs, log=lambda m: None,
+        events_path=os.path.join(trace_dir, "supervisor-events.jsonl"))
+    sup.start()
+    deadline = time.time() + 120.0
+    while sup.running() and time.time() < deadline:
+        sup.poll()
+        time.sleep(0.02)
+    if sup.running():
+        sup.terminate_all()
+        raise AssertionError("goodput chaos run did not drain in 120s")
+    for name in ("w0", "w1"):
+        if sup.done(name) != 0:
+            raise AssertionError(
+                f"chaos child {name} finished rc={sup.done(name)}")
+
+    led = gp_lib.ledger_from_dir(trace_dir)
+    fleet = led["fleet"]
+    return {
+        "trace_dir": trace_dir,
+        "telem_dirs": telem_dirs,
+        "n_processes": fleet["n_processes"],
+        "relaunches": fleet["relaunches"],
+        "covered_s": fleet["covered_s"],
+        "goodput_fraction": fleet["goodput_fraction"],
+        "categories": fleet["categories"],
+        "relaunch_gap_s": fleet["categories"].get("relaunch_gap", 0.0),
+        "retrain_rollback_s": fleet["categories"].get("rollback", 0.0),
+        "sum_ok_all_processes": all(p["sum_ok"] for p in led["processes"]),
+        "fleet_sum_ok": fleet["sum_ok"],
+        "max_abs_residual_s": max(
+            (abs(p["sum_residual_s"]) for p in led["processes"]),
+            default=0.0),
+        "crashed_incarnations": [
+            {"p": p["p"], "incarnations": len(p["incarnations"]),
+             "exit_rcs": [i["exit_rc"] for i in p["incarnations"]]}
+            for p in led["processes"]],
+    }
+
+
+def _goodput_serve_bitwise(tmp: str) -> dict:
+    """Tokens-bitwise pin for the serving half: the same prompts through
+    the continuous-batching scheduler with goodput accounting ON
+    (meter + kind="goodput" rollups + burn budget) vs OFF must generate
+    IDENTICAL token ids — the accounting layer is a span listener over
+    host timestamps and cannot reach the sampler."""
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.serve.scheduler import (
+        Scheduler, ServeConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.utils import prng
+
+    model = Transformer(TransformerConfig(
+        vocab_size=64, max_seq_len=64, n_layers=2, d_model=32,
+        n_heads=4, d_ff=64, compute_dtype=jnp.float32))
+    params = model.init(prng.init_key(0))
+    prompts = [[1, 2, 3], [4, 5, 6, 7], [9, 10]]
+    tokens = {}
+    records = {}
+    for arm in ("on", "off"):
+        tdir = os.path.join(tmp, f"serve_{arm}")
+        cfg = ServeConfig(slots=4, num_blocks=40, block_size=8,
+                          prefill_chunk=8, telemetry_dir=tdir,
+                          rollup_every=4, goodput=(arm == "on"))
+        sched = Scheduler(model, params, cfg)
+        rids = [sched.submit(p, 8) for p in prompts]
+        sched.run_until_drained()
+        tokens[arm] = [sched.result(r) for r in rids]
+        sched.close()
+        with open(os.path.join(tdir, "metrics.jsonl")) as f:
+            records[arm] = sum(
+                1 for ln in f if '"kind": "goodput"' in ln)
+    return {
+        "prompts": prompts,
+        "new_tokens": 8,
+        "tokens_bitwise_identical": tokens["on"] == tokens["off"],
+        "tokens": tokens["on"],
+        "goodput_records_on": records["on"],
+        "goodput_records_off": records["off"],
+    }
+
+
+def bench_goodput(out_path: str = "BENCH_GOODPUT.json",
+                  reps: int = 7, chain: int = 2) -> str:
+    """The goodput-accounting bench (utils/goodput.py): prices the
+    in-process :class:`GoodputMeter` the DESIGN §7 way — interleaved
+    per-rep OFF/ON pairs on the traced CPU-bench transformer chain, so
+    the ratio isolates exactly what the accounting layer adds on top of
+    tracing (one listener call + frontier dict update per span, plus a
+    snapshot per chain) — and then proves the accounting CONTRACTS on a
+    supervised 2-process chaos run: an injected crash -> relaunch must
+    come back as ``relaunch_gap`` + ``rollback`` with every process's
+    categories summing to its covered wall-clock, the merged telemetry
+    must surface a per-role goodput fraction through tools/obs_agg.py's
+    Prometheus export, and serving tokens must be bitwise identical
+    accounting on vs off."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from neural_networks_parallel_training_with_mpi_tpu.config import MeshConfig
+    from neural_networks_parallel_training_with_mpi_tpu.models.transformer import (
+        Transformer, TransformerConfig,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.ops import optim
+    from neural_networks_parallel_training_with_mpi_tpu.parallel import (
+        data_parallel as dp,
+        mesh as mesh_lib,
+        sharding as shd,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        trace as trace_lib,
+    )
+    from neural_networks_parallel_training_with_mpi_tpu.train.state import TrainState
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        goodput as gp_lib,
+        prng,
+    )
+
+    c = _LM
+    seq, batch_size = 128, 32
+    devices = jax.devices()
+    n = len(devices)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=n), devices=devices)
+    on_tpu = devices[0].platform not in ("cpu",)
+    model = Transformer(TransformerConfig(
+        vocab_size=c["vocab"], max_seq_len=seq, n_layers=c["n_layers"],
+        d_model=c["d_model"], n_heads=c["n_heads"], d_ff=c["d_ff"],
+        compute_dtype=jnp.bfloat16 if on_tpu else jnp.float32))
+    opt = optim.sgd(lr=1e-4, momentum=0.9)
+    rng = np.random.default_rng(0)
+    raw = {
+        "x": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "y": rng.integers(0, c["vocab"], (batch_size, seq)).astype(np.int32),
+        "mask": np.ones((batch_size,), np.float32),
+    }
+    batch = shd.shard_batch(mesh, raw)
+    step = dp.make_train_step(model, opt, mesh, "cross_entropy",
+                              "global_mean")
+    sync = _chain_sync_every()
+
+    def fresh_state():
+        return dp.replicate_state(
+            TrainState.create(model, opt, prng.init_key(0)), mesh)
+
+    def digest(state):
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(jax.device_get(state.params)):
+            h.update(np.ascontiguousarray(leaf).tobytes())
+        return h.hexdigest()
+
+    meter = gp_lib.GoodputMeter()
+
+    def run_chain(state, k, metered):
+        # BOTH arms are traced: the measured delta is the goodput
+        # layer alone — the span-listener fan-out, the meter's frontier
+        # update per span, and one snapshot per chain (the per-rollup
+        # cost the instrumented trainer pays)
+        if metered:
+            trace_lib.add_listener(meter.on_span)
+        t0 = time.perf_counter()
+        try:
+            loss = None
+            for i in range(k):
+                with trace_lib.span("dispatch", step=i):
+                    state, loss = step(state, batch)
+                if sync and (i + 1) % sync == 0:
+                    jax.block_until_ready(loss)
+            val = float(jax.device_get(loss))
+            if metered:
+                meter.snapshot()
+        finally:
+            if metered:
+                trace_lib.remove_listener(meter.on_span)
+        return time.perf_counter() - t0, state, val
+
+    trace_tmp = tempfile.mkdtemp(prefix="bench_goodput_")
+    tracer = trace_lib.start_run(os.path.join(trace_tmp, "ab"),
+                                 ledger=False)
+    try:
+        states = {"off": fresh_state(), "on": fresh_state()}
+        for name in states:  # warmup: jit compile both arms
+            _, states[name], _ = run_chain(states[name], 1, name == "on")
+        times = {"off": [], "on": []}
+        loss_vals = {}
+        for _rep in range(reps):
+            for name in ("off", "on"):
+                dt, states[name], loss_vals[name] = run_chain(
+                    states[name], chain, name == "on")
+                times[name].append(dt / chain)
+        dig = {name: digest(s) for name, s in states.items()}
+        snap = meter.snapshot()
+    finally:
+        trace_lib.stop_run(tracer)
+    assert np.isfinite(loss_vals["off"]) and np.isfinite(loss_vals["on"])
+    # snapshot values are rounded to 1e-6 each, so the sum of 11 rounded
+    # categories can miss the rounded covered total by up to half an ulp
+    # per term — widen the tolerance by the term count, nothing more
+    meter_sum_ok = abs(
+        sum(snap["categories"].values()) - snap["covered_s"]) < max(
+            gp_lib.SUM_TOL * (len(snap["categories"]) + 1),
+            1e-9 * max(snap["covered_s"], 1.0))
+
+    try:
+        chaos = _goodput_chaos_run(trace_tmp)
+
+        # fleet merge evidence: the chaos children's kind="goodput"
+        # telemetry records through the same aggregation every operator
+        # surface uses — per-role fraction must reach Prometheus
+        oa = _load_tool("obs_agg")
+        fleet_doc = oa.aggregate(chaos.pop("telem_dirs"))
+        prom = oa.to_prometheus(fleet_doc)
+        prom_lines = [ln for ln in prom.splitlines()
+                      if ln.startswith("nnpt_goodput_fraction{")]
+        chaos.pop("trace_dir", None)
+        merged = {
+            "fleet_goodput_fraction": fleet_doc["fleet"].get(
+                "goodput_fraction"),
+            "prometheus_fraction_lines": prom_lines,
+            "prometheus_families_present": (
+                "nnpt_goodput_seconds_total" in prom
+                and bool(prom_lines)),
+        }
+
+        serve = _goodput_serve_bitwise(trace_tmp)
+    finally:
+        shutil.rmtree(trace_tmp, ignore_errors=True)
+
+    pair_ratios = [a / b for a, b in zip(times["on"], times["off"])]
+    best_off, best_on = min(times["off"]), min(times["on"])
+    rec = {
+        "metric": "goodput_accounting_ab",
+        "platform": devices[0].platform,
+        "device_kind": devices[0].device_kind,
+        "n_devices": n,
+        "batch": batch_size,
+        "model": {"n_layers": c["n_layers"], "d_model": c["d_model"],
+                  "d_ff": c["d_ff"], "seq": seq, "vocab": c["vocab"]},
+        "reps": reps, "chain_steps": chain,
+        "arms": {
+            "goodput_off": {"step_ms_best": round(best_off * 1e3, 2),
+                            "step_ms_median": round(
+                                float(np.median(times["off"])) * 1e3, 2)},
+            "goodput_on": {"step_ms_best": round(best_on * 1e3, 2),
+                           "step_ms_median": round(
+                               float(np.median(times["on"])) * 1e3, 2)},
+        },
+        "overhead_best_pct": round((best_on / best_off - 1.0) * 100, 2),
+        "overhead_pair_median_pct": round(
+            (float(np.median(pair_ratios)) - 1.0) * 100, 2),
+        "overhead_gate_pct": 1.0,
+        "params_bitwise_identical": dig["off"] == dig["on"],
+        "params_sha256": dig["off"],
+        "meter_spans": int(snap["spans"]),
+        "meter_sum_ok": bool(meter_sum_ok),
+        "chaos": chaos,
+        "fleet_merge": merged,
+        "serve": serve,
+        "note": ("interleaved ON/OFF pairs (DESIGN §7), both arms "
+                 "traced so the ratio prices the goodput layer alone "
+                 "(span listener + frontier update per span + one "
+                 "snapshot per chain); chaos block is a supervised "
+                 "2-process stdlib run with one injected crash — the "
+                 "offline ledger classifies 100% of both processes' "
+                 "wall-clock (sum_ok), pricing the crash as "
+                 "relaunch_gap + re-trained rollback; fleet_merge pins "
+                 "the per-role goodput fraction surviving to the "
+                 "Prometheus export; serve pins tokens bitwise "
+                 "identical accounting on vs off"),
+    }
+    out_path = _divert_cpu_overwrite(out_path, on_tpu)
+    log(f"[goodput] off {best_off * 1e3:.1f} ms/step, on "
+        f"{best_on * 1e3:.1f} ms/step (pair-median "
+        f"{rec['overhead_pair_median_pct']:+.1f}%), chaos sum_ok="
+        f"{chaos['sum_ok_all_processes']} relaunch_gap="
+        f"{chaos['relaunch_gap_s']:.2f}s rollback="
+        f"{chaos['retrain_rollback_s']:.2f}s, serve tokens bitwise "
+        f"{'equal' if serve['tokens_bitwise_identical'] else 'DIFFERENT'}")
+    _emit_artifact(out_path, rec)
+    log(f"goodput A/B -> {out_path}")
+    # raise AFTER writing: a failing run must leave an artifact that
+    # records which contract broke, not vanish
+    if dig["off"] != dig["on"]:
+        raise AssertionError(f"goodput on/off param digests differ: {dig}")
+    if not serve["tokens_bitwise_identical"]:
+        raise AssertionError("serve tokens differ accounting on vs off")
+    if not (chaos["sum_ok_all_processes"] and chaos["fleet_sum_ok"]
+            and meter_sum_ok):
+        raise AssertionError(
+            f"goodput sum-to-covered invariant violated: {chaos}")
+    if chaos["relaunch_gap_s"] <= 0.0:
+        raise AssertionError(
+            "injected crash produced no relaunch_gap attribution")
+    if not merged["prometheus_families_present"]:
+        raise AssertionError(
+            "goodput families missing from the Prometheus export")
     return out_path
 
 
@@ -2550,8 +3005,7 @@ def bench_serve(out_path: str = "BENCH_SERVE.json",
                            "latency curves and the capacity ratio are "
                            "the platform-independent evidence")
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"serve bench -> {out_path}")
     return out_path
 
@@ -2687,8 +3141,7 @@ def bench_serve_fleet(out_path: str = "BENCH_FLEET.json") -> str:
     results["device_kind"] = devices[0].device_kind
     out_path = _divert_cpu_overwrite(
         out_path, devices[0].platform not in ("cpu",))
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"serve fleet bench -> {out_path} (2x {speedup_2}, "
         f"4x {speedup_4})")
     return out_path
@@ -2934,8 +3387,7 @@ def bench_autopilot(out_path: str = "BENCH_AUTOPILOT.json") -> str:
     results["device_kind"] = devices[0].device_kind
     out_path = _divert_cpu_overwrite(
         out_path, devices[0].platform not in ("cpu",))
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"autopilot bench -> {out_path} (overhead {overhead_pct}%, "
         f"reaction {ready_d and ready_d['reaction_s']}s)")
     return out_path
@@ -3103,8 +3555,7 @@ def bench_paged_attn(out_path: str = "BENCH_PAGED_ATTN.json") -> str:
             "FLOPs the fused kernel skips), the chip capture overwrites "
             "the timings")
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"paged-attention bench -> {out_path}")
     return out_path
 
@@ -3273,8 +3724,7 @@ def bench_prefix_cache(out_path: str = "BENCH_PREFIX_CACHE.json") -> str:
                  "a mechanism check at tiny shapes"),
     }
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"prefix-cache bench -> {out_path}")
     return out_path
 
@@ -3403,8 +3853,7 @@ def bench_rl(out_path: str = "BENCH_RL.json") -> str:
                            "meaningful; the steps-to-reward numbers are "
                            "the platform-independent evidence")
     out_path = _divert_cpu_overwrite(out_path, on_tpu)
-    with open(out_path, "w") as f:
-        json.dump(results, f, indent=2)
+    _emit_artifact(out_path, results)
     log(f"rl bench -> {out_path}")
     return out_path
 
@@ -3481,8 +3930,7 @@ def save_tpu_latest(records: list) -> None:
         "device_kind": tpu_recs[0].get("device_kind"),
         "records": sorted(merged.values(), key=lambda r: r["metric"]),
     }
-    with open(TPU_LATEST_PATH, "w") as f:
-        json.dump(doc, f, indent=2)
+    _emit_artifact(TPU_LATEST_PATH, doc)
     log(f"TPU provenance record -> {TPU_LATEST_PATH}")
 
 
@@ -3684,6 +4132,17 @@ def main() -> int:
                     help=argparse.SUPPRESS)
     ap.add_argument("--trace-overhead-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--goodput", action="store_true",
+                    help="goodput-accounting A/B (utils/goodput.py): "
+                         "interleaved meter OFF/ON pairs on the traced "
+                         "CPU-bench transformer chain, a supervised "
+                         "2-process chaos run (injected crash -> "
+                         "relaunch_gap + rollback, categories sum to "
+                         "covered wall-clock), per-role fraction "
+                         "through the Prometheus export, serve tokens "
+                         "bitwise pin; write BENCH_GOODPUT.json")
+    ap.add_argument("--goodput-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the torch reference baseline (vs_baseline=null)")
     ap.add_argument("--grad-reduction", choices=["global_mean", "local"],
@@ -3752,12 +4211,15 @@ def main() -> int:
     if args.quant_ab_inproc:
         print(json.dumps({"quant_artifact": bench_quant_ab()}))
         return 0
+    if args.goodput_inproc:
+        print(json.dumps({"goodput_artifact": bench_goodput()}))
+        return 0
 
     if (args.attention or args.decode or args.serve or args.rl
             or args.serve_fleet or args.autopilot
             or args.paged_attn or args.prefix_cache
             or args.update_sharding_ab or args.trace_overhead
-            or args.obs_overhead or args.quant_ab):
+            or args.obs_overhead or args.quant_ab or args.goodput):
         # standalone artifact runs: do NOT fall through into the default
         # config bench — on the exclusive tunnel that would spend extra
         # minutes of a flapping window re-measuring `wide` (+ its torch
@@ -3849,6 +4311,16 @@ def main() -> int:
             else:
                 path = bench_quant_ab()
             print(json.dumps({"quant_artifact": path}))
+        if args.goodput:
+            if choice == "cpu":
+                # same 8-virtual-device DP mesh as the sibling overhead
+                # measurements; the chaos half spawns its own stdlib
+                # children regardless of backend
+                path = _run_flag_cpu_child("--goodput-inproc", 8,
+                                           timeout=3000)
+            else:
+                path = bench_goodput()
+            print(json.dumps({"goodput_artifact": path}))
         return 0
 
     configs = sorted(METRIC_NAMES) if args.all else [args.config]
@@ -3968,8 +4440,7 @@ def main() -> int:
                         "sweep; cpu fallback writes " + out)
             except (OSError, ValueError):
                 pass
-        with open(out, "w") as f:
-            json.dump(records, f, indent=2)
+        _emit_artifact(out, records)
         log(f"all configs -> {out}")
 
     save_tpu_latest(records)

@@ -233,7 +233,7 @@ def _supervise(args, argv) -> int:
     child = strip_supervisor_flags(argv)
     if args.checkpoint_dir and "--resume" not in child:
         child.append("--resume")
-    heartbeat = postmortem = alerts = None
+    heartbeat = postmortem = alerts = events = None
     heartbeat_timeout = 0.0
     if getattr(args, "telemetry_dir", None):
         # watch exactly THIS child's heartbeat: the role-qualified file
@@ -248,6 +248,18 @@ def _supervise(args, argv) -> int:
                                  heartbeat_filename(role))
         postmortem = os.path.join(args.telemetry_dir, "postmortem.json")
         alerts = os.path.join(args.telemetry_dir, "metrics.jsonl")
+        # supervisor lifecycle JSONL next to the trace files so one dir
+        # holds the whole goodput join (utils/goodput.py prices the
+        # relaunch gaps from these events); lands in the trace/ subdir
+        # when tracing is on, else directly under the telemetry dir
+        from .train import trace as _trace_lib
+
+        events_dir = (_trace_lib.dir_from_config(args)
+                      if (getattr(args, "trace", False)
+                          or getattr(args, "trace_dir", None))
+                      else args.telemetry_dir)
+        os.makedirs(events_dir, exist_ok=True)
+        events = os.path.join(events_dir, "supervisor-events.jsonl")
         if getattr(args, "hang_timeout", 0.0) > 0:
             heartbeat_timeout = max(4.0 * args.hang_timeout, 60.0)
     probe = None
@@ -272,7 +284,8 @@ def _supervise(args, argv) -> int:
                      ckpt_dir=args.checkpoint_dir,
                      elastic=getattr(args, "elastic", False),
                      min_devices=getattr(args, "min_devices", 0),
-                     probe=probe)
+                     probe=probe,
+                     events_path=events)
 
 
 def main(argv=None) -> int:

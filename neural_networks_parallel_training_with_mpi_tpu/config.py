@@ -353,6 +353,15 @@ class TrainConfig:
     # names an explicit directory (and implies trace on).
     trace: bool = False
     trace_dir: Optional[str] = None
+    # goodput accounting (utils/goodput.py): an online taxonomy meter on
+    # the trace span-listener seam, emitting kind="goodput" records on
+    # the rollup cadence (categories provably sum to covered wall-clock;
+    # step anatomy joined from the compile ledger's XLA cost analysis).
+    # On whenever telemetry is on; priced by bench.py --goodput.
+    goodput: bool = True
+    # goodput-fraction floor for the ErrorBudget burn alert: a rollup
+    # window whose productive-step share is below this misses the SLO
+    goodput_target: float = 0.5
     # leader-gated jax.profiler capture (utils.profiling.trace): the
     # DEVICE-side complement to the host spans — per-op XLA timelines
     # for TensorBoard/XProf.  Alias of the legacy profile_dir knob with
@@ -776,6 +785,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(TensorBoard/XProf device timeline) — the "
                         "DEVICE complement to --trace's host spans; "
                         "equivalent to the legacy --profile_dir")
+    _add_bool_flag(p, "goodput", True,
+                   "goodput accounting (utils/goodput.py): classify "
+                   "wall-clock into the fixed taxonomy from the live "
+                   "span stream and emit kind=goodput records on the "
+                   "rollup cadence (tools/goodput_report.py renders the "
+                   "ledger; tools/obs_agg.py merges the fleet fraction)")
+    p.add_argument("--goodput_target", type=float, default=0.5,
+                   metavar="FRAC",
+                   help="goodput-fraction floor for the ErrorBudget burn "
+                        "alert (share of covered wall-clock in the "
+                        "productive 'step' category)")
     p.add_argument("--check_replicas_every", type=int, default=0,
                    help="verify replicated state is bit-identical across "
                         "device shards every N steps (0 = off); detect-"
@@ -928,6 +948,8 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         trace=args.trace or args.trace_dir is not None,
         trace_dir=args.trace_dir,
         xla_trace_dir=args.xla_trace_dir,
+        goodput=args.goodput,
+        goodput_target=args.goodput_target,
         eval_every=args.eval_every,
         check_replicas_every=args.check_replicas_every,
         sdc_check_every=args.sdc_check_every,

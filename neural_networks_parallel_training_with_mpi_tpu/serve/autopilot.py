@@ -177,6 +177,12 @@ class AutopilotConfig:
     canary_max_extensions: int = 3
     canary_max_p50_ratio: float = 3.0
     canary_max_miss_frac: float = 0.25
+    # decision-ledger persistence: when set, every decision is appended
+    # as one ``kind="autopilot"`` JSON line (the control loop's flight
+    # recorder — rendered by ``metrics_summary --autopilot`` and drawn
+    # as instant events by ``trace_report``, joined into the goodput
+    # ledger by ``goodput_report``)
+    events_path: Optional[str] = None
 
 
 class Autopilot:
@@ -215,6 +221,27 @@ class Autopilot:
         self.decisions.append(d)
         self.log(f"[autopilot] {action}: "
                  + ", ".join(f"{k}={v}" for k, v in extra.items()))
+        if self.cfg.events_path:
+            # append-only flight recorder; t_unix puts decisions on the
+            # same wall-clock axis as the trace spans, so trace_report
+            # can draw them as instants over the tick timeline
+            import json
+            import os
+
+            try:
+                rec = {"kind": "autopilot",
+                       "t_unix": round(time.time(), 3),
+                       "run": os.environ.get("NNPT_RUN_ID", ""),
+                       "p": int(os.environ.get("NNPT_PROCESS_ID", "0")
+                                or 0),
+                       "inc": int(os.environ.get("NNPT_INCARNATION",
+                                                 "0") or 0),
+                       **d}
+                with open(self.cfg.events_path, "a") as f:
+                    f.write(json.dumps(rec) + "\n")
+                    f.flush()
+            except (OSError, TypeError, ValueError):
+                pass  # the ledger must never take the control loop down
         return d
 
     def _action_failed(self, now: float, action: str,

@@ -605,6 +605,11 @@ class FleetRouter:
         self._results: Dict[int, List[int]] = {}
         self._next_rid = 0
         self._pumps = 0
+        # inter-pump queue-wait attribution (utils/goodput.py): when a
+        # pump ends with requests still queued (no feasible placement),
+        # the time to the next pump is router queue-wait — retro-emitted
+        # as a queue_wait span so the fleet goodput ledger prices it
+        self._gap_wall: Optional[float] = None
         # completions collected OUTSIDE pump() (on_replica_down drains
         # a dead handle's raced events); the next pump() surfaces them
         self._completed_backlog: List[int] = []
@@ -857,7 +862,15 @@ class FleetRouter:
     # ---- the service loop ----------------------------------------------
     def pump(self) -> List[int]:
         """One router pass; returns fleet rids completed during it."""
+        from ..train import trace as trace_lib
+
         self._pumps += 1
+        tracer = trace_lib.active()
+        if tracer is not None and self._gap_wall is not None:
+            gap = time.time() - self._gap_wall
+            if gap >= 1e-4:
+                tracer.record_span("queue_wait", self._gap_wall, gap,
+                                   {"pump": self._pumps, "router": True})
         done_now: List[int] = self._completed_backlog
         self._completed_backlog = []
         for h in self.replicas:
@@ -879,6 +892,9 @@ class FleetRouter:
         if (self._jsonl is not None and self.rollup_every
                 and self._pumps % self.rollup_every == 0):
             self._write_rollup()
+        # requests still queued after dispatch = the next inter-pump gap
+        # is queue-wait, not idle (see __init__)
+        self._gap_wall = time.time() if self.queue else None
         return done_now
 
     def _dispatch(self) -> None:

@@ -64,6 +64,7 @@ from ..models.transformer import Transformer
 from ..train import telemetry as telemetry_lib
 from ..train import trace as trace_lib
 from ..train.telemetry import Heartbeat
+from ..utils import goodput as goodput_lib
 from ..utils.logging import log
 from ..utils.sketches import ErrorBudget, Gauge, QuantileSketch
 from .paged_kv import PagedDecodeServer
@@ -113,6 +114,13 @@ class ServeConfig:
     alerts: bool = True
     slo_target: float = 0.99       # SLO: fraction of deadlines met
     slo_burn_threshold: float = 2.0  # alert at >= this x budget burn
+    # goodput accounting (utils/goodput.py): meter the tick-phase spans
+    # plus the inter-tick queue_wait/sched_bubble gap spans into
+    # kind="goodput" records on the rollup cadence (file stream only —
+    # needs telemetry_dir); the fleet dashboard shows the serve role's
+    # goodput fraction next to train's
+    goodput: bool = True
+    goodput_target: float = 0.5    # fraction floor for the burn alert
     # span tracing + compile ledger (train/trace.py): per-tick
     # admit/prefill/decode/retire spans and the serve programs' compile
     # events under this dir; None = ride any tracer the enclosing
@@ -207,6 +215,21 @@ class _ServeTelemetry:
         self._budget = (ErrorBudget("slo", target=cfg.slo_target,
                                     burn_threshold=cfg.slo_burn_threshold)
                         if cfg.alerts else None)
+        # goodput accounting: the span-listener meter hears the tick
+        # phases + the inter-tick queue_wait/sched_bubble gap spans and
+        # is snapshotted as kind="goodput" next to each rollup.  File
+        # stream only, so it stays gated on telemetry_dir like the rest
+        # of the IO (the router's placement signal doesn't need it).
+        self.goodput_meter: Optional[goodput_lib.GoodputMeter] = None
+        self._goodput_budget: Optional[ErrorBudget] = None
+        self._goodput_frac_min = float(getattr(cfg, "goodput_target", 0.5))
+        if self.enabled and bool(getattr(cfg, "goodput", True)):
+            self.goodput_meter = goodput_lib.GoodputMeter()
+            trace_lib.add_listener(self.goodput_meter.on_span)
+            if cfg.alerts:
+                self._goodput_budget = ErrorBudget(
+                    "goodput", target=0.9,
+                    window=50, min_events=5, cooldown=10)
         if not self.enabled:
             return
         os.makedirs(dirpath, exist_ok=True)
@@ -352,6 +375,30 @@ class _ServeTelemetry:
         rec = self.rollup_record(tick)
         self.rollups_written += 1
         self._write(rec)
+        self._write_goodput(tick)
+
+    def _write_goodput(self, tick: int) -> None:
+        """One ``kind="goodput"`` record next to each serve rollup
+        (cumulative per incarnation — the aggregator takes the newest
+        per identity); sustained goodput-fraction misses burn the same
+        ErrorBudget contract as the train role."""
+        if self.goodput_meter is None:
+            return
+        snap = self.goodput_meter.snapshot()
+        rec = goodput_lib.goodput_record(
+            snap, role="serve", step=tick,
+            ident=getattr(self, "_ident", None) or trace_lib.run_identity())
+        if self.replica is not None:
+            rec["replica"] = int(self.replica)
+        self._write(rec)
+        if self._goodput_budget is not None and snap["spans"] > 0:
+            frac = snap["goodput_fraction"] or 0.0
+            alert = self._goodput_budget.observe(
+                frac < self._goodput_frac_min)
+            if alert:
+                self._emit_alert({**alert, "goodput_fraction": frac,
+                                  "goodput_target":
+                                      self._goodput_frac_min})
 
     def close(self, tick: int, snap: Optional[Dict[str, Any]] = None
               ) -> None:
@@ -371,6 +418,8 @@ class _ServeTelemetry:
                     self._counters[key] = int(snap[key])
         self._maybe_rollup(tick, final=True)
         self.heartbeat.beat(tick, final_rec, force=True, final=True)
+        if self.goodput_meter is not None:
+            trace_lib.remove_listener(self.goodput_meter.on_span)
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
@@ -433,6 +482,15 @@ class Scheduler:
         rep = "" if cfg.replica is None else f"R{int(cfg.replica)}-"
         self._flow_prefix = (
             f"p{trace_lib.run_identity()['process_id']}-{rep}r")
+        # inter-tick gap attribution (utils/goodput.py): at the end of
+        # each tick remember the wall-clock and WHY the next gap would
+        # not be idle — requests queued with no live stream (queue_wait:
+        # admission capacity, not the model, is the bottleneck) vs
+        # streams mid-decode (sched_bubble: the loop owns the time).
+        # The next tick retro-emits that gap as a span, so the goodput
+        # taxonomy prices scheduler dead time instead of dropping it.
+        self._gap_wall: Optional[float] = None
+        self._gap_state: Optional[str] = None
 
     # ---- client surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
@@ -498,6 +556,12 @@ class Scheduler:
         rids completed during this tick."""
         self.tick_no += 1
         done_now: List[int] = []
+        tracer = trace_lib.active()
+        if tracer is not None and self._gap_state is not None:
+            gap = time.time() - self._gap_wall
+            if gap >= 1e-4:  # sub-100us gaps are loop overhead, not waits
+                tracer.record_span(self._gap_state, self._gap_wall, gap,
+                                   {"tick": self.tick_no})
         with trace_lib.span("admit", tick=self.tick_no):
             self._admit()
         with trace_lib.span("prefill", tick=self.tick_no):
@@ -524,6 +588,9 @@ class Scheduler:
                 for srv_rid in finished:
                     done_now.append(self._retire(srv_rid))
         self.telemetry.on_tick(self.tick_no, self._snapshot())
+        self._gap_wall = time.time()
+        self._gap_state = ("sched_bubble" if self._srv_rid
+                           else ("queue_wait" if self.queue else None))
         return done_now
 
     def run_until_drained(self, max_ticks: int = 100_000) -> List[int]:

@@ -341,6 +341,26 @@ RUN_ID_ENV = "NNPT_RUN_ID"
 INCARNATION_ENV = "NNPT_INCARNATION"
 
 
+def _append_event(path: Optional[str], rec: dict) -> None:
+    """Append one supervisor lifecycle record to the ``events_path``
+    JSONL (launch / exit / hang_kill / relaunch / stopped / gave_up).
+    This is the goodput layer's join key for inter-incarnation time:
+    ``utils/goodput.py`` prices the gap between an exit event and the
+    next incarnation's first span as ``relaunch_gap`` (or ``drain`` on
+    a terminal exit 47).  Best-effort: accounting must never take down
+    the supervisor."""
+    if not path:
+        return
+    import json
+
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+    except OSError:
+        pass
+
+
 def degrade_env(env: dict, probe: dict) -> dict:
     """Rewrite a child environment to the probed (shrunken) world: the
     coordinator rendezvous is dropped entirely and the child forms a
@@ -641,6 +661,7 @@ def supervise(cmd: Sequence[str], max_restarts: int,
               min_devices: int = 0,
               probe: Optional[Callable[[], Optional[dict]]] = None,
               elastic_after: int = 2,
+              events_path: Optional[str] = None,
               _sleep: Callable[[float], None] = time.sleep,
               _rand: Callable[[], float] = random.random) -> int:
     """Run ``cmd`` under the crash-restart policy; return the final exit
@@ -690,6 +711,9 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     (full manifest-checksum pass, utils.ckpt_manifest) the child's
     ``--resume`` will land on — so an operator tailing the supervisor sees
     immediately whether a crash mid-checkpoint cost a generation.
+    ``events_path``: append machine-readable lifecycle records (launch /
+    exit / relaunch, with wall-clock, run id, incarnation, rc) as JSONL —
+    the supervisor half of the goodput join (``utils/goodput.py``).
     """
     if log is None:
         log = lambda m: print(m, file=sys.stderr, flush=True)
@@ -727,6 +751,9 @@ def supervise(cmd: Sequence[str], max_restarts: int,
         child_env[INCARNATION_ENV] = str(attempt - 1)
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
         launched = time.time()
+        _append_event(events_path, {
+            "kind": "supervisor", "event": "launch",
+            "t": round(launched, 6), "run": run_id, "inc": attempt - 1})
         alert_pos = 0
         if alerts_path:
             try:
@@ -735,6 +762,10 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                 alert_pos = 0
         rc = _run_child(cmd, child_env, heartbeat_path, heartbeat_timeout,
                         log)
+        _append_event(events_path, {
+            "kind": "supervisor", "event": "exit",
+            "t": round(time.time(), 6), "run": run_id,
+            "inc": attempt - 1, "rc": rc})
         if alerts_path:
             alerts, _ = alerts_between(alerts_path, alert_pos)
             if alerts:
@@ -786,6 +817,10 @@ def supervise(cmd: Sequence[str], max_restarts: int,
                   EXIT_PEER: "peer loss"}.get(rc, "crash")
         log(f"[supervise] child exit {rc} ({reason}); relaunching in "
             f"{delay:.1f}s ({restarts_used + 1}/{max_restarts})")
+        _append_event(events_path, {
+            "kind": "supervisor", "event": "relaunch",
+            "t": round(time.time(), 6), "run": run_id,
+            "inc": attempt, "delay_s": round(delay, 3), "reason": reason})
         if ckpt_dir:
             step, bad, path = _restore_target(ckpt_dir)
             if step is not None:
@@ -998,6 +1033,7 @@ class GroupSupervisor:
                  log: Optional[Callable[[str], None]] = None,
                  jitter: float = 0.5,
                  env: Optional[dict] = None,
+                 events_path: Optional[str] = None,
                  _rand: Callable[[], float] = random.random,
                  now_fn: Callable[[], float] = time.time):
         import os as _os
@@ -1015,6 +1051,24 @@ class GroupSupervisor:
             f"run-{int(time.time())}-{_os.getpid()}")
         self._children = {s.name: _ChildState(spec=s) for s in specs}
         self._started = False
+        # lifecycle JSONL for the goodput join (see supervise()'s
+        # events_path); wall-clock stamped even under a virtual now_fn —
+        # the ledger correlates against trace timestamps, which are real
+        self._events_path = events_path
+
+    def _emit_event(self, st: _ChildState, kind: str, **extra) -> None:
+        spec = st.spec
+        rec = {"kind": "supervisor", "event": kind,
+               "t": round(time.time(), 6), "run": self.run_id,
+               "child": spec.name, "role": spec.role,
+               "inc": st.incarnation, **extra}
+        pid = (spec.env or {}).get(_PROCESS_ID_ENV)
+        if pid is not None:
+            try:
+                rec["p"] = int(pid)
+            except (TypeError, ValueError):
+                pass
+        _append_event(self._events_path, rec)
 
     # ---- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -1079,6 +1133,7 @@ class GroupSupervisor:
         st.relaunch_at = None
         self._log(f"[group] {spec.role}/{spec.name} inc "
                   f"{st.incarnation}: pid {st.proc.pid}")
+        self._emit_event(st, "launch", pid=getattr(st.proc, "pid", None))
         if spec.on_spawn is not None:
             spec.on_spawn(spec, st.proc, st.incarnation)
 
@@ -1131,6 +1186,7 @@ class GroupSupervisor:
                  **extra}
             st.events.append(e)
             events.append(e)
+            self._emit_event(st, kind, **extra)
 
         now = self._now()
         for st in self._children.values():

@@ -90,8 +90,9 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.optim import GuardedState, Optimizer, global_norm
+from ..utils import goodput as goodput_lib
 from ..utils.logging import is_leader, log
-from ..utils.sketches import EmaZScore, Gauge, QuantileSketch
+from ..utils.sketches import EmaZScore, ErrorBudget, Gauge, QuantileSketch
 from . import trace as trace_lib
 
 Pytree = Any
@@ -513,6 +514,8 @@ class Telemetry:
             self.recorder = FlightRecorder(0, None)
             self.heartbeat = Heartbeat(None)
             self._jsonl = None
+            self.goodput_meter = None
+            self._goodput_budget = None
             return
         if is_leader():
             os.makedirs(self.dir, exist_ok=True)
@@ -535,6 +538,28 @@ class Telemetry:
                                   feature_shape)))
         self.peak_total = (telemetry_peak_flops(device_kind, platform)
                            * max(1, n_devices))
+        # goodput accounting (utils/goodput.py): an online meter riding
+        # the trace span-listener seam, snapshotted as kind="goodput"
+        # records on the rollup cadence, with per-step anatomy joined
+        # from the compile ledger's XLA cost analysis.  --goodput 0
+        # disables (the bench's A/B arm); no tracer installed = the
+        # meter just never hears a span and reports idle.
+        self.peak_bw_total = (goodput_lib.peak_bytes_per_s(
+            device_kind, platform) * max(1, n_devices))
+        self.goodput_meter: Optional[goodput_lib.GoodputMeter] = None
+        self._goodput_budget: Optional[ErrorBudget] = None
+        self._goodput_frac_min = float(getattr(cfg, "goodput_target", 0.5))
+        self._goodput_prev: Optional[Tuple[int, Dict[str, Any]]] = None
+        if bool(getattr(cfg, "goodput", True)):
+            self.goodput_meter = goodput_lib.GoodputMeter()
+            trace_lib.add_listener(self.goodput_meter.on_span)
+            if self.alerts_enabled:
+                # attainment SLO: >= 90% of rollup windows should meet
+                # the goodput-fraction floor; sustained misses burn the
+                # budget at >= 2x and fire goodput_burn_rate
+                self._goodput_budget = ErrorBudget(
+                    "goodput", target=0.9,
+                    window=50, min_events=5, cooldown=10)
         _ACTIVE = self
 
     # ---- hot path --------------------------------------------------------
@@ -682,6 +707,69 @@ class Telemetry:
         self.rollups_written += 1
         self._jsonl.write(json.dumps(rec) + "\n")
         self._jsonl.flush()
+        self._write_goodput(step, ident)
+
+    def _step_anatomy(self) -> Optional[Dict[str, Any]]:
+        """Join the compile ledger's XLA cost analysis (flops / bytes
+        accessed, recorded at compile time) with the measured step time
+        and the meter's host-span seconds into a roofline position +
+        MFU-gap breakdown.  None when any leg of the join is missing
+        (no ledger, no cost analysis from this backend, no measured
+        step yet)."""
+        from ..utils import compile_ledger
+
+        led = compile_ledger.active()
+        last = self.last_record or {}
+        step_ms = last.get("step_time_ms")
+        if led is None or not isinstance(step_ms, (int, float)):
+            return None
+        flops = by = None
+        for e in reversed(led.events):
+            if e.get("flops"):
+                flops, by = e.get("flops"), e.get("bytes_accessed")
+                break
+        if not flops:
+            return None
+        # host cost per step: the meter's dispatch/load/fetch span
+        # seconds differenced over the steps since the last rollup
+        host_s = 0.0
+        if self.goodput_meter is not None and self._goodput_prev:
+            prev_step, prev_host = self._goodput_prev
+            cur = self.goodput_meter.snapshot()["host_seconds"]
+            dsteps = max(1, self._last_rollup_step - prev_step)
+            host_s = max(0.0, sum(cur.values())
+                         - sum(prev_host.values())) / dsteps
+        return goodput_lib.step_anatomy(
+            flops=flops, bytes_accessed=by, step_s=float(step_ms) / 1e3,
+            host_s=host_s, peak_flops=self.peak_total,
+            peak_bw=self.peak_bw_total)
+
+    def _write_goodput(self, step: int, ident: Dict[str, Any]) -> None:
+        """One ``kind="goodput"`` record next to each rollup: cumulative
+        per-category seconds (the aggregator takes the newest per
+        identity, like the sketches), plus the step anatomy.  The burn
+        alert reuses the PR 14 ErrorBudget: each rollup whose goodput
+        fraction is under ``--goodput_target`` consumes error budget."""
+        if self.goodput_meter is None or self._jsonl is None:
+            return
+        snap = self.goodput_meter.snapshot()
+        anatomy = self._step_anatomy()
+        rec = goodput_lib.goodput_record(snap, role=self.role,
+                                         step=step, ident=ident,
+                                         anatomy=anatomy)
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        self._goodput_prev = (int(step), snap["host_seconds"])
+        # no spans heard = tracing is off: the meter sees only idle and
+        # a burn alert would be noise, not signal
+        if self._goodput_budget is not None and snap["spans"] > 0:
+            frac = snap["goodput_fraction"] or 0.0
+            alert = self._goodput_budget.observe(
+                frac < self._goodput_frac_min)
+            if alert:
+                self._emit_alert(
+                    {**alert, "goodput_fraction": frac,
+                     "goodput_target": self._goodput_frac_min}, step)
 
     # ---- events ----------------------------------------------------------
 
@@ -819,6 +907,8 @@ class Telemetry:
 
         if _ACTIVE is self:
             _ACTIVE = None
+        if self.goodput_meter is not None:
+            trace_lib.remove_listener(self.goodput_meter.on_span)
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None

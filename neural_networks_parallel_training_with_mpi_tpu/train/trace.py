@@ -36,6 +36,10 @@ Span taxonomy (the fixed vocabulary the report tool groups by):
 ``rollback``    anomaly/SDC rollback: restore + re-place
 ``admit`` / ``prefill`` / ``decode`` / ``retire``
                 the serving scheduler's tick phases (serve/scheduler.py)
+``queue_wait``  serving inter-tick gap with requests queued but no slot
+``sched_bubble``
+                serving inter-tick gap with decoding streams in flight
+                (the scheduler loop, not the model, owned that time)
 ``compile:<n>`` a ledger-observed XLA compile (utils/compile_ledger.py)
 ==============  ========================================================
 
@@ -161,6 +165,12 @@ class Tracer:
         if attrs:
             rec.update(attrs)
         self._emit_bounded(rec)
+        if _SPAN_LISTENERS:
+            for fn in tuple(_SPAN_LISTENERS):
+                try:
+                    fn(name, t_unix, dur_s, attrs)
+                except Exception:
+                    pass
 
     def instant(self, name: str, **attrs) -> None:
         self._emit_bounded({"kind": "instant", "name": name,
@@ -199,6 +209,28 @@ class Tracer:
 # ---------------------------------------------------------------------------
 
 _ACTIVE: Optional[Tracer] = None
+
+# span listeners: callables ``fn(name, t_unix, dur_s, attrs)`` invoked for
+# every recorded span, from whichever thread recorded it.  This is how
+# ``utils/goodput.py``'s in-process meter observes the span stream without
+# re-reading the trace file; the disabled-path cost is one empty-list
+# truthiness check inside record_span.  Listener exceptions are swallowed —
+# accounting must never take down the traced process.
+_SPAN_LISTENERS: list = []
+
+
+def add_listener(fn) -> None:
+    """Register a span listener (idempotent)."""
+    if fn not in _SPAN_LISTENERS:
+        _SPAN_LISTENERS.append(fn)
+
+
+def remove_listener(fn) -> None:
+    """Unregister a span listener; missing listeners are ignored."""
+    try:
+        _SPAN_LISTENERS.remove(fn)
+    except ValueError:
+        pass
 
 
 class _NullSpan:

@@ -1,0 +1,100 @@
+"""Committed bench artifacts stay parseable and honest.
+
+Every BENCH_*.json in the repo root is a claim the README links to;
+this lane pins that (a) each one parses, (b) dict artifacts carry the
+keys their consumers (bench_diff, the README tables) read, (c) emitter-
+stamped ``_meta`` blocks are internally consistent — the honesty flags
+must agree with the measurement they describe (a ``platform: cpu``
+artifact may not claim real-chip numbers), and (d) the goodput artifact
+satisfies its acceptance gates as COMMITTED, not just at generation
+time: categories sum to the covered wall-clock, the injected crash is
+priced, params/tokens are bitwise-identical accounting on vs off, and
+the interleaved-pair overhead is within its stated gate.
+"""
+
+import glob
+import json
+import pathlib
+
+import pytest
+
+pytestmark = pytest.mark.goodput
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+ARTIFACTS = sorted(glob.glob(str(REPO / "BENCH_*.json")))
+
+
+def _docs():
+    for path in ARTIFACTS:
+        with open(path) as f:
+            yield path, json.load(f)
+
+
+def test_artifacts_exist():
+    assert ARTIFACTS, "no committed BENCH_*.json artifacts found"
+
+
+@pytest.mark.parametrize("path", ARTIFACTS,
+                         ids=[pathlib.Path(p).name for p in ARTIFACTS])
+def test_artifact_parses(path):
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc, (dict, list)), path
+
+
+def test_meta_blocks_are_consistent():
+    """Artifacts written by bench.py's ``_emit_artifact`` stamp a
+    ``_meta`` block; wherever one exists it must be self-consistent.
+    (Artifacts predating the emitter are exempt from carrying one —
+    re-running their bench upgrades them — but may not carry a broken
+    one.)"""
+    stamped = 0
+    for path, doc in _docs():
+        if not isinstance(doc, dict) or "_meta" not in doc:
+            continue
+        stamped += 1
+        meta = doc["_meta"]
+        assert meta["schema"] >= 1, path
+        assert meta["generated_unix"] > 0, path
+        assert isinstance(meta.get("host"), str) and meta["host"], path
+        honesty = meta["honesty"]
+        if "platform" in doc:
+            assert honesty["cpu_fallback"] == (doc["platform"] == "cpu"), \
+                f"{path}: honesty.cpu_fallback contradicts platform"
+        if "interleaved" in honesty and honesty["interleaved"]:
+            assert "interleaved" in str(doc.get("note", "")), path
+    assert stamped >= 1, "no _meta-stamped artifact committed"
+
+
+def test_goodput_artifact_acceptance_gates():
+    path = REPO / "BENCH_GOODPUT.json"
+    assert path.exists(), "BENCH_GOODPUT.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["metric"] == "goodput_accounting_ab"
+    assert doc["_meta"]["schema"] >= 1
+
+    # 100% of the chaos run's wall-clock is classified
+    chaos = doc["chaos"]
+    assert chaos["sum_ok_all_processes"] and chaos["fleet_sum_ok"]
+    assert abs(sum(chaos["categories"].values())
+               - chaos["covered_s"]) < 2e-5
+    # the injected crash is priced, not dropped
+    assert chaos["relaunches"] >= 1
+    assert chaos["relaunch_gap_s"] > 0.0
+    assert chaos["retrain_rollback_s"] > 0.0
+
+    # bitwise pins: accounting on vs off changes nothing it measures
+    assert doc["params_bitwise_identical"] is True
+    assert doc["serve"]["tokens_bitwise_identical"] is True
+    assert doc["meter_sum_ok"] is True
+
+    # the interleaved-pair overhead honors its own stated gate
+    assert doc["overhead_pair_median_pct"] <= doc["overhead_gate_pct"]
+    assert "interleaved" in doc["note"]
+
+    # per-role goodput fraction survives to the Prometheus export
+    merged = doc["fleet_merge"]
+    assert merged["prometheus_families_present"] is True
+    assert any(ln.startswith("nnpt_goodput_fraction{role=")
+               for ln in merged["prometheus_fraction_lines"])

@@ -1,0 +1,224 @@
+#!/usr/bin/env python3
+"""Noise-aware diff gate for two BENCH_*.json artifacts.
+
+Every bench in this repo emits a versioned artifact (``bench.py``'s
+``_emit_artifact`` stamps ``_meta``: schema, host, git rev, honesty
+flags).  This tool is the review-side half of that contract: given the
+OLD artifact (committed) and the NEW one (fresh run), it
+
+  * flattens every NUMERIC leaf of both documents to a dotted key path,
+  * infers the improvement direction from the key's name (``*_ms``,
+    ``*_s``, ``*_pct`` and friends are lower-better; ``*per_s``,
+    ``*fraction``, ``mfu`` and friends are higher-better; anything
+    else is reported but NEVER gated — a changed config knob is not a
+    regression),
+  * gates each directed metric with a RELATIVE tolerance
+    (``--rel-tol``, default 10%): shared-core CPU benches move a few
+    percent run to run, and a gate tighter than the measurement noise
+    only trains people to ignore it,
+  * REFUSES to gate across differing ``_meta.honesty`` flags (a CPU
+    fallback run vs a real-chip run is not a comparison, it is a
+    category error) unless ``--allow-honesty-mismatch`` is passed.
+
+Exit codes: 0 clean, 1 at least one gated regression, 2 the comparison
+itself is invalid (unreadable/NON-comparable artifacts).  Stdlib only;
+runs under ``python -S`` like every other tool here.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# direction by key-name suffix/substring, checked on the LAST path
+# segment.  Higher-better wins ties ("goodput_fraction" must not match
+# lower-better via a "_s"-style accident), then lower-better, else
+# undirected.
+_HIGHER = ("per_s", "per_sec", "tokens_per_s", "samples_per_sec",
+           "fraction", "mfu", "goodput", "hit_rate", "agreement",
+           "capacity", "throughput", "frames_per_s", "updates_per_s")
+_LOWER = ("_ms", "_s", "_sec", "_pct", "_bytes", "latency", "ttft",
+          "itl", "overhead", "residual", "skipped", "dropped",
+          "alerts_fired", "stale", "p50", "p99")
+# accounting/config keys that look directed but are descriptive: gating
+# them would flag "the chaos run covered a different number of seconds"
+# as a perf regression
+_SKIP = ("covered_s", "generated_unix", "t_start", "t_end", "t_unix",
+         "relaunch_gap_s", "rollback_s", "drain_s", "gate_pct",
+         "chain_steps", "rollup_every", "new_tokens", "reps", "seed",
+         "schema", "n_", "num_", "batch", "seq", "vocab", "d_model",
+         "d_ff", "block", "slots", "steps", "window", "every",
+         "max_", "min_events")
+
+
+def direction(path: str) -> Optional[str]:
+    """'higher' / 'lower' / None (undirected) for a flattened key."""
+    leaf = path.rsplit(".", 1)[-1].lower()
+    # higher-better names win first: "tokens_per_s_best" must not be
+    # swallowed by descriptive-key skips or a "_s"-suffix accident
+    if any(s in leaf for s in _HIGHER):
+        return "higher"
+    if any(s in leaf for s in _SKIP):
+        return None
+    if any(leaf.endswith(s) or s in leaf for s in _LOWER):
+        return "lower"
+    return None
+
+
+def flatten(doc: Any, prefix: str = "") -> Dict[str, float]:
+    """Numeric leaves only, dotted paths; ``_meta`` handled separately
+    (timestamps and git revs are provenance, not metrics); booleans are
+    CONTRACT flags, not magnitudes — a flipped one is always a failure,
+    so they flatten too (True=1) and gate at zero tolerance."""
+    out: Dict[str, float] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if k == "_meta":
+                continue
+            out.update(flatten(v, f"{prefix}{k}."))
+    elif isinstance(doc, list):
+        # index-addressed: list order is part of the artifact contract
+        for i, v in enumerate(doc):
+            out.update(flatten(v, f"{prefix}{i}."))
+    elif isinstance(doc, bool):
+        out[prefix[:-1]] = 1.0 if doc else 0.0
+    elif isinstance(doc, (int, float)):
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def _is_bool_path(old_doc: Any, path: str) -> bool:
+    node = old_doc
+    for seg in path.split("."):
+        if isinstance(node, list):
+            try:
+                node = node[int(seg)]
+            except (ValueError, IndexError):
+                return False
+        elif isinstance(node, dict):
+            if seg not in node:
+                return False
+            node = node[seg]
+        else:
+            return False
+    return isinstance(node, bool)
+
+
+def compare(old_doc: Any, new_doc: Any,
+            rel_tol: float = 0.10) -> Dict[str, Any]:
+    """All changed numeric leaves + the gated regressions among them."""
+    old_f, new_f = flatten(old_doc), flatten(new_doc)
+    changed: List[Dict[str, Any]] = []
+    regressions: List[Dict[str, Any]] = []
+    for path in sorted(set(old_f) & set(new_f)):
+        a, b = old_f[path], new_f[path]
+        if a == b:
+            continue
+        boolish = _is_bool_path(old_doc, path)
+        rel = (b - a) / abs(a) if a != 0 else None
+        d = direction(path)
+        row = {"key": path, "old": a, "new": b,
+               "rel_change": None if rel is None else round(rel, 4),
+               "direction": "contract" if boolish else d}
+        changed.append(row)
+        if boolish:
+            if a == 1.0 and b == 0.0:  # a contract pin flipped false
+                regressions.append(row)
+            continue
+        if d is None or rel is None:
+            continue
+        if d == "lower" and rel > rel_tol:
+            regressions.append(row)
+        elif d == "higher" and rel < -rel_tol:
+            regressions.append(row)
+    return {
+        "n_compared": len(set(old_f) & set(new_f)),
+        "only_old": sorted(set(old_f) - set(new_f)),
+        "only_new": sorted(set(new_f) - set(old_f)),
+        "changed": changed,
+        "regressions": regressions,
+        "rel_tol": rel_tol,
+    }
+
+
+def honesty(doc: Any) -> Optional[Dict[str, Any]]:
+    if isinstance(doc, dict):
+        meta = doc.get("_meta")
+        if isinstance(meta, dict):
+            return meta.get("honesty")
+    return None
+
+
+def render(report: Dict[str, Any], old_path: str, new_path: str) -> str:
+    lines = [f"bench diff: {old_path} -> {new_path} "
+             f"({report['n_compared']} comparable leaves, rel-tol "
+             f"{report['rel_tol']:.0%})"]
+    for row in report["changed"]:
+        mark = "  "
+        if row in report["regressions"]:
+            mark = "!!"
+        arrow = {"lower": "v better", "higher": "^ better",
+                 "contract": "pin", None: "undirected"}[row["direction"]]
+        rel = ("" if row["rel_change"] is None
+               else f" ({row['rel_change']:+.1%})")
+        lines.append(f"{mark} {row['key']}: {row['old']:g} -> "
+                     f"{row['new']:g}{rel} [{arrow}]")
+    for key in report["only_old"]:
+        lines.append(f"   - {key} (dropped in new)")
+    for key in report["only_new"]:
+        lines.append(f"   + {key} (new)")
+    if report["regressions"]:
+        lines.append(f"REGRESSED: {len(report['regressions'])} gated "
+                     "metric(s) beyond tolerance")
+    else:
+        lines.append("ok: no gated regressions")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("old", help="committed artifact (baseline)")
+    ap.add_argument("new", help="fresh artifact to judge")
+    ap.add_argument("--rel-tol", type=float, default=0.10,
+                    help="relative regression tolerance on directed "
+                         "metrics (default 0.10 = 10%%)")
+    ap.add_argument("--allow-honesty-mismatch", action="store_true",
+                    help="compare even when _meta.honesty flags differ "
+                         "(e.g. cpu_fallback vs real chip) — the "
+                         "mismatch is still printed")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"bench_diff: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+    hon = [honesty(d) for d in docs]
+    mismatch = hon[0] != hon[1]
+    if mismatch and not args.allow_honesty_mismatch:
+        print(f"bench_diff: honesty flags differ ({hon[0]} vs "
+              f"{hon[1]}): refusing to gate a category error — rerun "
+              "on matching hardware or pass "
+              "--allow-honesty-mismatch", file=sys.stderr)
+        return 2
+
+    report = compare(docs[0], docs[1], rel_tol=args.rel_tol)
+    report["honesty"] = {"old": hon[0], "new": hon[1],
+                         "mismatch": mismatch}
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        if mismatch:
+            print(f"note: honesty flags differ ({hon[0]} vs {hon[1]})")
+        print(render(report, args.old, args.new))
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,20 +31,20 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return sorted_vals[i]
 
 
+def load_records_counted(path: str, last: int = 0
+                         ) -> "tuple[List[Dict[str, Any]], int]":
+    """Tolerant JSONL load via the shared ``utils/jsonl`` reader:
+    returns ``(records, skipped)`` where ``skipped`` counts torn/bad
+    lines.  A missing file still raises OSError (callers distinguish
+    'no file' from 'empty stream')."""
+    with open(path):
+        pass  # existence/permission check — the reader treats absence as empty
+    records, skipped = _jsonl_mod().read_jsonl(path)
+    return (records[-last:] if last > 0 else records), skipped
+
+
 def load_records(path: str, last: int = 0) -> List[Dict[str, Any]]:
-    records: List[Dict[str, Any]] = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue  # torn tail line of a live run
-            if isinstance(rec, dict):
-                records.append(rec)
-    return records[-last:] if last > 0 else records
+    return load_records_counted(path, last=last)[0]
 
 
 def _series(records, key) -> List[float]:
@@ -384,6 +384,10 @@ def render_text(summary: Dict[str, Any], records: List[Dict[str, Any]],
         for e in events[-5:]:
             lines.append(f"  event: {e.get('event')} @ step "
                          f"{e.get('step')}")
+    if summary.get("lines_skipped"):
+        lines.append(f"note: {summary['lines_skipped']} unparseable "
+                     "JSONL line(s) skipped (torn tail of a "
+                     "live/killed writer)")
     return "\n".join(lines)
 
 
@@ -400,6 +404,29 @@ def _trace_report_mod():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+_jsonl_cache = None
+
+
+def _jsonl_mod():
+    """utils/jsonl.py — the one tolerant JSONL reader every
+    observability tool shares — loaded by file path so it works as a
+    bare script under ``python -S``."""
+    global _jsonl_cache
+    if _jsonl_cache is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "neural_networks_parallel_training_with_mpi_tpu", "utils",
+            "jsonl.py")
+        spec = importlib.util.spec_from_file_location("_nnpt_jsonl",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _jsonl_cache = mod
+    return _jsonl_cache
 
 
 _sketches_cache = None
@@ -442,6 +469,50 @@ def trace_view(path: str) -> Optional[Dict[str, Any]]:
     return None
 
 
+def autopilot_view(path: str) -> Optional[Dict[str, Any]]:
+    """The --autopilot summary: every ``kind="autopilot"`` decision from
+    the ledger files (``autopilot*.jsonl`` in the run dir or its
+    ``trace/`` subdir) — count by action plus the recent tail."""
+    import glob as glob_lib
+
+    paths: List[str] = []
+    for cand in (path, os.path.join(path, "trace")):
+        if os.path.isdir(cand):
+            paths.extend(sorted(glob_lib.glob(
+                os.path.join(cand, "autopilot*.jsonl"))))
+    if not os.path.isdir(path) and os.path.isfile(path):
+        paths.append(path)  # an explicit ledger file
+    recs, skipped = _jsonl_mod().read_many(paths)
+    decisions = [r for r in recs
+                 if r.get("kind") == "autopilot" or "action" in r]
+    if not decisions:
+        return None
+    by_action: Dict[str, int] = {}
+    for d in decisions:
+        key = str(d.get("action"))
+        by_action[key] = by_action.get(key, 0) + 1
+    return {"n": len(decisions), "by_action": by_action,
+            "lines_skipped": skipped, "last": decisions[-10:]}
+
+
+_AUTOPILOT_META = ("kind", "t", "t_unix", "action", "run", "p", "inc")
+
+
+def autopilot_lines(view: Dict[str, Any]) -> List[str]:
+    lines = [f"autopilot: {view['n']} decision(s) (" + ", ".join(
+        f"{k} x{v}" for k, v in sorted(view["by_action"].items()))
+        + ")"]
+    for d in view["last"]:
+        extra = ", ".join(f"{k}={v}" for k, v in d.items()
+                          if k not in _AUTOPILOT_META)
+        lines.append(f"  t+{d.get('t', '?')}s {d.get('action')}"
+                     + (f"  ({extra})" if extra else ""))
+    if view.get("lines_skipped"):
+        lines.append(f"  note: {view['lines_skipped']} unparseable "
+                     "ledger line(s) skipped")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="a --telemetry_dir or a metrics JSONL file")
@@ -460,6 +531,11 @@ def main(argv=None) -> int:
                          "cache columns (hit rate, shared blocks, CoW "
                          "forks, blocks saved) — nothing from the "
                          "training stream")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="autopilot-decision view: the persisted "
+                         "control-loop ledger (autopilot*.jsonl) — "
+                         "decision counts by action and the recent "
+                         "tail")
     args = ap.parse_args(argv)
 
     heartbeat = postmortem = None
@@ -493,20 +569,27 @@ def main(argv=None) -> int:
             pass
     else:
         metrics_path = args.path
+    lines_skipped = 0
     try:
-        records = load_records(metrics_path, last=args.last)
+        records, lines_skipped = load_records_counted(metrics_path,
+                                                      last=args.last)
     except OSError as e:
-        if not args.trace:
+        if not (args.trace or args.autopilot):
             print(f"ERROR: cannot read {metrics_path}: {e}",
                   file=sys.stderr)
             return 2
-        records = []  # trace-only view of a dir with no metrics stream
+        records = []  # trace/ledger-only view, no metrics stream
     summary = summarize(records, windowed=args.last > 0)
+    if lines_skipped:
+        summary["lines_skipped"] = lines_skipped
     trace = trace_view(args.path) if args.trace else None
+    pilot = autopilot_view(args.path) if args.autopilot else None
     if args.json:
         if args.serve:
             summary = {k: v for k, v in summary.items()
                        if k in ("n_records", "serving", "serving_ticks")}
+        if args.autopilot:
+            summary["autopilot"] = pilot
         summary["heartbeat"] = heartbeat
         summary["heartbeat_age_s"] = heartbeat_age
         if len(heartbeats) > 1:
@@ -516,6 +599,9 @@ def main(argv=None) -> int:
             trace.pop("_render", None)
             summary["trace"] = trace
         print(json.dumps(summary, indent=2))
+    elif args.autopilot:
+        print("\n".join(autopilot_lines(pilot)) if pilot
+              else "no autopilot decisions (autopilot*.jsonl) found")
     elif args.serve:
         out = serving_lines(summary)
         print("\n".join(out) if out

@@ -47,20 +47,22 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-_SKETCHES_PY = (pathlib.Path(__file__).resolve().parent.parent
-                / "neural_networks_parallel_training_with_mpi_tpu"
-                / "utils" / "sketches.py")
+_UTILS_DIR = (pathlib.Path(__file__).resolve().parent.parent
+              / "neural_networks_parallel_training_with_mpi_tpu"
+              / "utils")
+_SKETCHES_PY = _UTILS_DIR / "sketches.py"
+_JSONL_PY = _UTILS_DIR / "jsonl.py"
 
 
-def _load_sketches_mod():
-    spec = importlib.util.spec_from_file_location("_nnpt_sketches",
-                                                  _SKETCHES_PY)
+def _load_mod(name: str, path):
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-sk = _load_sketches_mod()
+sk = _load_mod("_nnpt_sketches", _SKETCHES_PY)
+jz = _load_mod("_nnpt_jsonl", _JSONL_PY)
 
 # fleet gauges that ADD across processes (load) vs. average (intensity)
 _ADDITIVE_GAUGES = ("tokens_per_sec", "queue_depth")
@@ -73,31 +75,12 @@ DEFAULT_STALE_AFTER_S = 120.0
 DEFAULT_ALERT_WINDOW_S = 3600.0
 
 
-def _load_jsonl(path: str) -> List[Dict[str, Any]]:
-    records: List[Dict[str, Any]] = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail line of a live run
-                if isinstance(rec, dict):
-                    records.append(rec)
-    except OSError:
-        pass
-    return records
-
-
 def collect_dir(dirpath: str) -> Dict[str, Any]:
-    """Everything the aggregator needs from one telemetry dir: rollup
-    and alert records, heartbeat files with their staleness, and the
-    latest point stats per stream kind (a dir with no rollups still
+    """Everything the aggregator needs from one telemetry dir: rollup,
+    goodput and alert records, heartbeat files with their staleness, and
+    the latest point stats per stream kind (a dir with no rollups still
     contributes its heartbeat + alerts)."""
-    recs = _load_jsonl(os.path.join(dirpath, "metrics.jsonl"))
+    recs, skipped = jz.read_jsonl(os.path.join(dirpath, "metrics.jsonl"))
     heartbeats = []
     for hb_path in sorted(glob_lib.glob(
             os.path.join(dirpath, "heartbeat*.json"))):
@@ -125,8 +108,10 @@ def collect_dir(dirpath: str) -> Dict[str, Any]:
     return {
         "dir": dirpath,
         "rollups": [r for r in recs if r.get("kind") == "rollup"],
+        "goodputs": [r for r in recs if r.get("kind") == "goodput"],
         "alerts": [r for r in recs if r.get("kind") == "alert"],
         "heartbeats": heartbeats,
+        "lines_skipped": skipped,
     }
 
 
@@ -214,6 +199,47 @@ def aggregate(dirs: List[str],
                 row[name] = cn[name]
         breakdown.append(row)
 
+    # ---- goodput ---------------------------------------------------------
+    # kind="goodput" records are CUMULATIVE per incarnation (like the
+    # sketches): the newest record per identity supersedes earlier ones
+    # from the same incarnation, and category seconds then SUM across
+    # every identity — a dead incarnation's lost seconds still happened
+    # and still belong in the fleet's time ledger.
+    latest_gp: Dict[Tuple, Dict[str, Any]] = {}
+    for c in collected:
+        for r in c["goodputs"]:
+            latest_gp[_identity(c["dir"], r)] = r
+    gp_roles: Dict[str, Dict[str, Any]] = {}
+    for key, rec in sorted(latest_gp.items()):
+        d, role, run, p, inc = key
+        gv = gp_roles.setdefault(role, {"writers": 0, "covered_s": 0.0,
+                                        "categories": {},
+                                        "anatomy": None,
+                                        "_anatomy_t": -1.0})
+        gv["writers"] += 1
+        gv["covered_s"] += float(rec.get("covered_s") or 0.0)
+        for cat, secs in (rec.get("categories") or {}).items():
+            if isinstance(secs, (int, float)):
+                gv["categories"][cat] = (gv["categories"].get(cat, 0.0)
+                                         + float(secs))
+        anatomy = rec.get("anatomy")
+        t_unix = rec.get("t_unix") or 0.0
+        if isinstance(anatomy, dict) and t_unix >= gv["_anatomy_t"]:
+            gv["anatomy"] = anatomy
+            gv["_anatomy_t"] = t_unix
+    gp_fleet_covered = 0.0
+    gp_fleet_step = 0.0
+    for role, gv in gp_roles.items():
+        covered = gv["covered_s"]
+        step_s = gv["categories"].get("step", 0.0)
+        gv["covered_s"] = round(covered, 6)
+        gv["categories"] = {k: round(v, 6)
+                            for k, v in sorted(gv["categories"].items())}
+        gv["fraction"] = round(step_s / covered, 6) if covered > 0 else None
+        gp_fleet_covered += covered
+        gp_fleet_step += step_s
+        del gv["_anatomy_t"]
+
     out_roles: Dict[str, Any] = {}
     fleet: Dict[str, Any] = {}
     for role, view in sorted(roles.items()):
@@ -239,6 +265,17 @@ def aggregate(dirs: List[str],
             # load, the summed latest gauges are
             if name in _ADDITIVE_GAUGES:
                 fleet[name] = val
+    for role, gv in sorted(gp_roles.items()):
+        # a goodput-only writer (tracing on before the first rollup)
+        # still gets a role row
+        row = out_roles.setdefault(role, {"writers": gv["writers"],
+                                          "sketches": {}, "counters": {},
+                                          "gauges": {}})
+        row["goodput"] = gv
+    if gp_fleet_covered > 0:
+        fleet["goodput_fraction"] = round(
+            gp_fleet_step / gp_fleet_covered, 6)
+        fleet["goodput_covered_s"] = round(gp_fleet_covered, 6)
 
     # ---- alerts ----------------------------------------------------------
     def scrub(rec: Dict[str, Any]) -> Dict[str, Any]:
@@ -289,6 +326,7 @@ def aggregate(dirs: List[str],
         "roles": out_roles,
         "breakdown": breakdown,
         "fleet": fleet,
+        "lines_skipped": sum(c["lines_skipped"] for c in collected),
         "heartbeats": heartbeats,
         "alerts": {"n": len(alerts), "by_name": by_name,
                    "window_s": alert_window_s,
@@ -367,6 +405,23 @@ def to_prometheus(doc: Dict[str, Any], prefix: str = "nnpt") -> str:
                  mtype="gauge" if f"{name}_current" not in typed
                  else None)
             typed.add(f"{name}_current")
+        gp = view.get("goodput")
+        if gp:
+            for cat, secs in (gp.get("categories") or {}).items():
+                emit("goodput_seconds_total", secs,
+                     {"role": role, "category": cat},
+                     mtype="counter" if "gp_s" not in typed else None,
+                     help_="wall-clock seconds attributed to each "
+                           "goodput category" if "gp_s" not in typed
+                     else None)
+                typed.add("gp_s")
+            if gp.get("fraction") is not None:
+                emit("goodput_fraction", gp["fraction"], {"role": role},
+                     mtype="gauge" if "gp_f" not in typed else None,
+                     help_="fraction of covered wall-clock spent on "
+                           "productive step compute"
+                     if "gp_f" not in typed else None)
+                typed.add("gp_f")
     for hb in doc.get("heartbeats") or []:
         emit("heartbeat_age_seconds", hb["age_s"],
              {"dir": hb["dir"], "role": hb["role"],
@@ -412,6 +467,28 @@ def render_text(doc: Dict[str, Any]) -> str:
             lines.append(f"  {name:<18} {val:.6g} "
                          f"({'sum' if name in _ADDITIVE_GAUGES else 'mean'}"
                          " across live writers)")
+        gp = view.get("goodput")
+        if gp and gp.get("covered_s"):
+            frac = gp.get("fraction")
+            head = (f"  goodput            "
+                    + (f"{frac * 100:.1f}%" if frac is not None else "?")
+                    + f" of {gp['covered_s']:.1f}s covered")
+            cats = [(c, s) for c, s in (gp.get("categories") or {}).items()
+                    if s > 0]
+            cats.sort(key=lambda kv: -kv[1])
+            if cats:
+                head += " — " + ", ".join(f"{c} {s:.1f}s"
+                                          for c, s in cats[:6])
+            lines.append(head)
+            an = gp.get("anatomy")
+            if isinstance(an, dict) and an.get("mfu") is not None:
+                gap = an.get("mfu_gap") or {}
+                lines.append(
+                    f"  anatomy            {an.get('roofline_bound', '?')}"
+                    f"-bound, mfu {an['mfu']:.3f} (gap: compute "
+                    f"{gap.get('compute_frac', 0) * 100:.0f}% host "
+                    f"{gap.get('host_frac', 0) * 100:.0f}% stall "
+                    f"{gap.get('stall_frac', 0) * 100:.0f}%)")
     breakdown = doc.get("breakdown") or []
     if breakdown:
         lines.append("per-writer (newest incarnation):")
@@ -447,6 +524,10 @@ def render_text(doc: Dict[str, Any]) -> str:
         lines.append(f"heartbeat {hb['role']:<6} p{hb['process']} "
                      f"step {hb['step']}: {hb['age_s']:.1f}s old "
                      f"[{mark}]")
+    skipped = doc.get("lines_skipped")
+    if skipped:
+        lines.append(f"note: {skipped} unparseable JSONL line(s) "
+                     "skipped (torn tail of a live/killed writer)")
     alerts = doc.get("alerts") or {}
     if alerts.get("n"):
         lines.append(f"ALERTS ({alerts['n']} in the last "
@@ -484,6 +565,9 @@ def render_dashboard(doc: Dict[str, Any]) -> str:
            .get("mfu"))
     if mfu and mfu.get("p50") is not None:
         banner.append(f"mfu p50 {mfu['p50']:.3f}")
+    gpf = fleet.get("goodput_fraction")
+    if isinstance(gpf, (int, float)):
+        banner.append(f"goodput={gpf * 100:.0f}%")
     n_alerts = (doc.get("alerts") or {}).get("n", 0)
     banner.append(f"alerts={n_alerts}")
     return (_CLEAR + "NNPT FLEET  |  " + "  |  ".join(banner) + "\n"

@@ -232,12 +232,17 @@ def main(argv=None) -> int:
             from neural_networks_parallel_training_with_mpi_tpu.serve \
                 import Autopilot, AutopilotConfig
 
+            import os
+
             ap_obj = Autopilot(fleet, AutopilotConfig(
                 min_replicas=args.min_replicas,
                 max_replicas=args.max_replicas,
                 scale_out_hold_s=args.scale_out_hold,
                 canary_fraction=args.canary_fraction,
-                canary_window_s=args.canary_window), log=log)
+                canary_window_s=args.canary_window,
+                events_path=(os.path.join(
+                    args.telemetry_dir, "autopilot-decisions.jsonl")
+                    if args.telemetry_dir else None)), log=log)
             fleet.autopilot = ap_obj
             if args.rollout_after > 0:
                 snap = _prepare_snapshot(args, log)

@@ -34,40 +34,43 @@ from __future__ import annotations
 
 import argparse
 import glob
+import importlib.util
 import json
 import os
+import pathlib
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 Key = Tuple[str, int, int]  # (run_id, process_id, incarnation)
 
-
-def _load_jsonl(path: str) -> List[Dict[str, Any]]:
-    records: List[Dict[str, Any]] = []
-    try:
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue  # torn tail line of a live run
-                if isinstance(rec, dict):
-                    records.append(rec)
-    except OSError:
-        pass
-    return records
+_JSONL_PY = (pathlib.Path(__file__).resolve().parent.parent
+             / "neural_networks_parallel_training_with_mpi_tpu"
+             / "utils" / "jsonl.py")
 
 
-def load_dir(dirpath: str) -> Dict[str, List[Dict[str, Any]]]:
-    """All span + compile records under a trace dir, keyed by kind."""
+def _load_jsonl_mod():
+    spec = importlib.util.spec_from_file_location("_nnpt_jsonl",
+                                                  _JSONL_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+jz = _load_jsonl_mod()
+
+
+def load_dir(dirpath: str) -> Dict[str, Any]:
+    """All span + compile + autopilot-decision records under a trace
+    dir, keyed by kind, plus the torn-line skip count from the shared
+    tolerant reader."""
     spans: List[Dict[str, Any]] = []
     compiles: List[Dict[str, Any]] = []
     metas: List[Dict[str, Any]] = []
+    skipped = 0
     for path in sorted(glob.glob(os.path.join(dirpath, "trace-*.jsonl"))):
-        for rec in _load_jsonl(path):
+        recs, skip = jz.read_jsonl(path)
+        skipped += skip
+        for rec in recs:
             kind = rec.get("kind")
             if kind in ("span", "instant", "flow"):
                 spans.append(rec)
@@ -75,9 +78,33 @@ def load_dir(dirpath: str) -> Dict[str, List[Dict[str, Any]]]:
                 metas.append(rec)
     for path in sorted(glob.glob(os.path.join(dirpath,
                                               "compiles-*.jsonl"))):
-        compiles.extend(r for r in _load_jsonl(path)
-                        if r.get("kind") == "compile")
-    return {"spans": spans, "compiles": compiles, "metas": metas}
+        recs, skip = jz.read_jsonl(path)
+        skipped += skip
+        compiles.extend(r for r in recs if r.get("kind") == "compile")
+    # the autopilot flight recorder (serve/autopilot.py events_path):
+    # each decision becomes an instant event on its writer's track, so
+    # Perfetto shows WHEN the control loop acted between the tick spans
+    n_decisions = 0
+    for path in sorted(glob.glob(os.path.join(dirpath,
+                                              "autopilot*.jsonl"))):
+        recs, skip = jz.read_jsonl(path)
+        skipped += skip
+        for rec in recs:
+            if rec.get("kind") != "autopilot" or "t_unix" not in rec:
+                continue
+            n_decisions += 1
+            inst = {"kind": "instant",
+                    "name": f"autopilot:{rec.get('action', '?')}",
+                    "t": rec.get("t_unix"),
+                    "p": rec.get("p", 0), "run": rec.get("run", ""),
+                    "inc": rec.get("inc", 0)}
+            inst.update({k: v for k, v in rec.items()
+                         if k not in ("kind", "t", "t_unix", "action",
+                                      "p", "run", "inc")})
+            spans.append(inst)
+    return {"spans": spans, "compiles": compiles, "metas": metas,
+            "autopilot_decisions": n_decisions,
+            "lines_skipped": skipped}
 
 
 def _key(rec: Dict[str, Any]) -> Key:
@@ -157,7 +184,10 @@ def summarize(data: Dict[str, List[Dict[str, Any]]]) -> Dict[str, Any]:
     spans = [r for r in data["spans"] if r.get("kind") == "span"]
     flows = [r for r in data["spans"] if r.get("kind") == "flow"]
     out: Dict[str, Any] = {"runs": sorted({_key(r)[0] for r in spans}),
-                           "groups": [], "compiles": []}
+                           "groups": [], "compiles": [],
+                           "autopilot_decisions":
+                               data.get("autopilot_decisions", 0),
+                           "lines_skipped": data.get("lines_skipped", 0)}
     # the bounded-trace footer: each tracer's final meta record counts
     # the spans dropped past the event cap.  Surfacing it per track is
     # what keeps a truncated timeline from reading as a complete one —
@@ -282,6 +312,14 @@ def render_text(summary: Dict[str, Any]) -> str:
                                             for p, v in r[k].items()))
             lines.append(f"  RECOMPILE {r['name']} (#{r['n_compile']}): "
                          + ("; ".join(what) if what else "?"))
+    if summary.get("autopilot_decisions"):
+        lines.append(f"autopilot: {summary['autopilot_decisions']} "
+                     "decision(s) drawn as instant events on their "
+                     "writers' tracks")
+    if summary.get("lines_skipped"):
+        lines.append(f"note: {summary['lines_skipped']} unparseable "
+                     "JSONL line(s) skipped (torn tail of a "
+                     "live/killed writer)")
     if not summary["groups"]:
         lines.append("(no spans found)")
     return "\n".join(lines)
