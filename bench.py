@@ -3393,6 +3393,100 @@ def bench_autopilot(out_path: str = "BENCH_AUTOPILOT.json") -> str:
     return out_path
 
 
+def bench_chaos(out_path: str = "BENCH_CHAOS.json") -> str:
+    """The chaos-campaign bench (utils/chaos.py): run the ``full``
+    plan — stub crash-vs-notice A/B plus three real-subprocess-fleet
+    failures (SIGKILL mid-load, advance-notice drain with backfill,
+    degraded-replica health eviction) — twice, gate on every
+    invariant, and report the recovery prices: MTTR, reaction time,
+    requeued requests, tokens lost, and the crash-vs-notice goodput
+    split (rollback + relaunch_gap collapsing to drain when the
+    failure is announced).  The campaign's wall-clock-free canonical
+    digest must match across both passes — reproducibility IS one of
+    the acceptance gates, not a side note."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.utils import (
+        chaos,
+    )
+
+    devices = jax.devices()
+    plan = chaos.load_plan("full")
+    doc = chaos.run_campaign(plan, repeat=2, log=log)
+
+    scenarios: dict = {}
+    for r in doc["scenarios"]:
+        scenarios[r["name"]] = {
+            "mode": r.get("mode", r.get("fault")),
+            "invariants": r["invariants"],
+            "metrics": r["metrics"],
+            "wall_s": r["wall_s"],
+        }
+    by = {r["name"]: r for r in doc["scenarios"]}
+    crash = by.get("stub_crash", {}).get("metrics", {})
+    notice = by.get("stub_preempt", {}).get("metrics", {})
+    results: dict = {
+        "plan": doc["plan"],
+        "seed": doc["seed"],
+        "scenarios": scenarios,
+        "crash_vs_notice": {
+            # the tentpole A/B: same failure point, announced vs not —
+            # the notice arm's rollback and relaunch_gap must be zero
+            "crash": {
+                "mttr_s": crash.get("mttr_s"),
+                "rollback_s":
+                    crash.get("categories", {}).get("rollback", 0.0),
+                "relaunch_gap_s":
+                    crash.get("categories", {}).get("relaunch_gap",
+                                                    0.0),
+            },
+            "notice": {
+                "mttr_s": notice.get("mttr_s"),
+                "rollback_s":
+                    notice.get("categories", {}).get("rollback", 0.0),
+                "relaunch_gap_s":
+                    notice.get("categories", {}).get("relaunch_gap",
+                                                     0.0),
+                "drain_s":
+                    notice.get("categories", {}).get("drain", 0.0),
+            },
+        },
+        "determinism": doc["determinism"],
+        "invariants_ok": doc["invariants_ok"],
+        "problems": doc["problems"],
+    }
+    results["acceptance"] = {
+        "all_invariants_held": doc["invariants_ok"],
+        "reproducible": doc["determinism"]["reproducible"],
+        "notice_zero_rollback":
+            notice.get("categories", {}).get("rollback", 0.0) == 0.0,
+        "notice_zero_relaunch_gap":
+            notice.get("categories", {}).get("relaunch_gap",
+                                             0.0) == 0.0,
+        "notice_fleet_zero_requeue":
+            by.get("fleet_preempt_notice", {})
+              .get("metrics", {}).get("requeued") == 0,
+        "evict_p99_recovered":
+            by.get("fleet_slow_evict", {})
+              .get("invariants", {}).get("p99_itl_recovered", False),
+    }
+    results["platform"] = devices[0].platform
+    results["device_kind"] = devices[0].device_kind
+    out_path = _divert_cpu_overwrite(
+        out_path, devices[0].platform not in ("cpu",))
+    _emit_artifact(out_path, results, honesty={
+        "stub_scenarios_no_jax": True,   # supervised span-emitting
+        # stdlib children stand in for trainers in the stub arms; the
+        # fleet arms are real subprocess replicas under load
+        "digest_excludes_wall_clock": True,  # canonical digest drops
+        # timing-jittered metrics and contingent escalation actions
+    })
+    log(f"chaos bench -> {out_path} "
+        f"(invariants_ok={doc['invariants_ok']}, "
+        f"reproducible={doc['determinism']['reproducible']})")
+    return out_path
+
+
 def bench_paged_attn(out_path: str = "BENCH_PAGED_ATTN.json") -> str:
     """The fused paged-attention bench (ops.pallas_kernels.paged_attention
     behind serve/paged_kv.py's ``attn_impl`` seam): (1) a gathered-vs-
@@ -4067,6 +4161,16 @@ def main() -> int:
                          "BENCH_AUTOPILOT.json")
     ap.add_argument("--autopilot-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos-campaign bench (utils/chaos.py): run "
+                         "the 'full' deterministic failure plan twice "
+                         "— crash-vs-notice stub A/B plus SIGKILL / "
+                         "advance-notice drain / health-eviction "
+                         "against a real subprocess fleet — gate on "
+                         "every invariant and the cross-pass canonical "
+                         "digest; write BENCH_CHAOS.json")
+    ap.add_argument("--chaos-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--serve-attn-impl", choices=["gathered", "fused"],
                     default="gathered",
                     help="attention dispatch for the --serve sweep: "
@@ -4189,6 +4293,9 @@ def main() -> int:
     if args.autopilot_inproc:
         print(json.dumps({"autopilot_artifact": bench_autopilot()}))
         return 0
+    if args.chaos_inproc:
+        print(json.dumps({"chaos_artifact": bench_chaos()}))
+        return 0
     if args.paged_attn_inproc:
         print(json.dumps({"paged_attn_artifact": bench_paged_attn()}))
         return 0
@@ -4216,7 +4323,7 @@ def main() -> int:
         return 0
 
     if (args.attention or args.decode or args.serve or args.rl
-            or args.serve_fleet or args.autopilot
+            or args.serve_fleet or args.autopilot or args.chaos
             or args.paged_attn or args.prefix_cache
             or args.update_sharding_ab or args.trace_overhead
             or args.obs_overhead or args.quant_ab or args.goodput):
@@ -4261,6 +4368,13 @@ def main() -> int:
             path = _run_flag_cpu_child("--autopilot-inproc", 1,
                                        timeout=3000)
             print(json.dumps({"autopilot_artifact": path}))
+        if args.chaos:
+            # subprocess-replica shape like --autopilot: the fleet
+            # scenarios spawn cpu-pinned worker processes, and the
+            # stub scenarios never touch jax at all
+            path = _run_flag_cpu_child("--chaos-inproc", 1,
+                                       timeout=3000)
+            print(json.dumps({"chaos_artifact": path}))
         if args.paged_attn:
             if choice == "cpu":
                 path = _run_flag_cpu_child("--paged-attn-inproc", 1)

@@ -285,7 +285,12 @@ def _supervise(args, argv) -> int:
                      elastic=getattr(args, "elastic", False),
                      min_devices=getattr(args, "min_devices", 0),
                      probe=probe,
-                     events_path=events)
+                     events_path=events,
+                     # a platform's advance notice (SIGUSR1) lands on
+                     # this top-level pid; the child is the process that
+                     # must checkpoint — forward it (train.resilience
+                     # preemption-notice channel)
+                     forward_preempt=True)
 
 
 def main(argv=None) -> int:
@@ -364,6 +369,14 @@ def main(argv=None) -> int:
     if val:
         log("validation: " + ", ".join(f"{k[4:]} {v:.6f}"
                                        for k, v in sorted(val.items())))
+    if result.get("preempt_notice"):
+        # advance-notice preemption (SIGUSR1): the final checkpoint is
+        # on disk, but the node is going away — exit 47 (decommission)
+        # so the supervisor stops WITHOUT calling the job finished, and
+        # the goodput ledger prices the tail as drain, not rollback
+        from .train.resilience import EXIT_DECOMMISSION
+
+        return EXIT_DECOMMISSION
     return 0
 
 

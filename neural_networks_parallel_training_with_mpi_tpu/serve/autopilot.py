@@ -42,6 +42,16 @@ decision kinds, each guarded so a noisy signal cannot flap the fleet:
   traffic shifts, and the old generation drains out through the same
   no-drop decommission path.
 
+Two robustness decision kinds ride the same guards: **preemption
+backfill** (a replica announcing an advance notice — ``preempt_notice``
+on the wire — is priced as lost capacity immediately; a replacement
+spawns while the victim finishes its in-flight work and exits 47) and
+**degraded-replica eviction** (``health_eviction``: a slow-but-alive
+replica whose windowed TTFT median or rollup ITL p50 sits
+``evict_*_ratio`` x beyond its peers' median for ``evict_hold_s`` is
+replaced-then-drained — the replacement accepts before the victim
+decommissions, so the fleet never dips below ``min_replicas``).
+
 Every action consumed by a failure arms a bounded exponential backoff
 (``action_backoff_s`` doubling to ``action_backoff_cap_s``), and
 successful scaling actions arm a ``cooldown_s`` — the two guards that
@@ -177,6 +187,19 @@ class AutopilotConfig:
     canary_max_extensions: int = 3
     canary_max_p50_ratio: float = 3.0
     canary_max_miss_frac: float = 0.25
+    # degraded-replica eviction (off by default: an A/B bench or an
+    # operator turns it on).  A replica whose WINDOWED TTFT median — or
+    # lifetime-rollup ITL p50 — sits ``evict_*_ratio`` x beyond the
+    # median of its peers for ``evict_hold_s`` is replaced-then-drained:
+    # the replacement spawns first, the victim decommissions only once
+    # the replacement accepts, so the fleet never dips below
+    # ``min_replicas`` (transiently +1 wide, like a rollout).
+    health_eviction: bool = False
+    evict_ttft_ratio: float = 3.0
+    evict_itl_ratio: float = 3.0
+    health_window_s: float = 6.0
+    evict_hold_s: float = 1.0
+    evict_min_samples: int = 8
     # decision-ledger persistence: when set, every decision is appended
     # as one ``kind="autopilot"`` JSON line (the control loop's flight
     # recorder — rendered by ``metrics_summary --autopilot`` and drawn
@@ -213,6 +236,10 @@ class Autopilot:
         self._pending_out: Optional[Dict[str, Any]] = None
         self._draining: Dict[str, Dict[str, Any]] = {}
         self._rollout: Optional[Dict[str, Any]] = None
+        # preemption notices + health-eviction hysteresis
+        self._noticed_seen: set = set()
+        self._backfill_due: List[str] = []
+        self._unhealthy_since: Dict[str, float] = {}
 
     # ---- bookkeeping ---------------------------------------------------
     def _decide(self, action: str, **extra) -> Dict[str, Any]:
@@ -315,11 +342,13 @@ class Autopilot:
         self._last_eval = now
         before = len(self.decisions)
         self._watch_pending_out(now)
+        self._watch_notices(now)
         self._watch_draining(now)
         if self._rollout is not None:
             self._advance_rollout(now)
         else:
             self._autoscale(now)
+            self._health_evict(now)
         return self.decisions[before:]
 
     # ---- autoscaling ---------------------------------------------------
@@ -393,6 +422,14 @@ class Autopilot:
             self._failures = 0
             self._decide("scale_out_ready", replica=p["name"],
                          reaction_s=round(now - p["t"], 3))
+            # replace-then-drain: the eviction victim leaves only once
+            # its replacement accepts, so capacity never dips
+            victim = p.get("then_evict")
+            if victim is not None and victim not in self._draining \
+                    and any(r.name == victim
+                            for r in self.fleet.router.replicas):
+                self._begin_decommission(now, victim,
+                                         kind="health_evict")
             return
         rc = self.fleet.replica_done(p["name"])
         if rc is not None:
@@ -458,6 +495,149 @@ class Autopilot:
                 self._decide("drain_stalled_kill", replica=name,
                              kind=st["kind"],
                              after_s=round(now - st["t"], 3))
+
+    # ---- preemption notices (advance-notice drain + backfill) ----------
+    def _watch_notices(self, now: float) -> None:
+        """A replica that announced a preemption notice
+        (``preempt_notice`` on the wire) stops accepting new work on
+        its own — the router's admission closes the moment the pump
+        lands the event — and exits 47 when idle or at its grace
+        deadline.  The autopilot's job is attribution and backfill:
+        record the notice ONCE in the decision ledger, reap the
+        self-initiated exit (it never enters ``_draining``), and spawn
+        a replacement while the victim is still finishing its
+        in-flight work, so capacity is restored before the death."""
+        for h in list(self.fleet.router.replicas):
+            if not getattr(h, "noticed", False):
+                continue
+            if h.name not in self._noticed_seen:
+                self._noticed_seen.add(h.name)
+                self._backfill_due.append(h.name)
+                g = getattr(h, "notice_grace_s", None)
+                self._decide("preempt_notice", replica=h.name,
+                             grace_s=(round(float(g), 3)
+                                      if g is not None else None))
+            if h.name in self._draining:
+                continue            # an explicit drain already owns it
+            rc = self.fleet.replica_done(h.name)
+            if rc is not None:
+                self.fleet.remove_replica(h.name)
+                self._decide("preempt_drained", replica=h.name, rc=rc,
+                             requeued=0 if rc == 47 else None)
+        # backfill one replacement per notice.  Deliberately NOT gated
+        # on cooldown: the capacity loss is involuntary, reacting to it
+        # is not flapping.  The one-action gate and failure backoff
+        # still apply, and a rollout owns spawning while active.
+        if (not self._backfill_due or self._rollout is not None
+                or self._pending_out is not None
+                or now < self._backoff_until):
+            return
+        width = len([h for h in self._active()
+                     if not getattr(h, "noticed", False)])
+        if width >= self.cfg.max_replicas:
+            self._backfill_due.clear()
+            return
+        victim = self._backfill_due.pop(0)
+        try:
+            h = self.fleet.add_replica(generation=self._primary_gen())
+        except Exception as exc:
+            self._backfill_due.insert(0, victim)
+            self._action_failed(now, "preempt_backfill",
+                                str(exc)[:200])
+            return
+        self._pending_out = {"name": h.name, "t": now,
+                             "deadline": now + self.cfg.ready_timeout_s}
+        self._decide("preempt_backfill", replica=h.name,
+                     replaces=victim)
+
+    # ---- degraded-replica eviction -------------------------------------
+    def _health_windowed(self, now: float) -> Dict[str, Any]:
+        """Per-replica windowed TTFT medians from the router's
+        completion samples (``FleetRouter.recent``) — the same windowed
+        signal the canary judge reads, so a degraded replica cannot
+        hide behind a healthy lifetime sketch."""
+        t_cut = now - self.cfg.health_window_s
+        by: Dict[str, List[float]] = {}
+        for s in self.fleet.router.recent:
+            if s["t"] < t_cut or s["ttft_ms"] is None:
+                continue
+            by.setdefault(s["replica"], []).append(s["ttft_ms"])
+        return {n: (sorted(v)[len(v) // 2], len(v))
+                for n, v in by.items()}
+
+    def _health_evict(self, now: float) -> None:
+        """Force-drain a slow-but-alive replica: windowed TTFT median
+        (or lifetime-rollup ITL p50) ``evict_*_ratio`` x beyond the
+        median of its PEERS, held for ``evict_hold_s``.  Shares the
+        one-action-in-flight gate, cooldown and backoff with the
+        autoscaler, and goes replace-then-drain through
+        ``_pending_out["then_evict"]`` so the fleet never dips below
+        ``min_replicas`` — even when the victim IS the floor."""
+        cfg = self.cfg
+        if not cfg.health_eviction:
+            return
+        if (now < self._cooldown_until or now < self._backoff_until
+                or self._pending_out is not None or self._draining):
+            return                  # one action in flight at a time
+        candidates = [h for h in self._active()
+                      if h.accepting()
+                      and not getattr(h, "noticed", False)]
+        if len(candidates) < 2:
+            self._unhealthy_since.clear()
+            return                  # no peers to compare against
+        names = {h.name for h in candidates}
+        windowed = {n: v for n, v
+                    in self._health_windowed(now).items()
+                    if n in names and v[1] >= cfg.evict_min_samples}
+        itl = {r["name"]: r.get("itl_ms_p50")
+               for r in self.breakdown() if r["name"] in names}
+        worst = None                # (name, verdict-extras)
+        for n in sorted(names):
+            vs: Dict[str, Any] = {}
+            if n in windowed and len(windowed) >= 2:
+                peers = sorted(m for k, (m, _) in windowed.items()
+                               if k != n)
+                base = peers[len(peers) // 2]
+                if base > 0 and windowed[n][0] / base \
+                        >= cfg.evict_ttft_ratio:
+                    vs["ttft_p50_ms"] = round(windowed[n][0], 1)
+                    vs["ttft_ratio"] = round(windowed[n][0] / base, 2)
+            mine = itl.get(n)
+            peers_i = sorted(v for k, v in itl.items()
+                             if k != n and v is not None)
+            if mine is not None and peers_i:
+                base_i = peers_i[len(peers_i) // 2]
+                if base_i > 0 and mine / base_i >= cfg.evict_itl_ratio:
+                    vs["itl_p50_ms"] = round(mine, 1)
+                    vs["itl_ratio"] = round(mine / base_i, 2)
+            if vs and (worst is None
+                       or vs.get("ttft_ratio", 0)
+                       > worst[1].get("ttft_ratio", 0)):
+                worst = (n, vs)
+        # hysteresis: the verdict must HOLD before anything moves
+        for n in list(self._unhealthy_since):
+            if worst is None or n != worst[0]:
+                del self._unhealthy_since[n]
+        if worst is None:
+            return
+        name, verdict = worst
+        since = self._unhealthy_since.setdefault(name, now)
+        if now - since < cfg.evict_hold_s:
+            return
+        del self._unhealthy_since[name]
+        # replace-then-drain: spawn the replacement first; the victim
+        # decommissions in _watch_pending_out once it accepts
+        try:
+            h = self.fleet.add_replica(generation=self._primary_gen())
+        except Exception as exc:
+            self._action_failed(now, "health_evict", str(exc)[:200])
+            return
+        self._pending_out = {"name": h.name, "t": now,
+                             "deadline": now + cfg.ready_timeout_s,
+                             "then_evict": name}
+        self._cooldown_until = now + cfg.cooldown_s
+        self._decide("health_evict", replica=name,
+                     replacement=h.name, **verdict)
 
     # ---- rollout / canary ----------------------------------------------
     def start_rollout(self, snapshot_dir,

@@ -58,6 +58,7 @@ import json
 import math
 import os
 import sys
+import tempfile
 import threading
 import time
 from dataclasses import dataclass, field
@@ -448,6 +449,12 @@ class ProcReplica(ReplicaHandle):
         #   merges, through this field instead of the filesystem)
         self.drained: Optional[List[Dict[str, Any]]] = None
         self.incarnation = -1
+        # advance-notice preemption (PR 18): the worker announced it is
+        # going away in ``notice_grace_s`` seconds — stop placing new
+        # work here (accepting() gates) while in-flight requests finish;
+        # the autopilot backfills BEFORE the exit lands
+        self.noticed = False
+        self.notice_grace_s: Optional[float] = None
 
     # ---- supervisor wiring --------------------------------------------
     def attach(self, proc, incarnation: int = 0) -> None:
@@ -457,6 +464,8 @@ class ProcReplica(ReplicaHandle):
         self._stdin = proc.stdin
         self.ready = False
         self._signal = None
+        self.noticed = False
+        self.notice_grace_s = None
         self.incarnation = incarnation
         t = threading.Thread(target=self._read_loop,
                              args=(proc.stdout,), daemon=True)
@@ -485,7 +494,7 @@ class ProcReplica(ReplicaHandle):
                 and self._proc.poll() is None)
 
     def accepting(self) -> bool:
-        return self.alive() and self.ready
+        return self.alive() and self.ready and not self.noticed
 
     def load(self) -> Optional[LoadSignal]:
         return self._signal
@@ -553,6 +562,15 @@ class ProcReplica(ReplicaHandle):
                     out.append(rec)
             elif ev == "drained":
                 self.drained = rec.get("requests") or []
+            elif ev == "preempt_notice":
+                # the worker is going away on purpose: close admission
+                # NOW (in-flight work finishes inside the grace window)
+                # so the autopilot can backfill before the exit lands
+                self.noticed = True
+                try:
+                    self.notice_grace_s = float(rec.get("grace_s"))
+                except (TypeError, ValueError):
+                    self.notice_grace_s = None
         return out
 
     def assigned(self) -> List[int]:
@@ -1110,7 +1128,7 @@ def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
     header)."""
     import subprocess
 
-    from ..train.resilience import ChildSpec
+    from ..train.resilience import PREEMPT_NOTICE_ENV, ChildSpec
 
     rid = int(generation) * GEN_STRIDE + int(k)
     name = f"replica-{rid}"
@@ -1129,6 +1147,14 @@ def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
     env = {"NNPT_PROCESS_ID": str(rid),
            "PYTHONPATH": cfg["repo_root"] + os.pathsep
            + os.environ.get("PYTHONPATH", "")}
+    # the advance-notice file channel (train.resilience): both ends of
+    # GroupSupervisor.notify_preempt agree on this path.  Without it the
+    # signal still delivers but the grace window falls back to the 2 s
+    # default, so a telemetry-less fleet gets a tempdir path instead.
+    env[PREEMPT_NOTICE_ENV] = (
+        os.path.join(tdir, "preempt-notice.json") if tdir
+        else os.path.join(tempfile.gettempdir(),
+                          f"nnpt-preempt-{os.getpid()}-{rid}.json"))
 
     def spawn(spec, env, _cmd=cmd):
         return subprocess.Popen(
@@ -1247,6 +1273,16 @@ class Fleet:
             if h.name == name:
                 return h.request_decommission()
         return False
+
+    def notify_preempt(self, name: str, grace_s: float = 2.0) -> bool:
+        """Deliver an advance preemption notice to one replica (the
+        real-world seam: SIGUSR1 + the notice file, via
+        ``GroupSupervisor.notify_preempt``).  The worker answers by
+        closing admission, finishing in-flight work inside the grace
+        window, and exiting 47 — terminal at the supervisor without a
+        retire (47 is in the no-retry contract), and the autopilot
+        backfills when it pumps the ``preempt_notice`` event."""
+        return self.supervisor.notify_preempt(name, grace_s=grace_s)
 
     def force_kill(self, name: str) -> None:
         """Stalled-drain escalation: SIGKILL the (already retired)
@@ -1566,6 +1602,42 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 ops.append(op)
         return ops, eof
 
+    # advance-notice preemption (train.resilience channel): SIGUSR1 from
+    # the supervisor/platform — or the injected twin, the ``preempt``
+    # fault kind — sets a deadline; the worker keeps serving its
+    # in-flight work, stops getting NEW work once the router pumps the
+    # announcement (ProcReplica.accepting gates), and exits 47 as soon
+    # as it is idle or the grace window closes, whichever comes first.
+    import signal as signal_lib
+
+    from ..train.resilience import (EXIT_DECOMMISSION, PREEMPT_GRACE_ENV,
+                                    read_preempt_notice)
+
+    notice: Dict[str, Any] = {"deadline": None, "grace_s": None,
+                              "announced": None}
+
+    def _notice_grace(spec_grace: Optional[float] = None) -> float:
+        if spec_grace is not None:
+            return float(spec_grace)
+        rec = read_preempt_notice() or {}
+        try:
+            return float(rec.get("grace_s")
+                         or os.environ.get(PREEMPT_GRACE_ENV) or 2.0)
+        except (TypeError, ValueError):
+            return 2.0
+
+    def _on_notice_signal(signum, frame):
+        if notice["deadline"] is not None:
+            return   # idempotent: a repeated notice never escalates
+        g = _notice_grace()
+        notice["grace_s"] = g
+        notice["deadline"] = time.monotonic() + g
+
+    try:
+        signal_lib.signal(signal_lib.SIGUSR1, _on_notice_signal)
+    except ValueError:
+        pass   # not the main thread (in-process tests): no signal seam
+
     emit({"ev": "ready", "replica": args.replica, "pid": os.getpid(),
           "tp": args.tp, "generation": args.generation, "incarnation":
           os.environ.get("NNPT_INCARNATION", "0")})
@@ -1594,13 +1666,23 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 if fault_plan is not None and fault_plan.fire_if_due(
                         "replica_kill", submits_seen,
                         proc=args.replica):
-                    import signal as signal_lib
-
                     print(f"[faults] replica_kill at submit "
                           f"{submits_seen}: SIGKILL", file=sys.stderr,
                           flush=True)
                     proto.flush()
                     os.kill(os.getpid(), signal_lib.SIGKILL)
+                if fault_plan is not None and notice["deadline"] is None:
+                    spec = fault_plan.due_spec(
+                        "preempt", submits_seen, proc=args.replica)
+                    if spec is not None:
+                        # injected twin of the SIGUSR1 notice: same
+                        # deadline bookkeeping, same drain-and-exit-47
+                        notice["grace_s"] = float(spec.grace)
+                        notice["deadline"] = (time.monotonic()
+                                              + float(spec.grace))
+                        print(f"[faults] preempt notice at submit "
+                              f"{submits_seen} (grace {spec.grace:.1f}s)",
+                              file=sys.stderr, flush=True)
                 req = FleetRequest(
                     rid=int(op["rid"]),
                     prompt=[int(t) for t in op["prompt"]],
@@ -1639,13 +1721,51 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 stop = True
         if stop:
             break
+        # 1b) advance-notice drain: announce once (the router closes
+        # admission when it pumps this), keep serving in-flight work,
+        # and exit 47 at idle-after-settle or the grace deadline —
+        # whichever comes first.  An idle exit reports an EMPTY drained
+        # set: the zero-requeue preemption the crash path cannot give.
+        if notice["deadline"] is not None:
+            now_m = time.monotonic()
+            if notice["announced"] is None:
+                notice["announced"] = now_m
+                print(f"[worker {args.replica}] preemption notice: "
+                      f"draining within {notice['grace_s']:.1f}s, then "
+                      f"exit {EXIT_DECOMMISSION}", file=sys.stderr,
+                      flush=True)
+                emit({"ev": "preempt_notice",
+                      "grace_s": notice["grace_s"]})
+            idle = not (engine.assigned()
+                        or (sched is not None
+                            and (sched.pending() or sched.in_flight())))
+            if now_m >= notice["deadline"] or (
+                    idle and now_m >= notice["announced"] + 0.25):
+                # the decommission handshake, self-initiated: report
+                # drained state (leftovers requeue exactly once through
+                # the router's ledger), then the terminal no-retry exit
+                if sched is not None:
+                    reqs = sched.drain()
+                    sched.server.allocator.assert_drained()
+                else:
+                    reqs = [{"rid": r, "prefilled": 0, "generated": 0}
+                            for r in engine.take_assigned()]
+                emit({"ev": "drained", "requests": reqs})
+                proto.flush()
+                if sched is not None:
+                    sched.close()
+                return EXIT_DECOMMISSION
         # 2) advance the engine one step; report completions
         for rec in engine.pump():
             rec.pop("requeue", None)
             emit({"ev": "done", **rec})
         ticks += 1
-        if args.step_sleep_ms and busy:
-            time.sleep(args.step_sleep_ms / 1e3)
+        slow_ms = (fault_plan.slow_penalty_ms(submits_seen,
+                                              proc=args.replica)
+                   if fault_plan is not None and busy else 0.0)
+        if (args.step_sleep_ms and busy) or slow_ms:
+            time.sleep(((args.step_sleep_ms if busy else 0.0)
+                        + slow_ms) / 1e3)
         # 3) status cadence: every N ticks while busy, ~4 Hz floor
         now = time.monotonic()
         if (ticks % max(1, args.status_every) == 0

@@ -355,6 +355,11 @@ def run_fleet_closed_loop(router, clients: int,
     ttft_all = [s.ttft_ms for s in stats if s.ttft_ms is not None]
     row["ttft_ms_p50"] = _pct(ttft_all, 50)
     row["ttft_ms_p99"] = _pct(ttft_all, 99)
+    # fleet-wide decode cadence: the signal a slow-but-alive replica
+    # degrades first (utils/chaos.py's eviction-recovery A/B reads it)
+    itl_all = [s.itl_ms for s in stats if s.itl_ms is not None]
+    row["itl_ms_p50"] = _pct(itl_all, 50)
+    row["itl_ms_p99"] = _pct(itl_all, 99)
     for k in classes:
         vals = [s.ttft_ms for rid, s in zip(finished, stats)
                 if cls_of[owner[rid]]["name"] == k["name"]

@@ -626,7 +626,26 @@ class Scheduler:
         rec["now"]["in_flight"] = len(self._srv_rid)
         rec["now"]["slots"] = self.cfg.slots
         rec["now"]["queue_cap"] = self.cfg.queue_depth
+        rec["now"]["tokens_at_risk"] = self.tokens_at_risk()
         return rec
+
+    def tokens_at_risk(self) -> int:
+        """Tokens of consumed work an unannounced kill would discard
+        right now: prefilled + generated across every in-flight stream
+        (queued requests carry zero — nothing has been spent on them).
+        The advance-notice drain exists to take this to zero before the
+        process dies; a chaos campaign's ``tokens_lost`` for a SIGKILL
+        arm is exactly this quantity at the moment of the kill."""
+        total = 0
+        for rid, srv_rid in self._srv_rid.items():
+            req = self.reqs[rid]
+            st = self.server._streams[srv_rid]
+            slot = self.server._slot_of[srv_rid]
+            prefilled, p = st.prefilled, len(req.prompt)
+            generated = (int(self.server._pos_host[slot]) - p + 1
+                         if prefilled >= p else 0)
+            total += prefilled + max(0, generated)
+        return total
 
     def drain(self) -> List[Dict[str, Any]]:
         """Stop serving and hand every unfinished request back for

@@ -65,6 +65,7 @@ other        crash (segfault, OOM, fault injection, ...)   retry
 from __future__ import annotations
 
 import math
+import os
 import random
 import signal
 import subprocess
@@ -255,28 +256,67 @@ class GracefulShutdown:
 
     ``with GracefulShutdown() as stop:`` installs handlers (previous
     handlers are restored on exit); ``stop.requested`` turns True on the
-    first signal.  A second signal of the same kind falls through to the
+    first signal.  A second TERMINATION signal falls through to the
     previous handler semantics via a hard re-raise — so an operator's
     double-Ctrl-C still kills a wedged run.  Signal handlers only exist on
     the main thread; elsewhere the context is an inert no-op (trainers
     driven from worker threads keep working, without preemption safety).
-    """
+
+    ``notice_signals`` (default SIGUSR1, :data:`PREEMPT_SIGNAL`) are the
+    ADVANCE-NOTICE channel: a cloud maintenance event or the supervisor's
+    :meth:`GroupSupervisor.notify_preempt` announces the preemption
+    ``grace_s`` seconds before the platform would hard-kill.  A notice
+    sets ``requested`` (same dispatch-boundary checkpoint path) plus
+    ``noticed``, and reads the grace window from the notice file
+    (:func:`read_preempt_notice`) or :data:`PREEMPT_GRACE_ENV`.  The
+    owner exits :data:`EXIT_DECOMMISSION` instead of 0 — terminal at the
+    supervisor, priced as ``drain`` by the goodput ledger — because the
+    capacity is GOING AWAY: a relaunch would land on a doomed node, and
+    "job finished" would be a lie.  Notices are idempotent (a repeated
+    SIGUSR1 never escalates to a kill)."""
 
     def __init__(self, signals: Sequence[int] = (signal.SIGTERM,
-                                                 signal.SIGINT)):
-        self._signals = tuple(signals)
+                                                 signal.SIGINT),
+                 notice_signals: Sequence[int] = (signal.SIGUSR1,)):
+        self._signals = tuple(signals) + tuple(
+            s for s in notice_signals if s not in signals)
+        self._notice = frozenset(notice_signals)
         self._previous: dict = {}
         self.requested = False
+        self.noticed = False
+        self.grace_s: Optional[float] = None
         self.signum: Optional[int] = None
+        self._escalated = False
 
     def _handler(self, signum, frame):
-        if self.requested:
-            # second signal: restore + re-raise so the default/previous
-            # disposition (usually: die now) takes over
+        if signum in self._notice:
+            first = not self.noticed
+            self.noticed = True
+            self.requested = True
+            if self.signum is None:
+                self.signum = signum
+            if first:
+                rec = read_preempt_notice() or {}
+                try:
+                    self.grace_s = float(
+                        rec.get("grace_s")
+                        or os.environ.get(PREEMPT_GRACE_ENV) or 2.0)
+                except (TypeError, ValueError):
+                    self.grace_s = 2.0
+                print(f"[resilience] preemption notice (signal {signum}, "
+                      f"grace {self.grace_s:.1f}s): finishing the current "
+                      "step, writing a final checkpoint, exiting "
+                      f"{EXIT_DECOMMISSION} (decommission)",
+                      file=sys.stderr, flush=True)
+            return
+        if self._escalated:
+            # second termination signal: restore + re-raise so the
+            # default/previous disposition (usually: die now) takes over
             prev = self._previous.get(signum, signal.SIG_DFL)
             signal.signal(signum, prev)
             signal.raise_signal(signum)
             return
+        self._escalated = True
         self.requested = True
         self.signum = signum
         print(f"[resilience] caught signal {signum}: finishing the current "
@@ -299,6 +339,68 @@ class GracefulShutdown:
             except ValueError:
                 pass
         self._previous.clear()
+
+
+# ---------------------------------------------------------------------------
+# the advance-notice preemption channel (PR 18)
+# ---------------------------------------------------------------------------
+# Real platforms announce most capacity loss: a maintenance event or spot
+# preemption arrives with a grace window before the hard kill.  The seam
+# is deliberately dumb — a signal plus an optional notice file — so the
+# injected twin (utils/faults.py kind ``preempt``) and the real thing
+# (an operator or node agent running ``kill -USR1``) are byte-identical
+# from the victim's point of view.
+
+PREEMPT_SIGNAL = signal.SIGUSR1
+# where the machine-readable half of the notice lands (JSON: t_unix,
+# grace_s); a supervisor stamps this into the child env so both ends
+# agree on the path
+PREEMPT_NOTICE_ENV = "NNPT_PREEMPT_NOTICE"
+# fallback grace window (seconds) when the signal arrives with no file
+PREEMPT_GRACE_ENV = "NNPT_PREEMPT_GRACE_S"
+
+
+def preempt_notice_path(env: Optional[dict] = None) -> Optional[str]:
+    return (env if env is not None else os.environ).get(PREEMPT_NOTICE_ENV)
+
+
+def write_preempt_notice(path: Optional[str] = None, *,
+                         grace_s: float = 2.0) -> Optional[str]:
+    """Write the notice file (``{"t_unix", "grace_s"}``) — the sender's
+    half of the advance-notice channel.  ``path`` defaults to this
+    process's own :data:`PREEMPT_NOTICE_ENV`; best-effort and silent when
+    no path is configured (the signal alone still carries the notice,
+    with :data:`PREEMPT_GRACE_ENV` / the 2 s default as the window)."""
+    import json
+
+    path = path or preempt_notice_path()
+    if not path:
+        return None
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"t_unix": round(time.time(), 3),
+                                "grace_s": float(grace_s)}) + "\n")
+        os.replace(tmp, path)
+    except OSError:
+        return None
+    return path
+
+
+def read_preempt_notice(path: Optional[str] = None) -> Optional[dict]:
+    """The receiver's half: parse the notice file, or None when absent /
+    unreadable (a signal with no file is still a valid notice)."""
+    import json
+
+    path = path or preempt_notice_path()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            rec = json.loads(f.read())
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
 
 
 def strip_supervisor_flags(argv: Sequence[str]) -> List[str]:
@@ -589,14 +691,21 @@ def alerts_between(path: Optional[str], start_pos: int
 
 def _run_child(cmd: Sequence[str], env: Optional[dict],
                heartbeat_path: Optional[str], heartbeat_timeout: float,
-               log: Callable[[str], None]) -> int:
-    """One child launch.  Without a heartbeat watch this is a plain
-    blocking call.  With one, the supervisor polls the telemetry
-    ``heartbeat.json`` (train.telemetry writes it atomically per dispatch)
-    and a child whose heartbeat goes stale is killed and reported as
-    :data:`EXIT_HANG` — the EXTERNAL complement to the in-process
-    ``utils.watchdog.HangWatchdog``, covering the failure mode where the
-    whole host process (watchdog thread included) is frozen.
+               log: Callable[[str], None],
+               forward_signals: Sequence[int] = ()) -> int:
+    """One child launch.  Without a heartbeat watch (or signals to
+    forward) this is a plain blocking call.  With a heartbeat, the
+    supervisor polls the telemetry ``heartbeat.json`` (train.telemetry
+    writes it atomically per dispatch) and a child whose heartbeat goes
+    stale is killed and reported as :data:`EXIT_HANG` — the EXTERNAL
+    complement to the in-process ``utils.watchdog.HangWatchdog``,
+    covering the failure mode where the whole host process (watchdog
+    thread included) is frozen.
+
+    ``forward_signals`` (the advance-notice seam): while the child runs,
+    each listed signal delivered to the SUPERVISOR is re-sent to the
+    child — a platform's preemption notice usually lands on the
+    top-level pid, and the doomed child is the one that must checkpoint.
 
     The monitor ARMS at the child's first heartbeat write (mtime newer
     than the launch) — the same discipline as the in-process watchdog's
@@ -605,46 +714,73 @@ def _run_child(cmd: Sequence[str], env: Optional[dict],
     heartbeat from a previous run must not count either.  The symmetric
     cost: a child frozen BEFORE its first dispatch is not caught by this
     monitor (nor by the in-process one)."""
-    if not (heartbeat_path and heartbeat_timeout > 0):
+    hb = bool(heartbeat_path and heartbeat_timeout > 0)
+    if not hb and not forward_signals:
         return subprocess.call(list(cmd), env=env)
     child = subprocess.Popen(list(cmd), env=env)
-    started = time.time()
-    poll_s = max(0.05, min(heartbeat_timeout / 4.0, 5.0))
-    armed = False
-    while True:
-        rc = child.poll()
-        if rc is not None:
-            return rc
-        age = heartbeat_age_s(heartbeat_path)
-        if not armed:
-            # arm only once THIS child has written the heartbeat
-            # (mtime after launch <=> age < runtime)
-            if age is not None and age < time.time() - started:
-                armed = True
-            else:
+    restore: dict = {}
+
+    def _forward(signum, frame):
+        log(f"[supervise] forwarding signal {signum} (preemption "
+            f"notice) to child {child.pid}")
+        try:
+            child.send_signal(signum)
+        except OSError:
+            pass
+
+    for s in forward_signals:
+        try:
+            restore[s] = signal.signal(s, _forward)
+        except ValueError:   # not the main thread: no forwarding
+            break
+    try:
+        started = time.time()
+        poll_s = (max(0.05, min(heartbeat_timeout / 4.0, 5.0))
+                  if hb else 0.1)
+        armed = False
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return rc
+            if not hb:
                 time.sleep(poll_s)
                 continue
-        idle = age if age is not None else time.time() - started
-        if idle > heartbeat_timeout:
-            log(f"[supervise] heartbeat stale for {idle:.0f}s "
-                f"(> {heartbeat_timeout:.0f}s): killing child "
-                f"{child.pid} as hung")
-            child.terminate()
+            age = heartbeat_age_s(heartbeat_path)
+            if not armed:
+                # arm only once THIS child has written the heartbeat
+                # (mtime after launch <=> age < runtime)
+                if age is not None and age < time.time() - started:
+                    armed = True
+                else:
+                    time.sleep(poll_s)
+                    continue
+            idle = age if age is not None else time.time() - started
+            if idle > heartbeat_timeout:
+                log(f"[supervise] heartbeat stale for {idle:.0f}s "
+                    f"(> {heartbeat_timeout:.0f}s): killing child "
+                    f"{child.pid} as hung")
+                child.terminate()
+                try:
+                    child.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    child.wait()
+                # deliberately EXIT_HANG even when the SIGTERM was
+                # absorbed gracefully (the child checkpoints and exits
+                # 0): that 0 means "clean final snapshot", NOT "job
+                # finished" — a stalled-but-signal-responsive child must
+                # be retried, not reported complete.  A healthy tail
+                # phase is protected by Telemetry.alive() beats during
+                # checkpoint/eval, and a spuriously killed near-done run
+                # converges in one resumed relaunch.
+                return EXIT_HANG
+            time.sleep(poll_s)
+    finally:
+        for s, prev in restore.items():
             try:
-                child.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                child.kill()
-                child.wait()
-            # deliberately EXIT_HANG even when the SIGTERM was absorbed
-            # gracefully (the child checkpoints and exits 0): that 0
-            # means "clean final snapshot", NOT "job finished" — a
-            # stalled-but-signal-responsive child must be retried, not
-            # reported complete.  A healthy tail phase is protected by
-            # Telemetry.alive() beats during checkpoint/eval, and a
-            # spuriously killed near-done run converges in one resumed
-            # relaunch.
-            return EXIT_HANG
-        time.sleep(poll_s)
+                signal.signal(s, prev)
+            except ValueError:
+                pass
 
 
 def supervise(cmd: Sequence[str], max_restarts: int,
@@ -662,6 +798,7 @@ def supervise(cmd: Sequence[str], max_restarts: int,
               probe: Optional[Callable[[], Optional[dict]]] = None,
               elastic_after: int = 2,
               events_path: Optional[str] = None,
+              forward_preempt: bool = False,
               _sleep: Callable[[float], None] = time.sleep,
               _rand: Callable[[], float] = random.random) -> int:
     """Run ``cmd`` under the crash-restart policy; return the final exit
@@ -714,6 +851,11 @@ def supervise(cmd: Sequence[str], max_restarts: int,
     ``events_path``: append machine-readable lifecycle records (launch /
     exit / relaunch, with wall-clock, run id, incarnation, rc) as JSONL —
     the supervisor half of the goodput join (``utils/goodput.py``).
+    ``forward_preempt``: re-send :data:`PREEMPT_SIGNAL` (SIGUSR1) to the
+    running child — a platform's advance notice lands on the top-level
+    supervisor pid, and the child is the process that must answer with a
+    final checkpoint + exit 47 (the :class:`GracefulShutdown` notice
+    path, priced as ``drain`` instead of rollback+replay).
     """
     if log is None:
         log = lambda m: print(m, file=sys.stderr, flush=True)
@@ -749,6 +891,15 @@ def supervise(cmd: Sequence[str], max_restarts: int,
             child_env = dict(_os.environ)
         child_env[RUN_ID_ENV] = run_id
         child_env[INCARNATION_ENV] = str(attempt - 1)
+        if not child_env.get(PREEMPT_NOTICE_ENV):
+            # give the notice file somewhere to land: without a path the
+            # signal still arrives but the grace window degrades to the
+            # 2 s default — an in-child fault injection or an operator's
+            # write_preempt_notice() must agree with the child on where
+            import tempfile as _tempfile
+            child_env[PREEMPT_NOTICE_ENV] = _os.path.join(
+                _tempfile.gettempdir(),
+                f"nnpt-preempt-{_os.getpid()}.json")
         log(f"[supervise] attempt {attempt}: {' '.join(cmd)}")
         launched = time.time()
         _append_event(events_path, {
@@ -761,7 +912,8 @@ def supervise(cmd: Sequence[str], max_restarts: int,
             except OSError:
                 alert_pos = 0
         rc = _run_child(cmd, child_env, heartbeat_path, heartbeat_timeout,
-                        log)
+                        log, forward_signals=((PREEMPT_SIGNAL,)
+                                              if forward_preempt else ()))
         _append_event(events_path, {
             "kind": "supervisor", "event": "exit",
             "t": round(time.time(), 6), "run": run_id,
@@ -1106,6 +1258,35 @@ class GroupSupervisor:
         else:
             self._log(f"[group] {st.spec.role}/{name}: retired (next "
                       "exit is terminal)")
+
+    def notify_preempt(self, name: str, grace_s: float = 2.0) -> bool:
+        """Propagate an advance preemption notice to a live child: write
+        the notice file (when the child's env names one via
+        :data:`PREEMPT_NOTICE_ENV`) and send :data:`PREEMPT_SIGNAL`.
+        The child answers per its own contract — a trainer checkpoints
+        and exits 47, a serving worker stops admitting, finishes
+        in-flight work inside the grace window and exits 47 — and 47 is
+        already in ``no_retry``, so the exit is terminal without an
+        explicit :meth:`retire`.  Returns whether the notice was
+        delivered (False: the child is already dead or unreachable —
+        the crash path owns what happens next)."""
+        st = self._children[name]
+        if st.proc is None or st.proc.poll() is not None:
+            return False
+        env = dict(self._base_env)
+        env.update(st.spec.env or {})
+        path = env.get(PREEMPT_NOTICE_ENV)
+        if path:
+            write_preempt_notice(path, grace_s=grace_s)
+        try:
+            st.proc.send_signal(PREEMPT_SIGNAL)
+        except OSError:
+            return False
+        self._log(f"[group] {st.spec.role}/{name}: preemption notice "
+                  f"delivered (grace {float(grace_s):.1f}s)")
+        self._emit_event(st, "preempt_notice",
+                         grace_s=round(float(grace_s), 3))
+        return True
 
     def remove_child(self, name: str) -> None:
         """Forget a TERMINAL child (stopped / gave up) so long-lived

@@ -1597,8 +1597,22 @@ class Trainer:
             # preemption-safe exit: the final save above already drained
             # pending async writes and snapshotted the current step — an
             # external restart (--resume / the supervisor) loses <= 1 step
-            log(f"preempted (signal {shutdown.signum}): final checkpoint "
-                f"at step {step}, exiting 0")
+            if shutdown.noticed:
+                # ADVANCE-notice preemption (SIGUSR1): the node is going
+                # away — the cli maps this to EXIT_DECOMMISSION (47), a
+                # terminal no-retry exit the goodput ledger prices as
+                # drain (the coordinated-shrink path, DESIGN.md §10:
+                # peers of a multi-host victim lose it and ride the
+                # elastic probe-and-shrink relaunch from THIS snapshot
+                # instead of rolling back)
+                log(f"preemption notice (signal {shutdown.signum}, grace "
+                    f"{shutdown.grace_s or 0:.1f}s): final checkpoint at "
+                    "step "
+                    f"{step}, exiting 47 (decommission)")
+                result["preempt_notice"] = True
+            else:
+                log(f"preempted (signal {shutdown.signum}): final "
+                    f"checkpoint at step {step}, exiting 0")
             result["preempted"] = True
         if monitor is not None:
             result["rollbacks"] = monitor.rollbacks

@@ -96,7 +96,33 @@ matches the worker's ``--replica`` id rather than a jax process index):
                      must still requeue the stalled replica's in-flight
                      work exactly once.
 
+Preemption / degradation faults (PR 18 — consumed by BOTH the Trainer's
+``apply`` path and a fleet worker's ``fire_if_due``/``slow_penalty_ms``
+polls, so one grammar drives the training and serving arms of the chaos
+campaigns):
+
+    ``preempt``      advance-notice preemption: deliver SIGUSR1 to this
+                     process with ``grace=S`` seconds of warning (the
+                     injected twin of a cloud maintenance notice — the
+                     real-world seam is the same signal sent by
+                     ``GroupSupervisor.notify_preempt`` or an operator).
+                     A trainer answers with a coordinated final
+                     checkpoint and exits 47 (decommission — goodput
+                     prices the tail as ``drain``, not rollback); a
+                     serving worker stops admitting, finishes in-flight
+                     work inside the grace window, and exits 47 so the
+                     autopilot backfills BEFORE the capacity disappears.
+    ``slow``         degrade, don't die: inject ``ms=M`` milliseconds of
+                     latency per step/tick while the window is open —
+                     the slow-but-alive replica stand-in the autopilot's
+                     health eviction must detect and replace.
+
 options
+    ``grace=S``   ``preempt`` only: seconds between the notice and the
+                  deadline (default 2.0) — the window the victim has to
+                  checkpoint/drain before the platform would hard-kill.
+    ``ms=M``      ``slow`` only: injected latency per step/tick in
+                  milliseconds (default 50.0).
     ``max=N``     fire at most N times over this process's lifetime
                   (in-memory counter) — lets a NaN window be *passable*
                   after a rollback replays it.
@@ -128,7 +154,7 @@ from typing import Dict, List, Optional
 ENV_VAR = "NNPT_FAULTS"
 KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
          "ckpt_ioerr", "bitflip", "desync", "peer_kill", "peer_hang",
-         "device_loss", "replica_kill", "stall_drain")
+         "device_loss", "replica_kill", "stall_drain", "preempt", "slow")
 # kinds that perturb the train state (FaultPlan.apply_state) rather than
 # the batch/process (FaultPlan.apply)
 STATE_KINDS = ("bitflip", "desync")
@@ -161,6 +187,8 @@ class _Fault:
     eps: float = 1e-3             # desync: perturbation magnitude
     det: bool = False             # desync: deterministic in-step variant
     proc: Optional[int] = None    # fire only on this process index
+    grace: float = 2.0            # preempt: notice-to-deadline seconds
+    ms: float = 50.0              # slow: injected latency per step/tick
     fires: int = 0
 
     def should_fire(self, step: int) -> bool:
@@ -195,6 +223,12 @@ def _parse_one(item: str) -> _Fault:
     if end < start:
         raise ValueError(f"fault window {window!r} ends before it starts")
     fault = _Fault(kind, start, end)
+    if kind == "preempt":
+        # a preemption notice is an EDGE, not a level: one notice per
+        # spec unless max= explicitly asks for repeats (repeats are
+        # idempotent at the receiver, but a one-shot default keeps
+        # due_spec callers honest)
+        fault.max_fires = 1
     for opt in filter(None, opts.split("&")):
         key, _, val = opt.partition("=")
         if key == "max":
@@ -215,6 +249,20 @@ def _parse_one(item: str) -> _Fault:
             fault.det = True
         elif key == "proc":
             fault.proc = int(val)
+        elif key == "grace":
+            fault.grace = float(val)
+            if fault.grace < 0:
+                raise ValueError(f"grace= must be >= 0 in {item!r}")
+            if kind != "preempt":
+                raise ValueError(
+                    f"option 'grace' only applies to preempt, not {kind!r}")
+        elif key == "ms":
+            fault.ms = float(val)
+            if fault.ms < 0:
+                raise ValueError(f"ms= must be >= 0 in {item!r}")
+            if kind != "slow":
+                raise ValueError(
+                    f"option 'ms' only applies to slow, not {kind!r}")
         else:
             raise ValueError(f"unknown fault option {key!r} in {item!r}")
     if fault.det and kind != "desync":
@@ -443,14 +491,11 @@ class FaultPlan:
                      else state._replace(opt_state=target))
         return state
 
-    def fire_if_due(self, kind: str, step: int,
-                    proc: Optional[int] = None) -> bool:
-        """Generic due-check for callers that own their own fault
-        semantics (the fleet worker's :data:`FLEET_KINDS`): True — and
-        the fault is marked fired — iff a matching spec is due at
-        ``step``.  ``proc`` is the CALLER's identity (a fleet worker
-        passes its ``--replica`` id, not jax's process index), matched
-        against the spec's ``proc=`` option when both are set."""
+    def due_spec(self, kind: str, step: int,
+                 proc: Optional[int] = None) -> Optional[_Fault]:
+        """Like :meth:`fire_if_due`, but returns the fired spec itself so
+        callers can read its knobs (a fleet worker needs ``preempt``'s
+        ``grace``); None when nothing is due."""
         for f in self.faults:
             if f.kind != kind:
                 continue
@@ -460,8 +505,38 @@ class FaultPlan:
             if not f.should_fire(step):
                 continue
             f.mark_fired()
-            return True
-        return False
+            return f
+        return None
+
+    def fire_if_due(self, kind: str, step: int,
+                    proc: Optional[int] = None) -> bool:
+        """Generic due-check for callers that own their own fault
+        semantics (the fleet worker's :data:`FLEET_KINDS`): True — and
+        the fault is marked fired — iff a matching spec is due at
+        ``step``.  ``proc`` is the CALLER's identity (a fleet worker
+        passes its ``--replica`` id, not jax's process index), matched
+        against the spec's ``proc=`` option when both are set."""
+        return self.due_spec(kind, step, proc=proc) is not None
+
+    def slow_penalty_ms(self, step: int,
+                        proc: Optional[int] = None) -> float:
+        """Summed injected latency (ms) due at ``step`` from ``slow``
+        specs — polled per tick by a fleet worker (the degraded-replica
+        stand-in sleeps this much extra every engine pass while the
+        window is open).  Unlike the one-shot kinds this fires on every
+        poll inside the window; ``max=N`` still bounds total fires."""
+        ms = 0.0
+        for f in self.faults:
+            if f.kind != "slow":
+                continue
+            if (f.proc is not None and proc is not None
+                    and f.proc != proc):
+                continue
+            if not f.should_fire(step):
+                continue
+            f.mark_fired()
+            ms += f.ms
+        return ms
 
     def apply(self, step: int, batch: Dict,
               ckpt_dir: Optional[str] = None) -> Dict:
@@ -533,6 +608,27 @@ class FaultPlan:
                       file=sys.stderr, flush=True)
                 os.kill(os.getpid(), signal.SIGTERM)
                 continue  # the loop's shutdown flag breaks at the NEXT step
+            if f.kind == "preempt":
+                # advance-notice preemption: SIGUSR1 to self, exactly the
+                # signal GroupSupervisor.notify_preempt / an operator
+                # would deliver — the graceful-shutdown path must answer
+                # with a final checkpoint and the DECOMMISSION exit (47),
+                # pricing the tail as drain instead of rollback+replay
+                print(f"[faults] injected preemption notice at step "
+                      f"{step} (grace {f.grace:.1f}s)", file=sys.stderr,
+                      flush=True)
+                from ..train import resilience as res_lib
+
+                res_lib.write_preempt_notice(grace_s=f.grace)
+                os.kill(os.getpid(), signal.SIGUSR1)
+                continue  # the loop's notice flag breaks at the NEXT step
+            if f.kind == "slow":
+                # degrade, don't die: the straggler stand-in — per-step
+                # injected host latency while the window is open
+                import time
+
+                time.sleep(f.ms / 1e3)
+                continue
             # nan: multiplying by NaN keeps the leaf's placement/sharding
             # (a fresh full_like would force a reshard inside the step);
             # NaN*0 == NaN, so padded rows poison the loss sum too

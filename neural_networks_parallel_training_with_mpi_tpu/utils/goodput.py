@@ -275,24 +275,44 @@ def build_ledger(records: Iterable[Dict[str, Any]],
         if kind == "span":
             g["spans"].append(rec)
 
-    # index supervisor exits: (run, inc) -> newest exit event
-    exits: Dict[Tuple[Optional[str], int], Dict[str, Any]] = {}
+    # index supervisor exits: (run, p, inc) -> newest exit event.  The
+    # process id matters: a GroupSupervisor's children share ONE run id,
+    # so without p a sibling's later rc-0 exit would overwrite a
+    # drained child's rc-47 event and its drain tail would go unpriced.
+    # Single-child supervise() events carry no "p" — the lookup falls
+    # back to a p-less key for them.
+    exits: Dict[Tuple[Optional[str], Optional[int], int],
+                Dict[str, Any]] = {}
     relaunches = 0
+    preempt_notices = 0
     for ev in sup_events:
         what = ev.get("event")
         if what == "relaunch":
             relaunches += 1
+        if what == "preempt_notice":
+            # advance-notice preemption: the child's tail past its last
+            # span is priced as ``drain`` (its exit rc is 47), not as
+            # rollback/relaunch_gap — the crash-vs-notice A/B keys on
+            # this counter being nonzero in the notice arm
+            preempt_notices += 1
         if what not in ("exit", "hang_kill", "gave_up"):
             continue
-        key = (ev.get("run") or None, int(ev.get("inc",
-                                                 ev.get("incarnation", 0))
-                                          or 0))
+        try:
+            ev_p: Optional[int] = int(ev["p"])
+        except (KeyError, TypeError, ValueError):
+            ev_p = None
+        key = (ev.get("run") or None, ev_p,
+               int(ev.get("inc", ev.get("incarnation", 0)) or 0))
         prev = exits.get(key)
         if prev is None or _as_float(ev.get("t")) >= _as_float(prev.get("t")):
             exits[key] = ev
 
-    def _exit_for(run: str, inc: int) -> Optional[Dict[str, Any]]:
-        return exits.get((run, inc)) or exits.get((None, inc))
+    def _exit_for(run: str, p: int, inc: int) -> Optional[Dict[str, Any]]:
+        for k in ((run, p, inc), (run, None, inc),
+                  (None, p, inc), (None, None, inc)):
+            if k in exits:
+                return exits[k]
+        return None
 
     # per (run, p): sweep each incarnation, then stitch the gaps
     by_proc: Dict[Tuple[str, int], List[Tuple[int, Dict[str, Any]]]] = {}
@@ -313,7 +333,7 @@ def build_ledger(records: Iterable[Dict[str, Any]],
             spans = sorted(g["spans"], key=lambda s: _as_float(s.get("t")))
             t_lo = g["t_lo"] if g["t_lo"] is not None else 0.0
             t_hi = g["t_hi"] if g["t_hi"] is not None else t_lo
-            ex = _exit_for(run, inc)
+            ex = _exit_for(run, p, inc)
             drain_s = 0.0
             if ex is not None and int(ex.get("rc", -1)) == EXIT_DECOMMISSION:
                 t_exit = _as_float(ex.get("t"))
@@ -374,6 +394,7 @@ def build_ledger(records: Iterable[Dict[str, Any]],
                 SUM_TOL * max(1, len(processes)),
                 1e-9 * max(fleet_covered, 1.0)),
             "relaunches": relaunches,
+            "preempt_notices": preempt_notices,
             "decisions": len(list(decisions)),
         },
     }

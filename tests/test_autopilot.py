@@ -535,6 +535,95 @@ def test_stalled_drain_escalates_to_force_kill():
     assert ap.decisions[-1]["forced"] is True
 
 
+def _slow_samples(router, t0, slow="replica-1", fast="replica-0",
+                  n=6, slow_ms=100.0):
+    for i in range(n):
+        router.recent.append({"t": t0 + 0.1 * i, "replica": fast,
+                              "ttft_ms": 20.0})
+        router.recent.append({"t": t0 + 0.1 * i, "replica": slow,
+                              "ttft_ms": slow_ms})
+
+
+def test_health_eviction_replace_then_drain_respects_floor():
+    """Degraded-replica eviction (DESIGN §11): windowed-TTFT verdict
+    must HOLD evict_hold_s, the replacement spawns BEFORE the victim
+    drains (the fleet never dips below min_replicas, even when the
+    victim IS the floor), and the whole move shares the autoscaler's
+    one-action-in-flight gate and cooldown."""
+    cfg = AutopilotConfig(min_replicas=2, max_replicas=2,
+                          interval_s=0.0, cooldown_s=5.0,
+                          health_eviction=True, evict_ttft_ratio=3.0,
+                          health_window_s=60.0, evict_hold_s=1.0,
+                          evict_min_samples=4, drain_timeout_s=30.0)
+    a = _CtrlReplica("replica-0", in_flight=2)
+    b = _CtrlReplica("replica-1", in_flight=2)
+    ap, fleet, router, clock = _autopilot([a, b], cfg)
+    _slow_samples(router, 0.0)            # replica-1: 5x peer median
+    clock[0] = 1.0
+    ap.tick()
+    assert _actions(ap) == []             # hysteresis: must hold first
+    clock[0] = 2.1                        # unhealthy held 1.1s
+    ap.tick()
+    assert _actions(ap) == ["health_evict"]
+    d = ap.decisions[-1]
+    assert d["replica"] == "replica-1"
+    assert d["replacement"] == fleet.spawned[0].name
+    assert d["ttft_ratio"] == pytest.approx(5.0)
+    # replace-then-drain: the victim still serves, width is +1 not -1
+    assert fleet.decommissioned == []
+    assert len(router.replicas) == 3
+    # one-action gate: the pending replacement blocks a second eviction
+    clock[0] = 2.2
+    ap.tick()
+    assert _actions(ap) == ["health_evict"]
+    # replacement accepts -> victim drains; floor never violated
+    fleet.spawned[0].ready = True
+    clock[0] = 3.0
+    ap.tick()
+    assert _actions(ap)[-1] == "scale_out_ready"
+    assert fleet.decommissioned == ["replica-1"]
+    fleet.done_rc["replica-1"] = EXIT_DECOMMISSION
+    clock[0] = 3.5
+    ap.tick()
+    assert _actions(ap)[-1] == "drained"
+    assert ap.decisions[-1]["rc"] == EXIT_DECOMMISSION
+    assert sorted(h.name for h in router.replicas) == \
+        sorted(["replica-0", fleet.spawned[0].name])
+    # cooldown (armed at the evict decision) gates the NEXT move: even
+    # with a fresh degraded verdict, nothing fires before it expires
+    _slow_samples(router, 4.0, slow="replica-0",
+                  fast=fleet.spawned[0].name)
+    clock[0] = 5.0
+    ap.tick()
+    clock[0] = 6.5
+    ap.tick()
+    assert _actions(ap).count("health_evict") == 1
+
+
+def test_health_eviction_needs_peers_and_min_samples():
+    """A lone replica is never evicted (no peers to compare against),
+    and a thin sample window never convicts."""
+    cfg = AutopilotConfig(min_replicas=1, max_replicas=2,
+                          interval_s=0.0, cooldown_s=0.0,
+                          health_eviction=True, evict_ttft_ratio=3.0,
+                          health_window_s=60.0, evict_hold_s=0.0,
+                          evict_min_samples=4)
+    a = _CtrlReplica("replica-0", in_flight=2)
+    ap, fleet, router, clock = _autopilot([a], cfg)
+    _slow_samples(router, 0.0, slow="replica-0", fast="replica-0",
+                  slow_ms=500.0)
+    clock[0] = 1.0
+    ap.tick()
+    assert "health_evict" not in _actions(ap)      # no peers
+    b = _CtrlReplica("replica-1", in_flight=2)
+    router.add_replica(b)
+    router.recent.clear()
+    _slow_samples(router, 1.0, n=2)                # < evict_min_samples
+    clock[0] = 2.0
+    ap.tick()
+    assert "health_evict" not in _actions(ap)
+
+
 def test_rollout_rejects_unverified_snapshot(tmp_path):
     """A bad manifest refuses BEFORE any spawn: the serving generation
     is never touched."""

@@ -387,3 +387,20 @@ def test_example_24_fleet_autopilot_completes():
     assert "zero downtime: all" in out.stdout
     assert "corrupt canary: rolled back at t=" in out.stdout
     assert "generation 0 undisturbed" in out.stdout
+
+
+def test_example_25_preemption_drain_completes():
+    """Notice-drain vs SIGKILL A/B on a 2-replica fleet: the same
+    failure with and without the advance notice, over bitwise-identical
+    traffic — the notice arm must requeue NOTHING (victim drains to
+    exit 47, the autopilot backfills before it dies) while the SIGKILL
+    arm requeues every in-flight request and redecodes their tokens."""
+    out = subprocess.run(
+        ["bash", str(REPO / "examples" / "25_preemption_drain.sh")],
+        capture_output=True, text=True, timeout=420, env=_clean_env(),
+        cwd=str(REPO),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "notice arm: zero requeued requests" in out.stdout
+    assert "requests requeued" in out.stdout          # the kill arm paid
+    assert "identical traffic both arms" in out.stdout

@@ -173,6 +173,68 @@ def test_fault_env_fallback(monkeypatch):
     assert faults_lib.FaultPlan.from_config("nan@2").faults[0].start == 2
 
 
+def test_fault_preempt_and_slow_parsing():
+    plan = faults_lib.FaultPlan.parse("preempt@5?grace=3.5,"
+                                      "slow@2-4?ms=120")
+    f_p, f_s = plan.faults
+    assert (f_p.kind, f_p.start, f_p.end, f_p.grace) == \
+        ("preempt", 5, 5, 3.5)
+    assert (f_s.kind, f_s.start, f_s.end, f_s.ms) == ("slow", 2, 4, 120.0)
+    # defaults when the option is omitted
+    d_p, d_s = faults_lib.FaultPlan.parse("preempt@1,slow@1").faults
+    assert d_p.grace == 2.0 and d_s.ms == 50.0
+    # slow is a per-poll penalty inside the window, zero outside
+    assert plan.slow_penalty_ms(1) == 0.0
+    assert plan.slow_penalty_ms(3) == 120.0
+    assert plan.slow_penalty_ms(3) == 120.0   # every poll, not one-shot
+    assert plan.slow_penalty_ms(5) == 0.0
+    # due_spec returns the spec (the worker reads grace off it) exactly
+    # once, and only at the armed step
+    assert plan.due_spec("preempt", 4) is None
+    fired = plan.due_spec("preempt", 5)
+    assert fired is not None and fired.grace == 3.5
+    assert plan.due_spec("preempt", 5) is None   # one-shot
+    # option/kind mismatches and negative windows are config errors
+    for bad in ("nan@3?grace=1", "preempt@3?ms=5", "slow@3?grace=1",
+                "slow@3?ms=-1", "preempt@3?grace=-2"):
+        with pytest.raises(ValueError):
+            faults_lib.FaultPlan.parse(bad)
+
+
+def test_graceful_shutdown_preempt_notice(tmp_path, monkeypatch):
+    """The advance-notice channel end to end in one process: notice file
+    + SIGUSR1 -> noticed (grace from the file), idempotent on repeat,
+    handlers restored on exit."""
+    import signal
+
+    from neural_networks_parallel_training_with_mpi_tpu.train import (
+        resilience as res,
+    )
+
+    notice = tmp_path / "preempt-notice.json"
+    monkeypatch.setenv(res.PREEMPT_NOTICE_ENV, str(notice))
+    assert res.read_preempt_notice() is None      # absent: no notice yet
+    assert res.write_preempt_notice(grace_s=4.5) == str(notice)
+    rec = res.read_preempt_notice()
+    assert rec["grace_s"] == 4.5 and "t_unix" in rec
+
+    with res.GracefulShutdown() as stop:
+        assert not stop.requested and not stop.noticed
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert stop.noticed and stop.requested
+        assert stop.grace_s == 4.5                # read from the file
+        os.kill(os.getpid(), signal.SIGUSR1)      # repeat: never escalates
+        assert stop.noticed and stop.grace_s == 4.5
+    assert signal.getsignal(signal.SIGUSR1) is signal.SIG_DFL
+
+    # no file: PREEMPT_GRACE_ENV, then the 2 s default
+    notice.unlink()
+    monkeypatch.setenv(res.PREEMPT_GRACE_ENV, "7.25")
+    with res.GracefulShutdown() as stop:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert stop.grace_s == 7.25
+
+
 # --------------------------------------------------------- guarded trainer
 
 
@@ -401,6 +463,23 @@ def test_sigterm_graceful_exit_in_process(tmp_path, mesh8):
     assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
 
 
+def test_preempt_notice_in_process(tmp_path, mesh8):
+    """Injected advance-notice preemption (faults kind ``preempt``):
+    SIGUSR1 at step 7 -> the same dispatch-boundary final-checkpoint path
+    as SIGTERM, but the result says preempt_notice (the CLI maps that to
+    exit 47 so a supervisor retires instead of relaunching)."""
+    cfg = _cfg(nepochs=10, checkpoint_dir=str(tmp_path),
+               faults="preempt@7?grace=9")
+    result = Trainer(cfg, mesh=mesh8).fit()
+    assert result.get("preempt_notice") is True
+    assert result.get("preempted") is True
+    assert result["steps"] == 8                   # <= 1 step lost
+    assert ckpt.latest_step(str(tmp_path)) == 8
+    import signal
+
+    assert signal.getsignal(signal.SIGUSR1) is signal.SIG_DFL
+
+
 def test_sigterm_final_wait_surfaces_async_write_errors(tmp_path, mesh8,
                                                        monkeypatch):
     """A failing BACKGROUND checkpoint write must be re-raised by the
@@ -540,6 +619,24 @@ def test_cli_sigterm_checkpoint_and_exit0(tmp_path):
     out2 = _cli(["--nepochs", "10", "--checkpoint_dir", str(d), "--resume"])
     assert out2.returncode == 0, (out2.stdout + out2.stderr)[-3000:]
     assert ckpt.latest_step(str(d)) == 40
+
+
+def test_cli_preempt_notice_exit47_not_retried(tmp_path):
+    """Acceptance: an ADVANCE-notice preemption (SIGUSR1 mid-run) writes
+    the same valid final checkpoint but exits 47 (decommission) — and a
+    supervisor retires the slot instead of relaunching onto the doomed
+    node (47 is in the no-retry set)."""
+    d = tmp_path / "c"
+    out = _cli(["--nepochs", "10", "--checkpoint_dir", str(d),
+                "--faults", "preempt@7?grace=9",
+                "--supervise", "3", "--supervise_backoff", "0.1"])
+    text = out.stdout + out.stderr
+    assert out.returncode == 47, text[-3000:]
+    assert "preemption notice" in text
+    assert "[supervise] attempt 2" not in text    # exactly one launch
+    assert ckpt.latest_step(str(d)) == 8          # checkpoint still valid
+    restored = ckpt.restore(str(d))
+    assert int(np.asarray(restored.step)) == 8
 
 
 # ---------------------------------------------------------------- overhead

@@ -40,7 +40,12 @@ _HIGHER = ("per_s", "per_sec", "tokens_per_s", "samples_per_sec",
            "capacity", "throughput", "frames_per_s", "updates_per_s")
 _LOWER = ("_ms", "_s", "_sec", "_pct", "_bytes", "latency", "ttft",
           "itl", "overhead", "residual", "skipped", "dropped",
-          "alerts_fired", "stale", "p50", "p99")
+          "alerts_fired", "stale", "p50", "p99",
+          # BENCH_CHAOS recovery prices: faster repair / fewer redone
+          # requests is better (mttr/reaction also carry the _s
+          # suffix, but the bare names keep ratio keys directed)
+          "mttr", "reaction", "tokens_lost", "requeued",
+          "steps_replayed")
 # accounting/config keys that look directed but are descriptive: gating
 # them would flag "the chaos run covered a different number of seconds"
 # as a perf regression

@@ -1,0 +1,96 @@
+"""Chaos-campaign runner: planned failures as a CI gate.
+
+Drives ``utils/chaos.py``: load a plan (builtin ``lite``/``full`` or a
+JSON file), run every scenario ``--repeat`` times against real
+processes, check every invariant (request-ledger exactness, no
+duplicate deliveries, goodput classifying 100% of wall-clock, the
+advance-notice arm's rollback/relaunch_gap/requeue collapsing to zero,
+retired-stays-down), and verify the campaign is DETERMINISTIC — the
+wall-clock-free canonical digest must be identical across passes.
+
+The exit code IS the gate: 0 when every invariant holds and the
+digests match, 1 otherwise — the CI ``chaos-lite`` lane runs the
+``lite`` plan (supervised stdlib children, no jax needed) under
+``python -S`` and fails the build on any violation::
+
+    python tools/chaos_campaign.py lite
+    python tools/chaos_campaign.py full --repeat 2 --json out.json
+    python tools/chaos_campaign.py my_plan.json --seed 7
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import pathlib
+import sys
+
+_CHAOS_PY = (pathlib.Path(__file__).resolve().parent.parent
+             / "neural_networks_parallel_training_with_mpi_tpu"
+             / "utils" / "chaos.py")
+
+
+def _load_chaos():
+    spec = importlib.util.spec_from_file_location("_cc_chaos",
+                                                  _CHAOS_PY)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_cc_chaos"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run a deterministic chaos campaign and gate on "
+                    "its invariants")
+    ap.add_argument("plan", help="builtin plan name (lite, full) or a "
+                                 "JSON plan file")
+    ap.add_argument("--repeat", type=int, default=2,
+                    help="passes over the plan; >= 2 checks the "
+                         "canonical digests match (default 2)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the plan's seed")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full campaign document here")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress per-scenario progress lines")
+    args = ap.parse_args(argv)
+
+    chaos = _load_chaos()
+    plan = chaos.load_plan(args.plan)
+    if args.seed is not None:
+        plan["seed"] = int(args.seed)
+    log = (lambda m: None) if args.quiet else print
+    doc = chaos.run_campaign(plan, repeat=args.repeat, log=log)
+
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    print(f"plan={doc['plan']} seed={doc['seed']} "
+          f"scenarios={len(doc['scenarios'])} "
+          f"passes={doc['determinism']['passes']}")
+    for r in doc["scenarios"]:
+        inv = r["invariants"]
+        held = sum(1 for v in inv.values() if v)
+        mt = r["metrics"]
+        extras = " ".join(
+            f"{k}={mt[k]}" for k in ("mttr_s", "reaction_s",
+                                     "requeued", "tokens_lost")
+            if mt.get(k) is not None)
+        print(f"  {r['name']:<22} invariants {held}/{len(inv)} "
+              f"wall={r['wall_s']}s {extras}")
+    print(f"deterministic={doc['determinism']['reproducible']} "
+          f"digest={doc['determinism']['digests'][0][:16]}")
+    if doc["problems"]:
+        for p in doc["problems"]:
+            print(f"VIOLATED: {p}", file=sys.stderr)
+        return 1
+    print("all invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,8 @@ def render(ledger: Dict[str, Any], min_seconds: float = 1e-4) -> str:
         + (f"{frac * 100:.1f}%" if frac is not None else "?")
         + f", {fleet.get('relaunches', 0)} relaunch(es), "
         f"{fleet.get('decisions', 0)} autopilot decision(s)"
+        + (f", {fleet.get('preempt_notices', 0)} preemption "
+           "notice(s)" if fleet.get("preempt_notices") else "")
         + ("" if fleet.get("sum_ok") else "  [SUM MISMATCH]"))
     lines.append("  [" + _bar(fleet.get("categories") or {}, covered)
                  + "]")
@@ -122,6 +124,14 @@ def render(ledger: Dict[str, Any], min_seconds: float = 1e-4) -> str:
                                   covered, min_seconds))
     legend = "  ".join(f"{_GLYPH[c]}={c}" for c in gp.CATEGORIES)
     lines.append(f"  legend: {legend}")
+    if fleet.get("preempt_notices"):
+        # crash-vs-notice reading aid: an announced preemption (exit
+        # rc=47 after a notice) prices its tail as 'drain' — the
+        # crash categories 'rollback' and 'relaunch_gap' staying at
+        # zero is the advance-notice win, not an accounting gap
+        lines.append("  note: advance-notice exits (rc=47) price "
+                     "their tail as drain; rollback/relaunch_gap at "
+                     "zero is the announced-preemption contract")
     skipped = fleet.get("lines_skipped")
     if skipped:
         lines.append(f"  note: {skipped} unparseable JSONL line(s) "
