@@ -1256,6 +1256,7 @@ def _run_flag_cpu_child(flag: str, n_devices: int,
                     or doc.get("decode_artifact")
                     or doc.get("serve_artifact")
                     or doc.get("serve_fleet_artifact")
+                    or doc.get("serve_disagg_artifact")
                     or doc.get("paged_attn_artifact")
                     or doc.get("rl_artifact")
                     or doc.get("update_sharding_artifact")
@@ -3147,6 +3148,189 @@ def bench_serve_fleet(out_path: str = "BENCH_FLEET.json") -> str:
     return out_path
 
 
+def bench_serve_disagg(out_path: str = "BENCH_DISAGG.json") -> str:
+    """The disaggregated prefill/decode bench (serve/fleet.py role
+    pools + the handoff ledger, DESIGN.md §11): price the block
+    handoff and pin its safety.
+
+    Arms (identical request plan wherever tokens are pinned — same
+    seed, same ``long_prefill`` mix, so every arm's token stream is
+    byte-comparable):
+
+    * ``decode_floor`` — one unified replica, near-zero prompts: the
+      decode-cadence floor (what ITL looks like when prefill work is
+      negligible).  Different traffic by construction, so it is the
+      cadence REFERENCE, not part of the token pin.
+    * ``unified`` — two unified replicas under the long-prompt-heavy
+      mix: chunked prefill interleaves with decode on the SAME
+      replica, so long prompts tax running streams' ITL.
+    * ``disagg`` — one prefill + one decode replica, same traffic:
+      prefill runs elsewhere, blocks arrive via the handoff, and the
+      decode pool's ITL p99 must stay FLAT (near the floor, at or
+      under unified) — the whole point of disaggregation.
+    * ``degraded`` — the prefill replica dies for good (restart budget
+      zero): the router serves unified on the surviving decode pool;
+      degraded dispatches/seconds are priced and tokens still match.
+    * four chaos arms — one per fleet fault kind (``handoff_kill``
+      pre-commit, ``handoff_kill_post``, ``decode_kill``,
+      ``handoff_stall``): every recovery path exercised under load,
+      each arm completing ALL requests with byte-identical tokens.
+
+    Honesty: same device-emulation convention as BENCH_FLEET (each
+    decode tick padded with ``device_emulation_ms`` of emulated device
+    latency; this one-core host time-slices the replicas), and the
+    byte-identity pin holds for GREEDY decode only — replicas are
+    bit-identical by construction, so tokens are a pure function of
+    the request plan, never of placement, handoff, or recovery."""
+    import jax
+
+    from neural_networks_parallel_training_with_mpi_tpu.serve import (
+        launch_fleet, run_fleet_closed_loop,
+    )
+
+    devices = jax.devices()
+    device_ms = 15.0
+    model = dict(vocab=256, seq=128, layers=2, d_model=64, heads=4,
+                 d_ff=128, init_seed=0)
+    serve = dict(slots=4, block_size=16, prefill_chunk=32,
+                 queue_depth=16)
+    clients, rpc, seed = 6, 4, 11
+    results: dict = {
+        "model": model, "serve_per_replica": serve,
+        "device_emulation_ms": device_ms,
+        "mix": "long_prefill",
+        "clients": clients, "requests_per_client": rpc, "seed": seed,
+        "host_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else os.cpu_count(),
+    }
+
+    def run_arm(label, *, roles, fault=None, max_restarts=1,
+                handoff_timeout_s=60.0, mix="long_prefill",
+                prompt_lens=(4, 24), max_new=(8, 24)):
+        """One fleet arm: ``roles`` spawns the healthy replicas;
+        ``fault`` (role, faults-spec) adds one more carrying the
+        injected fault (its worker index is len(roles), matching the
+        spec's ``proc=``)."""
+        fleet = launch_fleet(
+            len(roles), model=model, serve=serve,
+            step_sleep_ms=device_ms,
+            router_kwargs=dict(queue_depth=128,
+                               handoff_timeout_s=handoff_timeout_s),
+            prewarm=True, max_restarts=max_restarts, roles=roles,
+            log=lambda m: None)
+        try:
+            if fault is not None:
+                frole, fstr = fault
+                fleet.add_replica(role=frole, faults=fstr)
+            fleet.wait_ready(600)
+            row = run_fleet_closed_loop(
+                fleet, clients, rpc, vocab_size=model["vocab"],
+                prompt_lens=prompt_lens, max_new=max_new, seed=seed,
+                mix=mix)
+            hs = fleet.router.handoff_stats()
+            # include any STILL-OPEN degraded span (an arm that ends
+            # degraded would otherwise report only closed spans)
+            hs["degraded_mode_s"] = (
+                fleet.router.load_report()["now"]["degraded_mode_s"])
+            row["handoff"] = hs
+            log(f"[disagg {label}] {row['tokens_per_sec']} tok/s "
+                f"itl_p99 {row['itl_ms_p99']:.1f} ms "
+                f"handoffs {hs['handoffs']} "
+                f"requeued {row['requeued']} "
+                f"degraded {hs['degraded_dispatches']}")
+            return row
+        finally:
+            fleet.close()
+
+    # ---- cadence floor: negligible prefill, same decode lengths ------
+    floor = run_arm("decode_floor", roles=[None], mix=None,
+                    prompt_lens=(4, 8), max_new=(16, 28))
+    results["decode_floor"] = floor
+
+    # ---- unified vs disagg at equal replica count --------------------
+    unified = run_arm("unified", roles=[None, None])
+    disagg = run_arm("disagg", roles=["prefill", "decode"])
+    results["unified"] = unified
+    results["disagg"] = disagg
+
+    # ---- degraded mode: prefill pool dies, zero restart budget -------
+    degraded = run_arm("degraded", roles=["decode"],
+                       fault=("prefill", "replica_kill@2?proc=1&max=1"),
+                       max_restarts=0)
+    results["degraded"] = degraded
+
+    # ---- chaos arms: one per fleet fault kind ------------------------
+    # fault plans reset per process life, so a killed worker re-fires
+    # on relaunch until the restart budget runs out — each kill arm
+    # therefore ALSO ends in (and prices) degraded single-pool serving
+    chaos_specs = [
+        ("handoff_kill", ["decode"],
+         ("prefill", "handoff_kill@2?proc=1&max=1"), 60.0),
+        ("handoff_kill_post", ["decode"],
+         ("prefill", "handoff_kill_post@2?proc=1&max=1"), 60.0),
+        ("decode_kill", ["prefill"],
+         ("decode", "decode_kill@2?proc=1&max=1"), 60.0),
+        # stall: the 2nd inject is swallowed (no ack) — a short ledger
+        # timeout so the retry path is exercised inside the arm
+        ("handoff_stall", ["prefill"],
+         ("decode", "handoff_stall@2?proc=1&max=1"), 2.0),
+    ]
+    chaos: dict = {}
+    for name, roles, fault, timeout_s in chaos_specs:
+        row = run_arm(name, roles=roles, fault=fault,
+                      max_restarts=1, handoff_timeout_s=timeout_s)
+        chaos[name] = row
+    results["chaos"] = chaos
+
+    pinned = [("unified", unified), ("disagg", disagg),
+              ("degraded", degraded)] + sorted(chaos.items())
+    shas = {k: r["tokens_sha256"] for k, r in pinned}
+    want = clients * rpc
+    results["acceptance"] = {
+        "tokens_sha256": shas,
+        "tokens_identical_all_arms":
+            len(set(shas.values())) == 1,
+        "all_arms_completed":
+            all(r["requests"] == want for _, r in pinned),
+        "itl_p99_floor_ms": floor["itl_ms_p99"],
+        "itl_p99_unified_ms": unified["itl_ms_p99"],
+        "itl_p99_disagg_ms": disagg["itl_ms_p99"],
+        # flat = the disagg decode pool's cadence stays near the
+        # no-prefill floor and never loses to unified under the same
+        # long-prompt mix (5% noise allowance on a one-core host)
+        "disagg_itl_p99_flat": bool(
+            disagg["itl_ms_p99"] <= floor["itl_ms_p99"] * 1.6
+            and disagg["itl_ms_p99"] <= unified["itl_ms_p99"] * 1.05),
+        "handoffs_committed": disagg["handoff"]["handoffs"] > 0,
+        "handoff_ms_p50": disagg["handoff"]["handoff_ms_p50"],
+        "handoff_ms_p99": disagg["handoff"]["handoff_ms_p99"],
+        "degraded_served_unified":
+            degraded["handoff"]["degraded_dispatches"] > 0,
+        "stall_retried":
+            chaos["handoff_stall"]["handoff"]["handoff_retries"] > 0,
+        "decode_kill_redecoded":
+            chaos["decode_kill"]["handoff"]["redecodes"] > 0,
+        "kill_requeued":
+            chaos["handoff_kill"]["requeued"] > 0,
+    }
+    results["platform"] = devices[0].platform
+    results["device_kind"] = devices[0].device_kind
+    out_path = _divert_cpu_overwrite(
+        out_path, devices[0].platform not in ("cpu",))
+    _emit_artifact(out_path, results, honesty={
+        "device_emulation": True,   # decode ticks padded with emulated
+        # device latency; one-core host time-slices the replicas
+        "greedy_byte_identity_only": True,  # the cross-arm token pin
+        # holds for greedy decode (temperature=0) — sampled decode has
+        # per-server PRNG state and is out of scope by design
+    })
+    acc = results["acceptance"]
+    log(f"serve disagg bench -> {out_path} "
+        f"(tokens_identical={acc['tokens_identical_all_arms']}, "
+        f"itl_flat={acc['disagg_itl_p99_flat']})")
+    return out_path
+
+
 def bench_autopilot(out_path: str = "BENCH_AUTOPILOT.json") -> str:
     """The fleet-autopilot bench (serve/autopilot.py): price the
     control loop.  Four arms, all on the BENCH_FLEET device-emulated
@@ -4151,6 +4335,16 @@ def main() -> int:
                          "overload rejection; write BENCH_FLEET.json")
     ap.add_argument("--serve-fleet-inproc", action="store_true",
                     help=argparse.SUPPRESS)  # internal: child entry
+    ap.add_argument("--serve-disagg", action="store_true",
+                    help="disaggregated prefill/decode bench "
+                         "(serve/fleet.py role pools + handoff "
+                         "ledger): unified-vs-disagg decode-ITL A/B "
+                         "under a long-prompt mix, degraded single-"
+                         "pool arm, one chaos arm per fleet fault "
+                         "kind, byte-identical tokens across every "
+                         "arm; write BENCH_DISAGG.json")
+    ap.add_argument("--serve-disagg-inproc", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: child entry
     ap.add_argument("--autopilot", action="store_true",
                     help="fleet-autopilot bench (serve/autopilot.py): "
                          "steady-state control-loop overhead vs "
@@ -4290,6 +4484,10 @@ def main() -> int:
     if args.serve_fleet_inproc:
         print(json.dumps({"serve_fleet_artifact": bench_serve_fleet()}))
         return 0
+    if args.serve_disagg_inproc:
+        print(json.dumps({"serve_disagg_artifact":
+                          bench_serve_disagg()}))
+        return 0
     if args.autopilot_inproc:
         print(json.dumps({"autopilot_artifact": bench_autopilot()}))
         return 0
@@ -4323,7 +4521,8 @@ def main() -> int:
         return 0
 
     if (args.attention or args.decode or args.serve or args.rl
-            or args.serve_fleet or args.autopilot or args.chaos
+            or args.serve_fleet or args.serve_disagg
+            or args.autopilot or args.chaos
             or args.paged_attn or args.prefix_cache
             or args.update_sharding_ab or args.trace_overhead
             or args.obs_overhead or args.quant_ab or args.goodput):
@@ -4361,6 +4560,12 @@ def main() -> int:
             path = _run_flag_cpu_child("--serve-fleet-inproc", 1,
                                        timeout=3000)
             print(json.dumps({"serve_fleet_artifact": path}))
+        if args.serve_disagg:
+            # subprocess-replica shape like --serve-fleet: the role
+            # pools ARE cpu-pinned worker processes
+            path = _run_flag_cpu_child("--serve-disagg-inproc", 1,
+                                       timeout=3000)
+            print(json.dumps({"serve_disagg_artifact": path}))
         if args.autopilot:
             # subprocess-replica shape like --serve-fleet: the control
             # loop's subjects are worker processes with their own cpu

@@ -37,8 +37,10 @@ from .paged_kv import (
 from .paged_kv import ATTN_IMPLS
 from .scheduler import Request, Scheduler, ServeConfig
 from .loadgen import (
+    MIXES,
     make_requests,
     prewarm,
+    resolve_mix,
     run_closed_loop,
     run_fleet_closed_loop,
     sweep_loads,
@@ -52,6 +54,7 @@ from .fleet import (
     ProcReplica,
     TPGenerateReplica,
     launch_fleet,
+    role_kind,
 )
 from .autopilot import (
     Autopilot,
@@ -63,9 +66,10 @@ from .autopilot import (
 __all__ = [
     "ATTN_IMPLS", "BlockAllocator", "BlockExhausted", "PagedDecodeServer",
     "PrefixIndex", "init_paged_kv", "Request", "Scheduler", "ServeConfig",
-    "make_requests", "prewarm", "run_closed_loop", "sweep_loads",
+    "MIXES", "make_requests", "prewarm", "resolve_mix",
+    "run_closed_loop", "sweep_loads",
     "Fleet", "FleetRequest", "FleetRouter", "InprocReplica", "LoadSignal",
-    "ProcReplica", "TPGenerateReplica", "launch_fleet",
+    "ProcReplica", "TPGenerateReplica", "launch_fleet", "role_kind",
     "run_fleet_closed_loop",
     "Autopilot", "AutopilotConfig", "load_weight_snapshot",
     "save_weight_snapshot",

@@ -69,11 +69,12 @@ import math
 import time
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..utils.sketches import QuantileSketch
 from .fleet import GEN_STRIDE  # noqa: F401  (re-exported: the id<->
 #   generation stride is part of this module's attribution contract)
+from .fleet import role_kind
 
 
 # ---------------------------------------------------------------------------
@@ -163,6 +164,13 @@ class AutopilotConfig:
     # fleet width
     min_replicas: int = 1
     max_replicas: int = 4
+    # per-role floor for DISAGGREGATED fleets (prefill/decode roles,
+    # DESIGN.md §11): a role pool that has ever served is kept at this
+    # many replicas — scale-in refuses victims that would breach it,
+    # and an EMPTIED pool (crash-then-retire, eviction) is backfilled
+    # with the same role so degraded unified serving is a transient,
+    # not a steady state.  Unified fleets never hit either path.
+    min_per_role: int = 1
     # decision cadence: tick() is called every Fleet.pump but only
     # evaluates this often (the steady-state overhead knob)
     interval_s: float = 0.2
@@ -238,8 +246,13 @@ class Autopilot:
         self._rollout: Optional[Dict[str, Any]] = None
         # preemption notices + health-eviction hysteresis
         self._noticed_seen: set = set()
-        self._backfill_due: List[str] = []
+        self._backfill_due: List[Tuple[str, Optional[str]]] = []
         self._unhealthy_since: Dict[str, float] = {}
+        # disagg role memory: pools this fleet has served with.  A pool
+        # that empties (all members dead AND removed) leaves no handle
+        # to read the role from, so remember it here — _watch_pools
+        # backfills from this set.
+        self._roles_seen: set = set()
 
     # ---- bookkeeping ---------------------------------------------------
     def _decide(self, action: str, **extra) -> Dict[str, Any]:
@@ -289,6 +302,32 @@ class Autopilot:
         at the router and not already being drained out."""
         return [h for h in self.fleet.router.replicas
                 if h.name not in self._draining]
+
+    def _spawn(self, role: Optional[str] = None, **kw):
+        """``fleet.add_replica`` with the role passed ONLY when set, so
+        unified fleets (and the in-process stand-ins tests drive) keep
+        their pre-disagg call shape."""
+        if role is not None and role != "unified":
+            return self.fleet.add_replica(role=role, **kw)
+        return self.fleet.add_replica(**kw)
+
+    def _pool_counts(self) -> Dict[str, int]:
+        """LIVE replicas per role kind (prefill / decode / unified),
+        and the role-memory update: any disagg role seen here is
+        remembered for empty-pool backfill.  Membership is ``alive``,
+        not ``accepting`` — a replica still compiling occupies its
+        pool (else the startup window would read as an empty pool and
+        trigger a spurious backfill)."""
+        by: Dict[str, int] = {}
+        for h in self._active():
+            kind = role_kind(h)
+            if kind in ("prefill", "decode"):
+                self._roles_seen.add(kind)
+            alive = getattr(h, "alive", None)
+            live = alive() if callable(alive) else h.accepting()
+            if live and not getattr(h, "noticed", False):
+                by[kind] = by.get(kind, 0) + 1
+        return by
 
     def summary(self) -> Dict[str, Any]:
         """Decision counts per action (bench/test assertion surface)."""
@@ -347,28 +386,69 @@ class Autopilot:
         if self._rollout is not None:
             self._advance_rollout(now)
         else:
+            self._watch_pools(now)
             self._autoscale(now)
             self._health_evict(now)
         return self.decisions[before:]
+
+    # ---- disagg pool floors (DESIGN.md §11) ----------------------------
+    def _watch_pools(self, now: float) -> None:
+        """Backfill an EMPTIED disagg role pool.  While a pool is empty
+        the router serves degraded-unified (correct but unpriced:
+        prefill and decode interfere again), so this reacts like the
+        preemption backfill — not gated on cooldown, only on the
+        one-action gate and failure backoff.  Roles come from
+        ``_roles_seen``: an empty pool leaves no handle to read."""
+        counts = self._pool_counts()
+        if (len(self._roles_seen) < 2       # never was a disagg fleet
+                or self._rollout is not None
+                or self._pending_out is not None
+                or now < self._backoff_until):
+            return
+        missing = sorted(r for r in self._roles_seen
+                         if counts.get(r, 0) < self.cfg.min_per_role)
+        if not missing:
+            return
+        role = missing[0]
+        try:
+            h = self._spawn(role=role, generation=self._primary_gen())
+        except Exception as exc:
+            self._action_failed(now, "pool_backfill", str(exc)[:200])
+            return
+        self._pending_out = {"name": h.name, "t": now,
+                             "deadline": now + self.cfg.ready_timeout_s}
+        self._decide("pool_backfill", replica=h.name, role=role,
+                     pool_size=counts.get(role, 0))
 
     # ---- autoscaling ---------------------------------------------------
     def _observe(self):
         router = self.fleet.router
         occs = []
+        by_role: Dict[str, List[float]] = {}
         for h in self._active():
             if not h.accepting():
                 continue
             sig = h.load()
-            occs.append(sig.occupancy if sig is not None else 0.0)
+            occ = sig.occupancy if sig is not None else 0.0
+            occs.append(occ)
+            by_role.setdefault(role_kind(h), []).append(occ)
         queue = len(router.queue)
         mean_occ = (sum(occs) / len(occs)) if occs else math.inf
-        return mean_occ, queue
+        occ_by_role = {k: sum(v) / len(v) for k, v in by_role.items()}
+        return mean_occ, queue, occ_by_role
 
     def _autoscale(self, now: float) -> None:
         cfg = self.cfg
-        mean_occ, queue = self._observe()
+        mean_occ, queue, occ_by_role = self._observe()
+        # disagg fleets watch each pool: one hot role is a capacity
+        # problem even when the other pool idles the fleet-wide mean
+        # below the threshold (a long-prompt wave saturates prefill
+        # while decode coasts)
+        hot_roles = {k: v for k, v in occ_by_role.items()
+                     if k in ("prefill", "decode")
+                     and v >= cfg.high_occupancy}
         high = (mean_occ >= cfg.high_occupancy
-                or queue >= cfg.high_queue)
+                or queue >= cfg.high_queue or bool(hot_roles))
         low = mean_occ <= cfg.low_occupancy and queue == 0
         # hysteresis: the signal must HOLD before anything moves
         if high:
@@ -388,10 +468,20 @@ class Autopilot:
         if (self._high_since is not None
                 and now - self._high_since >= cfg.scale_out_hold_s
                 and n < cfg.max_replicas):
+            # disagg fleets scale the PRESSURED pool: the role with the
+            # highest mean occupancy gets the new replica, so a
+            # long-prompt wave widens prefill without over-building the
+            # decode pool (and vice versa).  Unified fleets pass None.
+            role = None
+            disagg = [(v, k) for k, v in
+                      (hot_roles or occ_by_role).items()
+                      if k in ("prefill", "decode")]
+            if disagg:
+                role = max(disagg)[1]
             self._scale_out(now, reason={
                 "mean_occupancy": round(mean_occ, 3)
                 if math.isfinite(mean_occ) else None,
-                "queue_depth": queue})
+                "queue_depth": queue}, role=role)
         elif (self._low_since is not None
                 and now - self._low_since >= cfg.scale_in_hold_s
                 and n > cfg.min_replicas):
@@ -399,9 +489,10 @@ class Autopilot:
                 "mean_occupancy": round(mean_occ, 3)
                 if math.isfinite(mean_occ) else None})
 
-    def _scale_out(self, now: float, reason) -> None:
+    def _scale_out(self, now: float, reason,
+                   role: Optional[str] = None) -> None:
         try:
-            h = self.fleet.add_replica(generation=self._primary_gen())
+            h = self._spawn(role=role, generation=self._primary_gen())
         except Exception as exc:          # spawn refusal = failed action
             self._action_failed(now, "scale_out", str(exc)[:200])
             return
@@ -409,6 +500,8 @@ class Autopilot:
                              "deadline": now + self.cfg.ready_timeout_s}
         self._high_since = None
         self._cooldown_until = now + self.cfg.cooldown_s
+        if role is not None:
+            reason = {**reason, "role": role}
         self._decide("scale_out", replica=h.name, **reason)
 
     def _watch_pending_out(self, now: float) -> None:
@@ -456,6 +549,17 @@ class Autopilot:
         victims = [h for h in self._active()
                    if getattr(h, "generation", 0) == gen]
         if len(victims) <= self.cfg.min_replicas:
+            return
+        # per-role floor: in a disagg fleet, removing a replica must not
+        # drop its role pool below min_per_role — an emptied pool means
+        # degraded unified serving, which scale-in must never cause.
+        pool = {}
+        for h in victims:
+            pool[role_kind(h)] = pool.get(role_kind(h), 0) + 1
+        victims = [h for h in victims
+                   if role_kind(h) == "unified"
+                   or pool[role_kind(h)] > self.cfg.min_per_role]
+        if not victims:
             return
         victim = max(victims, key=lambda h: h.name)  # newest out first
         self._begin_decommission(now, victim.name, kind="scale_in")
@@ -512,7 +616,9 @@ class Autopilot:
                 continue
             if h.name not in self._noticed_seen:
                 self._noticed_seen.add(h.name)
-                self._backfill_due.append(h.name)
+                # record the role AT NOTICE TIME: the handle may be
+                # gone by the time the backfill slot frees up
+                self._backfill_due.append((h.name, role_kind(h)))
                 g = getattr(h, "notice_grace_s", None)
                 self._decide("preempt_notice", replica=h.name,
                              grace_s=(round(float(g), 3)
@@ -537,11 +643,14 @@ class Autopilot:
         if width >= self.cfg.max_replicas:
             self._backfill_due.clear()
             return
-        victim = self._backfill_due.pop(0)
+        victim, vrole = self._backfill_due.pop(0)
         try:
-            h = self.fleet.add_replica(generation=self._primary_gen())
+            # the replacement inherits the victim's role, so a preempted
+            # prefill replica is backfilled INTO the prefill pool
+            h = self._spawn(role=vrole,
+                            generation=self._primary_gen())
         except Exception as exc:
-            self._backfill_due.insert(0, victim)
+            self._backfill_due.insert(0, (victim, vrole))
             self._action_failed(now, "preempt_backfill",
                                 str(exc)[:200])
             return
@@ -625,10 +734,15 @@ class Autopilot:
         if now - since < cfg.evict_hold_s:
             return
         del self._unhealthy_since[name]
-        # replace-then-drain: spawn the replacement first; the victim
-        # decommissions in _watch_pending_out once it accepts
+        # replace-then-drain: spawn the replacement first (same role as
+        # the victim, so an evicted prefill replica is replaced in the
+        # prefill pool); the victim decommissions in _watch_pending_out
+        # once it accepts
+        vrole = next((role_kind(h) for h in candidates
+                      if h.name == name), None)
         try:
-            h = self.fleet.add_replica(generation=self._primary_gen())
+            h = self._spawn(role=vrole,
+                            generation=self._primary_gen())
         except Exception as exc:
             self._action_failed(now, "health_evict", str(exc)[:200])
             return

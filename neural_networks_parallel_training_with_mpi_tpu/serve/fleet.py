@@ -64,17 +64,21 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
+from ..utils.logging import log
 from ..utils.sketches import Gauge, QuantileSketch
 
 Pytree = Any
 
 # wire protocol (one JSON object per line):
 #   parent -> worker : {"op": "submit", "rid", "prompt", "max_new",
-#                       "slo_ms"} | {"op": "drain"}
+#                       "slo_ms", "unified"?} | {"op": "drain"}
+#                     | {"op": "inject", "rid", "payload", "slo_ms"}
 #                     | {"op": "decommission"} | {"op": "exit"}
 #   worker -> parent : {"ev": "ready", ...} | {"ev": "done", "rid",
 #                       "tokens", "ttft_ms", "itl_ms", ...}
-#                     | {"ev": "reject", "rid"}
+#                     | {"ev": "reject", "rid", "inject"?}
+#                     | {"ev": "handoff", "rid", "payload", "ttft_ms"}
+#                     | {"ev": "injected", "rid"}
 #                     | {"ev": "status", "report": <load_report>}
 #                     | {"ev": "drained", "requests": [...]}
 #                     | {"ev": "load_error", "error": ...}
@@ -83,6 +87,17 @@ Pytree = Any
 # terminal exit with train.resilience.EXIT_DECOMMISSION (47) — the
 # autopilot's scale-in handshake (the supervisor must have retired the
 # child first so the exit is final, not relaunched).
+#
+# Disaggregated handoff (DESIGN.md §11): a PREFILL-role worker answers
+# a submit with "handoff" instead of "done" — the exported stream
+# (serve/paged_kv.export_stream: block contents + first sampled token)
+# rides the event, and emitting it is the COMMIT point: the router owns
+# the record from that line on.  The router forwards it to a
+# decode-role worker as an "inject" op, which acks "injected" (or
+# rejects with "inject": true when a slot/blocks are unavailable) and
+# later reports the normal "done".  "unified": true on a submit pins
+# end-to-end service regardless of the worker's role — the degraded
+# single-pool fallback.
 
 # replica ids encode the WEIGHT GENERATION: a generation-g replica gets
 # id g * GEN_STRIDE + k, so its flow-trace prefix (p{id}-R{id}-r...) and
@@ -114,6 +129,7 @@ class LoadSignal:
     ttft_p50_ms: Optional[float] = None
     ttft_p99_ms: Optional[float] = None
     replica: Optional[int] = None
+    role: str = "unified"              # scheduler serving role
 
     @classmethod
     def from_report(cls, rec: Dict[str, Any]) -> "LoadSignal":
@@ -128,6 +144,7 @@ class LoadSignal:
             free_blocks=int(now.get("free_blocks", 0)),
             block_utilization=float(now.get("block_utilization", 0.0)),
             replica=rec.get("replica"),
+            role=str(now.get("role", "unified") or "unified"),
         )
         doc = (rec.get("sketches") or {}).get("ttft_ms")
         if doc:
@@ -169,6 +186,18 @@ class FleetRequest:
     n_generated: Optional[int] = None
     generation: Optional[int] = None   # weight generation that COMPLETED
     #                                    this request (set at completion)
+    # --- disaggregated-handoff ledger (DESIGN.md §11) ---------------
+    # phase: queued -> prefilling -> handoff_inflight -> decoding.
+    # ``handoff`` holds the COMMITTED export payload until completion:
+    # it IS the decode-death recovery record (re-inject, no re-prefill).
+    phase: str = "queued"
+    unified: bool = False              # degraded end-to-end dispatch
+    handoff: Optional[Dict[str, Any]] = None
+    handoff_t: Optional[float] = None  # commit time (handoff received)
+    handoff_ms: Optional[float] = None # commit -> injected ack latency
+    handoff_retries: int = 0
+    handoff_next_t: float = 0.0        # backoff: earliest re-dispatch
+    prefill_replica: Optional[str] = None
 
     @property
     def deadline_missed(self) -> Optional[bool]:
@@ -181,6 +210,21 @@ class FleetRequest:
 # ---------------------------------------------------------------------------
 # replica handles
 # ---------------------------------------------------------------------------
+
+def role_kind(handle_or_role) -> str:
+    """Collapse a handle's role string to one of the three placement
+    kinds: ``"prefill"`` / ``"decode"`` / ``"unified"``.  Legacy role
+    strings ("replica", "serve", "serve-replica") are unified — a
+    pre-disagg fleet routes exactly as before."""
+    role = handle_or_role if isinstance(handle_or_role, str) else \
+        getattr(handle_or_role, "role", "replica")
+    role = str(role or "replica")
+    if role.endswith("prefill"):
+        return "prefill"
+    if role.endswith("decode"):
+        return "decode"
+    return "unified"
+
 
 class ReplicaHandle:
     """What the router needs from a replica, regardless of where it
@@ -203,6 +247,21 @@ class ReplicaHandle:
 
     def submit(self, req: FleetRequest) -> bool:
         raise NotImplementedError
+
+    def can_inject(self) -> bool:
+        """Whether this handle understands the ``inject`` op at all
+        (batch engines don't)."""
+        return False
+
+    def inject(self, req: FleetRequest, payload: Dict[str, Any]) -> bool:
+        """Dispatch a committed handoff record.  May refuse (False) —
+        the record stays in the router's handoff queue."""
+        return False
+
+    def forget(self, rid: int) -> None:
+        """Drop one rid from the assigned set WITHOUT completing it —
+        the router's handoff-timeout path, which re-owns the record
+        before re-dispatching it elsewhere."""
 
     def pump(self) -> List[Dict[str, Any]]:
         raise NotImplementedError
@@ -229,7 +288,10 @@ class InprocReplica(ReplicaHandle):
     def __init__(self, scheduler, name: str = "replica-0"):
         self.name = name
         self.sched = scheduler
+        srole = str(getattr(scheduler.cfg, "role", "unified") or "unified")
+        self.role = "replica" if srole == "unified" else srole
         self._local: Dict[int, int] = {}     # fleet rid -> scheduler rid
+        self._events: List[Dict[str, Any]] = []   # pending injected acks
         self._dead = False
 
     def alive(self) -> bool:
@@ -248,20 +310,60 @@ class InprocReplica(ReplicaHandle):
         if self._dead:
             return False
         lrid = self.sched.submit(req.prompt, req.max_new,
-                                 slo_ms=req.slo_ms)
+                                 slo_ms=req.slo_ms, unified=req.unified)
         if lrid is None:
             return False
         self._local[req.rid] = lrid
         return True
 
+    def can_inject(self) -> bool:
+        return not self._dead
+
+    def inject(self, req: FleetRequest, payload: Dict[str, Any]) -> bool:
+        if self._dead:
+            return False
+        try:
+            lrid = self.sched.inject(payload, slo_ms=req.slo_ms)
+        except ValueError:
+            return False
+        if lrid is None:
+            return False
+        self._local[req.rid] = lrid
+        # the ack rides the next pump so the router sees the same
+        # event order a subprocess replica produces
+        self._events.append({"ev": "injected", "rid": req.rid})
+        return True
+
+    def forget(self, rid: int) -> None:
+        self._local.pop(rid, None)
+
     def pump(self) -> List[Dict[str, Any]]:
-        if self._dead or not (self.sched.pending()
-                              or self.sched.in_flight()):
+        if self._dead:
             return []
-        done_local = set(self.sched.tick())
-        out = []
+        out, self._events = self._events, []
+        if self.sched.pending() or self.sched.in_flight():
+            done_local = set(self.sched.tick())
+        else:
+            done_local = set()
+        for rec in self.sched.take_handoffs():
+            frid = next((f for f, l in self._local.items()
+                         if l == rec["rid"]), None)
+            if frid is None:
+                continue
+            del self._local[frid]
+            out.append({"ev": "handoff", "rid": frid,
+                        "payload": rec["payload"],
+                        "ttft_ms": rec.get("ttft_ms")})
         for frid, lrid in list(self._local.items()):
-            if lrid not in done_local:
+            fin = lrid in done_local
+            if not fin:
+                # injected single-token streams retire inside inject()
+                # and never appear in a tick's done list
+                try:
+                    fin = self.sched.done(lrid)
+                except KeyError:
+                    fin = False
+            if not fin:
                 continue
             st = self.sched.stats(lrid)
             out.append({"rid": frid,
@@ -512,13 +614,29 @@ class ProcReplica(ReplicaHandle):
     def submit(self, req: FleetRequest) -> bool:
         if not self.accepting():
             return False
-        if not self._send({"op": "submit", "rid": req.rid,
-                           "prompt": req.prompt,
-                           "max_new": req.max_new,
-                           "slo_ms": req.slo_ms}):
+        op = {"op": "submit", "rid": req.rid, "prompt": req.prompt,
+              "max_new": req.max_new, "slo_ms": req.slo_ms}
+        if req.unified:
+            op["unified"] = True
+        if not self._send(op):
             return False
         self._assigned[req.rid] = req
         return True
+
+    def can_inject(self) -> bool:
+        return True
+
+    def inject(self, req: FleetRequest, payload: Dict[str, Any]) -> bool:
+        if not self.accepting():
+            return False
+        if not self._send({"op": "inject", "rid": req.rid,
+                           "payload": payload, "slo_ms": req.slo_ms}):
+            return False
+        self._assigned[req.rid] = req
+        return True
+
+    def forget(self, rid: int) -> None:
+        self._assigned.pop(rid, None)
 
     def request_drain(self) -> bool:
         return self._send({"op": "drain"})
@@ -551,6 +669,15 @@ class ProcReplica(ReplicaHandle):
                     pass
             elif ev == "done":
                 self._assigned.pop(int(rec["rid"]), None)
+                out.append(rec)
+            elif ev == "handoff":
+                # the stream left this (prefill) worker: emitting the
+                # event IS the commit — the router owns the record now
+                self._assigned.pop(int(rec["rid"]), None)
+                out.append(rec)
+            elif ev == "injected":
+                # inject ack: the stream is live on this (decode)
+                # worker; it stays in the assigned set until done
                 out.append(rec)
             elif ev == "reject":
                 # the worker's local queue refused (should not happen
@@ -605,6 +732,8 @@ class FleetRouter:
                  feasibility_margin: float = 1.5,
                  telemetry_dir: Optional[str] = None,
                  rollup_every: int = 50,
+                 handoff_timeout_s: float = 5.0,
+                 handoff_max_retries: int = 8,
                  now_fn=time.monotonic):
         self.replicas = list(replicas)
         if not self.replicas:
@@ -631,6 +760,25 @@ class FleetRouter:
         # completions collected OUTSIDE pump() (on_replica_down drains
         # a dead handle's raced events); the next pump() surfaces them
         self._completed_backlog: List[int] = []
+        # --- disaggregated-handoff ledger (DESIGN.md §11) -------------
+        # rids whose committed handoff record awaits a decode replica;
+        # _inflight_injects maps a dispatched-but-unacked inject to
+        # (handle name, deadline) so a stall times out and retries
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self.handoff_max_retries = int(handoff_max_retries)
+        self._handoff_queue: Deque[int] = collections.deque()
+        self._inflight_injects: Dict[int, Tuple[str, float]] = {}
+        self._handoff_ms = QuantileSketch()
+        self.handoffs = 0            # records committed at the router
+        self.handoff_retries = 0     # inject rejects + timeouts
+        self.handoff_reprefills = 0  # records dropped -> full re-prefill
+        self.redecodes = 0           # decode deaths recovered from record
+        self.duplicates_suppressed = 0
+        # degraded single-pool mode: a disagg fleet with an empty
+        # prefill or decode pool serves unified until backfill
+        self.degraded_dispatches = 0
+        self.degraded_mode_s = 0.0
+        self._degraded_since: Optional[float] = None
         # counters (the router's own rollup record reports these)
         self.routed = 0
         self.rejected = 0            # bounded-queue + infeasible rejects
@@ -726,7 +874,10 @@ class FleetRouter:
         return len(self.queue)
 
     def in_flight(self) -> int:
-        return sum(len(h.assigned()) for h in self.replicas)
+        # committed handoff records awaiting a decode replica are
+        # in-flight work the fleet still owes, visible nowhere else
+        return (sum(len(h.assigned()) for h in self.replicas)
+                + len(self._handoff_queue))
 
     def per_replica_completed(self) -> Dict[str, int]:
         return dict(self._completed_by)
@@ -834,7 +985,8 @@ class FleetRouter:
         return False
 
     def _place(self, req: FleetRequest,
-               sigs: Optional[Dict[str, Optional[LoadSignal]]] = None
+               sigs: Optional[Dict[str, Optional[LoadSignal]]] = None,
+               kinds: Optional[Tuple[str, ...]] = None
                ) -> Optional[ReplicaHandle]:
         """Least-loaded placement over the live load signals, deadline
         feasibility preferred: among accepting replicas whose router-
@@ -848,6 +1000,8 @@ class FleetRouter:
         desired_gen = self._desired_gen(req)
         for h in self.replicas:
             if not h.accepting():
+                continue
+            if kinds is not None and role_kind(h) not in kinds:
                 continue
             sig = (sigs[h.name] if sigs is not None
                    and h.name in sigs else h.load())
@@ -897,13 +1051,33 @@ class FleetRouter:
             # honored, not re-run)
             alive = h.alive()
             for rec in h.pump():
-                if rec.get("ev") == "reject":
-                    self._requeue_one(int(rec["rid"]), h.name)
+                ev = rec.get("ev")
+                if ev == "reject":
+                    if rec.get("inject"):
+                        self._handoff_failed(int(rec["rid"]), h.name)
+                    else:
+                        self._requeue_one(int(rec["rid"]), h.name)
+                    continue
+                if ev == "handoff":
+                    self._on_handoff(h, rec)
+                    continue
+                if ev == "injected":
+                    self._on_injected(h, int(rec["rid"]))
+                    continue
+                prev = self.reqs.get(int(rec["rid"]))
+                if prev is not None and prev.t_done is not None:
+                    # a timed-out inject that was actually alive can
+                    # complete AFTER its re-dispatch did: exactly-once
+                    # delivery means the second result is dropped here
+                    self.duplicates_suppressed += 1
                     continue
                 done_now.append(self._complete(h, rec))
             if not alive and self._was_alive.get(h.name, True):
                 self._on_death(h)
             self._was_alive[h.name] = alive
+        self._check_handoff_timeouts()
+        self._update_degraded()
+        self._dispatch_handoffs()
         self._dispatch()
         if self._heartbeat is not None:
             self._heartbeat.beat(self._pumps, None)
@@ -915,6 +1089,40 @@ class FleetRouter:
         self._gap_wall = time.time() if self.queue else None
         return done_now
 
+    def _pool_health(self) -> Tuple[bool, bool, bool]:
+        """(disagg, prefill_ok, decode_ok): whether the fleet has role
+        pools at all, and whether each duty has an accepting replica
+        (unified replicas count for both)."""
+        disagg = any(role_kind(h) in ("prefill", "decode")
+                     for h in self.replicas)
+        prefill_ok = decode_ok = False
+        for h in self.replicas:
+            if not h.accepting():
+                continue
+            kind = role_kind(h)
+            prefill_ok = prefill_ok or kind in ("unified", "prefill")
+            decode_ok = decode_ok or kind in ("unified", "decode")
+        return disagg, prefill_ok, decode_ok
+
+    def _update_degraded(self) -> None:
+        """Track wall-clock spent with a missing pool.  Degraded is a
+        MODE, not an error: traffic keeps flowing unified while the
+        autopilot backfills the empty pool."""
+        disagg, prefill_ok, decode_ok = self._pool_health()
+        # XOR on purpose: one empty pool = degraded single-pool serving;
+        # BOTH empty (startup compile window, total outage) is an
+        # availability gap, not a serving mode
+        degraded = disagg and (prefill_ok != decode_ok)
+        if degraded and self._degraded_since is None:
+            self._degraded_since = self.now()
+            log(f"fleet: degraded single-pool mode "
+                f"(prefill_ok={prefill_ok} decode_ok={decode_ok}) — "
+                f"serving unified until backfill")
+        elif not degraded and self._degraded_since is not None:
+            self.degraded_mode_s += self.now() - self._degraded_since
+            self._degraded_since = None
+            log("fleet: both role pools healthy — degraded mode over")
+
     def _dispatch(self) -> None:
         # load signals fetched ONCE per pass: an InprocReplica's load()
         # serializes + re-parses its whole sketch state, and the signal
@@ -922,9 +1130,22 @@ class FleetRouter:
         # (the router-side assigned() count, which does, is read live)
         sigs = {h.name: h.load() for h in self.replicas
                 if h.accepting()}
+        disagg, prefill_ok, decode_ok = self._pool_health()
         while self.queue:
             req = self.queue[0]
-            h = self._place(req, sigs)
+            if not disagg:
+                req.unified = False
+                h = self._place(req, sigs)
+            elif prefill_ok:
+                # healthy prefill duty; unified pins end-to-end service
+                # when there is no decode pool to hand off to
+                req.unified = not decode_ok
+                h = self._place(req, sigs, kinds=("unified", "prefill"))
+            else:
+                # no prefill-capable replica: the decode pool serves
+                # end-to-end rather than stranding traffic
+                req.unified = True
+                h = self._place(req, sigs, kinds=("unified", "decode"))
             if h is None:
                 return
             if not h.submit(req):
@@ -934,17 +1155,180 @@ class FleetRouter:
             self.queue.popleft()
             req.replica = h.name
             req.t_dispatch = self.now()
+            req.phase = ("decoding" if req.unified or not disagg
+                         or role_kind(h) != "prefill" else "prefilling")
+            if disagg and req.unified:
+                self.degraded_dispatches += 1
             self.routed += 1
+
+    # ---- the handoff ledger (DESIGN.md §11) ----------------------------
+    def _on_handoff(self, h: ReplicaHandle, rec: Dict[str, Any]) -> None:
+        """COMMIT: the prefill replica exported the stream and the
+        router received the record.  From here the payload — block
+        contents, block table, first sampled token — lives in the
+        ledger, so a decode death re-decodes from it without repaying
+        prefill."""
+        rid = int(rec["rid"])
+        req = self.reqs.get(rid)
+        if req is None or req.t_done is not None:
+            return
+        req.handoff = rec.get("payload")
+        req.prefill_replica = h.name
+        req.replica = None
+        req.phase = "handoff_inflight"
+        req.handoff_t = self.now()
+        req.handoff_next_t = 0.0
+        # fleet-level TTFT is owned by the PREFILL side (the first
+        # token was sampled there); the decode side only prices ITL
+        if rec.get("ttft_ms") is not None:
+            wait_ms = ((req.t_dispatch or req.t_submit)
+                       - req.t_submit) * 1e3
+            req.ttft_ms = wait_ms + float(rec["ttft_ms"])
+        self.handoffs += 1
+        self._handoff_queue.append(rid)
+
+    def _on_injected(self, h: ReplicaHandle, rid: int) -> None:
+        req = self.reqs.get(rid)
+        if req is None:
+            return
+        self._inflight_injects.pop(rid, None)
+        req.phase = "decoding"
+        req.replica = h.name
+        if req.handoff_t is not None and req.handoff_ms is None:
+            req.handoff_ms = (self.now() - req.handoff_t) * 1e3
+            self._handoff_ms.add(req.handoff_ms)
+
+    def _handoff_failed(self, rid: int, from_name: str) -> None:
+        """An inject was rejected, timed out, or its target died before
+        acking: retry with deterministic jittered backoff; after
+        ``handoff_max_retries`` the record is dropped and the request
+        re-prefills from scratch (the one path that repays prefill)."""
+        req = self.reqs.get(rid)
+        if req is None or req.t_done is not None:
+            return
+        self._inflight_injects.pop(rid, None)
+        req.replica = None
+        req.handoff_retries += 1
+        self.handoff_retries += 1
+        if req.handoff is None or (req.handoff_retries
+                                   > self.handoff_max_retries):
+            req.handoff = None
+            req.handoff_t = None
+            req.phase = "queued"
+            self.handoff_reprefills += 1
+            self._requeue_one(rid, from_name)
+            return
+        # deterministic jitter (same discipline as the canary slice:
+        # hash the rid, don't consult a clock-seeded RNG) so chaos arms
+        # replay identically
+        base = min(2.0, 0.05 * (2 ** (req.handoff_retries - 1)))
+        jitter = ((rid * 2654435761 + req.handoff_retries * 40503)
+                  % 1000) / 1000.0
+        req.handoff_next_t = self.now() + base * (0.5 + jitter)
+        req.phase = "handoff_inflight"
+        if rid not in self._handoff_queue:
+            self._handoff_queue.append(rid)
+
+    def _check_handoff_timeouts(self) -> None:
+        now = self.now()
+        for rid, (name, deadline) in list(self._inflight_injects.items()):
+            if now < deadline:
+                continue
+            # re-own the record BEFORE re-dispatch: the stalled worker
+            # must not surface this rid as assigned work anymore (a
+            # late completion is suppressed as a duplicate)
+            for h in self.replicas:
+                if h.name == name:
+                    h.forget(rid)
+                    break
+            self._handoff_failed(rid, name)
+
+    def _place_inject(self, req: FleetRequest) -> Optional[ReplicaHandle]:
+        """Least-loaded inject placement: decode pool preferred,
+        unified replicas as fallback, prefill replicas never (the whole
+        point is taking decode work OFF them)."""
+        best = None
+        best_key = None
+        for h in self.replicas:
+            if not h.accepting() or not h.can_inject():
+                continue
+            kind = role_kind(h)
+            if kind == "prefill":
+                continue
+            sig = h.load()
+            n_assigned = len(h.assigned())
+            slots = sig.slots if sig is not None else 1
+            if n_assigned >= slots + self.replica_queue_cap:
+                continue
+            if sig is None:
+                occ, util = float(n_assigned), 0.0
+            else:
+                occ = max(sig.occupancy, n_assigned / max(1, sig.slots))
+                util = sig.block_utilization
+            key = (kind != "decode", occ, util, h.name)
+            if best_key is None or key < best_key:
+                best, best_key = h, key
+        return best
+
+    def _dispatch_handoffs(self) -> None:
+        now = self.now()
+        disagg, prefill_ok, decode_ok = self._pool_health()
+        if disagg and prefill_ok and not decode_ok:
+            # the decode DUTY is gone (pool dead or drained, no unified
+            # fallback): a committed record has no target and waiting
+            # is a hang, not a recovery.  Degrade the records the same
+            # way queued traffic degrades — drop to a unified requeue
+            # (re-prefill, the one path that repays prefill) on the
+            # surviving pool.  A transient relaunch window pays one
+            # extra prefill per in-flight record; tokens are unchanged
+            # (greedy re-execution), and the reprefill is COUNTED.
+            for _ in range(len(self._handoff_queue)):
+                rid = self._handoff_queue.popleft()
+                req = self.reqs.get(rid)
+                if (req is None or req.t_done is not None
+                        or req.handoff is None):
+                    continue
+                req.handoff = None
+                req.handoff_t = None
+                req.phase = "queued"
+                self.handoff_reprefills += 1
+                self._requeue_one(rid, req.prefill_replica or "?")
+            return
+        for _ in range(len(self._handoff_queue)):
+            rid = self._handoff_queue.popleft()
+            req = self.reqs.get(rid)
+            if req is None or req.t_done is not None or req.handoff is None:
+                continue
+            if now < req.handoff_next_t:
+                self._handoff_queue.append(rid)
+                continue
+            h = self._place_inject(req)
+            if h is None or not h.inject(req, req.handoff):
+                # no decode-capable target right now: keep the record;
+                # timeout/retry accounting only starts at dispatch
+                self._handoff_queue.append(rid)
+                continue
+            req.replica = h.name
+            self._inflight_injects[rid] = (
+                h.name, now + self.handoff_timeout_s)
 
     def _complete(self, h: ReplicaHandle, rec: Dict[str, Any]) -> int:
         rid = int(rec["rid"])
         req = self.reqs[rid]
         req.t_done = self.now()
-        wait_ms = ((req.t_dispatch or req.t_submit)
-                   - req.t_submit) * 1e3
-        req.ttft_ms = (wait_ms + float(rec["ttft_ms"])
-                       if rec.get("ttft_ms") is not None else wait_ms)
+        if req.handoff is None and req.ttft_ms is None:
+            # unified path: the serving replica owns TTFT.  Handed-off
+            # requests already composed router wait + prefill TTFT at
+            # commit; the decode side's "ttft_ms" is inject latency,
+            # not a user-visible first token.
+            wait_ms = ((req.t_dispatch or req.t_submit)
+                       - req.t_submit) * 1e3
+            req.ttft_ms = (wait_ms + float(rec["ttft_ms"])
+                           if rec.get("ttft_ms") is not None else wait_ms)
         req.itl_ms = rec.get("itl_ms")
+        req.handoff = None             # record retired: exactly-once
+        req.phase = "done"
+        self._inflight_injects.pop(rid, None)
         toks = [int(t) for t in rec["tokens"]]
         self._results[rid] = toks
         req.n_generated = len(toks) - len(req.prompt)
@@ -994,6 +1378,19 @@ class FleetRouter:
         for rid in sorted(rids,
                           key=lambda r: (self.reqs[r].t_submit, r),
                           reverse=True):
+            req = self.reqs.get(rid)
+            if (req is not None and req.t_done is None
+                    and req.handoff is not None):
+                # decode death AFTER commit: the ledger still holds the
+                # exported blocks + first token, so this is a re-decode,
+                # not a re-prefill — prefill is not repaid
+                self._inflight_injects.pop(rid, None)
+                req.replica = None
+                req.phase = "handoff_inflight"
+                self.redecodes += 1
+                if rid not in self._handoff_queue:
+                    self._handoff_queue.appendleft(rid)
+                continue
             self._requeue_one(rid, h.name)
         if getattr(h, "drained", None):
             # a gracefully drained replica reported its consumed-token
@@ -1011,11 +1408,25 @@ class FleetRouter:
             if h.name != name:
                 continue
             for rec in h.pump():
-                if rec.get("ev") == "reject":
-                    self._requeue_one(int(rec["rid"]), h.name)
+                ev = rec.get("ev")
+                if ev == "reject":
+                    if rec.get("inject"):
+                        self._handoff_failed(int(rec["rid"]), h.name)
+                    else:
+                        self._requeue_one(int(rec["rid"]), h.name)
+                elif ev == "handoff":
+                    # a commit that raced the death is a commit: the
+                    # record reached the router, decode proceeds
+                    self._on_handoff(h, rec)
+                elif ev == "injected":
+                    self._on_injected(h, int(rec["rid"]))
                 else:
-                    self._completed_backlog.append(
-                        self._complete(h, rec))
+                    prev = self.reqs.get(int(rec["rid"]))
+                    if prev is not None and prev.t_done is not None:
+                        self.duplicates_suppressed += 1
+                    else:
+                        self._completed_backlog.append(
+                            self._complete(h, rec))
             if h.assigned():
                 self._on_death(h)
             self._was_alive[name] = False
@@ -1040,18 +1451,48 @@ class FleetRouter:
             "t_unix": round(time.time(), 3),
             "p": ident["process_id"], "run": ident["run_id"],
             "inc": ident["incarnation"],
-            "sketches": ({"ttft_ms": self._ttft.to_dict()}
-                         if self._ttft.n else {}),
+            "sketches": {k: s.to_dict()
+                         for k, s in (("ttft_ms", self._ttft),
+                                      ("handoff_ms", self._handoff_ms))
+                         if s.n},
             "counters": {"routed": self.routed,
                          "rejected": self.rejected,
                          "rejected_infeasible": self.rejected_infeasible,
                          "requeued": self.requeued,
                          "completed": self.completed,
                          "replica_deaths": self.replica_deaths,
-                         "deadline_misses": self.deadline_misses},
+                         "deadline_misses": self.deadline_misses,
+                         "handoffs": self.handoffs,
+                         "handoff_retries": self.handoff_retries,
+                         "handoff_reprefills": self.handoff_reprefills,
+                         "redecodes": self.redecodes,
+                         "degraded_dispatches": self.degraded_dispatches,
+                         "duplicates_suppressed":
+                             self.duplicates_suppressed},
             "gauges": {"queue_depth": self._q_gauge.to_dict()},
             "now": {"queue_depth": len(self.queue),
-                    "in_flight": self.in_flight()},
+                    "in_flight": self.in_flight(),
+                    "handoff_queue": len(self._handoff_queue),
+                    "degraded": self._degraded_since is not None,
+                    "degraded_mode_s": round(self.degraded_mode_s
+                                             + ((self.now()
+                                                 - self._degraded_since)
+                                                if self._degraded_since
+                                                is not None else 0.0), 6)},
+        }
+
+    def handoff_stats(self) -> Dict[str, Any]:
+        """The bench's one-call view of the handoff ledger."""
+        return {
+            "handoffs": self.handoffs,
+            "handoff_ms_p50": self._handoff_ms.quantile(0.5),
+            "handoff_ms_p99": self._handoff_ms.quantile(0.99),
+            "handoff_retries": self.handoff_retries,
+            "handoff_reprefills": self.handoff_reprefills,
+            "redecodes": self.redecodes,
+            "degraded_dispatches": self.degraded_dispatches,
+            "degraded_mode_s": round(self.degraded_mode_s, 6),
+            "duplicates_suppressed": self.duplicates_suppressed,
         }
 
     def _write_rollup(self) -> None:
@@ -1062,6 +1503,9 @@ class FleetRouter:
             pass
 
     def close(self) -> None:
+        if self._degraded_since is not None:
+            self.degraded_mode_s += self.now() - self._degraded_since
+            self._degraded_since = None
         if self._jsonl is not None:
             self._write_rollup()
             if self._heartbeat is not None:
@@ -1119,7 +1563,8 @@ def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
                    ckpt: Optional[str] = None,
                    faults: Optional[str] = None,
                    step_sleep_ms: Optional[float] = None,
-                   crash_at_request: int = 0):
+                   crash_at_request: int = 0,
+                   role: Optional[str] = None):
     """Build one subprocess replica's (handle, ChildSpec, telemetry dir)
     from a fleet spawn config — the per-replica constructor shared by
     :func:`launch_fleet` and :meth:`Fleet.add_replica` (the autopilot's
@@ -1134,10 +1579,17 @@ def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
     name = f"replica-{rid}"
     tdir = (os.path.join(cfg["telemetry_root"], name)
             if cfg["telemetry_root"] else None)
-    handle = ProcReplica(name=name, generation=generation)
+    serve = dict(cfg["serve"])
+    if role is not None:
+        serve["role"] = role
+    srole = str(serve.get("role") or "unified")
+    handle = ProcReplica(
+        name=name,
+        role=("replica" if srole == "unified" else srole),
+        generation=generation)
     cmd = worker_cmd(
         cfg["python"], replica=rid, model=cfg["model"],
-        serve=cfg["serve"], telemetry_dir=tdir,
+        serve=serve, telemetry_dir=tdir,
         status_every=cfg["status_every"],
         step_sleep_ms=(cfg["step_sleep_ms"] if step_sleep_ms is None
                        else step_sleep_ms),
@@ -1165,7 +1617,10 @@ def _spawn_replica(cfg: Dict[str, Any], k: int, *, generation: int = 0,
         _h.attach(proc, inc)
 
     spec = ChildSpec(
-        name=name, cmd=cmd, role="serve-replica", env=env,
+        name=name, cmd=cmd,
+        role=("serve-replica" if srole == "unified"
+              else f"serve-{srole}"),
+        env=env,
         max_restarts=cfg["max_restarts"], backoff=cfg["backoff"],
         backoff_cap=cfg["backoff_cap"],
         heartbeat_path=(os.path.join(
@@ -1237,14 +1692,17 @@ class Fleet:
     def add_replica(self, *, generation: int = 0,
                     ckpt: Optional[str] = None,
                     faults: Optional[str] = None,
-                    step_sleep_ms: Optional[float] = None
+                    step_sleep_ms: Optional[float] = None,
+                    role: Optional[str] = None
                     ) -> ProcReplica:
         """Spawn ONE new supervised replica at runtime from the stored
         launch recipe: scale-out (same generation) or a rollout spawning
         ``generation`` from a verified weight snapshot (``ckpt``).  The
         replica starts taking traffic when its ready event lands;
         ``faults`` injects the fleet fault kinds (utils/faults.py) into
-        just this worker."""
+        just this worker; ``role`` overrides the recipe's serving role
+        (the autopilot backfills a dead prefill pool with
+        ``role="prefill"``, not whatever the recipe says)."""
         if self.spawn_cfg is None:
             raise RuntimeError(
                 "this Fleet was not built by launch_fleet (no spawn "
@@ -1253,7 +1711,7 @@ class Fleet:
         self._next_index += 1
         handle, spec, tdir = _spawn_replica(
             self.spawn_cfg, k, generation=generation, ckpt=ckpt,
-            faults=faults, step_sleep_ms=step_sleep_ms)
+            faults=faults, step_sleep_ms=step_sleep_ms, role=role)
         self.handles.append(handle)
         if tdir:
             self.telemetry_dirs.append(tdir)
@@ -1345,6 +1803,7 @@ def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
                  crash_at_request: int = 0,
                  prewarm: bool = False,
                  python: Optional[str] = None,
+                 roles: Optional[Sequence[Optional[str]]] = None,
                  log=None) -> Fleet:
     """Assemble a subprocess fleet: N workers (each its own jax
     runtime) under a :class:`train.resilience.GroupSupervisor`, wired
@@ -1352,7 +1811,11 @@ def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
     geometry flags (:func:`worker_cmd`); every replica gets its own
     telemetry dir under ``telemetry_root`` (``replica-K/``) and a
     distinct ``NNPT_PROCESS_ID`` so heartbeats, rollup identities and
-    flow-trace ids never collide (tools/obs_agg.py merges the dirs)."""
+    flow-trace ids never collide (tools/obs_agg.py merges the dirs).
+    ``roles`` (optional, one entry per replica, e.g. ``["prefill",
+    "decode", "decode"]``) builds a DISAGGREGATED fleet: each entry
+    overrides the serve config's role for that replica; None entries
+    keep the recipe's role."""
     from ..train.resilience import GroupSupervisor
 
     python = python or sys.executable
@@ -1368,10 +1831,14 @@ def launch_fleet(n_replicas: int, *, model: Dict[str, Any],
     handles: List[ProcReplica] = []
     specs = []
     tdirs: List[str] = []
+    if roles is not None and len(roles) != int(n_replicas):
+        raise ValueError(
+            f"roles has {len(roles)} entries for {n_replicas} replicas")
     for k in range(int(n_replicas)):
         handle, spec, tdir = _spawn_replica(
             cfg, k, crash_at_request=(crash_at_request
-                                      if k == 0 else 0))
+                                      if k == 0 else 0),
+            role=(roles[k] if roles is not None else None))
         handles.append(handle)
         specs.append(spec)
         tdirs.append(tdir)
@@ -1421,6 +1888,13 @@ def _worker_argparser():
     ap.add_argument("--prefix-cache", action="store_true")
     ap.add_argument("--kv-quant", action="store_true")
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--role", default="unified",
+                    choices=("unified", "prefill", "decode"),
+                    help="serving role (DESIGN.md §11): prefill "
+                         "replicas export streams at the prefill->"
+                         "decode boundary as handoff events; decode "
+                         "replicas admit them via the inject op; "
+                         "unified serves end-to-end")
     # fleet plumbing
     ap.add_argument("--telemetry-dir", default=None)
     ap.add_argument("--status-every", type=int, default=5,
@@ -1548,7 +2022,7 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
             prefill_chunk=args.prefill_chunk,
             queue_depth=args.queue_depth, attn_impl=args.attn_impl,
             prefix_cache=args.prefix_cache, kv_quant=args.kv_quant,
-            temperature=args.temperature,
+            temperature=args.temperature, role=args.role,
             telemetry_dir=args.telemetry_dir,
             rollup_every=max(1, args.status_every) * 5,
             replica=args.replica))
@@ -1559,9 +2033,46 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
 
             # a throwaway scheduler with identical geometry/sampling:
             # compiled programs are lru-cached per (model, geometry,
-            # sampling, attn_impl), so its warmth is THIS scheduler's
+            # sampling, attn_impl), so its warmth is THIS scheduler's.
+            # Always warmed UNIFIED: a prefill-role throwaway would
+            # hand its prewarm requests off instead of completing them
+            # (prewarm drives requests to completion), and the program
+            # cache is role-blind anyway.
             prewarm(lambda: Scheduler(model, params, dataclasses.replace(
-                sched.cfg, telemetry_dir=None, trace_dir=None)))
+                sched.cfg, role="unified", telemetry_dir=None,
+                trace_dir=None)))
+            if args.role == "decode":
+                # warm the handoff import scatter (``serve_import``)
+                # + the first post-inject decode step with one
+                # export/import round trip through throwaway
+                # prefill/decode schedulers — else the pool's first
+                # real inject books the compile as a fake handoff_ms
+                # outlier
+                pre = Scheduler(model, params, dataclasses.replace(
+                    sched.cfg, role="prefill", telemetry_dir=None,
+                    trace_dir=None))
+                dec = Scheduler(model, params, dataclasses.replace(
+                    sched.cfg, role="decode", telemetry_dir=None,
+                    trace_dir=None))
+                try:
+                    r = pre.submit([1, 2, 3, 4], 4)
+                    assert r is not None, "handoff prewarm rejected"
+                    for _ in range(64):
+                        pre.tick()
+                        hs = pre.take_handoffs()
+                        if hs:
+                            break
+                    else:
+                        raise AssertionError(
+                            "handoff prewarm never exported")
+                    r2 = dec.inject(hs[0]["payload"])
+                    assert r2 is not None, "handoff prewarm inject "\
+                        "rejected"
+                    dec.run_until_drained()
+                    dec.result(r2)
+                finally:
+                    pre.close()
+                    dec.close()
         engine = InprocReplica(sched, name=f"replica-{args.replica}")
 
     # raw non-blocking stdin: a burst of submit lines must all drain in
@@ -1639,9 +2150,12 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
         pass   # not the main thread (in-process tests): no signal seam
 
     emit({"ev": "ready", "replica": args.replica, "pid": os.getpid(),
-          "tp": args.tp, "generation": args.generation, "incarnation":
+          "tp": args.tp, "role": args.role,
+          "generation": args.generation, "incarnation":
           os.environ.get("NNPT_INCARNATION", "0")})
     submits_seen = 0
+    injects_seen = 0
+    handoffs_seen = 0
     ticks = 0
     last_status = 0.0
     stop = False
@@ -1688,9 +2202,40 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                     prompt=[int(t) for t in op["prompt"]],
                     max_new=int(op["max_new"]),
                     slo_ms=op.get("slo_ms"),
-                    t_submit=time.monotonic(), deadline=math.inf)
+                    t_submit=time.monotonic(), deadline=math.inf,
+                    unified=bool(op.get("unified")))
                 if not engine.submit(req):
                     emit({"ev": "reject", "rid": req.rid})
+            elif kind == "inject":
+                # a committed handoff record arriving at a decode
+                # replica; ack "injected" or reject with "inject": true
+                injects_seen += 1
+                if fault_plan is not None and fault_plan.fire_if_due(
+                        "handoff_stall", injects_seen,
+                        proc=args.replica):
+                    # wedged-inject stand-in: swallow the op (no ack,
+                    # no stream) — the router's handoff timeout must
+                    # abort and retry elsewhere
+                    print(f"[faults] handoff_stall: ignoring inject "
+                          f"{injects_seen}", file=sys.stderr, flush=True)
+                    continue
+                req = FleetRequest(
+                    rid=int(op["rid"]),
+                    prompt=[int(t) for t in
+                            (op.get("payload") or {}).get("prompt", [])],
+                    max_new=int((op.get("payload") or {})
+                                .get("max_new", 1)),
+                    slo_ms=op.get("slo_ms"),
+                    t_submit=time.monotonic(), deadline=math.inf)
+                ok = False
+                try:
+                    ok = engine.inject(req, op.get("payload") or {})
+                except ValueError as exc:
+                    print(f"[worker {args.replica}] inject rejected: "
+                          f"{exc}", file=sys.stderr, flush=True)
+                if not ok:
+                    emit({"ev": "reject", "rid": req.rid,
+                          "inject": True})
             elif kind in ("drain", "decommission"):
                 if fault_plan is not None and fault_plan.fire_if_due(
                         "stall_drain", submits_seen,
@@ -1755,10 +2300,48 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 if sched is not None:
                     sched.close()
                 return EXIT_DECOMMISSION
-        # 2) advance the engine one step; report completions
+        # 2) advance the engine one step; report completions, handoffs
+        # and inject acks (the engine tags non-done events with "ev")
         for rec in engine.pump():
             rec.pop("requeue", None)
-            emit({"ev": "done", **rec})
+            ev = rec.pop("ev", "done")
+            if ev == "handoff":
+                handoffs_seen += 1
+                if fault_plan is not None and fault_plan.fire_if_due(
+                        "handoff_kill", handoffs_seen,
+                        proc=args.replica):
+                    # die BEFORE the commit line reaches the wire: the
+                    # router never saw the record, so the request
+                    # requeues for a full re-prefill elsewhere
+                    print(f"[faults] handoff_kill at handoff "
+                          f"{handoffs_seen}: SIGKILL pre-commit",
+                          file=sys.stderr, flush=True)
+                    proto.flush()
+                    os.kill(os.getpid(), signal_lib.SIGKILL)
+                emit({"ev": "handoff", **rec})
+                if fault_plan is not None and fault_plan.fire_if_due(
+                        "handoff_kill_post", handoffs_seen,
+                        proc=args.replica):
+                    # die AFTER the commit line: the router owns the
+                    # record — decode proceeds, prefill is not repaid
+                    print(f"[faults] handoff_kill_post at handoff "
+                          f"{handoffs_seen}: SIGKILL post-commit",
+                          file=sys.stderr, flush=True)
+                    proto.flush()
+                    os.kill(os.getpid(), signal_lib.SIGKILL)
+                continue
+            emit({"ev": ev, **rec})
+            if ev == "injected" and fault_plan is not None \
+                    and fault_plan.fire_if_due(
+                        "decode_kill", injects_seen,
+                        proc=args.replica):
+                # decode death mid-stream, after the ack: the router
+                # re-injects from its ledger record (re-decode only)
+                print(f"[faults] decode_kill after inject "
+                      f"{injects_seen}: SIGKILL", file=sys.stderr,
+                      flush=True)
+                proto.flush()
+                os.kill(os.getpid(), signal_lib.SIGKILL)
         ticks += 1
         slow_ms = (fault_plan.slow_penalty_ms(submits_seen,
                                               proc=args.replica)

@@ -79,10 +79,45 @@ def prewarm(make_scheduler, *, prompt_lens=(4, 24)) -> None:
         sched.close()
 
 
+# Named traffic presets: one word in a bench flag pins the whole shape
+# (prompt/decode ranges + shared-prefix mix), so two arms saying
+# ``mix="long_prefill"`` provably serve the same traffic.  Values are
+# sized for the bench geometry (seq=128): the longest shared request is
+# shared_prefix_len + prompt_lens[1] tokens, inside the seq-2 admission
+# budget.
+MIXES: Dict[str, Dict[str, Any]] = {
+    # prefill-heavy: long prompts, with decodes just long enough that
+    # per-stream cadence is a real measurement (a 4-token decode's ITL
+    # is all admission noise) — the traffic where a unified pool lets
+    # prefill bursts stall decode cadence, and the disaggregated
+    # prefill/decode split (DESIGN.md §11) earns its keep.  Half the
+    # requests share one 24-token prefix so the shared/unique split
+    # prices the prefix cache under the same mix.  Longest request:
+    # 24 + 72 prompt + 28 decode = 124 <= the seq-2 admission budget.
+    "long_prefill": dict(prompt_lens=(32, 72), max_new=(16, 28),
+                         shared_prefix_len=24, shared_fraction=0.5),
+}
+
+
+def resolve_mix(mix: Optional[str], prompt_lens, max_new,
+                shared_prefix_len: int, shared_fraction: float):
+    """Apply a :data:`MIXES` preset: when ``mix`` is set its values
+    REPLACE the four traffic-shape arguments (a preset exists to pin
+    the shape; silently merging caller overrides would unpin it)."""
+    if mix is None:
+        return prompt_lens, max_new, shared_prefix_len, shared_fraction
+    if mix not in MIXES:
+        raise ValueError(f"unknown mix {mix!r}; have {sorted(MIXES)}")
+    m = MIXES[mix]
+    return (m["prompt_lens"], m["max_new"], m["shared_prefix_len"],
+            m["shared_fraction"])
+
+
 def make_requests(clients: int, requests_per_client: int, *,
                   vocab_size: int, prompt_lens=(4, 24), max_new=(8, 32),
                   seed: int = 0, shared_prefix_len: int = 0,
-                  shared_fraction: float = 0.0, stream: int = 0
+                  shared_fraction: float = 0.0, stream: int = 0,
+                  mix: Optional[str] = None
                   ) -> List[List[Dict[str, Any]]]:
     """Pre-generate every client's request list (client-major, one RNG
     pass) so the stream is a pure function of the arguments — queue
@@ -100,6 +135,9 @@ def make_requests(clients: int, requests_per_client: int, *,
     "agree"); ``stream=k`` mixes ``k`` into the RNG seed sequence, while
     ``stream=0`` keeps the historical ``default_rng(seed)`` draws so
     every committed bench artifact's traffic is reproducible."""
+    (prompt_lens, max_new, shared_prefix_len,
+     shared_fraction) = resolve_mix(mix, prompt_lens, max_new,
+                                    shared_prefix_len, shared_fraction)
     rng = (np.random.default_rng(seed) if not stream
            else np.random.default_rng((int(seed), int(stream))))
     shared = (rng.integers(0, vocab_size, (shared_prefix_len,)).tolist()
@@ -132,6 +170,7 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                     slo_ms: Optional[float] = None,
                     shared_prefix_len: int = 0,
                     shared_fraction: float = 0.0, stream: int = 0,
+                    mix: Optional[str] = None,
                     max_ticks: int = 200_000) -> Dict[str, Any]:
     """Drive ``scheduler`` with ``clients`` closed-loop clients until
     each has completed ``requests_per_client`` requests; returns the
@@ -143,6 +182,9 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
     The request stream comes from :func:`make_requests` — a pure
     function of the arguments — so a sweep's load points (and an A/B's
     arms) serve the same request mix."""
+    (prompt_lens, max_new, shared_prefix_len,
+     shared_fraction) = resolve_mix(mix, prompt_lens, max_new,
+                                    shared_prefix_len, shared_fraction)
     plan = make_requests(clients, requests_per_client,
                          vocab_size=vocab_size, prompt_lens=prompt_lens,
                          max_new=max_new, seed=seed,
@@ -222,6 +264,8 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
         "blocks_in_use_mean": round(blocks_sum / max(1, n_ticks), 2),
         "tokens_sha256": h.hexdigest(),
     }
+    if mix is not None:
+        row["mix"] = mix
     if shared_prefix_len > 0:
         row["shared_prefix_len"] = int(shared_prefix_len)
         row["shared_fraction"] = float(shared_fraction)
@@ -232,6 +276,12 @@ def run_closed_loop(scheduler, clients: int, requests_per_client: int,
                     if scheduler.stats(r).ttft_ms is not None]
             row[f"ttft_ms_p50_{cls}"] = _pct(vals, 50)
             row[f"ttft_ms_p99_{cls}"] = _pct(vals, 99)
+            # decode cadence per class: a prefix hit shortens TTFT but
+            # must NOT change steady-state ITL — the pair proves it
+            ivals = [scheduler.stats(r).itl_ms for r in rids
+                     if scheduler.stats(r).itl_ms is not None]
+            row[f"itl_ms_p50_{cls}"] = _pct(ivals, 50)
+            row[f"itl_ms_p99_{cls}"] = _pct(ivals, 99)
     if getattr(scheduler.cfg, "prefix_cache", False):
         row["prefix_cache"] = scheduler.server.prefix_stats()
     return row
@@ -271,6 +321,7 @@ def run_fleet_closed_loop(router, clients: int,
                           seed: int = 0,
                           classes: Optional[List[Dict[str, Any]]] = None,
                           stream: int = 0,
+                          mix: Optional[str] = None,
                           max_wall_s: float = 600.0) -> Dict[str, Any]:
     """The MULTI-REPLICA closed-loop driver: ``clients`` one-outstanding
     clients against a ``serve.fleet.FleetRouter`` instead of one
@@ -286,15 +337,20 @@ def run_fleet_closed_loop(router, clients: int,
     infeasible) surface as ``router_rejections`` with clients retrying,
     the closed-loop discipline."""
     classes = classes or [{"name": "all", "slo_ms": None}]
+    (prompt_lens, max_new, shared_prefix_len,
+     shared_fraction) = resolve_mix(mix, prompt_lens, max_new, 0, 0.0)
     plan = make_requests(clients, requests_per_client,
                          vocab_size=vocab_size, prompt_lens=prompt_lens,
-                         max_new=max_new, seed=seed, stream=stream)
+                         max_new=max_new, seed=seed, stream=stream,
+                         shared_prefix_len=shared_prefix_len,
+                         shared_fraction=shared_fraction)
     cls_of = [classes[ci % len(classes)] for ci in range(int(clients))]
     next_idx = [0] * int(clients)
     outstanding: List[Optional[int]] = [None] * int(clients)
     finished: List[int] = []
     owner: Dict[int, int] = {}          # fleet rid -> client
     tokens_of: Dict[int, tuple] = {}    # fleet rid -> (ci, idx, tokens)
+    shared_rids: set = set()
     submit_retries = 0
     t0 = time.perf_counter()
     while True:
@@ -310,6 +366,8 @@ def run_fleet_closed_loop(router, clients: int,
                 submit_retries += 1
                 continue
             owner[rid] = ci
+            if req.get("shared"):
+                shared_rids.add(rid)
             tokens_of[rid] = (ci, next_idx[ci], None)
             outstanding[ci] = rid
             next_idx[ci] += 1
@@ -360,6 +418,25 @@ def run_fleet_closed_loop(router, clients: int,
     itl_all = [s.itl_ms for s in stats if s.itl_ms is not None]
     row["itl_ms_p50"] = _pct(itl_all, 50)
     row["itl_ms_p99"] = _pct(itl_all, 99)
+    if mix is not None:
+        row["mix"] = mix
+    if shared_prefix_len > 0:
+        # shared/unique split under a prefix mix, TTFT and ITL both:
+        # the shared class's TTFT prices prefix reuse, its ITL pins
+        # that reuse never taxes decode cadence
+        row["shared_prefix_len"] = int(shared_prefix_len)
+        row["shared_fraction"] = float(shared_fraction)
+        row["shared_requests"] = len(shared_rids)
+        for cls, rids in (("shared", shared_rids),
+                          ("unique", set(finished) - shared_rids)):
+            tv = [s.ttft_ms for rid, s in zip(finished, stats)
+                  if rid in rids and s.ttft_ms is not None]
+            iv = [s.itl_ms for rid, s in zip(finished, stats)
+                  if rid in rids and s.itl_ms is not None]
+            row[f"ttft_ms_p50_{cls}"] = _pct(tv, 50)
+            row[f"ttft_ms_p99_{cls}"] = _pct(tv, 99)
+            row[f"itl_ms_p50_{cls}"] = _pct(iv, 50)
+            row[f"itl_ms_p99_{cls}"] = _pct(iv, 99)
     for k in classes:
         vals = [s.ttft_ms for rid, s in zip(finished, stats)
                 if cls_of[owner[rid]]["name"] == k["name"]

@@ -85,6 +85,7 @@ plain-free or cached-free, never referenced.
 
 from __future__ import annotations
 
+import base64
 import collections
 import functools
 from dataclasses import dataclass, field
@@ -341,14 +342,17 @@ def init_paged_kv(model: Transformer, num_blocks: int, block_size: int,
 def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
                     temperature: float, top_k: int, top_p: float,
                     kv_quant: bool = False, attn_impl: str = "gathered"):
-    """The three jitted programs of a paged server: chunk prefill (one
+    """The four jitted programs of a paged server: chunk prefill (one
     per power-of-two chunk bucket, via jit's shape cache), the batched
-    decode step, and the copy-on-write block copy (``serve_cow``).
-    Cached per (model, geometry, sampling, attn_impl) so several
-    servers compile once.  ``attn_impl='fused'`` swaps the gathered
-    attention for the Pallas paged kernel; everything else (scatter
-    coordinates, sampling, bookkeeping) is shared, which is what makes
-    gathered-vs-fused an attention-only A/B."""
+    decode step, the copy-on-write block copy (``serve_cow``), and the
+    block-handoff import scatter (``serve_import`` — the CoW copy's
+    sibling with the source row arriving from the host instead of
+    another pool row).  Cached per (model, geometry, sampling,
+    attn_impl) so several servers compile once.  ``attn_impl='fused'``
+    swaps the gathered attention for the Pallas paged kernel;
+    everything else (scatter coordinates, sampling, bookkeeping) is
+    shared, which is what makes gathered-vs-fused an attention-only
+    A/B."""
     bs, mb = int(block_size), int(max_blocks)
     t_cap = bs * mb
     c = model.cfg
@@ -512,6 +516,16 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
         return jax.tree_util.tree_map(
             lambda p: p.at[dst].set(p[src]), pools)
 
+    def imp(pools, rows, dst):
+        """Block-handoff import: scatter one block row of host-supplied
+        K/V content (``rows`` — a pytree matching one pool block row per
+        layer, int8 scale pools included) into pool row ``dst``.  Like
+        ``cow``, ``dst`` is a TRACED scalar, so importing N blocks
+        reuses one compiled program no matter which pool rows the
+        allocator handed out."""
+        return jax.tree_util.tree_map(
+            lambda p, r: p.at[dst].set(r.astype(p.dtype)), pools, rows)
+
     # compile-ledger seam (utils/compile_ledger): while a ledger is
     # installed every distinct compile of the serve programs is recorded
     # — which is how the "block-table churn never recompiles" invariant
@@ -529,7 +543,9 @@ def _paged_programs(model: Transformer, block_size: int, max_blocks: int,
             ledger_lib.instrument(jax.jit(step, donate_argnums=(1, 2, 4)),
                                   f"serve_decode[{tag}]"),
             ledger_lib.instrument(jax.jit(cow, donate_argnums=(0,)),
-                                  f"serve_cow[{tag}]"))
+                                  f"serve_cow[{tag}]"),
+            ledger_lib.instrument(jax.jit(imp, donate_argnums=(0,)),
+                                  f"serve_import[{tag}]"))
 
 
 @dataclass
@@ -593,6 +609,10 @@ class PagedDecodeServer:
         self.cow_forks = 0            # copy-on-write block forks
         self.cache_evictions = 0      # cached-free blocks reclaimed (LRU)
         self.blocks_shared_total = 0  # cumulative matched blocks at admit
+        # disaggregated-handoff counters (export happens on the prefill
+        # role, import on the decode role)
+        self.handoffs_exported = 0
+        self.handoffs_imported = 0
         self._lookup_memo = None      # (prompt, index-version) -> walk
         self._sampling = (float(temperature), int(top_k), float(top_p))
         self.kv_quant = bool(kv_quant)
@@ -600,7 +620,8 @@ class PagedDecodeServer:
             raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, "
                              f"got {attn_impl!r}")
         self.attn_impl = attn_impl
-        self._prefill_fn, self._step_fn, self._cow_fn = _paged_programs(
+        (self._prefill_fn, self._step_fn, self._cow_fn,
+         self._import_fn) = _paged_programs(
             model, self.block_size, self.max_blocks, *self._sampling,
             self.kv_quant, self.attn_impl)
         self.pools = init_paged_kv(model, self.num_blocks,
@@ -1071,6 +1092,151 @@ class PagedDecodeServer:
         slot = self._slot_of.pop(rid)
         self._release_stream(st, slot)
         return list(st.prompt), st.max_new
+
+    # ---- block handoff (disaggregated prefill/decode) -----------------
+    def _handoff_geometry(self) -> Dict[str, Any]:
+        """The pool facts both sides of a handoff must agree on byte-for-
+        byte.  Everything here is static server config, so a mismatch is
+        a deployment error (raise), never a transient to retry."""
+        return {
+            "block_size": self.block_size,
+            "n_layers": len(self.pools),
+            "kv_heads": int(self.model.cfg.kv_heads),
+            "head_dim": int(self.model.cfg.head_dim),
+            "kv_quant": self.kv_quant,
+            "dtype": str(np.dtype(
+                np.asarray(jax.device_get(self.pools[0]["k"][:1])).dtype)),
+        }
+
+    def export_stream(self, rid: int) -> Dict[str, Any]:
+        """Serialize a prefill-complete stream for handoff to a decode
+        server: the block CONTENTS covering the written prompt positions
+        (per layer, K/V and int8 scale pools alike, base64 of the raw
+        device bytes — ``tobytes``/``frombuffer`` round-trips every
+        dtype exactly, bf16 included), the prompt, and the first sampled
+        token.  Read-only: the stream keeps running here until the
+        caller explicitly releases it (``evict``), so a failed handoff
+        costs nothing.  Only positions ``0..p-1`` have K/V (the first
+        sampled token's K/V is written by its decode step, which runs on
+        the importing side) — so exactly ``blocks_for(p)`` block rows
+        travel.  Raises for a stream whose prefill is not complete."""
+        st = self._streams[rid]
+        slot = self._slot_of[rid]
+        p = len(st.prompt)
+        if st.prefilled < p:
+            raise ValueError(
+                f"export of rid={rid} with prefill incomplete "
+                f"({st.prefilled}/{p}): handoff happens at the "
+                "prefill->decode boundary only")
+        n_copy = self.blocks_for(p)
+        idx = jnp.asarray(np.asarray(st.blocks[:n_copy], np.int64))
+        layers = []
+        for pool in self.pools:
+            rec = {}
+            for name, arr in pool.items():
+                rows = np.ascontiguousarray(
+                    np.asarray(jax.device_get(arr[idx])))
+                rec[name] = base64.b64encode(rows.tobytes()).decode("ascii")
+            layers.append(rec)
+        first_token = int(jax.device_get(self.tokens[slot, p]))
+        self.handoffs_exported += 1
+        return {
+            "v": 1,
+            "prompt": list(st.prompt),
+            "max_new": int(st.max_new),
+            "first_token": first_token,
+            "n_blocks": n_copy,
+            "geom": self._handoff_geometry(),
+            "layers": layers,
+        }
+
+    def import_stream(self, payload: Dict[str, Any]) -> Optional[int]:
+        """Admit a handed-off stream directly in the DECODING state:
+        allocate fresh blocks, scatter the exported block contents into
+        them on-device (one traced-dst program — block-id churn never
+        recompiles), rebuild the token row (prompt + first sampled
+        token), and register the prompt blocks into the local prefix
+        index so later arrivals sharing the prompt hit the cache here
+        too.  Returns a request id, or None when a slot or the blocks
+        are unavailable (nothing consumed — the router retries or falls
+        back).  Raises on geometry mismatch or a request this server
+        could never hold, mirroring :meth:`try_admit`'s contract."""
+        geom = dict(payload["geom"])
+        mine = self._handoff_geometry()
+        if geom != mine:
+            raise ValueError(f"handoff geometry mismatch: exporter "
+                             f"{geom} vs importer {mine}")
+        prompt_ids = [int(t) for t in payload["prompt"]]
+        max_new = int(payload["max_new"])
+        p = len(prompt_ids)
+        if p == 0:
+            raise ValueError("empty prompt in handoff payload")
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens {max_new} < 1")
+        if p + max_new > self.max_len:
+            raise ValueError(f"prompt {p} + {max_new} exceeds server "
+                             f"max_len {self.max_len}")
+        total_need = self.blocks_for(p + max_new)
+        if total_need > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {total_need} blocks but the pool only "
+                f"has {self.allocator.capacity}: unservable at any load")
+        n_copy = int(payload["n_blocks"])
+        if n_copy != self.blocks_for(p):
+            raise ValueError(f"handoff carries {n_copy} blocks, prompt "
+                             f"of {p} needs {self.blocks_for(p)}")
+        if not self.free_slots():
+            return None
+        need = self.blocks_for(p + 1)
+        blocks = self.allocator.alloc(need)
+        if blocks is None:
+            return None
+        # decode the per-layer block rows; shapes are fixed by geometry,
+        # so a short buffer is a hard error, not a retry
+        bs = self.block_size
+        kv, hd = mine["kv_heads"], mine["head_dim"]
+        decoded = []
+        for li, rec in enumerate(payload["layers"]):
+            pool = self.pools[li]
+            out = {}
+            for name, b64 in rec.items():
+                arr = np.asarray(jax.device_get(pool[name][:1]))
+                shape = (n_copy, bs, kv) if name.endswith("_scale") \
+                    else (n_copy, bs, kv, hd)
+                raw = np.frombuffer(base64.b64decode(b64),
+                                    dtype=arr.dtype).reshape(shape)
+                out[name] = raw
+            decoded.append(out)
+        for i in range(n_copy):
+            rows = [{name: jnp.asarray(lay[name][i])
+                     for name in lay} for lay in decoded]
+            self.pools = self._import_fn(
+                self.pools, rows, jnp.asarray(blocks[i], jnp.int32))
+        rid = self._rid
+        self._rid += 1
+        st = _Stream(rid=rid, prompt=prompt_ids, max_new=max_new,
+                     target=p + max_new, blocks=blocks, prefilled=p)
+        slot = next(s for s in range(self.slots)
+                    if s not in self._slot_of.values())
+        self._streams[rid] = st
+        self._slot_of[rid] = slot
+        self.tables[slot, :] = SINK_BLOCK
+        self.tables[slot, :len(blocks)] = blocks
+        row = np.zeros((self.t_cap,), np.int32)
+        row[:p] = prompt_ids
+        row[p] = int(payload["first_token"])
+        self.tokens = self.tokens.at[slot].set(jnp.asarray(row))
+        self.pos = self.pos.at[slot].set(p)
+        self._pos_host[slot] = p
+        self.active[slot] = max_new > 1
+        self.prompt_tokens_admitted += p
+        self.handoffs_imported += 1
+        self._register_prefix(st, final=True)
+        if max_new <= 1:
+            # degenerate single-token request: already complete (the
+            # prefill side normally finishes these without a handoff)
+            self._finish(rid)
+        return rid
 
     # ---- decode --------------------------------------------------------
     def step(self) -> List[int]:

@@ -138,6 +138,18 @@ class ServeConfig:
     #                                never collide on a merged timeline
     #                                (the scheduler-local rid restarts at
     #                                0 in every replica)
+    # disaggregated serving role (DESIGN.md §11): 'unified' (default —
+    # this scheduler prefills AND decodes, every pre-existing path),
+    # 'prefill' (chunked prefill only: a completed prefill EXPORTS the
+    # stream — block contents + first sampled token — for handoff to a
+    # decode replica instead of decoding it here; take_handoffs()
+    # drains the exports), or 'decode' (accepts handoffs via inject()).
+    # Either role still serves plain submits end-to-end when asked
+    # (``unified=True`` on submit) — the degraded fallback an empty
+    # peer pool routes through.  Telemetry roles become
+    # 'serve-prefill'/'serve-decode' so a hot prefill pool is visible
+    # per-role in tools/obs_agg.py, never averaged into decode numbers.
+    role: str = "unified"
 
 
 @dataclass
@@ -153,6 +165,9 @@ class Request:
     t_first: Optional[float] = None       # first output token sampled
     t_done: Optional[float] = None
     evictions: int = 0
+    unified: bool = False                 # serve end-to-end regardless of
+    #                                       the scheduler's role (degraded
+    #                                       single-pool fallback)
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -200,6 +215,12 @@ class _ServeTelemetry:
         self.metrics_every = max(1, int(cfg.metrics_every))
         self.rollup_every = max(0, int(cfg.rollup_every))
         self.replica = cfg.replica
+        # role-qualified telemetry identity: unified keeps the historic
+        # "serve" role; disaggregated roles split into serve-prefill /
+        # serve-decode so per-role fleet rollups fall out of the
+        # aggregator's existing role grouping
+        role = getattr(cfg, "role", "unified") or "unified"
+        self.role = "serve" if role == "unified" else f"serve-{role}"
         self._jsonl = None
         self.heartbeat = Heartbeat(None)
         self.alerts_fired = 0
@@ -236,7 +257,7 @@ class _ServeTelemetry:
         self.metrics_path = os.path.join(dirpath, "metrics.jsonl")
         self._jsonl = open(self.metrics_path, "a")
         self.heartbeat = Heartbeat(os.path.join(
-            dirpath, telemetry_lib.heartbeat_filename("serve")))
+            dirpath, telemetry_lib.heartbeat_filename(self.role)))
 
     def _write(self, rec: Dict[str, Any]) -> None:
         if self._jsonl is not None:
@@ -267,7 +288,7 @@ class _ServeTelemetry:
         self._gauges["queue_depth"].set(snap["queue_depth"])
         self._gauges["block_utilization"].set(snap["block_utilization"])
         for key in ("admitted", "rejected", "evicted", "completed",
-                    "tokens_out"):
+                    "tokens_out", "handed_off", "injected"):
             if key in snap:
                 self._counters[key] = int(snap[key])
         self._last_tokens = snap["tokens_out"]
@@ -307,9 +328,16 @@ class _ServeTelemetry:
                 if alert and self.enabled:
                     self._emit_alert(alert, rid=req.rid)
 
+    def on_handoff(self, ttft_ms: float) -> None:
+        """A prefill-role handoff: the prefill side OWNS the TTFT number
+        (the first token was sampled here), so it lands in this
+        replica's sketch — the decode side records only decode-phase
+        ITL for injected streams."""
+        self._sketches["ttft_ms"].add(round(ttft_ms, 3))
+
     def _emit_alert(self, alert: Dict[str, Any], **extra) -> None:
         self.alerts_fired += 1
-        rec = {"kind": "alert", "role": "serve",
+        rec = {"kind": "alert", "role": self.role,
                "t": round(time.perf_counter() - self._t0, 6),
                "t_unix": round(time.time(), 3), **alert, **extra}
         self._write(rec)
@@ -347,7 +375,7 @@ class _ServeTelemetry:
             counters["slo_events"] = self._budget.events
             counters["slo_misses"] = self._budget.misses
         rec = {
-            "kind": "rollup", "role": "serve", "step": int(tick),
+            "kind": "rollup", "role": self.role, "step": int(tick),
             "t": round(time.perf_counter() - self._t0, 6),
             "t_unix": round(time.time(), 3),
             "p": ident["process_id"], "run": ident["run_id"],
@@ -386,7 +414,7 @@ class _ServeTelemetry:
             return
         snap = self.goodput_meter.snapshot()
         rec = goodput_lib.goodput_record(
-            snap, role="serve", step=tick,
+            snap, role=self.role, step=tick,
             ident=getattr(self, "_ident", None) or trace_lib.run_identity())
         if self.replica is not None:
             rec["replica"] = int(self.replica)
@@ -413,7 +441,7 @@ class _ServeTelemetry:
                          "final": True, **snap}
             self._write(final_rec)
             for key in ("admitted", "rejected", "evicted", "completed",
-                        "tokens_out"):
+                        "tokens_out", "handed_off", "injected"):
                 if key in snap:
                     self._counters[key] = int(snap[key])
         self._maybe_rollup(tick, final=True)
@@ -438,6 +466,9 @@ class Scheduler:
         # dataclass, and a shared default instance would leak one
         # caller's tweaks into every later default-constructed Scheduler
         self.cfg = cfg = ServeConfig() if cfg is None else cfg
+        if cfg.role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role must be 'unified', 'prefill' or "
+                             f"'decode', got {cfg.role!r}")
         self.now = now_fn
         # install the span tracer + compile ledger BEFORE the server
         # builds its programs, so their compiles land in the ledger; an
@@ -465,6 +496,12 @@ class Scheduler:
         self.evicted = 0
         self.completed = 0
         self.tokens_out = 0
+        # disaggregated-handoff state: exports a prefill-role tick
+        # produced, waiting for the worker loop to take them; counters
+        # for both directions of the handoff
+        self._handoffs: List[Dict[str, Any]] = []
+        self.handed_off = 0
+        self.injected = 0
         # decode-step key accounting (host arithmetic, zero device
         # traffic): attended = what the math needs, padded = what the
         # gathered path reduces over, kernel = whole blocks the fused
@@ -494,12 +531,16 @@ class Scheduler:
 
     # ---- client surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               slo_ms: Optional[float] = None) -> Optional[int]:
+               slo_ms: Optional[float] = None,
+               unified: bool = False) -> Optional[int]:
         """Enqueue a request; returns its id, or None when the bounded
         queue is full (the request is REJECTED — overload sheds load
         instead of growing latency without bound).  Raises for requests
         the server could never hold (over ``max_len`` / pool capacity),
-        mirroring ``PagedDecodeServer.try_admit``'s loud refusal."""
+        mirroring ``PagedDecodeServer.try_admit``'s loud refusal.
+        ``unified=True`` pins the request to end-to-end service on THIS
+        scheduler regardless of its role — the degraded fallback a
+        router uses when the peer pool is empty."""
         prompt_ids = [int(t) for t in prompt_ids]
         p = len(prompt_ids)
         if p == 0:
@@ -524,7 +565,7 @@ class Scheduler:
                       max_new=int(max_new_tokens), t_submit=now,
                       deadline=(now + slo / 1e3 if slo is not None
                                 else math.inf),
-                      slo_ms=slo)
+                      slo_ms=slo, unified=bool(unified))
         self.reqs[rid] = req
         self.queue.append(req)
         return rid
@@ -627,7 +668,54 @@ class Scheduler:
         rec["now"]["slots"] = self.cfg.slots
         rec["now"]["queue_cap"] = self.cfg.queue_depth
         rec["now"]["tokens_at_risk"] = self.tokens_at_risk()
+        rec["now"]["role"] = self.cfg.role
+        rec["now"]["handoffs_ready"] = len(self._handoffs)
         return rec
+
+    def take_handoffs(self) -> List[Dict[str, Any]]:
+        """Drain the handoff exports a prefill-role scheduler has
+        produced since the last call: one ``{"rid", "payload",
+        "slo_ms", "ttft_ms", "prompt_tokens"}`` descriptor per stream
+        whose prefill completed.  The caller (the fleet worker loop /
+        InprocReplica) forwards each to the router, which owns the
+        record from that commit point on."""
+        out, self._handoffs = self._handoffs, []
+        return out
+
+    def inject(self, payload: Dict[str, Any],
+               slo_ms: Optional[float] = None) -> Optional[int]:
+        """Admit a handed-off stream directly into decode: imports the
+        exported block contents + first sampled token
+        (:meth:`PagedDecodeServer.import_stream`) and registers the
+        request as decoding — no queue, no prefill duty.  Returns a
+        request id, or None when a slot or the blocks are unavailable
+        (nothing consumed; the router retries elsewhere or later).
+        ``t_first`` is stamped now — the REAL time-to-first-token lives
+        on the prefill side (the router composes end-to-end timings);
+        this side's numbers price the decode phase only."""
+        srv_rid = self.server.import_stream(payload)
+        if srv_rid is None:
+            return None
+        now = self.now()
+        slo = self.cfg.default_slo_ms if slo_ms is None else slo_ms
+        rid = self._next_rid
+        self._next_rid += 1
+        req = Request(rid=rid,
+                      prompt=[int(t) for t in payload["prompt"]],
+                      max_new=int(payload["max_new"]), t_submit=now,
+                      deadline=(now + slo / 1e3 if slo is not None
+                                else math.inf),
+                      slo_ms=slo, t_first=now)
+        self.reqs[rid] = req
+        self._srv_rid[rid] = srv_rid
+        self._sched_rid[srv_rid] = rid
+        self.injected += 1
+        trace_lib.flow("req", f"{self._flow_prefix}{rid}", "t",
+                       rid=rid, stage="inject", tick=self.tick_no)
+        if self.server.done(srv_rid):
+            # degenerate single-token handoff: already complete
+            self._retire(srv_rid)
+        return rid
 
     def tokens_at_risk(self) -> int:
         """Tokens of consumed work an unannounced kill would discard
@@ -682,6 +770,19 @@ class Scheduler:
                         "generated": max(0, generated),
                         "t_submit": req.t_submit,
                         "evictions": req.evictions})
+        # handoffs exported but never taken by the worker loop: the
+        # stream is gone from the server, but the REQUEST must not
+        # vanish — hand it back as undone work (full re-prefill on
+        # whichever replica the router picks next)
+        for h in self._handoffs:
+            req = self.reqs[h["rid"]]
+            req.t_first = None
+            out.append({"rid": req.rid, "prompt": list(req.prompt),
+                        "max_new": req.max_new, "slo_ms": req.slo_ms,
+                        "prefilled": 0, "generated": 0,
+                        "t_submit": req.t_submit,
+                        "evictions": req.evictions})
+        self._handoffs = []
         for req in self.queue:
             out.append({"rid": req.rid, "prompt": list(req.prompt),
                         "max_new": req.max_new, "slo_ms": req.slo_ms,
@@ -755,10 +856,34 @@ class Scheduler:
                        rid=rid, stage="prefill", tick=self.tick_no)
         if self.server.prefill_step(srv_rid, self.cfg.prefill_chunk):
             self._prefilling.popleft()
-            self.reqs[rid].t_first = self.now()
+            req = self.reqs[rid]
+            req.t_first = self.now()
             if self.server.done(srv_rid):   # single-token request
                 done_now.append(self._retire(srv_rid))
+            elif self.cfg.role == "prefill" and not req.unified:
+                # disaggregated handoff: the stream leaves this replica
+                # at the prefill->decode boundary.  Export FIRST (read-
+                # only), then release — under prefix_cache the owned
+                # prompt blocks were registered during prefill, so the
+                # release parks them cached-free and the content stays
+                # resident for future prefix hits
+                self._export_handoff(rid, srv_rid)
         return done_now
+
+    def _export_handoff(self, rid: int, srv_rid: int) -> None:
+        req = self.reqs[rid]
+        payload = self.server.export_stream(srv_rid)
+        self._srv_rid.pop(rid)
+        self._sched_rid.pop(srv_rid)
+        self.server.evict(srv_rid)
+        ttft = round((req.t_first - req.t_submit) * 1e3, 3)
+        self.handed_off += 1
+        self.telemetry.on_handoff(ttft)
+        self._handoffs.append({
+            "rid": rid, "payload": payload, "slo_ms": req.slo_ms,
+            "ttft_ms": ttft, "prompt_tokens": len(req.prompt)})
+        trace_lib.flow("req", f"{self._flow_prefix}{rid}", "t",
+                       rid=rid, stage="handoff", tick=self.tick_no)
 
     def _grow_or_evict(self) -> None:
         """Supply every decoding stream's next block, evicting
@@ -853,6 +978,8 @@ class Scheduler:
             "evicted": self.evicted,
             "completed": self.completed,
             "tokens_out": self.tokens_out,
+            "handed_off": self.handed_off,
+            "injected": self.injected,
             "attended_keys": self.attended_keys,
             "padded_keys": self.padded_keys,
             "kernel_keys": self.kernel_keys,

@@ -98,10 +98,14 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
              "steps": 8, "at_step": 3},
             {"name": "stub_preempt", "kind": "stub", "fault": "preempt",
              "steps": 8, "at_step": 3, "grace_s": 5.0},
+            {"name": "stub_handoff_kill", "kind": "stub_handoff",
+             "rids": 6, "at": 3},
         ],
     },
     # the bench plan (BENCH_CHAOS.json): lite plus the subprocess-fleet
-    # scenarios — SIGKILL vs advance-notice A/B and health eviction.
+    # scenarios — SIGKILL vs advance-notice A/B, health eviction, and
+    # the disaggregated prefill/decode handoff under a crash-looping
+    # prefill pool (DESIGN.md §11).
     "full": {
         "name": "full",
         "seed": 0,
@@ -110,6 +114,8 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
              "steps": 8, "at_step": 3},
             {"name": "stub_preempt", "kind": "stub", "fault": "preempt",
              "steps": 8, "at_step": 3, "grace_s": 5.0},
+            {"name": "stub_handoff_kill", "kind": "stub_handoff",
+             "rids": 6, "at": 3},
             {"name": "fleet_crash", "kind": "fleet", "mode": "kill",
              "replicas": 2, "clients": 8, "rpc": 5,
              "after_completed": 4},
@@ -119,6 +125,9 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
             {"name": "fleet_slow_evict", "kind": "fleet",
              "mode": "slow_evict", "replicas": 2, "clients": 6,
              "rpc": 6, "slow_ms": 120.0},
+            {"name": "fleet_disagg_handoff", "kind": "fleet",
+             "mode": "disagg_handoff", "clients": 6, "rpc": 4,
+             "kill_at_handoff": 2},
         ],
     },
 }
@@ -369,6 +378,185 @@ def _canonical_events(events: List[Dict[str, Any]]) -> Dict[str, List]:
 
 
 # ---------------------------------------------------------------------------
+# stub handoff scenario: the disagg commit protocol, no jax
+# ---------------------------------------------------------------------------
+
+# Two supervised stdlib children model the disaggregated handoff
+# protocol's commit discipline (serve/fleet.py, DESIGN.md §11) with a
+# filesystem ledger: the PREFILL child computes a payload per request
+# id and commits it with an atomic link (the handoff-file appearing IS
+# the commit point — exactly the router's `handoff` event); the DECODE
+# child consumes committed payloads and link-commits the decoded
+# tokens.  A duplicate commit attempt (link onto an existing row) is
+# counted, never silently absorbed.  The fault: the prefill child
+# SIGKILLs itself (os._exit) just BEFORE committing request ``at`` on
+# its first life — the pre-commit death.  The supervisor relaunches it
+# and the second life re-prefills ONLY the uncommitted rows, so every
+# request is decoded exactly once and the tokens are byte-identical to
+# the no-fault expectation.
+_HANDOFF_CHILD = r'''
+import hashlib
+import os
+import sys
+import time
+
+role, spool, n, at = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                      int(sys.argv[4]))
+hand = os.path.join(spool, "handoff")
+done = os.path.join(spool, "done")
+marker = os.path.join(spool, "crashed.marker")
+dup = os.path.join(spool, "dup-%s.count" % role)
+
+
+def commit(path, text):
+    # link-commit: atomic publish that FAILS if the row exists — the
+    # exactly-once primitive under test (a second commit is a bug
+    # surfaced, not a write absorbed)
+    tmp = path + ".tmp-%d" % os.getpid()
+    with open(tmp, "w") as f:
+        f.write(text)
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        with open(dup, "a") as f:
+            f.write(path + "\n")
+    os.unlink(tmp)
+
+
+deadline = time.time() + 60.0
+if role == "prefill":
+    crash = not os.path.exists(marker)
+    while time.time() < deadline:
+        todo = [r for r in range(n)
+                if not os.path.exists(os.path.join(hand, str(r)))]
+        if not todo:
+            sys.exit(0)
+        for r in sorted(todo):
+            if crash and r == at:
+                open(marker, "w").close()
+                os._exit(1)       # pre-commit death: no handoff row
+            payload = hashlib.sha256(b"block-%d" % r).hexdigest()
+            commit(os.path.join(hand, str(r)), payload)
+        time.sleep(0.002)
+else:
+    while time.time() < deadline:
+        todo = [r for r in range(n)
+                if not os.path.exists(os.path.join(done, str(r)))]
+        if not todo:
+            sys.exit(0)
+        for r in todo:
+            hp = os.path.join(hand, str(r))
+            if not os.path.exists(hp):
+                continue          # not committed yet: nothing to steal
+            with open(hp) as f:
+                payload = f.read()
+            tok = hashlib.sha256(
+                (payload + "|decode").encode()).hexdigest()
+            commit(os.path.join(done, str(r)), tok)
+        time.sleep(0.002)
+os._exit(3)                       # deadline: report the stuck role
+'''
+
+
+def _run_stub_handoff_scenario(sc: Dict[str, Any], tmp: str,
+                               log: Callable[[str], None]
+                               ) -> Dict[str, Any]:
+    m = _mods()
+    res = m["res"]
+    n = int(sc.get("rids", 6))
+    at = int(sc.get("at", 3))
+
+    spool = os.path.join(tmp, "spool")
+    for d in ("handoff", "done"):
+        os.makedirs(os.path.join(spool, d), exist_ok=True)
+    script = os.path.join(tmp, "handoff_child.py")
+    with open(script, "w") as f:
+        f.write(_HANDOFF_CHILD)
+    events_path = os.path.join(tmp, "supervisor-events.jsonl")
+
+    def cmd(role):
+        return [sys.executable, "-S", script, role, spool, str(n),
+                str(at)]
+
+    specs = [
+        res.ChildSpec(name="w_pre", cmd=cmd("prefill"),
+                      role="serve-prefill",
+                      env={"NNPT_PROCESS_ID": "0"}, backoff=0.2),
+        res.ChildSpec(name="w_dec", cmd=cmd("decode"),
+                      role="serve-decode",
+                      env={"NNPT_PROCESS_ID": "1"}, backoff=0.2),
+    ]
+    sup = res.GroupSupervisor(specs, log=lambda msg: None,
+                              events_path=events_path)
+    sup.start()
+    deadline = time.time() + 120.0
+    while sup.running() and time.time() < deadline:
+        sup.poll()
+        time.sleep(0.005)
+    if sup.running():
+        sup.terminate_all()
+        raise AssertionError(f"{sc['name']}: children not done in 120s")
+    rcs = {name: sup.done(name) for name in ("w_pre", "w_dec")}
+    events = _read_events(events_path)
+
+    def _rows(sub):
+        out = {}
+        d = os.path.join(spool, sub)
+        for name in os.listdir(d):
+            with open(os.path.join(d, name)) as f:
+                out[int(name)] = f.read()
+        return out
+
+    committed, delivered = _rows("handoff"), _rows("done")
+    dups = []
+    for role in ("prefill", "decode"):
+        p = os.path.join(spool, f"dup-{role}.count")
+        if os.path.exists(p):
+            with open(p) as f:
+                dups += [ln for ln in f.read().splitlines() if ln]
+    expected = {
+        r: hashlib.sha256(
+            (hashlib.sha256(b"block-%d" % r).hexdigest()
+             + "|decode").encode()).hexdigest()
+        for r in range(n)}
+    tokens_digest = hashlib.sha256(json.dumps(
+        {str(k): v for k, v in sorted(delivered.items())},
+        sort_keys=True).encode()).hexdigest()
+
+    inv = {
+        # the pre-commit death happened and the supervisor recovered it
+        "prefill_crashed_then_relaunched": any(
+            e.get("event") == "relaunch" and e.get("child") == "w_pre"
+            for e in events),
+        # every request committed exactly once — no duplicate rows even
+        # though the relaunched prefill re-scanned the whole spool
+        "exactly_once_commit": (sorted(committed) == list(range(n))
+                                and not dups),
+        "exactly_once_delivery": sorted(delivered) == list(range(n)),
+        # decode output byte-identical to the no-fault expectation
+        "tokens_byte_identical": delivered == expected,
+        "children_finished_ok": all(v == 0 for v in rcs.values()),
+    }
+    return {
+        "name": sc["name"], "kind": "stub_handoff",
+        "metrics": {
+            "rids": n, "killed_before_rid": at,
+            "committed": len(committed), "delivered": len(delivered),
+            "duplicate_commit_attempts": len(dups),
+            "tokens_digest": tokens_digest,
+            "final_rcs": rcs,
+        },
+        "invariants": inv,
+        "canonical": {
+            "events": _canonical_events(events),
+            "tokens_digest": tokens_digest,
+            "final_rcs": rcs,
+            "invariants": inv,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # fleet scenarios: subprocess replicas, the real router + autopilot
 # ---------------------------------------------------------------------------
 
@@ -396,6 +584,9 @@ def _run_fleet_scenario(sc: Dict[str, Any], tmp: str, seed: int,
             f"{_p}.serve.loadgen").run_fleet_closed_loop
 
     mode = sc["mode"]
+    if mode == "disagg_handoff":
+        return _run_fleet_disagg(sc, tmp, seed, launch_fleet,
+                                 run_fleet_closed_loop)
     n = int(sc.get("replicas", 2))
     clients = int(sc.get("clients", 8))
     rpc = int(sc.get("rpc", 5))
@@ -630,6 +821,99 @@ def _run_fleet_scenario(sc: Dict[str, Any], tmp: str, seed: int,
     }
 
 
+def _run_fleet_disagg(sc: Dict[str, Any], tmp: str, seed: int,
+                      launch_fleet, run_fleet_closed_loop
+                      ) -> Dict[str, Any]:
+    """The disaggregated prefill/decode handoff under fire (DESIGN.md
+    §11): a 1-prefill + 1-decode fleet whose prefill worker SIGKILLs
+    itself just BEFORE its Nth handoff commit (``handoff_kill``), on
+    EVERY life — so the pool crash-loops through the supervisor's
+    relaunch budget and ends gone.  The claim checked: through
+    pre-commit deaths, re-prefills, and the final degraded-unified
+    window, every request is delivered exactly once and the tokens are
+    byte-identical to a unified single-replica fleet serving the same
+    plan."""
+    clients = int(sc.get("clients", 6))
+    rpc = int(sc.get("rpc", 4))
+    kill_at = int(sc.get("kill_at_handoff", 2))
+    model = dict(vocab=256, seq=128, layers=2, d_model=64, heads=4,
+                 d_ff=128, init_seed=0)
+    serve = dict(slots=4, block_size=16, prefill_chunk=32,
+                 queue_depth=16)
+    load = dict(vocab_size=model["vocab"], prompt_lens=(4, 24),
+                max_new=(8, 24), seed=seed,
+                classes=[{"name": "all", "slo_ms": None}])
+
+    # the byte-identity reference: one unified replica, same plan
+    base = launch_fleet(1, model=model, serve=serve, step_sleep_ms=15.0,
+                        router_kwargs=dict(queue_depth=128),
+                        prewarm=True, max_restarts=2,
+                        log=lambda msg: None)
+    try:
+        base.wait_ready(600)
+        row0 = run_fleet_closed_loop(base, clients, rpc, **load)
+    finally:
+        base.close()
+
+    events_path = os.path.join(tmp, "supervisor-events.jsonl")
+    fleet = launch_fleet(
+        1, model=model, serve=serve, step_sleep_ms=15.0,
+        router_kwargs=dict(queue_depth=128, handoff_timeout_s=60.0),
+        prewarm=True, max_restarts=1, roles=["decode"],
+        log=lambda msg: None)
+    try:
+        fleet.supervisor._events_path = events_path
+        pre = fleet.add_replica(
+            role="prefill",
+            faults=f"handoff_kill@{kill_at}?proc=1&max=1")
+        fleet.wait_ready(600)
+        row = run_fleet_closed_loop(fleet, clients, rpc, **load)
+        completed_total = fleet.router.completed
+        hstats = fleet.router.handoff_stats()
+        requeued = fleet.router.requeued
+        events = _read_events(events_path)
+    finally:
+        fleet.close()
+
+    submitted = clients * rpc
+    pre_exits = [e for e in events
+                 if e.get("event") == "exit"
+                 and e.get("child") == pre.name]
+    inv: Dict[str, bool] = {
+        "ledger_exact": row["requests"] == submitted,
+        "no_duplicate_deliveries": completed_total == row["requests"],
+        # THE §11 invariant: disagg + pre-commit kills + degraded
+        # fallback change latency, never bytes
+        "tokens_identical_to_unified":
+            row["tokens_sha256"] == row0["tokens_sha256"],
+        "handoffs_committed": hstats["handoffs"] >= 1,
+        "prefill_killed_at_handoff": len(pre_exits) >= 1,
+        "kill_requeued_inflight": requeued >= 1,
+        "degraded_fallback_served": hstats["degraded_dispatches"] >= 1,
+    }
+    return {
+        "name": sc["name"], "kind": "fleet", "mode": "disagg_handoff",
+        "metrics": {
+            "submitted": submitted,
+            "requests": row["requests"],
+            "requeued": requeued,
+            "tokens_per_sec": row["tokens_per_sec"],
+            "itl_ms_p99": row.get("itl_ms_p99"),
+            "ttft_ms_p99": row.get("ttft_ms_p99"),
+            "tokens_sha256": row["tokens_sha256"],
+            "tokens_sha256_unified": row0["tokens_sha256"],
+            "prefill_exits": len(pre_exits),
+            **hstats,
+        },
+        "invariants": inv,
+        "canonical": {
+            "tokens_sha256": row["tokens_sha256"],
+            "tokens_match": row["tokens_sha256"] == row0["tokens_sha256"],
+            "invariants": inv,
+        },
+    }
+
+
 def _relaunched_after_exit(events: List[Dict[str, Any]], child: str,
                            rc: int) -> bool:
     """True if ``child`` was relaunched AFTER its rc==``rc`` exit — the
@@ -679,6 +963,8 @@ def run_scenario(sc: Dict[str, Any], seed: int = 0,
             out = _run_fleet_scenario(sc, tmp, seed, log)
         elif sc.get("kind") == "stub":
             out = _run_stub_scenario(sc, tmp, log)
+        elif sc.get("kind") == "stub_handoff":
+            out = _run_stub_handoff_scenario(sc, tmp, log)
         else:
             raise ValueError(f"unknown scenario kind: {sc.get('kind')}")
         out["wall_s"] = round(time.monotonic() - t0, 3)

@@ -96,6 +96,27 @@ matches the worker's ``--replica`` id rather than a jax process index):
                      must still requeue the stalled replica's in-flight
                      work exactly once.
 
+Disaggregated-handoff faults (DESIGN.md §11 — a PREFILL worker counts
+handoff events, a DECODE worker counts inject ops; both honor
+``proc=K`` against ``--replica``):
+
+    ``handoff_kill``      SIGKILL the prefill worker on its Nth handoff
+                          BEFORE the commit line reaches the wire — the
+                          router never saw the record, so the request
+                          must requeue for a full re-prefill elsewhere,
+                          exactly once.
+    ``handoff_kill_post`` SIGKILL the prefill worker just AFTER the
+                          commit line — the router owns the record;
+                          decode must proceed without repaying prefill.
+    ``decode_kill``       SIGKILL the decode worker right after acking
+                          its Nth inject — decode death mid-stream; the
+                          router re-injects from its ledger record
+                          (re-decode only, no re-prefill).
+    ``handoff_stall``     swallow the Nth inject op (no ack, no stream)
+                          — the wedged-handoff stand-in the router's
+                          handoff timeout must abort and retry with
+                          jittered backoff.
+
 Preemption / degradation faults (PR 18 — consumed by BOTH the Trainer's
 ``apply`` path and a fleet worker's ``fire_if_due``/``slow_penalty_ms``
 polls, so one grammar drives the training and serving arms of the chaos
@@ -154,13 +175,16 @@ from typing import Dict, List, Optional
 ENV_VAR = "NNPT_FAULTS"
 KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
          "ckpt_ioerr", "bitflip", "desync", "peer_kill", "peer_hang",
-         "device_loss", "replica_kill", "stall_drain", "preempt", "slow")
+         "device_loss", "replica_kill", "stall_drain", "preempt", "slow",
+         "handoff_kill", "handoff_kill_post", "decode_kill",
+         "handoff_stall")
 # kinds that perturb the train state (FaultPlan.apply_state) rather than
 # the batch/process (FaultPlan.apply)
 STATE_KINDS = ("bitflip", "desync")
 # kinds a serving-fleet worker polls via FaultPlan.fire_if_due — never
 # fired by the Trainer's apply/apply_state paths
-FLEET_KINDS = ("replica_kill", "stall_drain")
+FLEET_KINDS = ("replica_kill", "stall_drain", "handoff_kill",
+               "handoff_kill_post", "decode_kill", "handoff_stall")
 
 
 def _process_index() -> int:

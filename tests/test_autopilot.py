@@ -854,7 +854,9 @@ def test_fault_plan_fleet_kinds_fire_once_and_match_proc():
     assert not plan.fire_if_due("replica_kill", 3, proc=1002)  # max=1
     assert plan.fire_if_due("stall_drain", 0, proc=1002)
     # fleet kinds never leak into the in-step apply() path
-    assert faults_lib.FLEET_KINDS == ("replica_kill", "stall_drain")
+    assert faults_lib.FLEET_KINDS == (
+        "replica_kill", "stall_drain", "handoff_kill",
+        "handoff_kill_post", "decode_kill", "handoff_stall")
 
 
 # ---------------------------------------------------------------------------

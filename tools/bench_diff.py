@@ -45,7 +45,14 @@ _LOWER = ("_ms", "_s", "_sec", "_pct", "_bytes", "latency", "ttft",
           # requests is better (mttr/reaction also carry the _s
           # suffix, but the bare names keep ratio keys directed)
           "mttr", "reaction", "tokens_lost", "requeued",
-          "steps_replayed")
+          "steps_replayed",
+          # BENCH_DISAGG handoff prices: a cheaper/rarer-retried block
+          # handoff and less time degraded to unified serving is
+          # better ("handoff_ms" percentiles also carry _ms/p99, the
+          # bare names keep ratio keys directed; "degraded" covers
+          # degraded_mode_s AND degraded_dispatches)
+          "handoff_ms", "handoff_retries", "handoff_reprefills",
+          "redecodes", "duplicates", "degraded")
 # accounting/config keys that look directed but are descriptive: gating
 # them would flag "the chaos run covered a different number of seconds"
 # as a perf regression
@@ -54,7 +61,10 @@ _SKIP = ("covered_s", "generated_unix", "t_start", "t_end", "t_unix",
          "chain_steps", "rollup_every", "new_tokens", "reps", "seed",
          "schema", "n_", "num_", "batch", "seq", "vocab", "d_model",
          "d_ff", "block", "slots", "steps", "window", "every",
-         "max_", "min_events")
+         "max_", "min_events",
+         # handoff VOLUME is traffic shape, not a direction — only its
+         # price (handoff_ms / retries / reprefills) is gated
+         "handoffs")
 
 
 def direction(path: str) -> Optional[str]:

@@ -192,12 +192,54 @@ def aggregate(dirs: List[str],
         for name in ("queue_depth", "block_utilization", "in_flight"):
             if name in now_state:
                 row[name] = now_state[name]
+        # disaggregated serving (DESIGN.md §11): the worker's pool role
+        # (unified / prefill / decode) + its live occupancy, so one hot
+        # pool is visible next to the fleet aggregate
+        if now_state.get("role"):
+            row["serve_role"] = str(now_state["role"])
+        slots = now_state.get("slots")
+        if isinstance(slots, (int, float)) and slots > 0:
+            row["occupancy"] = round(
+                (float(now_state.get("in_flight") or 0)
+                 + float(now_state.get("queue_depth") or 0))
+                / float(slots), 4)
         cn = rec.get("counters") or {}
         for name in ("completed", "requeued", "rejected",
-                     "replica_deaths"):
+                     "replica_deaths", "handed_off", "injected"):
             if name in cn:
                 row[name] = cn[name]
         breakdown.append(row)
+
+    # per-POOL serving rollup: writers / queue / in-flight / occupancy
+    # summed per serve role (unified, prefill, decode) from each live
+    # writer's now-state — the disagg fleet's pool-pressure view (the
+    # autopilot reads the same signal per handle; this is the merged
+    # telemetry-side mirror)
+    serving: Dict[str, Dict[str, Any]] = {}
+    for row in breakdown:
+        srole = row.get("serve_role")
+        if not srole:
+            continue
+        pool = serving.setdefault(srole, {
+            "writers": 0, "queue_depth": 0.0, "in_flight": 0.0,
+            "slots": 0.0})
+        pool["writers"] += 1
+        for name in ("queue_depth", "in_flight"):
+            if isinstance(row.get(name), (int, float)):
+                pool[name] += float(row[name])
+    for key, rec in sorted(latest.items()):
+        d, role, run, p, inc = key
+        if inc != newest_inc[(d, role, run, p)]:
+            continue
+        now_state = rec.get("now") or {}
+        srole = now_state.get("role")
+        if srole in serving and isinstance(now_state.get("slots"),
+                                           (int, float)):
+            serving[str(srole)]["slots"] += float(now_state["slots"])
+    for pool in serving.values():
+        pool["occupancy"] = (
+            round((pool["in_flight"] + pool["queue_depth"])
+                  / pool["slots"], 4) if pool["slots"] > 0 else None)
 
     # ---- goodput ---------------------------------------------------------
     # kind="goodput" records are CUMULATIVE per incarnation (like the
@@ -325,6 +367,7 @@ def aggregate(dirs: List[str],
             for k in sorted(latest)],
         "roles": out_roles,
         "breakdown": breakdown,
+        "serving": serving,
         "fleet": fleet,
         "lines_skipped": sum(c["lines_skipped"] for c in collected),
         "heartbeats": heartbeats,
@@ -489,6 +532,17 @@ def render_text(doc: Dict[str, Any]) -> str:
                     f"{gap.get('compute_frac', 0) * 100:.0f}% host "
                     f"{gap.get('host_frac', 0) * 100:.0f}% stall "
                     f"{gap.get('stall_frac', 0) * 100:.0f}%)")
+    serving = doc.get("serving") or {}
+    if serving:
+        lines.append("serving pools:")
+        for srole, pool in sorted(serving.items()):
+            occ = pool.get("occupancy")
+            lines.append(
+                f"  {srole:<8} {pool['writers']} writer(s)  "
+                f"q={pool['queue_depth']:g}  "
+                f"in_flight={pool['in_flight']:g}  "
+                f"slots={pool['slots']:g}"
+                + (f"  occ={occ:.2f}" if occ is not None else ""))
     breakdown = doc.get("breakdown") or []
     if breakdown:
         lines.append("per-writer (newest incarnation):")
