@@ -253,6 +253,11 @@ class Autopilot:
         # to read the role from, so remember it here — _watch_pools
         # backfills from this set.
         self._roles_seen: set = set()
+        # one-shot WAL-recovery disclosure (first tick after a router
+        # relaunch): everything this loop observes — rollups, rates,
+        # per-replica history — was REBUILT from the journal, not
+        # carried across the crash
+        self._recovery_disclosed = False
 
     # ---- bookkeeping ---------------------------------------------------
     def _decide(self, action: str, **extra) -> Dict[str, Any]:
@@ -380,6 +385,18 @@ class Autopilot:
             return []
         self._last_eval = now
         before = len(self.decisions)
+        rec = getattr(self.fleet.router, "recovery", None)
+        if not self._recovery_disclosed and rec and rec.get("recovered"):
+            # disclose ONCE that this incarnation's state is journal-
+            # rebuilt (serve/wal.py): consumers of the decision ledger
+            # must not read pre-crash trends into post-crash rollups
+            self._recovery_disclosed = True
+            self._decide("post_recovery",
+                         replayed=rec.get("replayed", 0),
+                         deduped=rec.get("deduped", 0),
+                         converted=rec.get("converted", 0),
+                         lost=rec.get("lost", 0),
+                         wall_s=rec.get("wall_s", 0.0))
         self._watch_pending_out(now)
         self._watch_notices(now)
         self._watch_draining(now)

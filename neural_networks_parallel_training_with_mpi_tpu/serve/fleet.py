@@ -734,6 +734,7 @@ class FleetRouter:
                  rollup_every: int = 50,
                  handoff_timeout_s: float = 5.0,
                  handoff_max_retries: int = 8,
+                 wal_dir: Optional[str] = None,
                  now_fn=time.monotonic):
         self.replicas = list(replicas)
         if not self.replicas:
@@ -823,10 +824,113 @@ class FleetRouter:
             self._heartbeat = telemetry_lib.Heartbeat(os.path.join(
                 telemetry_dir,
                 telemetry_lib.heartbeat_filename("router")))
+        # --- durable control plane (write-ahead request ledger) -------
+        # with a wal_dir, every commit point (accept, assign,
+        # handoff-commit, completion) is journaled BEFORE the router's
+        # in-memory state moves, and construction replays whatever a
+        # previous incarnation journaled — the recovery path mirrors
+        # the live protocol exactly (queued work requeues, committed
+        # handoff records re-inject or degrade to unified reprefills,
+        # completed requests answer from the journal)
+        self._wal = None
+        self._idem: Dict[str, int] = {}
+        self._replayed_rids: set = set()
+        self.recovery: Dict[str, Any] = {
+            "recovered": False, "replayed": 0, "deduped": 0,
+            "converted": 0, "lost": 0, "wall_s": 0.0}
+        if wal_dir:
+            from .wal import WriteAheadLog
+
+            t_wal = time.perf_counter()
+            self._wal = WriteAheadLog(wal_dir)
+            self._recover(self._wal.open())
+            self.recovery["lost"] = (
+                self._wal.report.get("quarantined_records", 0)
+                + self._wal.report.get("quarantined_segments", 0))
+            self.recovery["wall_s"] = round(
+                time.perf_counter() - t_wal, 6)
+
+    def _recover(self, records) -> None:
+        """Rebuild the request + handoff ledgers from a replayed WAL.
+        Unfinished requests re-admit exactly once IN THEIR RECORDED
+        PHASE: accepted/assigned work requeues for a full re-prefill
+        (its replica died with the old incarnation — the pre-commit
+        recovery row), committed handoff records rejoin the handoff
+        queue (re-inject, or degrade to unified reprefills when the
+        decode pool never comes back — the existing recovery table),
+        and completed requests restore their results so an
+        idempotency-key resubmit is answered from the journal with the
+        exact bytes the first incarnation delivered."""
+        if not records:
+            return
+        now = self.now()
+        order: List[int] = []
+        for rec in records:
+            kind = rec.get("kind")
+            rid = rec.get("rid")
+            if kind == "accept":
+                rid = int(rid)
+                req = FleetRequest(
+                    rid=rid, prompt=[int(t) for t in rec["prompt"]],
+                    max_new=int(rec["max_new"]),
+                    slo_ms=rec.get("slo_ms"), t_submit=now,
+                    deadline=(now + rec["slo_ms"] / 1e3
+                              if rec.get("slo_ms") is not None
+                              else math.inf))
+                self.reqs[rid] = req
+                order.append(rid)
+                if rec.get("idem"):
+                    self._idem[str(rec["idem"])] = rid
+            elif kind == "handoff" and int(rid) in self.reqs:
+                req = self.reqs[int(rid)]
+                req.handoff = rec.get("payload")
+                req.prefill_replica = rec.get("prefill")
+                req.phase = "handoff_inflight"
+                req.handoff_t = now
+                if rec.get("ttft_ms") is not None:
+                    req.ttft_ms = float(rec["ttft_ms"])
+            elif kind == "complete" and int(rid) in self.reqs:
+                req = self.reqs[int(rid)]
+                req.t_done = now
+                req.phase = "done"
+                req.handoff = None
+                req.ttft_ms = rec.get("ttft_ms")
+                req.itl_ms = rec.get("itl_ms")
+                req.generation = int(rec.get("generation", 0))
+                toks = [int(t) for t in rec["tokens"]]
+                self._results[req.rid] = toks
+                req.n_generated = len(toks) - len(req.prompt)
+                self.completed += 1
+                self._completed_by_gen[req.generation] = (
+                    self._completed_by_gen.get(req.generation, 0) + 1)
+            # "assign" records carry no recovery action of their own:
+            # the assigned replica died with the old incarnation, so an
+            # assigned-but-uncommitted request recovers exactly like a
+            # queued one (full re-prefill) — the same row of the table
+            # a live prefill death takes
+        self._next_rid = 1 + max(order, default=-1)
+        for rid in order:
+            req = self.reqs[rid]
+            if req.t_done is not None:
+                continue
+            self.recovery["replayed"] += 1
+            self._replayed_rids.add(rid)
+            if req.handoff is not None:
+                self._handoff_queue.append(rid)
+            else:
+                req.phase = "queued"
+                req.replica = None
+                self.queue.append(req)
+        self.recovery["recovered"] = True
+        log(f"router: recovered {len(order)} journaled requests "
+            f"({self.recovery['replayed']} re-admitted, "
+            f"{self.completed} already complete, "
+            f"{len(self._handoff_queue)} committed handoffs)")
 
     # ---- client surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               slo_ms: Optional[float] = None) -> Optional[int]:
+               slo_ms: Optional[float] = None,
+               idem: Optional[str] = None) -> Optional[int]:
         """Enqueue at the fleet; returns the fleet rid, or None when
         admission rejects (bounded queue full, or — with
         ``reject_infeasible`` — no replica can plausibly meet the
@@ -837,6 +941,19 @@ class FleetRouter:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens {max_new_tokens} < 1")
+        if idem is not None and idem in self._idem:
+            # idempotency-key dedupe (durable control plane): the
+            # journal already owns this request.  Completed -> answer
+            # from the journal (the rid re-surfaces on the next pump
+            # with the original bytes); still in flight -> re-attach
+            # the client to the live rid, never a second execution.
+            rid = self._idem[idem]
+            req = self.reqs.get(rid)
+            if req is not None:
+                self.recovery["deduped"] += 1
+                if req.t_done is not None:
+                    self._completed_backlog.append(rid)
+                return rid
         if len(self.queue) >= self.queue_depth:
             self.rejected += 1
             return None
@@ -853,6 +970,14 @@ class FleetRouter:
         req = FleetRequest(rid=rid, prompt=prompt_ids,
                            max_new=int(max_new_tokens), slo_ms=slo,
                            t_submit=now, deadline=deadline)
+        if self._wal is not None:
+            # ACCEPT commit point: journal before the queue sees it —
+            # an accepted request survives the very next SIGKILL
+            self._wal.append("accept", rid=rid, prompt=prompt_ids,
+                             max_new=int(max_new_tokens), slo_ms=slo,
+                             idem=idem)
+        if idem is not None:
+            self._idem[idem] = rid
         self.reqs[rid] = req
         self.queue.append(req)
         return rid
@@ -1157,6 +1282,14 @@ class FleetRouter:
             req.t_dispatch = self.now()
             req.phase = ("decoding" if req.unified or not disagg
                          or role_kind(h) != "prefill" else "prefilling")
+            if self._wal is not None:
+                # ASSIGN commit point: recovery treats assigned-but-
+                # uncommitted exactly like queued (the replica dies
+                # with the incarnation), so the record is provenance —
+                # which replica owed this request when the lights went
+                # out — not a distinct replay phase
+                self._wal.append("assign", rid=req.rid, replica=h.name,
+                                 phase=req.phase)
             if disagg and req.unified:
                 self.degraded_dispatches += 1
             self.routed += 1
@@ -1184,6 +1317,13 @@ class FleetRouter:
             wait_ms = ((req.t_dispatch or req.t_submit)
                        - req.t_submit) * 1e3
             req.ttft_ms = wait_ms + float(rec["ttft_ms"])
+        if self._wal is not None:
+            # HANDOFF-COMMIT point: the exported payload itself is
+            # journaled — after a full-fleet SIGKILL the next
+            # incarnation re-injects from the journal without repaying
+            # prefill, the same row a live decode death takes
+            self._wal.append("handoff", rid=rid, payload=req.handoff,
+                             prefill=h.name, ttft_ms=req.ttft_ms)
         self.handoffs += 1
         self._handoff_queue.append(rid)
 
@@ -1292,6 +1432,12 @@ class FleetRouter:
                 req.handoff_t = None
                 req.phase = "queued"
                 self.handoff_reprefills += 1
+                if rid in self._replayed_rids:
+                    # a journaled handoff record whose decode pool
+                    # never came back: converted to a unified
+                    # reprefill, the recovery table's last row
+                    self.recovery["converted"] += 1
+                    self._replayed_rids.discard(rid)
                 self._requeue_one(rid, req.prefill_replica or "?")
             return
         for _ in range(len(self._handoff_queue)):
@@ -1333,6 +1479,13 @@ class FleetRouter:
         self._results[rid] = toks
         req.n_generated = len(toks) - len(req.prompt)
         req.generation = getattr(h, "generation", 0)
+        if self._wal is not None:
+            # COMPLETION commit point: tokens ride the record so a
+            # post-restart idempotency-key resubmit is answered with
+            # the exact bytes this delivery carried
+            self._wal.append("complete", rid=rid, tokens=toks,
+                             ttft_ms=req.ttft_ms, itl_ms=req.itl_ms,
+                             generation=req.generation)
         self.completed += 1
         self._completed_by[h.name] = (
             self._completed_by.get(h.name, 0) + 1)
@@ -1468,11 +1621,21 @@ class FleetRouter:
                          "redecodes": self.redecodes,
                          "degraded_dispatches": self.degraded_dispatches,
                          "duplicates_suppressed":
-                             self.duplicates_suppressed},
+                             self.duplicates_suppressed,
+                         "recovery_replayed": self.recovery["replayed"],
+                         "recovery_deduped": self.recovery["deduped"],
+                         "recovery_converted":
+                             self.recovery["converted"],
+                         "recovery_lost": self.recovery["lost"]},
             "gauges": {"queue_depth": self._q_gauge.to_dict()},
             "now": {"queue_depth": len(self.queue),
                     "in_flight": self.in_flight(),
                     "handoff_queue": len(self._handoff_queue),
+                    # rebuilt-from-journal state is DISCLOSED, not
+                    # passed off as organic history: the autopilot and
+                    # the aggregator can tell a post-recovery rollup
+                    # from a first-life one
+                    "post_recovery": bool(self.recovery["recovered"]),
                     "degraded": self._degraded_since is not None,
                     "degraded_mode_s": round(self.degraded_mode_s
                                              + ((self.now()
@@ -1493,6 +1656,7 @@ class FleetRouter:
             "degraded_dispatches": self.degraded_dispatches,
             "degraded_mode_s": round(self.degraded_mode_s, 6),
             "duplicates_suppressed": self.duplicates_suppressed,
+            "recovery": dict(self.recovery),
         }
 
     def _write_rollup(self) -> None:
@@ -1506,6 +1670,9 @@ class FleetRouter:
         if self._degraded_since is not None:
             self.degraded_mode_s += self.now() - self._degraded_since
             self._degraded_since = None
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None
         if self._jsonl is not None:
             self._write_rollup()
             if self._heartbeat is not None:
@@ -1664,9 +1831,10 @@ class Fleet:
     # supervised subprocesses — load drivers (serve.loadgen.
     # run_fleet_closed_loop) work on either unchanged
     def submit(self, prompt_ids, max_new_tokens: int,
-               slo_ms: Optional[float] = None) -> Optional[int]:
+               slo_ms: Optional[float] = None,
+               idem: Optional[str] = None) -> Optional[int]:
         return self.router.submit(prompt_ids, max_new_tokens,
-                                  slo_ms=slo_ms)
+                                  slo_ms=slo_ms, idem=idem)
 
     def result(self, rid: int) -> List[int]:
         return self.router.result(rid)
@@ -1966,8 +2134,15 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
     params = model.init(prng.init_key(args.init_seed))
 
     def emit(obj: Dict[str, Any]) -> None:
-        proto.write(json.dumps(obj) + "\n")
-        proto.flush()
+        try:
+            proto.write(json.dumps(obj) + "\n")
+            proto.flush()
+        except BrokenPipeError:
+            # the control plane died mid-write: the event has no
+            # reader.  The stdin-EOF orphan path owns the exit; a
+            # SIGPIPE-shaped crash here would turn a clean orphan
+            # drain into a fake worker failure.
+            pass
 
     if args.ckpt:
         # rollout path: replace the seed-derived params with a VERIFIED
@@ -2167,8 +2342,18 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
         if not busy:
             sel.select(timeout=0.05)    # idle: park until ops arrive
         ops, eof = read_ops()
-        if eof:
-            stop = True    # parent hung up: exit cleanly
+        if eof and not any(op.get("op") == "exit" for op in ops):
+            # stdin EOF without the exit handshake: the control plane
+            # died and this worker is ORPHANED.  Its in-flight work is
+            # already owed by the next incarnation's journal replay, so
+            # finishing it would deliver to nobody — drain through the
+            # existing advance-notice channel (zero grace) and take the
+            # same terminal exit 47 a noticed preemption takes.
+            if notice["deadline"] is None:
+                notice["grace_s"] = 0.0
+                notice["deadline"] = time.monotonic()
+        elif eof:
+            stop = True    # parent hung up after exit: leave cleanly
         for op in ops:
             kind = op.get("op")
             if kind == "submit":
@@ -2246,8 +2431,7 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                           file=sys.stderr, flush=True)
                     continue
                 if sched is not None:
-                    reqs = sched.drain()
-                    sched.server.allocator.assert_drained()
+                    reqs = sched.quiesce()
                 else:
                     reqs = [{"rid": r, "prefilled": 0, "generated": 0}
                             for r in engine.take_assigned()]
@@ -2290,8 +2474,7 @@ def worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 # drained state (leftovers requeue exactly once through
                 # the router's ledger), then the terminal no-retry exit
                 if sched is not None:
-                    reqs = sched.drain()
-                    sched.server.allocator.assert_drained()
+                    reqs = sched.quiesce()
                 else:
                     reqs = [{"rid": r, "prefilled": 0, "generated": 0}
                             for r in engine.take_assigned()]

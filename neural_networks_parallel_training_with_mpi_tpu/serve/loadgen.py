@@ -360,8 +360,14 @@ def run_fleet_closed_loop(router, clients: int,
                     next_idx[ci] >= requests_per_client:
                 continue
             req = plan[ci][next_idx[ci]]
+            # client idempotency key: a pure function of the request's
+            # coordinates in the plan (seed/stream/client/index), so a
+            # relaunched driver resubmitting after a control-plane
+            # death names each request IDENTICALLY and the router's
+            # journal dedupes instead of re-executing (serve/wal.py)
+            idem = f"{int(seed)}.{int(stream)}.{ci}.{next_idx[ci]}"
             rid = router.submit(req["prompt"], req["max_new"],
-                                slo_ms=cls_of[ci]["slo_ms"])
+                                slo_ms=cls_of[ci]["slo_ms"], idem=idem)
             if rid is None:
                 submit_retries += 1
                 continue
@@ -373,7 +379,12 @@ def run_fleet_closed_loop(router, clients: int,
             next_idx[ci] += 1
             progressed = True
         for rid in router.pump():
-            ci = owner[rid]
+            ci = owner.get(rid)
+            if ci is None:
+                # a journal-replayed request can complete before its
+                # client re-attaches (recovered router, fresh driver);
+                # the idempotency-key resubmit re-announces it
+                continue
             outstanding[ci] = None
             finished.append(rid)
             c, i, _ = tokens_of[rid]

@@ -793,6 +793,17 @@ class Scheduler:
         out.sort(key=lambda d: (d["t_submit"], d["rid"]))
         return out
 
+    def quiesce(self) -> List[Dict[str, Any]]:
+        """:meth:`drain` plus the proof: evict everything, then assert
+        the allocator really is empty before the caller exits.  The one
+        call shared by every worker shutdown path — the advance-notice
+        preemption drain, the decommission handshake, and the orphaned
+        worker whose control plane died (stdin EOF) — so "exited
+        cleanly" always MEANS "leaked no blocks"."""
+        out = self.drain()
+        self.server.allocator.assert_drained()
+        return out
+
     # ---- internals -----------------------------------------------------
     def _committed_tokens(self) -> int:
         """In-flight committed (prompt + max_new) tokens, refcount-aware:

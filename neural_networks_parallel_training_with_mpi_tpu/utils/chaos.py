@@ -46,6 +46,8 @@ import hashlib
 import importlib.util
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -100,6 +102,8 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
              "steps": 8, "at_step": 3, "grace_s": 5.0},
             {"name": "stub_handoff_kill", "kind": "stub_handoff",
              "rids": 6, "at": 3},
+            {"name": "stub_router_kill", "kind": "stub_wal",
+             "rids": 6, "at": 3},
         ],
     },
     # the bench plan (BENCH_CHAOS.json): lite plus the subprocess-fleet
@@ -116,6 +120,8 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
              "steps": 8, "at_step": 3, "grace_s": 5.0},
             {"name": "stub_handoff_kill", "kind": "stub_handoff",
              "rids": 6, "at": 3},
+            {"name": "stub_router_kill", "kind": "stub_wal",
+             "rids": 6, "at": 3},
             {"name": "fleet_crash", "kind": "fleet", "mode": "kill",
              "replicas": 2, "clients": 8, "rpc": 5,
              "after_completed": 4},
@@ -128,6 +134,9 @@ BUILTIN_PLANS: Dict[str, Dict[str, Any]] = {
             {"name": "fleet_disagg_handoff", "kind": "fleet",
              "mode": "disagg_handoff", "clients": 6, "rpc": 4,
              "kill_at_handoff": 2},
+            {"name": "fleet_ctrlplane", "kind": "fleet",
+             "mode": "ctrlplane", "clients": 4, "rpc": 3,
+             "kill_at_completed": 2},
         ],
     },
 }
@@ -557,6 +566,213 @@ def _run_stub_handoff_scenario(sc: Dict[str, Any], tmp: str,
 
 
 # ---------------------------------------------------------------------------
+# stub wal scenario: the REAL write-ahead log, killed and replayed, no jax
+# ---------------------------------------------------------------------------
+
+# One supervised stdlib child models the durable router (serve/wal.py +
+# serve/fleet.py recovery, DESIGN.md §12) against the REAL wal module
+# (file-path loaded — the code under test, not a model of it): per
+# request it journals ``accept``, computes deterministic tokens,
+# journals ``complete`` (tokens ride the record), then link-commits the
+# delivery row.  The fault: on its first life the child writes HALF of
+# a ``complete`` record — flushed, fsynced, no newline — and SIGKILLs
+# itself (``os._exit``): the torn-tail case.  The supervisor relaunches
+# it; the second life's ``open()`` truncates the torn tail, replays the
+# journal, re-delivers completed requests FROM THE JOURNAL (never
+# recomputed — the idempotency-dedupe semantic), and re-executes only
+# the unfinished ones.  Tiny segments force rotation, so the sealed-
+# segment manifest path runs in the no-jax lane too.
+_WAL_CHILD = r'''
+import hashlib
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+wal = _load("_nnpt_wal", sys.argv[1])
+spool, n, at = sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+done = os.path.join(spool, "done")
+marker = os.path.join(spool, "crashed.marker")
+dup = os.path.join(spool, "dup-router.count")
+
+
+def commit(path, text):
+    # link-commit: atomic publish that FAILS if the row exists — the
+    # exactly-once delivery primitive (same discipline as the handoff
+    # stub: a second commit is a bug surfaced, not a write absorbed)
+    tmp = path + ".tmp-%d" % os.getpid()
+    with open(tmp, "w") as f:
+        f.write(text)
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        with open(dup, "a") as f:
+            f.write(path + "\n")
+    os.unlink(tmp)
+
+
+def toks(rid):
+    return hashlib.sha256(b"req-%d" % rid).hexdigest()
+
+
+crash = not os.path.exists(marker)
+w = wal.WriteAheadLog(os.path.join(spool, "wal"), segment_records=4)
+recs = w.open()
+life = "life1" if crash else "life2"
+with open(os.path.join(spool, "report-%s.json" % life), "w") as f:
+    json.dump(w.report, f, sort_keys=True)
+accepted, completed = set(), {}
+for r in recs:
+    if r["kind"] == "accept":
+        accepted.add(r["rid"])
+    elif r["kind"] == "complete":
+        completed[r["rid"]] = r["tokens"]
+# journaled completions deliver from the RECORD — the replayed tokens,
+# not a recomputation (what the router's idempotency dedupe answers)
+for rid, t in sorted(completed.items()):
+    p = os.path.join(done, str(rid))
+    if not os.path.exists(p):
+        commit(p, t)
+for rid in range(n):
+    if rid in completed:
+        continue
+    if rid not in accepted:
+        w.append("accept", rid=rid, idem="k%d" % rid)
+    t = toks(rid)
+    if crash and rid == at:
+        open(marker, "w").close()
+        # the torn write: half a complete record, fsynced, no newline
+        line = wal.encode_record(
+            {"seq": 10 ** 6, "kind": "complete", "rid": rid,
+             "tokens": t})
+        w._f.write(line[:len(line) // 2])
+        w._f.flush()
+        os.fsync(w._f.fileno())
+        os._exit(1)
+    w.append("complete", rid=rid, tokens=t)
+    commit(os.path.join(done, str(rid)), t)
+w.close()
+with open(os.path.join(spool, "summary.json"), "w") as f:
+    json.dump({"replayed_complete": len(completed),
+               "accepted_seen": sorted(accepted)}, f, sort_keys=True)
+sys.exit(0)
+'''
+
+
+def _run_stub_wal_scenario(sc: Dict[str, Any], tmp: str,
+                           log: Callable[[str], None]) -> Dict[str, Any]:
+    m = _mods()
+    res = m["res"]
+    n = int(sc.get("rids", 6))
+    at = int(sc.get("at", 3))
+
+    spool = os.path.join(tmp, "spool")
+    os.makedirs(os.path.join(spool, "done"), exist_ok=True)
+    script = os.path.join(tmp, "wal_child.py")
+    with open(script, "w") as f:
+        f.write(_WAL_CHILD)
+    wal_py = os.path.join(_PKG, "serve", "wal.py")
+    events_path = os.path.join(tmp, "supervisor-events.jsonl")
+
+    specs = [
+        res.ChildSpec(name="w_rt",
+                      cmd=[sys.executable, "-S", script, wal_py, spool,
+                           str(n), str(at)],
+                      role="serve-router",
+                      env={"NNPT_PROCESS_ID": "0"}, backoff=0.2),
+    ]
+    sup = res.GroupSupervisor(specs, log=lambda msg: None,
+                              events_path=events_path)
+    sup.start()
+    deadline = time.time() + 120.0
+    while sup.running() and time.time() < deadline:
+        sup.poll()
+        time.sleep(0.005)
+    if sup.running():
+        sup.terminate_all()
+        raise AssertionError(f"{sc['name']}: child not done in 120s")
+    rcs = {"w_rt": sup.done("w_rt")}
+    events = _read_events(events_path)
+
+    delivered = {}
+    ddir = os.path.join(spool, "done")
+    for name in os.listdir(ddir):
+        with open(os.path.join(ddir, name)) as f:
+            delivered[int(name)] = f.read()
+    dups = []
+    dp = os.path.join(spool, "dup-router.count")
+    if os.path.exists(dp):
+        with open(dp) as f:
+            dups = [ln for ln in f.read().splitlines() if ln]
+
+    def _json(name, default):
+        p = os.path.join(spool, name)
+        try:
+            with open(p) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return default
+
+    report2 = _json("report-life2.json", {})
+    summary = _json("summary.json", {})
+    expected = {r: hashlib.sha256(b"req-%d" % r).hexdigest()
+                for r in range(n)}
+    tokens_digest = hashlib.sha256(json.dumps(
+        {str(k): v for k, v in sorted(delivered.items())},
+        sort_keys=True).encode()).hexdigest()
+
+    inv = {
+        "router_crashed_then_relaunched": any(
+            e.get("event") == "relaunch" and e.get("child") == "w_rt"
+            for e in events),
+        # the half-written record was truncated, not treated as fatal
+        # and not replayed as data
+        "torn_tail_truncated":
+            bool(report2.get("torn_tail_truncated")),
+        # rotation ran: the replayed journal spans sealed segments
+        "segments_sealed": int(report2.get("segments", 0)) >= 1,
+        "no_records_quarantined":
+            int(report2.get("quarantined_records", 0)) == 0,
+        # completed requests re-delivered from the journal, unfinished
+        # ones re-executed — each delivery row committed exactly once
+        "journal_deduped":
+            int(summary.get("replayed_complete", 0)) >= 1,
+        "exactly_once_delivery": (sorted(delivered) == list(range(n))
+                                  and not dups),
+        "tokens_byte_identical": delivered == expected,
+        "children_finished_ok": all(v == 0 for v in rcs.values()),
+    }
+    return {
+        "name": sc["name"], "kind": "stub_wal",
+        "metrics": {
+            "rids": n, "killed_at_rid": at,
+            "delivered": len(delivered),
+            "replayed_complete": summary.get("replayed_complete"),
+            "duplicate_commit_attempts": len(dups),
+            "wal_report_life2": report2,
+            "tokens_digest": tokens_digest,
+            "final_rcs": rcs,
+        },
+        "invariants": inv,
+        "canonical": {
+            "events": _canonical_events(events),
+            "tokens_digest": tokens_digest,
+            "final_rcs": rcs,
+            "invariants": inv,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # fleet scenarios: subprocess replicas, the real router + autopilot
 # ---------------------------------------------------------------------------
 
@@ -587,6 +803,8 @@ def _run_fleet_scenario(sc: Dict[str, Any], tmp: str, seed: int,
     if mode == "disagg_handoff":
         return _run_fleet_disagg(sc, tmp, seed, launch_fleet,
                                  run_fleet_closed_loop)
+    if mode == "ctrlplane":
+        return _run_fleet_ctrlplane(sc, tmp, seed, log)
     n = int(sc.get("replicas", 2))
     clients = int(sc.get("clients", 8))
     rpc = int(sc.get("rpc", 5))
@@ -914,6 +1132,160 @@ def _run_fleet_disagg(sc: Dict[str, Any], tmp: str, seed: int,
     }
 
 
+def _run_fleet_ctrlplane(sc: Dict[str, Any], tmp: str, seed: int,
+                         log: Callable[[str], None]) -> Dict[str, Any]:
+    """Control-plane death under load (DESIGN.md §12): the router +
+    workers run in a killable driver subprocess
+    (serve/ctrlplane_driver.py) with a write-ahead request ledger; the
+    scenario SIGKILLs the driver pid mid-load (``router_kill`` — the
+    workers orphan and drain via the notice channel) and, in a second
+    arm, the whole process group (``fleet_kill`` — fired only while a
+    committed handoff is still inflight, the hardest record class).
+    Each arm relaunches on the same WAL dir; recovery must re-admit
+    exactly once per journaled phase and finish with tokens
+    byte-identical to the uncrashed baseline."""
+    try:
+        from ..serve import wal as wal_mod
+        from .faults import FaultPlan
+    except ImportError:
+        if os.path.dirname(_PKG) not in sys.path:
+            sys.path.insert(0, os.path.dirname(_PKG))
+        from importlib import import_module
+        _p = os.path.basename(_PKG)
+        wal_mod = import_module(f"{_p}.serve.wal")
+        FaultPlan = import_module(f"{_p}.utils.faults").FaultPlan
+
+    clients = int(sc.get("clients", 4))
+    rpc = int(sc.get("rpc", 3))
+    kill_at = int(sc.get("kill_at_completed", 2))
+    want = clients * rpc
+    pkg = os.path.basename(_PKG)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = (os.path.dirname(_PKG) + os.pathsep
+                         + env.get("PYTHONPATH", "")).rstrip(os.pathsep)
+
+    def cmd(wal_dir: str, out: str) -> List[str]:
+        return [sys.executable, "-m", f"{pkg}.serve.ctrlplane_driver",
+                "--roles", "prefill,decode",
+                "--clients", str(clients), "--rpc", str(rpc),
+                "--seed", str(seed), "--mix", "long_prefill",
+                "--step-sleep-ms", "15",
+                "--wal-dir", wal_dir, "--out", out]
+
+    def run_life(label: str, wal_dir: str) -> Dict[str, Any]:
+        out = os.path.join(tmp, label + ".json")
+        with open(os.path.join(tmp, label + ".stderr"), "w") as errf:
+            subprocess.run(cmd(wal_dir, out), env=env, stderr=errf,
+                           check=True, timeout=600)
+        with open(out) as f:
+            return json.load(f)
+
+    def progress(wal_dir: str):
+        recs, _ = wal_mod.replay(wal_dir, repair=False)
+        done = {r.get("rid") for r in recs
+                if r.get("kind") == "complete"}
+        inflight = sum(1 for r in recs if r.get("kind") == "handoff"
+                       and r.get("rid") not in done)
+        return len(done), inflight
+
+    def crash_arm(label: str, kind: str) -> Dict[str, Any]:
+        wal_dir = os.path.join(tmp, "wal_" + label)
+        plan = FaultPlan.parse(f"{kind}@{kill_at}?max=1")
+        fired, kd, ki = False, 0, 0
+        with open(os.path.join(tmp, label + "_life1.stderr"),
+                  "w") as errf:
+            p = subprocess.Popen(
+                cmd(wal_dir, os.path.join(tmp, label + "_life1.json")),
+                env=env, stderr=errf, start_new_session=True)
+            t0 = time.monotonic()
+            while p.poll() is None and time.monotonic() - t0 < 300:
+                done, inflight = progress(wal_dir)
+                # fleet_kill waits for a committed handoff inflight
+                # (late-fire fallback so a fast decode pool cannot
+                # starve the arm); the gate runs BEFORE fire_if_due so
+                # an unmet precondition does not consume the fire
+                ok = (kind != "fleet_kill" or inflight > 0
+                      or done >= want - 4)
+                if ok and plan.fire_if_due(kind, done):
+                    if kind == "fleet_kill":
+                        os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+                    else:
+                        os.kill(p.pid, signal.SIGKILL)
+                    fired, kd, ki = True, done, inflight
+                    break
+                time.sleep(0.1)
+            p.wait(timeout=120)
+        if kind == "router_kill":
+            time.sleep(2.0)  # orphans hit EOF, drain, exit 47
+        doc = run_life(label + "_life2", wal_dir)
+        doc["fired"] = fired
+        doc["kill_at_completed"] = kd
+        doc["handoffs_inflight_at_kill"] = ki
+        log(f"[chaos ctrlplane {label}] fired={fired} at={kd} "
+            f"inflight={ki} recovery={doc['recovery']}")
+        return doc
+
+    base = run_life("baseline", "")
+    rk = crash_arm("router_kill", "router_kill")
+    fk = crash_arm("fleet_kill", "fleet_kill")
+
+    def _arm_inv(doc):
+        return (doc["fired"] and doc["resumed"]
+                and doc["row"]["tokens_sha256"]
+                == base["row"]["tokens_sha256"]
+                and doc["row"]["requests"] == want
+                and doc["recovery"]["lost"] == 0
+                and (doc["recovery"]["replayed"]
+                     + doc["recovery"]["deduped"]) > 0)
+
+    inv = {
+        "baseline_completed": base["row"]["requests"] == want,
+        # exactly-once across router death: journal replayed, completed
+        # requests deduped, tokens byte-identical, nothing lost
+        "router_kill_exactly_once": _arm_inv(rk),
+        "fleet_kill_exactly_once": _arm_inv(fk),
+        # the ledger never over-delivers: completed == accepted requests
+        "no_duplicate_deliveries": (
+            rk["completed"] == want and fk["completed"] == want),
+    }
+    canonical_inv = dict(inv)
+    return {
+        "name": sc["name"], "kind": "fleet", "mode": "ctrlplane",
+        "metrics": {
+            "submitted": want,
+            "tokens_sha256": base["row"]["tokens_sha256"],
+            "router_kill": {
+                "kill_at_completed": rk["kill_at_completed"],
+                "handoffs_inflight_at_kill":
+                    rk["handoffs_inflight_at_kill"],
+                "recovery": rk["recovery"],
+                "recovery_wall_s": rk["ready_wall_s"],
+            },
+            "fleet_kill": {
+                "kill_at_completed": fk["kill_at_completed"],
+                "handoffs_inflight_at_kill":
+                    fk["handoffs_inflight_at_kill"],
+                "recovery": fk["recovery"],
+                "recovery_wall_s": fk["ready_wall_s"],
+            },
+        },
+        "invariants": inv,
+        # kill timing (and with it every replay counter) is wall-clock
+        # jitter: the digest pins only the token identity + verdicts
+        "canonical": {
+            "tokens_sha256": base["row"]["tokens_sha256"],
+            "tokens_match": {
+                "router_kill": rk["row"]["tokens_sha256"]
+                == base["row"]["tokens_sha256"],
+                "fleet_kill": fk["row"]["tokens_sha256"]
+                == base["row"]["tokens_sha256"],
+            },
+            "invariants": canonical_inv,
+        },
+    }
+
+
 def _relaunched_after_exit(events: List[Dict[str, Any]], child: str,
                            rc: int) -> bool:
     """True if ``child`` was relaunched AFTER its rc==``rc`` exit — the
@@ -965,6 +1337,8 @@ def run_scenario(sc: Dict[str, Any], seed: int = 0,
             out = _run_stub_scenario(sc, tmp, log)
         elif sc.get("kind") == "stub_handoff":
             out = _run_stub_handoff_scenario(sc, tmp, log)
+        elif sc.get("kind") == "stub_wal":
+            out = _run_stub_wal_scenario(sc, tmp, log)
         else:
             raise ValueError(f"unknown scenario kind: {sc.get('kind')}")
         out["wall_s"] = round(time.monotonic() - t0, 3)

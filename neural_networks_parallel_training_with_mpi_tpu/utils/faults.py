@@ -117,6 +117,23 @@ handoff events, a DECODE worker counts inject ops; both honor
                           handoff timeout must abort and retry with
                           jittered backoff.
 
+Control-plane faults (the DRIVER fires these — ``bench.py
+--ctrlplane`` and the chaos ``fleet_ctrlplane`` scenario poll
+:meth:`FaultPlan.fire_if_due` with the router's COMPLETED count as the
+step; the victim is the operator process itself, which a worker-side
+hook can never reach):
+
+    ``router_kill``  SIGKILL the router/supervisor process on its Nth
+                     completion — workers orphan (stdin EOF) and drain
+                     through the notice channel's discipline; the next
+                     incarnation replays the write-ahead request ledger
+                     (serve/wal.py) and owes every unfinished request.
+    ``fleet_kill``   SIGKILL the ENTIRE fleet process group on the Nth
+                     completion — router, prefill and decode pools,
+                     committed handoff records in flight.  Relaunch
+                     must re-admit exactly once per journaled phase
+                     with byte-identical tokens.
+
 Preemption / degradation faults (PR 18 — consumed by BOTH the Trainer's
 ``apply`` path and a fleet worker's ``fire_if_due``/``slow_penalty_ms``
 polls, so one grammar drives the training and serving arms of the chaos
@@ -177,7 +194,7 @@ KINDS = ("nan", "crash", "sigterm", "torn_ckpt", "corrupt_ckpt",
          "ckpt_ioerr", "bitflip", "desync", "peer_kill", "peer_hang",
          "device_loss", "replica_kill", "stall_drain", "preempt", "slow",
          "handoff_kill", "handoff_kill_post", "decode_kill",
-         "handoff_stall")
+         "handoff_stall", "router_kill", "fleet_kill")
 # kinds that perturb the train state (FaultPlan.apply_state) rather than
 # the batch/process (FaultPlan.apply)
 STATE_KINDS = ("bitflip", "desync")
@@ -185,6 +202,16 @@ STATE_KINDS = ("bitflip", "desync")
 # fired by the Trainer's apply/apply_state paths
 FLEET_KINDS = ("replica_kill", "stall_drain", "handoff_kill",
                "handoff_kill_post", "decode_kill", "handoff_stall")
+# kinds the EXPERIMENT DRIVER polls (bench --ctrlplane, the chaos
+# fleet_ctrlplane scenario): the victim is the router/supervisor
+# process itself, which cannot SIGKILL itself from inside its own
+# service loop and still model an external control-plane death — so
+# the driver owning the fleet's process group fires these when the
+# router's completion count reaches the window.  ``router_kill@N``
+# kills ONLY the operator process (workers orphan and drain via the
+# notice channel's discipline); ``fleet_kill@N`` kills the whole
+# process group mid-load.  Recovery is the WAL replay (serve/wal.py).
+DRIVER_KINDS = ("router_kill", "fleet_kill")
 
 
 def _process_index() -> int:
@@ -565,8 +592,9 @@ class FaultPlan:
     def apply(self, step: int, batch: Dict,
               ckpt_dir: Optional[str] = None) -> Dict:
         for f in self.faults:
-            if f.kind in STATE_KINDS or f.kind in FLEET_KINDS:
-                continue  # apply_state's / fire_if_due's job
+            if (f.kind in STATE_KINDS or f.kind in FLEET_KINDS
+                    or f.kind in DRIVER_KINDS):
+                continue  # apply_state's / fire_if_due's / driver's job
             if f.proc is not None and _process_index() != f.proc:
                 continue  # another process is the victim
             if not f.should_fire(step):

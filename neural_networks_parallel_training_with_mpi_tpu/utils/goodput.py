@@ -80,8 +80,8 @@ except Exception:  # standalone file-path load: tools inject utils/jsonl
 #: exactly one of these, and consumers (obs_agg, the report tool, the
 #: bench gates) iterate THIS tuple rather than discovering keys.
 CATEGORIES = ("step", "compile", "data_stall", "ckpt", "rollback", "eval",
-              "relaunch_gap", "drain", "serve_queue_wait", "serve_bubble",
-              "idle")
+              "recovery", "relaunch_gap", "drain", "serve_queue_wait",
+              "serve_bubble", "idle")
 
 #: span-name -> category for the fixed trace vocabulary (train/trace.py)
 SPAN_CATEGORY = {
@@ -93,6 +93,9 @@ SPAN_CATEGORY = {
     "rollback": "rollback",
     "queue_wait": "serve_queue_wait",
     "sched_bubble": "serve_bubble",
+    # control-plane crash recovery: the window between a relaunched
+    # router opening its WAL and the fleet serving again (serve/wal.py)
+    "recovery": "recovery",
 }
 
 #: spans whose presence on both sides of a gap means the async pipeline
@@ -101,9 +104,9 @@ PIPELINE_SPANS = ("dispatch", "load", "fetch")
 
 #: overlap resolution, most-exclusive first: a category earlier in this
 #: tuple owns any second where its span overlaps a later one's.
-PRIORITY = ("rollback", "compile", "eval", "step", "data_stall", "ckpt",
-            "serve_queue_wait", "serve_bubble", "drain", "relaunch_gap",
-            "idle")
+PRIORITY = ("rollback", "recovery", "compile", "eval", "step", "data_stall",
+            "ckpt", "serve_queue_wait", "serve_bubble", "drain",
+            "relaunch_gap", "idle")
 
 _PRIO = {c: i for i, c in enumerate(PRIORITY)}
 

@@ -52,7 +52,10 @@ _LOWER = ("_ms", "_s", "_sec", "_pct", "_bytes", "latency", "ttft",
           # bare names keep ratio keys directed; "degraded" covers
           # degraded_mode_s AND degraded_dispatches)
           "handoff_ms", "handoff_retries", "handoff_reprefills",
-          "redecodes", "duplicates", "degraded")
+          "redecodes", "duplicates", "degraded",
+          # BENCH_CTRLPLANE: a quarantined/unreplayable WAL record is
+          # a durability regression ("recovery_wall_s" rides _s)
+          "recovery_lost")
 # accounting/config keys that look directed but are descriptive: gating
 # them would flag "the chaos run covered a different number of seconds"
 # as a perf regression
@@ -64,7 +67,11 @@ _SKIP = ("covered_s", "generated_unix", "t_start", "t_end", "t_unix",
          "max_", "min_events",
          # handoff VOLUME is traffic shape, not a direction — only its
          # price (handoff_ms / retries / reprefills) is gated
-         "handoffs")
+         "handoffs",
+         # recovery VOLUME counters depend on kill timing: how many
+         # requests were mid-flight is jitter, not a direction — only
+         # recovery_lost and recovery_wall_s are gated
+         "recovery_replayed", "recovery_deduped", "recovery_converted")
 
 
 def direction(path: str) -> Optional[str]:

@@ -205,7 +205,11 @@ def aggregate(dirs: List[str],
                 / float(slots), 4)
         cn = rec.get("counters") or {}
         for name in ("completed", "requeued", "rejected",
-                     "replica_deaths", "handed_off", "injected"):
+                     "replica_deaths", "handed_off", "injected",
+                     # WAL-recovery rollup (serve/wal.py): how the
+                     # relaunched router re-admitted its journal
+                     "recovery_replayed", "recovery_deduped",
+                     "recovery_converted", "recovery_lost"):
             if name in cn:
                 row[name] = cn[name]
         breakdown.append(row)

@@ -99,6 +99,17 @@ def main(argv=None) -> int:
         description=__doc__.splitlines()[0],
         formatter_class=argparse.ArgumentDefaultsHelpFormatter)
     ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--roles", default=None,
+                    help="comma list assigning a disagg role per "
+                         "replica (e.g. 'prefill,decode'; an empty "
+                         "item means unified).  Length must equal "
+                         "--replicas")
+    ap.add_argument("--wal-dir", default=None,
+                    help="durable control plane: journal the router's "
+                         "request ledger to this directory "
+                         "(serve/wal.py) and replay it on relaunch — "
+                         "rerunning with the same dir recovers "
+                         "unfinished requests exactly once")
     ap.add_argument("--tp", type=int, default=0,
                     help="each replica spans a tensor-parallel mesh of "
                          "N virtual CPU devices through generate_tp "
@@ -205,6 +216,12 @@ def main(argv=None) -> int:
 
     log = (lambda m: None) if args.json else (
         lambda m: print(m, file=sys.stderr, flush=True))
+    roles = None
+    if args.roles is not None:
+        roles = [r.strip() or None for r in args.roles.split(",")]
+        if len(roles) != args.replicas:
+            ap.error(f"--roles lists {len(roles)} role(s) for "
+                     f"--replicas {args.replicas}")
     model = dict(vocab=args.vocab, seq=args.seq, layers=args.layers,
                  d_model=args.d_model, heads=args.heads, d_ff=args.d_ff,
                  init_seed=args.init_seed)
@@ -217,8 +234,9 @@ def main(argv=None) -> int:
         telemetry_root=args.telemetry_dir,
         router_kwargs=dict(queue_depth=args.queue_depth,
                            replica_queue_cap=args.replica_queue_cap,
-                           reject_infeasible=args.reject_infeasible),
-        step_sleep_ms=args.step_sleep_ms, tp=args.tp,
+                           reject_infeasible=args.reject_infeasible,
+                           wal_dir=args.wal_dir),
+        step_sleep_ms=args.step_sleep_ms, tp=args.tp, roles=roles,
         max_restarts=args.max_restarts, backoff=args.backoff,
         heartbeat_timeout=args.heartbeat_timeout,
         prewarm=args.prewarm, log=log)
